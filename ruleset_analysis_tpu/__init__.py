@@ -1,0 +1,27 @@
+"""ruleset_analysis_tpu — a TPU-native firewall ruleset-analysis framework.
+
+A from-scratch rebuild of the capabilities of ``arnesund/ruleset-analysis``
+(Cisco ASA access-list usage analysis over syslog at scale), re-designed
+TPU-first:
+
+- the host-side ruleset parser (the ``getaccesslists.py`` analog, see
+  SURVEY.md L1) emits a packed, device-resident *rule tensor*;
+- the per-log-line first-match scan (the ``mapper.py`` hot loop, SURVEY.md
+  L3) becomes a vmapped branch-free predicate over packed 5-tuple batches,
+  compiled by XLA for the TPU vector unit;
+- the exact streaming reduction (``reducer.py``, SURVEY.md L4) becomes
+  on-device exact bincounts plus mergeable sketches (count-min sketch,
+  HyperLogLog, heavy-hitter candidates) merged across chips with XLA
+  collectives (``psum``/``pmax``) over ICI instead of a Hadoop shuffle.
+
+Subpackages
+-----------
+hostside  : pure-Python host layer — ASA config parsing, object-group
+            expansion, syslog parsing, the exact oracle, synthetic data.
+ops       : JAX device ops — first-match kernel, hashing, CMS, HLL, top-K.
+models    : the flagship analysis pipeline (state + jitted step function).
+parallel  : mesh construction and shard_map'd data-parallel step.
+runtime   : streaming driver, checkpoint/resume, reporting, metrics.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,192 @@
+"""Command-line interface — the job-submission layer (SURVEY.md §4.2).
+
+The reference drives everything through three entry points: the parser
+script (``getaccesslists.py``), a Hadoop Streaming submission wrapper
+(``runAnalysis.sh``), and the report step.  This CLI is the single
+replacement for all three:
+
+  ruleset-analyze parse-acls CONFIG [CONFIG...] --out PREFIX
+  ruleset-analyze run --ruleset PREFIX --logs FILE --backend {oracle,tpu}
+  ruleset-analyze synth --out-dir DIR [...]
+
+``--backend=oracle`` is the exact pure-Python path (the Hadoop-semantics
+stand-in); ``--backend=tpu`` dispatches the hot loop to JAX (the reference
+north star's ``--backend=tpu``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .config import AnalysisConfig, SketchConfig
+from .hostside import aclparse, oracle, pack, synth
+from .runtime import report as report_mod
+
+
+def _cmd_parse_acls(args: argparse.Namespace) -> int:
+    rulesets = []
+    for path in args.configs:
+        rs = aclparse.parse_config_file(path)
+        print(
+            f"{path}: firewall={rs.firewall} acls={len(rs.acls)} "
+            f"rules={rs.rule_count()} expanded_aces={rs.ace_count()}",
+            file=sys.stderr,
+        )
+        rulesets.append(rs)
+    packed = pack.pack_rulesets(rulesets)
+    pack.save_packed(packed, args.out)
+    print(
+        f"packed {packed.rules.shape[0]} ACE rows, {packed.n_rules} rule keys, "
+        f"{packed.n_acls} ACLs -> {args.out}.npz/.json",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def _iter_log_lines(paths: list[str]):
+    for path in paths:
+        if path == "-":
+            yield from sys.stdin
+        else:
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                yield from f
+
+
+def _cmd_run(args: argparse.Namespace) -> int:
+    cfg = AnalysisConfig(
+        backend=args.backend,
+        batch_size=args.batch_size,
+        sketch=SketchConfig(
+            cms_width=args.cms_width,
+            cms_depth=args.cms_depth,
+            hll_p=args.hll_p,
+        ),
+        checkpoint_every_chunks=args.checkpoint_every,
+        checkpoint_dir=args.checkpoint_dir,
+    )
+    packed = pack.load_packed(args.ruleset)
+    lines = _iter_log_lines(args.logs)
+
+    if args.backend == "oracle":
+        # Exact path: rebuild Ruleset objects is not possible from packed form
+        # alone; the oracle needs the original configs.
+        if not args.acl_configs:
+            print("--backend=oracle requires --acl-configs (original config files)", file=sys.stderr)
+            return 2
+        rulesets = [aclparse.parse_config_file(p) for p in args.acl_configs]
+        orc = oracle.Oracle(rulesets)
+        res = orc.consume(lines)
+        talkers = {
+            k: c.most_common(args.topk) for k, c in res.talkers.items()
+        }
+        rep = report_mod.build_report(
+            packed,
+            dict(res.hits),
+            backend="oracle",
+            totals={
+                "lines_total": res.lines_total,
+                "lines_matched": res.lines_matched,
+                "lines_skipped": res.lines_skipped,
+            },
+            unique_sources={k: len(v) for k, v in res.sources.items()},
+            talkers=talkers,
+        )
+    elif args.backend == "tpu":
+        try:
+            from .runtime.stream import run_stream  # deferred: imports JAX
+        except ImportError as e:
+            print(f"error: tpu backend unavailable ({e})", file=sys.stderr)
+            return 1
+        rep = run_stream(packed, lines, cfg, topk=args.topk)
+    else:
+        print(f"unknown backend {args.backend!r}", file=sys.stderr)
+        return 2
+
+    payload = rep.to_json() if args.json else rep.to_text()
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload + "\n")
+    else:
+        print(payload)
+    return 0
+
+
+def _cmd_synth(args: argparse.Namespace) -> int:
+    import os
+
+    os.makedirs(args.out_dir, exist_ok=True)
+    cfg_text = synth.synth_config(
+        n_acls=args.acls, rules_per_acl=args.rules, seed=args.seed, hostname=args.hostname
+    )
+    cfg_path = f"{args.out_dir}/{args.hostname}.cfg"
+    with open(cfg_path, "w", encoding="utf-8") as f:
+        f.write(cfg_text)
+    rs = aclparse.parse_asa_config(cfg_text, args.hostname)
+    packed = pack.pack_rulesets([rs])
+    tuples = synth.synth_tuples(packed, args.lines, seed=args.seed)
+    log_lines = synth.render_syslog(packed, tuples, seed=args.seed)
+    log_path = f"{args.out_dir}/{args.hostname}.log"
+    with open(log_path, "w", encoding="utf-8") as f:
+        f.write("\n".join(log_lines) + "\n")
+    pack.save_packed(packed, f"{args.out_dir}/{args.hostname}")
+    print(f"wrote {cfg_path}, {log_path}, {args.out_dir}/{args.hostname}.npz", file=sys.stderr)
+    return 0
+
+
+def make_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="ruleset-analyze")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("parse-acls", help="parse ASA configs into a packed ruleset")
+    p.add_argument("configs", nargs="+")
+    p.add_argument("--out", required=True, help="output path prefix")
+    p.set_defaults(fn=_cmd_parse_acls)
+
+    p = sub.add_parser("run", help="run the analysis over syslog")
+    p.add_argument("--ruleset", required=True, help="packed ruleset path prefix")
+    p.add_argument("--logs", nargs="+", required=True, help="syslog file(s), '-' for stdin")
+    p.add_argument("--backend", choices=["oracle", "tpu"], default="tpu")
+    p.add_argument("--acl-configs", nargs="*", default=[], help="original configs (oracle backend)")
+    p.add_argument("--batch-size", type=int, default=1 << 16)
+    p.add_argument("--cms-width", type=int, default=1 << 14)
+    p.add_argument("--cms-depth", type=int, default=4)
+    p.add_argument("--hll-p", type=int, default=6)
+    p.add_argument("--topk", type=int, default=10)
+    p.add_argument("--checkpoint-every", type=int, default=0, metavar="CHUNKS")
+    p.add_argument("--checkpoint-dir", default="out/ckpt")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--out", default=None)
+    p.set_defaults(fn=_cmd_run)
+
+    p = sub.add_parser("synth", help="generate synthetic config + syslog")
+    p.add_argument("--out-dir", required=True)
+    p.add_argument("--acls", type=int, default=4)
+    p.add_argument("--rules", type=int, default=32)
+    p.add_argument("--lines", type=int, default=10000)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--hostname", default="fw1")
+    p.set_defaults(fn=_cmd_synth)
+    return ap
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = make_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except aclparse.AclParseError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except BrokenPipeError:
+        # stdout consumer (head, less) closed early — normal, not an error
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,74 @@
+"""Central configuration (the reference's ``config.py`` analog, SURVEY.md L0).
+
+The reference keeps module-level constants naming the firewall inventory and
+job paths; scripts ``import config`` and read them.  We keep that shape for
+compatibility (module constants below) and add a typed, immutable
+:class:`AnalysisConfig` used by the CLI and runtime, since the TPU path has
+real tunables (batch size, sketch geometry, mesh shape) that the Hadoop path
+never needed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+# ---------------------------------------------------------------------------
+# Reference-style module constants (SURVEY.md §3: "module-level constants:
+# firewall list, credentials/paths, HDFS/job paths").  Paths are local rather
+# than HDFS; the firewall inventory maps a firewall name to the path of its
+# saved configuration.
+# ---------------------------------------------------------------------------
+
+#: Firewall inventory: name -> path of the saved ASA configuration file.
+FIREWALLS: dict[str, str] = {}
+
+#: Directory where `parse-acls` (the getaccesslists analog) writes parsed,
+#: serialized rulesets.
+RULESET_DIR = os.environ.get("RA_RULESET_DIR", "rulesets")
+
+#: Directory for analysis outputs (reports, checkpoints).
+OUTPUT_DIR = os.environ.get("RA_OUTPUT_DIR", "out")
+
+
+# ---------------------------------------------------------------------------
+# Typed runtime configuration.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SketchConfig:
+    """Geometry of the mergeable sketches kept on device.
+
+    Defaults follow the usual error bounds: a count-min sketch of width ``w``
+    and depth ``d`` over-estimates by at most ``e*N/w`` with probability
+    ``1 - exp(-d)``; a HyperLogLog with ``m = 2**hll_p`` registers has
+    relative error ``~1.04/sqrt(m)``.
+    """
+
+    cms_width: int = 1 << 14
+    cms_depth: int = 4
+    hll_p: int = 6  # 64 registers/rule -> ~13% per-rule cardinality error
+    topk_capacity: int = 256  # host-side Space-Saving summary size per ACL
+    topk_chunk_candidates: int = 64  # device top_k candidates fed per chunk
+
+    @property
+    def hll_m(self) -> int:
+        return 1 << self.hll_p
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalysisConfig:
+    """Everything the runtime needs to run one analysis job."""
+
+    backend: str = "tpu"  # {"oracle", "tpu"}
+    batch_size: int = 1 << 16  # log lines per device step (per global batch)
+    sketch: SketchConfig = dataclasses.field(default_factory=SketchConfig)
+    exact_counts: bool = True  # keep the exact per-rule bincount alongside sketches
+    mesh_axis: str = "data"
+    checkpoint_every_chunks: int = 0  # 0 = no checkpointing
+    checkpoint_dir: str = os.path.join(OUTPUT_DIR, "ckpt")
+    seed: int = 0
+
+    def replace(self, **kw) -> "AnalysisConfig":
+        return dataclasses.replace(self, **kw)

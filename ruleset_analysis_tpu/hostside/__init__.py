@@ -1,0 +1,5 @@
+"""Pure-Python host layer: parsing, oracle semantics, synthetic data.
+
+No JAX imports anywhere in this subpackage — it must stay importable and fast
+on machines with no accelerator, exactly like the reference's host scripts.
+"""

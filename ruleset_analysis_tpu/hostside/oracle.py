@@ -1,0 +1,126 @@
+"""The exact oracle: reference mapper/reducer semantics in pure Python.
+
+This is a direct, trivially-auditable implementation of the reference's hot
+path (SURVEY.md §4.3/§4.4): per log line, linear first-match scan over the
+named ACL's expanded ACEs in configuration order; per matched configured
+rule, an exact hit count.  It is deliberately written against the *parsed*
+:class:`Ruleset` objects — NOT the packed tensors — so it is an independent
+yardstick for the TPU path (SURVEY.md §5 "golden semantics tests") and the
+stand-in for the reference's exact Hadoop run when measuring unused-rule
+recall.
+
+It also computes the exact versions of every sketched statistic:
+per-rule unique-source cardinality and per-ACL top talkers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter, defaultdict
+from collections.abc import Iterable
+
+from .aclparse import Ruleset
+from .syslog import ParsedLine, parse_line
+
+#: Key identifying one configured rule: (firewall, acl, 1-based rule index).
+#: Index 0 means the ACL's implicit deny.
+RuleKey = tuple[str, str, int]
+
+
+@dataclasses.dataclass
+class OracleResult:
+    """Exact analysis results (the reduce output + report inputs)."""
+
+    hits: Counter  # RuleKey -> exact hit count
+    sources: dict  # RuleKey -> set of src IPs (exact cardinality)
+    talkers: dict  # (firewall, acl) -> Counter of src IPs
+    lines_total: int = 0
+    lines_matched: int = 0
+    lines_skipped: int = 0
+
+    def unused_rules(self, rulesets: Iterable[Ruleset]) -> list[RuleKey]:
+        """Configured rules with zero hits, in configuration order."""
+        out = []
+        for rs in rulesets:
+            for acl, rules in rs.acls.items():
+                for rule in rules:
+                    key = (rs.firewall, acl, rule.index)
+                    if self.hits.get(key, 0) == 0:
+                        out.append(key)
+        return out
+
+
+class Oracle:
+    """Streaming exact analyzer over parsed rulesets."""
+
+    def __init__(self, rulesets: list[Ruleset]):
+        self.by_fw = {rs.firewall: rs for rs in rulesets}
+        self.rulesets = rulesets
+        self.result = OracleResult(
+            hits=Counter(), sources=defaultdict(set), talkers=defaultdict(Counter)
+        )
+
+    def resolve_acl(self, p: ParsedLine) -> tuple[Ruleset, str] | None:
+        rs = self.by_fw.get(p.firewall)
+        if rs is None:
+            return None
+        if p.acl is not None:
+            return (rs, p.acl) if p.acl in rs.acls else None
+        if p.ingress_if is not None:
+            bound = rs.bindings.get(p.ingress_if)
+            if bound and bound[1] == "in" and bound[0] in rs.acls:
+                return rs, bound[0]
+        return None
+
+    def match_line(self, p: ParsedLine) -> RuleKey | None:
+        """First-match key for one parsed line (None = line not analyzable)."""
+        resolved = self.resolve_acl(p)
+        if resolved is None:
+            return None
+        rs, acl = resolved
+        for rule in rs.acls[acl]:
+            for ace in rule.aces:
+                if ace.matches(p.proto, p.src, p.sport, p.dst, p.dport):
+                    return (rs.firewall, acl, rule.index)
+        return (rs.firewall, acl, 0)  # implicit deny
+
+    def consume(self, lines: Iterable[str]) -> OracleResult:
+        r = self.result
+        for line in lines:
+            r.lines_total += 1
+            p = parse_line(line)
+            key = None if p is None else self.match_line(p)
+            if key is None:
+                r.lines_skipped += 1
+                continue
+            r.lines_matched += 1
+            r.hits[key] += 1
+            r.sources[key].add(p.src)
+            r.talkers[(key[0], key[1])][p.src] += 1
+        return r
+
+    def consume_parsed(self, parsed: Iterable[ParsedLine]) -> OracleResult:
+        r = self.result
+        for p in parsed:
+            r.lines_total += 1
+            key = self.match_line(p)
+            if key is None:
+                r.lines_skipped += 1
+                continue
+            r.lines_matched += 1
+            r.hits[key] += 1
+            r.sources[key].add(p.src)
+            r.talkers[(key[0], key[1])][p.src] += 1
+        return r
+
+
+def unused_rule_recall(exact_unused: list[RuleKey], estimated_unused: list[RuleKey]) -> float:
+    """Fraction of the exact run's unused rules the estimated run also found.
+
+    This is the headline accuracy metric (BASELINE.md: >=99% unused-ACL
+    recall vs the exact run).
+    """
+    if not exact_unused:
+        return 1.0
+    est = set(estimated_unused)
+    return sum(1 for k in exact_unused if k in est) / len(exact_unused)

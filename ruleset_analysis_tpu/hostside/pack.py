@@ -1,0 +1,231 @@
+"""Packing: Rulesets -> device-ready rule tensor; parsed lines -> tuple batches.
+
+This is the rebuilt L1->L3 boundary (SURVEY.md §2): where the reference
+pickles per-firewall ACL dicts and ships them to every Hadoop map task, we
+pack every firewall's expanded ACEs into ONE flat uint32 rule matrix that
+lives in device HBM, plus small host-side lookup tables.
+
+Rule matrix layout (``[R, RULE_COLS] uint32``, row order = global config
+order, which is load-bearing for first-match parity):
+
+  col 0  acl_gid   — global ACL id (firewall+ACL resolved on host)
+  col 1  proto_lo  | 2 proto_hi
+  col 3  src_lo    | 4 src_hi
+  col 5  sport_lo  | 6 sport_hi
+  col 7  dst_lo    | 8 dst_hi
+  col 9  dport_lo  | 10 dport_hi
+  col 11 key_id    — id of the configured rule this expanded row belongs to
+
+Padding rows carry ``acl_gid = NO_ACL`` and can never match.
+
+Tuple batch layout (``[B, TUPLE_COLS] uint32``):
+
+  col 0 acl_gid | 1 proto | 2 src | 3 sport | 4 dst | 5 dport | 6 valid
+
+Key space: keys ``0..n_rules-1`` are configured rules in global order;
+keys ``n_rules..n_rules+n_acls-1`` are each ACL's implicit deny.  The
+unused-rule report is "configured-rule keys with zero hits" (SURVEY.md §4.5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+
+import numpy as np
+
+from .aclparse import Ruleset
+from .syslog import ParsedLine, parse_line
+
+RULE_COLS = 12
+TUPLE_COLS = 7
+
+# rule matrix columns
+R_ACL, R_PLO, R_PHI, R_SLO, R_SHI, R_SPLO, R_SPHI, R_DLO, R_DHI, R_DPLO, R_DPHI, R_KEY = range(12)
+# tuple columns
+T_ACL, T_PROTO, T_SRC, T_SPORT, T_DST, T_DPORT, T_VALID = range(7)
+
+NO_ACL = np.uint32(0xFFFFFFFF)
+
+
+@dataclasses.dataclass
+class KeyMeta:
+    """Report-facing identity of one count key."""
+
+    firewall: str
+    acl: str
+    index: int  # 1-based rule position; 0 for the ACL's implicit deny
+    text: str
+    implicit_deny: bool = False
+
+
+@dataclasses.dataclass
+class PackedRuleset:
+    """The packed, device-shippable form of one or more firewalls' rulesets."""
+
+    rules: np.ndarray  # [R, RULE_COLS] uint32
+    n_rules: int  # number of configured-rule keys
+    n_acls: int
+    key_meta: list[KeyMeta]  # len == n_keys
+    acl_gid: dict[tuple[str, str], int]  # (firewall, acl name) -> gid
+    deny_key: np.ndarray  # [n_acls] uint32: acl_gid -> implicit-deny key
+    bindings: dict[tuple[str, str], int]  # (firewall, iface) -> acl_gid ('in')
+
+    @property
+    def n_keys(self) -> int:
+        return self.n_rules + self.n_acls
+
+    def key_name(self, key: int) -> str:
+        m = self.key_meta[key]
+        tag = "implicit-deny" if m.implicit_deny else str(m.index)
+        return f"{m.firewall} {m.acl} {tag}"
+
+
+def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> PackedRuleset:
+    """Pack parsed rulesets into the flat rule matrix + key universe."""
+    acl_gid: dict[tuple[str, str], int] = {}
+    key_meta: list[KeyMeta] = []
+    rows: list[list[int]] = []
+    bindings: dict[tuple[str, str], int] = {}
+
+    for rs in rulesets:
+        for acl in rs.acls:
+            acl_gid[(rs.firewall, acl)] = len(acl_gid)
+
+    for rs in rulesets:
+        for acl, rules in rs.acls.items():
+            gid = acl_gid[(rs.firewall, acl)]
+            for rule in rules:
+                key = len(key_meta)
+                key_meta.append(
+                    KeyMeta(firewall=rs.firewall, acl=acl, index=rule.index, text=rule.text)
+                )
+                for a in rule.aces:
+                    rows.append(
+                        [
+                            gid,
+                            a.proto_lo,
+                            a.proto_hi,
+                            a.src_lo,
+                            a.src_hi,
+                            a.sport_lo,
+                            a.sport_hi,
+                            a.dst_lo,
+                            a.dst_hi,
+                            a.dport_lo,
+                            a.dport_hi,
+                            key,
+                        ]
+                    )
+        for iface, (acl, direction) in rs.bindings.items():
+            if direction == "in" and (rs.firewall, acl) in acl_gid:
+                bindings[(rs.firewall, iface)] = acl_gid[(rs.firewall, acl)]
+
+    n_rules = len(key_meta)
+    n_acls = len(acl_gid)
+    deny_key = np.zeros(max(n_acls, 1), dtype=np.uint32)
+    for (fw, acl), gid in acl_gid.items():
+        deny_key[gid] = n_rules + gid
+        key_meta.append(
+            KeyMeta(firewall=fw, acl=acl, index=0, text="<implicit deny>", implicit_deny=True)
+        )
+
+    r = len(rows)
+    pad_to = max(pad_rules_to or 0, r, 1)
+    mat = np.full((pad_to, RULE_COLS), 0, dtype=np.uint32)
+    mat[:, R_ACL] = NO_ACL
+    if rows:
+        mat[:r] = np.asarray(rows, dtype=np.uint32)
+    return PackedRuleset(
+        rules=mat,
+        n_rules=n_rules,
+        n_acls=n_acls,
+        key_meta=key_meta,
+        acl_gid=acl_gid,
+        deny_key=deny_key,
+        bindings=bindings,
+    )
+
+
+class LinePacker:
+    """Parses raw syslog lines into packed tuple batches against a PackedRuleset.
+
+    Lines that don't parse, reference an unknown firewall/ACL, or (for
+    connection messages) hit an interface with no ``access-group`` binding
+    are packed with ``valid=0`` — the mapper analog of silently skipping
+    non-matching input lines.
+    """
+
+    def __init__(self, packed: PackedRuleset):
+        self.packed = packed
+        self.skipped = 0
+        self.parsed = 0
+
+    def resolve_acl(self, p: ParsedLine) -> int | None:
+        if p.acl is not None:
+            return self.packed.acl_gid.get((p.firewall, p.acl))
+        if p.ingress_if is not None:
+            return self.packed.bindings.get((p.firewall, p.ingress_if))
+        return None
+
+    def pack_parsed(self, parsed: list[ParsedLine | None], batch_size: int | None = None) -> np.ndarray:
+        """Pack parsed lines into a [B, TUPLE_COLS] uint32 batch (padded)."""
+        b = batch_size or len(parsed)
+        out = np.zeros((b, TUPLE_COLS), dtype=np.uint32)
+        i = 0
+        for p in parsed:
+            gid = None if p is None else self.resolve_acl(p)
+            if gid is None:
+                self.skipped += 1
+                continue
+            if i >= b:
+                raise ValueError(
+                    f"more than batch_size={b} valid lines in chunk; "
+                    "feed chunks of at most batch_size lines"
+                )
+            out[i] = (gid, p.proto, p.src, p.sport, p.dst, p.dport, 1)
+            i += 1
+            self.parsed += 1
+        return out
+
+    def pack_lines(self, lines: list[str], batch_size: int | None = None) -> np.ndarray:
+        return self.pack_parsed([parse_line(ln) for ln in lines], batch_size)
+
+
+# ---------------------------------------------------------------------------
+# Serialization (the analog of the reference pickling parser output to disk
+# for shipment to map tasks — SURVEY.md §4.1).  JSON + npz: inspectable and
+# dependency-free.
+# ---------------------------------------------------------------------------
+
+
+def save_packed(packed: PackedRuleset, path_prefix: str) -> None:
+    np.savez_compressed(
+        path_prefix + ".npz",
+        rules=packed.rules,
+        deny_key=packed.deny_key,
+        n_rules=np.int64(packed.n_rules),
+        n_acls=np.int64(packed.n_acls),
+    )
+    meta = {
+        "key_meta": [dataclasses.asdict(m) for m in packed.key_meta],
+        "acl_gid": [[fw, acl, gid] for (fw, acl), gid in packed.acl_gid.items()],
+        "bindings": [[fw, iface, gid] for (fw, iface), gid in packed.bindings.items()],
+    }
+    with open(path_prefix + ".json", "w", encoding="utf-8") as f:
+        json.dump(meta, f)
+
+
+def load_packed(path_prefix: str) -> PackedRuleset:
+    z = np.load(path_prefix + ".npz")
+    with open(path_prefix + ".json", "r", encoding="utf-8") as f:
+        meta = json.load(f)
+    return PackedRuleset(
+        rules=z["rules"],
+        n_rules=int(z["n_rules"]),
+        n_acls=int(z["n_acls"]),
+        key_meta=[KeyMeta(**m) for m in meta["key_meta"]],
+        acl_gid={(fw, acl): gid for fw, acl, gid in meta["acl_gid"]},
+        deny_key=z["deny_key"],
+        bindings={(fw, iface): gid for fw, iface, gid in meta["bindings"]},
+    )

@@ -1,0 +1,192 @@
+"""Synthetic ASA configs and syslog — test fixtures and benchmark feedstock.
+
+SURVEY.md §5 calls for "a synthetic-syslog generator (parameterized by
+ruleset so that expected hits are known by construction)".  Generation
+intent here is only a *bias* — ground truth for every test comes from the
+oracle, never from the generator — so overlapping rules shadowing each
+other can't make expectations silently wrong.
+
+Two tiers:
+
+- text tier: ASA config text + raw syslog lines (exercises the full parse
+  path end-to-end);
+- packed tier: vectorized numpy generation of tuple batches straight
+  against a PackedRuleset (feeds device benchmarks at rates the text
+  renderer can't reach).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .aclparse import u32_to_ip
+from .pack import (
+    PackedRuleset,
+    R_ACL,
+    R_DHI,
+    R_DLO,
+    R_DPHI,
+    R_DPLO,
+    R_PHI,
+    R_PLO,
+    R_SHI,
+    R_SLO,
+    R_SPHI,
+    R_SPLO,
+    T_VALID,
+    TUPLE_COLS,
+    NO_ACL,
+)
+
+_COMMON_PROTOS = np.array([6, 6, 6, 17, 17, 1], dtype=np.uint32)
+
+
+def synth_config(
+    n_acls: int = 4,
+    rules_per_acl: int = 32,
+    n_groups: int = 4,
+    seed: int = 0,
+    hostname: str = "fw1",
+) -> str:
+    """Generate ASA configuration text with object-groups and varied ACEs."""
+    rng = np.random.default_rng(seed)
+    lines = [f"hostname {hostname}", "!"]
+
+    group_names = []
+    for g in range(n_groups):
+        name = f"NETGRP{g}"
+        group_names.append(name)
+        lines.append(f"object-group network {name}")
+        for _ in range(int(rng.integers(2, 5))):
+            if rng.random() < 0.5:
+                lines.append(f" network-object host 10.{g}.{rng.integers(0,255)}.{rng.integers(1,255)}")
+            else:
+                lines.append(f" network-object 172.{16+g}.{rng.integers(0,255)}.0 255.255.255.0")
+    lines.append("object-group service WEBPORTS tcp")
+    lines.append(" port-object eq 80")
+    lines.append(" port-object eq 443")
+    lines.append(" port-object range 8000 8100")
+
+    protos = ["tcp", "udp", "ip", "icmp"]
+    for a in range(n_acls):
+        acl = f"ACL{a}"
+        for r in range(rules_per_acl):
+            action = "permit" if rng.random() < 0.7 else "deny"
+            proto = protos[int(rng.integers(0, len(protos)))]
+            # source
+            roll = rng.random()
+            if roll < 0.25:
+                src = "any"
+            elif roll < 0.5:
+                src = f"object-group {group_names[int(rng.integers(0, n_groups))]}"
+            elif roll < 0.75:
+                src = f"host 192.168.{a}.{rng.integers(1, 255)}"
+            else:
+                src = f"10.{rng.integers(0, 32)}.0.0 255.255.0.0"
+            # destination
+            if rng.random() < 0.4:
+                dst = "any"
+            else:
+                dst = f"198.51.{rng.integers(0, 100)}.0 255.255.255.0"
+            # destination port spec
+            port = ""
+            if proto in ("tcp", "udp"):
+                roll = rng.random()
+                if roll < 0.3:
+                    port = f" eq {rng.integers(1, 1024)}"
+                elif roll < 0.5:
+                    lo = int(rng.integers(1024, 30000))
+                    port = f" range {lo} {lo + int(rng.integers(1, 5000))}"
+                elif proto == "tcp" and roll < 0.6:
+                    port = " object-group WEBPORTS"
+            lines.append(f"access-list {acl} extended {action} {proto} {src} {dst}{port}")
+        lines.append(f"access-group ACL{a} in interface if{a}")
+    return "\n".join(lines) + "\n"
+
+
+def synth_tuples(
+    packed: PackedRuleset,
+    n: int,
+    seed: int = 0,
+    miss_fraction: float = 0.1,
+) -> np.ndarray:
+    """Vectorized batch of packed tuples biased to hit real rules.
+
+    A ``miss_fraction`` of lines draw fully random field values (mostly
+    landing in implicit deny), the rest sample inside a random expanded
+    ACE's ranges.
+    """
+    rng = np.random.default_rng(seed)
+    rules = packed.rules.astype(np.int64)
+    real = rules[:, R_ACL] != int(NO_ACL)
+    rules = rules[real]
+    if rules.shape[0] == 0:
+        raise ValueError("packed ruleset has no rules")
+    pick = rng.integers(0, rules.shape[0], size=n)
+    rr = rules[pick]
+
+    def _within(lo_col: int, hi_col: int) -> np.ndarray:
+        lo, hi = rr[:, lo_col], rr[:, hi_col]
+        return rng.integers(lo, hi + 1)
+
+    proto = _within(R_PLO, R_PHI)
+    full_proto = (rr[:, R_PLO] == 0) & (rr[:, R_PHI] == 255)
+    proto = np.where(full_proto, rng.choice(_COMMON_PROTOS, size=n).astype(np.int64), proto)
+
+    out = np.zeros((n, TUPLE_COLS), dtype=np.uint32)
+    out[:, 0] = rr[:, R_ACL].astype(np.uint32)
+    out[:, 1] = proto.astype(np.uint32)
+    out[:, 2] = _within(R_SLO, R_SHI).astype(np.uint32)
+    out[:, 3] = _within(R_SPLO, R_SPHI).astype(np.uint32)
+    out[:, 4] = _within(R_DLO, R_DHI).astype(np.uint32)
+    out[:, 5] = _within(R_DPLO, R_DPHI).astype(np.uint32)
+    out[:, T_VALID] = 1
+
+    miss = rng.random(n) < miss_fraction
+    n_miss = int(miss.sum())
+    if n_miss:
+        out[miss, 1] = rng.integers(0, 256, size=n_miss)
+        out[miss, 2] = rng.integers(0, 1 << 32, size=n_miss, dtype=np.uint32)
+        out[miss, 3] = rng.integers(0, 1 << 16, size=n_miss)
+        out[miss, 4] = rng.integers(0, 1 << 32, size=n_miss, dtype=np.uint32)
+        out[miss, 5] = rng.integers(0, 1 << 16, size=n_miss)
+    return out
+
+
+_PROTO_NAMES = {6: "tcp", 17: "udp", 1: "icmp"}
+
+
+def render_syslog(
+    packed: PackedRuleset,
+    tuples: np.ndarray,
+    seed: int = 0,
+    timestamp: str = "Jul 29 07:48:01",
+) -> list[str]:
+    """Render packed tuples back into raw ASA 106100 syslog text.
+
+    106100 names the ACL directly, so rendering needs no interface-binding
+    inverse lookup; the text round-trips through the real parse path.
+    """
+    gid_to_name = {gid: (fw, acl) for (fw, acl), gid in packed.acl_gid.items()}
+    rng = np.random.default_rng(seed)
+    verdicts = rng.random(tuples.shape[0])
+    out = []
+    for i, row in enumerate(tuples):
+        if not row[T_VALID]:
+            out.append(f"{timestamp} noise : not an ASA message")
+            continue
+        fw, acl = gid_to_name[int(row[0])]
+        proto = int(row[1])
+        pname = _PROTO_NAMES.get(proto, str(proto))
+        verdict = "permitted" if verdicts[i] < 0.8 else "denied"
+        src, dst = u32_to_ip(int(row[2])), u32_to_ip(int(row[4]))
+        if proto == 1:
+            # icmp: type travels in the dport column; render as (type)(code 0)
+            paren_s, paren_d = int(row[5]), 0
+        else:
+            paren_s, paren_d = int(row[3]), int(row[5])
+        out.append(
+            f"{timestamp} {fw} : %ASA-6-106100: access-list {acl} {verdict} {pname} "
+            f"inside/{src}({paren_s}) -> outside/{dst}({paren_d}) hit-cnt 1 first hit [0x0, 0x0]"
+        )
+    return out

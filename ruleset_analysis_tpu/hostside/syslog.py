@@ -1,0 +1,164 @@
+"""Cisco ASA syslog parsing: log line -> (firewall, ACL, 5-tuple).
+
+The reference's ``mapper.py`` (SURVEY.md §4.3) regex-parses each syslog line,
+extracts the connection 5-tuple plus the firewall identity, and decides which
+ACL to evaluate.  This module is that parse step, host-side and backend-
+agnostic: both the exact oracle and the TPU packer consume its output.
+
+Message classes handled (the classes SURVEY.md §4.3 names):
+
+- ``%ASA-n-106100``: ``access-list <acl> permitted|denied <proto>
+  <if>/<src>(<sport>) -> <if>/<dst>(<dport>) hit-cnt ...`` — names the ACL
+  directly.
+- ``%ASA-n-106023``: ``Deny <proto> src <if>:<src>[/<sport>] dst
+  <if>:<dst>[/<dport>] [(type <t>, code <c>)] by access-group "<acl>"``.
+- ``%ASA-n-302013/302015``: ``Built inbound|outbound TCP|UDP connection <id>
+  for <if>:<a>/<p> (...) to <if>:<b>/<q> (...)`` — no ACL in the message;
+  the ACL is resolved from the ingress interface's ``access-group`` binding.
+
+ICMP convention (shared with aclparse): the ICMP *type* travels in the
+destination-port column and the source port is 0, so one packed tuple layout
+serves every protocol.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+from .aclparse import PROTO_NUMBERS, ip_to_u32
+
+
+@dataclasses.dataclass(frozen=True)
+class ParsedLine:
+    """One successfully parsed ASA log line, ACL not yet resolved."""
+
+    firewall: str
+    acl: str | None  # None for connection messages; resolve via binding
+    ingress_if: str | None
+    proto: int
+    src: int
+    sport: int
+    dst: int
+    dport: int
+    permitted: bool | None  # what the firewall says it did (106100/106023)
+
+
+_PROTO_BY_NAME = {k: (v if v is not None else 0) for k, v in PROTO_NUMBERS.items()}
+
+
+def _proto_num(tok: str) -> int:
+    v = _PROTO_BY_NAME.get(tok.lower())
+    if v is not None:
+        return v
+    try:
+        return int(tok)
+    except ValueError:
+        return 0
+
+
+# hostname is the last whitespace token before the %ASA tag (syslog relay
+# prefixes vary; this is robust to "<pri>MMM dd hh:mm:ss host : %ASA-...").
+_TAG_RE = re.compile(r"(?:^|\s)(\S+?)\s*:?\s*%ASA-\d-(\d{6}):\s*(.*)$")
+
+_M106100_RE = re.compile(
+    r"access-list\s+(\S+)\s+(permitted|denied|est-allowed)\s+(\S+)\s+"
+    r"(\S+?)/([\d.]+)\((\d+)\)(?:\([^)]*\))?\s*->\s*"
+    r"(\S+?)/([\d.]+)\((\d+)\)"
+)
+
+_M106023_RE = re.compile(
+    r"Deny\s+(\S+)\s+src\s+(\S+?):([\d.]+)(?:/(\d+))?\s+"
+    r"dst\s+(\S+?):([\d.]+)(?:/(\d+))?"
+    r"(?:\s+\(type\s+(\d+),\s*code\s+(\d+)\))?"
+    r'.*?by\s+access-group\s+"([^"]+)"'
+)
+
+_M302013_RE = re.compile(
+    r"Built\s+(inbound|outbound)\s+(TCP|UDP)\s+connection\s+\S+\s+for\s+"
+    r"(\S+?):([\d.]+)/(\d+)\s*(?:\([^)]*\))?\s*to\s+"
+    r"(\S+?):([\d.]+)/(\d+)"
+)
+
+
+def parse_line(line: str) -> ParsedLine | None:
+    """Parse one raw syslog line; None if it is not a handled ASA message."""
+    m = _TAG_RE.search(line)
+    if not m:
+        return None
+    host, msgid, body = m.group(1), m.group(2), m.group(3)
+
+    if msgid == "106100":
+        b = _M106100_RE.search(body)
+        if not b:
+            return None
+        acl, verdict, proto_tok = b.group(1), b.group(2), b.group(3)
+        proto = _proto_num(proto_tok)
+        sport = int(b.group(6))
+        dport = int(b.group(9))
+        if proto == 1:
+            # ICMP: the parenthesised values are type/code; type -> dport
+            dport = sport
+            sport = 0
+        return ParsedLine(
+            firewall=host,
+            acl=acl,
+            ingress_if=b.group(4),
+            proto=proto,
+            src=ip_to_u32(b.group(5)),
+            sport=sport,
+            dst=ip_to_u32(b.group(8)),
+            dport=dport,
+            permitted=(verdict != "denied"),
+        )
+
+    if msgid == "106023":
+        b = _M106023_RE.search(body)
+        if not b:
+            return None
+        proto = _proto_num(b.group(1))
+        sport = int(b.group(4) or 0)
+        dport = int(b.group(7) or 0)
+        if proto == 1 and b.group(8) is not None:
+            dport = int(b.group(8))  # icmp type
+            sport = 0
+        return ParsedLine(
+            firewall=host,
+            acl=b.group(10),
+            ingress_if=b.group(2),
+            proto=proto,
+            src=ip_to_u32(b.group(3)),
+            sport=sport,
+            dst=ip_to_u32(b.group(6)),
+            dport=dport,
+            permitted=False,
+        )
+
+    if msgid in ("302013", "302015"):
+        b = _M302013_RE.search(body)
+        if not b:
+            return None
+        direction = b.group(1)
+        proto = 6 if b.group(2) == "TCP" else 17
+        if_a, ip_a, port_a = b.group(3), ip_to_u32(b.group(4)), int(b.group(5))
+        if_b, ip_b, port_b = b.group(6), ip_to_u32(b.group(7)), int(b.group(8))
+        # "Built ... for A to B": A is the lower-security side.  Inbound
+        # connections are initiated at A (src=A); outbound are initiated at B
+        # (src=B) with A as the destination side.
+        if direction == "inbound":
+            src, sport, dst, dport, ingress = ip_a, port_a, ip_b, port_b, if_a
+        else:
+            src, sport, dst, dport, ingress = ip_b, port_b, ip_a, port_a, if_b
+        return ParsedLine(
+            firewall=host,
+            acl=None,
+            ingress_if=ingress,
+            proto=proto,
+            src=src,
+            sport=sport,
+            dst=dst,
+            dport=dport,
+            permitted=True,
+        )
+
+    return None

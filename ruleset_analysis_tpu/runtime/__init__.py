@@ -1,0 +1,1 @@
+"""Runtime: streaming driver, checkpointing, reporting, metrics."""

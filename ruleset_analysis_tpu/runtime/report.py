@@ -1,0 +1,105 @@
+"""The unused-rule report — the reference's L5 layer (SURVEY.md §2, §4.5).
+
+Reference semantics: set-difference of all configured rules minus rules with
+hits, ordered per ACL; plus per-rule hit counts.  The TPU rebuild adds the
+sketched statistics (estimated counts, per-rule unique-source cardinality,
+top talkers) to the same report structure.
+
+Pure host code; consumes plain dicts so both the oracle backend and the TPU
+backend feed it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+from ..hostside.oracle import RuleKey
+from ..hostside.pack import PackedRuleset
+
+
+@dataclasses.dataclass
+class Report:
+    """One analysis run's full output."""
+
+    per_rule: list[dict]  # one entry per key, config order
+    unused: list[RuleKey]
+    totals: dict
+    talkers: dict  # "<fw> <acl>" -> [[src_ip_str, count], ...]
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "totals": self.totals,
+                "per_rule": self.per_rule,
+                "unused": [list(k) for k in self.unused],
+                "talkers": self.talkers,
+            },
+            indent=2,
+        )
+
+    def to_text(self) -> str:
+        out = []
+        t = self.totals
+        out.append(
+            f"# lines={t.get('lines_total', 0)} matched={t.get('lines_matched', 0)} "
+            f"skipped={t.get('lines_skipped', 0)} backend={t.get('backend', '?')}"
+        )
+        # group by ACL: key order is all configured rules first, then every
+        # ACL's implicit deny, so naive sequential headers would repeat
+        by_acl: dict[tuple[str, str], list[dict]] = {}
+        for e in self.per_rule:
+            by_acl.setdefault((e["firewall"], e["acl"]), []).append(e)
+        for (fw, acl), entries in by_acl.items():
+            out.append(f"\n== {fw} / {acl} ==")
+            for e in entries:
+                tag = "implicit-deny" if e["index"] == 0 else f"rule {e['index']}"
+                extra = ""
+                if "unique_sources" in e:
+                    extra = f"  uniq_src~{e['unique_sources']}"
+                out.append(f"  {tag:>14}: {e['hits']:>12}{extra}  | {e['text']}")
+        out.append(f"\n# unused rules: {len(self.unused)}")
+        for fw, acl, idx in self.unused:
+            out.append(f"  UNUSED {fw} {acl} rule {idx}")
+        return "\n".join(out)
+
+
+def build_report(
+    packed: PackedRuleset,
+    hits: dict[RuleKey, int],
+    *,
+    backend: str,
+    totals: dict[str, Any] | None = None,
+    unique_sources: dict[RuleKey, int] | None = None,
+    talkers: dict[tuple[str, str], list[tuple[int, int]]] | None = None,
+) -> Report:
+    """Assemble the report from per-key hits (exact or estimated)."""
+    from ..hostside.aclparse import u32_to_ip
+
+    per_rule = []
+    unused: list[RuleKey] = []
+    for key_id, meta in enumerate(packed.key_meta):
+        key: RuleKey = (meta.firewall, meta.acl, meta.index)
+        h = int(hits.get(key, 0))
+        entry = {
+            "firewall": meta.firewall,
+            "acl": meta.acl,
+            "index": meta.index,
+            "key_id": key_id,
+            "hits": h,
+            "text": meta.text,
+        }
+        if unique_sources is not None and key in unique_sources:
+            entry["unique_sources"] = int(unique_sources[key])
+        per_rule.append(entry)
+        if not meta.implicit_deny and h == 0:
+            unused.append(key)
+    talk = {}
+    for (fw, acl), items in (talkers or {}).items():
+        talk[f"{fw} {acl}"] = [[u32_to_ip(int(ip)), int(c)] for ip, c in items]
+    t = dict(totals or {})
+    t["backend"] = backend
+    t["n_rules"] = packed.n_rules
+    t["n_unused"] = len(unused)
+    return Report(per_rule=per_rule, unused=unused, totals=t, talkers=talk)

@@ -1,0 +1,16 @@
+"""Test harness setup.
+
+Must run before any jax import: force the CPU backend with 8 fake devices so
+multi-chip sharding tests (SURVEY.md §5 "multi-node without a cluster") run
+anywhere, exactly as they would on a real v5e-8 mesh.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

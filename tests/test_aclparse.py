@@ -1,0 +1,246 @@
+"""ACL parser unit tests: address/port/protocol normalization and expansion."""
+
+import pytest
+
+from ruleset_analysis_tpu.hostside import aclparse as A
+
+
+def parse(text, fw="fw1"):
+    return A.parse_asa_config(text, fw)
+
+
+def test_ip_roundtrip():
+    assert A.ip_to_u32("0.0.0.0") == 0
+    assert A.ip_to_u32("255.255.255.255") == 0xFFFFFFFF
+    assert A.ip_to_u32("10.0.0.1") == (10 << 24) | 1
+    assert A.u32_to_ip(A.ip_to_u32("192.168.4.77")) == "192.168.4.77"
+    with pytest.raises(A.AclParseError):
+        A.ip_to_u32("300.1.1.1")
+    with pytest.raises(A.AclParseError):
+        A.ip_to_u32("1.2.3")
+
+
+def test_subnet_range():
+    lo, hi = A.subnet_range("10.1.2.0", "255.255.255.0")
+    assert A.u32_to_ip(lo) == "10.1.2.0"
+    assert A.u32_to_ip(hi) == "10.1.2.255"
+    lo, hi = A.subnet_range("10.1.2.99", "255.255.255.0")  # host bits set in net
+    assert A.u32_to_ip(lo) == "10.1.2.0"
+
+
+def test_simple_ace():
+    rs = parse("access-list OUT extended permit tcp any host 10.0.0.5 eq 443\n")
+    [rule] = rs.acls["OUT"]
+    assert rule.index == 1
+    [ace] = rule.aces
+    assert ace.action == A.PERMIT
+    assert (ace.proto_lo, ace.proto_hi) == (6, 6)
+    assert (ace.src_lo, ace.src_hi) == (0, 0xFFFFFFFF)
+    assert ace.dst_lo == ace.dst_hi == A.ip_to_u32("10.0.0.5")
+    assert (ace.dport_lo, ace.dport_hi) == (443, 443)
+    assert (ace.sport_lo, ace.sport_hi) == (0, 65535)
+
+
+def test_ip_proto_is_any_proto():
+    rs = parse("access-list X extended deny ip 10.0.0.0 255.0.0.0 any\n")
+    [ace] = rs.acls["X"][0].aces
+    assert (ace.proto_lo, ace.proto_hi) == (0, 255)
+    assert ace.action == A.DENY
+    assert A.u32_to_ip(ace.src_lo) == "10.0.0.0"
+    assert A.u32_to_ip(ace.src_hi) == "10.255.255.255"
+
+
+def test_port_operators():
+    rs = parse(
+        "access-list P extended permit tcp any any gt 1023\n"
+        "access-list P extended permit tcp any any lt 1024\n"
+        "access-list P extended permit tcp any any range 8000 9000\n"
+        "access-list P extended permit udp any any neq 53\n"
+        "access-list P extended permit tcp any any eq https\n"
+    )
+    rules = rs.acls["P"]
+    assert (rules[0].aces[0].dport_lo, rules[0].aces[0].dport_hi) == (1024, 65535)
+    assert (rules[1].aces[0].dport_lo, rules[1].aces[0].dport_hi) == (0, 1023)
+    assert (rules[2].aces[0].dport_lo, rules[2].aces[0].dport_hi) == (8000, 9000)
+    # neq expands to two rows under one configured rule
+    neq = rules[3]
+    assert len(neq.aces) == 2
+    assert {(a.dport_lo, a.dport_hi) for a in neq.aces} == {(0, 52), (54, 65535)}
+    assert (rules[4].aces[0].dport_lo, rules[4].aces[0].dport_hi) == (443, 443)
+
+
+def test_source_port_spec():
+    rs = parse("access-list S extended permit tcp any eq 1024 any eq 80\n")
+    [ace] = rs.acls["S"][0].aces
+    assert (ace.sport_lo, ace.sport_hi) == (1024, 1024)
+    assert (ace.dport_lo, ace.dport_hi) == (80, 80)
+
+
+def test_network_object_group_expansion():
+    rs = parse(
+        "object-group network SRV\n"
+        " network-object host 10.0.0.1\n"
+        " network-object 10.1.0.0 255.255.0.0\n"
+        "access-list G extended permit tcp object-group SRV any eq 22\n"
+    )
+    [rule] = rs.acls["G"]
+    assert len(rule.aces) == 2
+    assert {(a.src_lo, a.src_hi) for a in rule.aces} == {
+        (A.ip_to_u32("10.0.0.1"),) * 2,
+        (A.ip_to_u32("10.1.0.0"), A.ip_to_u32("10.1.255.255")),
+    }
+
+
+def test_nested_group_and_cycle_detection():
+    rs = parse(
+        "object-group network INNER\n"
+        " network-object host 1.1.1.1\n"
+        "object-group network OUTER\n"
+        " group-object INNER\n"
+        " network-object host 2.2.2.2\n"
+        "access-list N extended permit ip object-group OUTER any\n"
+    )
+    assert len(rs.acls["N"][0].aces) == 2
+    with pytest.raises(A.AclParseError, match="cycle"):
+        parse(
+            "object-group network A1\n"
+            " group-object B1\n"
+            "object-group network B1\n"
+            " group-object A1\n"
+            "access-list C extended permit ip object-group A1 any\n"
+        )
+
+
+def test_service_group_ports():
+    rs = parse(
+        "object-group service WEB tcp\n"
+        " port-object eq 80\n"
+        " port-object range 8000 8010\n"
+        "access-list W extended permit tcp any any object-group WEB\n"
+    )
+    [rule] = rs.acls["W"]
+    assert {(a.dport_lo, a.dport_hi) for a in rule.aces} == {(80, 80), (8000, 8010)}
+
+
+def test_generic_service_group_bundles_proto_and_port():
+    rs = parse(
+        "object-group service MIXED\n"
+        " service-object tcp destination eq 443\n"
+        " service-object udp destination eq 53\n"
+        " service-object icmp\n"
+        "access-list M extended permit object-group MIXED any any\n"
+    )
+    [rule] = rs.acls["M"]
+    combos = {(a.proto_lo, a.dport_lo, a.dport_hi) for a in rule.aces}
+    assert (6, 443, 443) in combos
+    assert (17, 53, 53) in combos
+    assert (1, 0, 65535) in combos
+
+
+def test_protocol_object_group():
+    rs = parse(
+        "object-group protocol TUNNEL\n"
+        " protocol-object esp\n"
+        " protocol-object gre\n"
+        "access-list T extended permit object-group TUNNEL any any\n"
+    )
+    [rule] = rs.acls["T"]
+    assert {(a.proto_lo, a.proto_hi) for a in rule.aces} == {(50, 50), (47, 47)}
+
+
+def test_object_network():
+    rs = parse(
+        "object network WEB1\n"
+        " host 10.9.9.9\n"
+        "object network NET1\n"
+        " subnet 10.8.0.0 255.255.0.0\n"
+        "object network RANGE1\n"
+        " range 10.7.0.5 10.7.0.9\n"
+        "access-list O extended permit tcp object NET1 object WEB1 eq 80\n"
+        "access-list O extended permit tcp object RANGE1 any\n"
+    )
+    r0, r1 = rs.acls["O"]
+    assert r0.aces[0].dst_lo == A.ip_to_u32("10.9.9.9")
+    assert (r1.aces[0].src_lo, r1.aces[0].src_hi) == (
+        A.ip_to_u32("10.7.0.5"),
+        A.ip_to_u32("10.7.0.9"),
+    )
+
+
+def test_icmp_type_in_dport_column():
+    rs = parse(
+        "access-list I extended permit icmp any any echo\n"
+        "access-list I extended permit icmp any any 11\n"
+        "access-list I extended permit icmp any any\n"
+    )
+    rules = rs.acls["I"]
+    assert (rules[0].aces[0].dport_lo, rules[0].aces[0].dport_hi) == (8, 8)
+    assert (rules[1].aces[0].dport_lo, rules[1].aces[0].dport_hi) == (11, 11)
+    assert (rules[2].aces[0].dport_lo, rules[2].aces[0].dport_hi) == (0, 65535)
+
+
+def test_inactive_rule_has_no_rows_but_is_reported():
+    rs = parse("access-list D extended permit tcp any any eq 80 inactive\n")
+    [rule] = rs.acls["D"]
+    assert rule.aces == []
+    assert rule.index == 1
+
+
+def test_remarks_skipped_and_indices_stable():
+    rs = parse(
+        "access-list R remark allow web\n"
+        "access-list R extended permit tcp any any eq 80\n"
+        "access-list R remark block rest\n"
+        "access-list R extended deny ip any any\n"
+    )
+    rules = rs.acls["R"]
+    assert [r.index for r in rules] == [1, 2]
+
+
+def test_standard_acl_matches_source():
+    rs = parse("access-list STD standard permit 10.0.0.0 255.0.0.0\n")
+    [ace] = rs.acls["STD"][0].aces
+    assert A.u32_to_ip(ace.src_lo) == "10.0.0.0"
+    assert (ace.dst_lo, ace.dst_hi) == (0, 0xFFFFFFFF)
+    assert (ace.proto_lo, ace.proto_hi) == (0, 255)
+
+
+def test_access_group_binding():
+    rs = parse(
+        "access-list OUT extended permit ip any any\n"
+        "access-group OUT in interface outside\n"
+    )
+    assert rs.bindings["outside"] == ("OUT", "in")
+
+
+def test_hostname_detection(tmp_path):
+    p = tmp_path / "fw.cfg"
+    p.write_text("hostname edge-fw-1\naccess-list A extended permit ip any any\n")
+    rs = A.parse_config_file(str(p))
+    assert rs.firewall == "edge-fw-1"
+
+
+def test_impossible_port_spec_matches_nothing():
+    # "gt 65535" can never match — must NOT degrade to match-all (review finding)
+    rs = parse("access-list T extended permit tcp any gt 65535 any\n")
+    [rule] = rs.acls["T"]
+    assert rule.aces == []
+    rs = parse("access-list T2 extended permit tcp any any lt 0\n")
+    assert rs.acls["T2"][0].aces == []
+
+
+def test_icmp_type_group_cycle_and_bad_name():
+    with pytest.raises(A.AclParseError, match="cycle"):
+        parse(
+            "object-group icmp-type IA\n"
+            " group-object IB\n"
+            "object-group icmp-type IB\n"
+            " group-object IA\n"
+            "access-list C extended permit icmp any any object-group IA\n"
+        )
+    with pytest.raises(A.AclParseError, match="unknown icmp type"):
+        parse(
+            "object-group icmp-type IT\n"
+            " icmp-object bogus-name\n"
+            "access-list C extended permit icmp any any object-group IT\n"
+        )

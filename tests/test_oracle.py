@@ -1,0 +1,141 @@
+"""Golden first-match semantics tests against the exact oracle (SURVEY.md §5)."""
+
+from collections import Counter
+
+from ruleset_analysis_tpu.hostside import aclparse, oracle, pack, synth
+from ruleset_analysis_tpu.hostside.syslog import parse_line
+
+CFG = """\
+hostname fw1
+access-list OUT extended permit tcp any host 10.0.0.5 eq 443
+access-list OUT extended permit tcp any host 10.0.0.5 eq 80
+access-list OUT extended deny tcp any 10.0.0.0 255.255.255.0
+access-list OUT extended permit ip any any
+access-group OUT in interface outside
+"""
+
+
+def logline(acl, proto, src, sport, dst, dport, fw="fw1"):
+    return (
+        f"Jul 29 07:48:01 {fw} : %ASA-6-106100: access-list {acl} permitted {proto} "
+        f"inside/{src}({sport}) -> outside/{dst}({dport}) hit-cnt 1 first hit [0x0, 0x0]"
+    )
+
+
+def run(lines, cfg=CFG):
+    rs = aclparse.parse_asa_config(cfg, "fw1")
+    orc = oracle.Oracle([rs])
+    return orc.consume(lines), rs
+
+
+def test_first_match_wins_over_later_rules():
+    res, _ = run([logline("OUT", "tcp", "1.2.3.4", 1000, "10.0.0.5", 443)])
+    assert res.hits == Counter({("fw1", "OUT", 1): 1})
+
+
+def test_overlapping_rules_ordered():
+    # 10.0.0.5:80 matches rule 2 (eq 80) before the broader deny rule 3
+    res, _ = run([logline("OUT", "tcp", "1.2.3.4", 1000, "10.0.0.5", 80)])
+    assert res.hits == Counter({("fw1", "OUT", 2): 1})
+    # 10.0.0.9:80 skips rules 1-2 (wrong host) and lands on the subnet deny
+    res, _ = run([logline("OUT", "tcp", "1.2.3.4", 1000, "10.0.0.9", 80)])
+    assert res.hits == Counter({("fw1", "OUT", 3): 1})
+
+
+def test_catch_all_and_implicit_deny():
+    # udp anywhere -> rule 4 (permit ip any any)
+    res, _ = run([logline("OUT", "udp", "9.9.9.9", 53, "8.8.8.8", 53)])
+    assert res.hits == Counter({("fw1", "OUT", 4): 1})
+    # with no catch-all, unmatched traffic lands on implicit deny (index 0)
+    cfg = "access-list X extended permit tcp any any eq 22\n"
+    res, _ = run([logline("X", "udp", "9.9.9.9", 53, "8.8.8.8", 53)], cfg=cfg)
+    assert res.hits == Counter({("fw1", "X", 0): 1})
+
+
+def test_unknown_acl_and_firewall_skipped():
+    res, _ = run(
+        [
+            logline("NOPE", "tcp", "1.1.1.1", 1, "2.2.2.2", 2),
+            logline("OUT", "tcp", "1.1.1.1", 1, "2.2.2.2", 2, fw="otherfw"),
+        ]
+    )
+    assert res.lines_skipped == 2
+    assert not res.hits
+
+
+def test_conn_message_resolved_via_binding():
+    line = (
+        "Jul 29 07:48:03 fw1 : %ASA-6-302013: Built inbound TCP connection 1 for "
+        "outside:203.0.113.5/51000 (203.0.113.5/51000) to inside:10.0.0.5/443 (10.0.0.5/443)"
+    )
+    res, _ = run([line])
+    assert res.hits == Counter({("fw1", "OUT", 1): 1})
+
+
+def test_unused_rules_report():
+    res, rs = run([logline("OUT", "tcp", "1.2.3.4", 1000, "10.0.0.5", 443)])
+    unused = res.unused_rules([rs])
+    assert ("fw1", "OUT", 2) in unused
+    assert ("fw1", "OUT", 3) in unused
+    assert ("fw1", "OUT", 4) in unused
+    assert ("fw1", "OUT", 1) not in unused
+
+
+def test_sources_and_talkers():
+    lines = [
+        logline("OUT", "tcp", "1.2.3.4", 1000, "10.0.0.5", 443),
+        logline("OUT", "tcp", "1.2.3.4", 1001, "10.0.0.5", 443),
+        logline("OUT", "tcp", "5.6.7.8", 1002, "10.0.0.5", 443),
+    ]
+    res, _ = run(lines)
+    key = ("fw1", "OUT", 1)
+    assert res.hits[key] == 3
+    assert len(res.sources[key]) == 2
+    top = res.talkers[("fw1", "OUT")].most_common(1)
+    assert top[0][1] == 2
+
+
+def test_oracle_matches_packed_semantics_on_synthetic_corpus():
+    """Cross-check: oracle (Ruleset scan) vs an independent packed-row scan."""
+    cfg_text = synth.synth_config(n_acls=3, rules_per_acl=16, seed=7)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    tuples = synth.synth_tuples(packed, 500, seed=7)
+    lines = synth.render_syslog(packed, tuples, seed=7)
+
+    orc = oracle.Oracle([rs])
+    res = orc.consume(lines)
+
+    # independent numpy first-match over the packed matrix
+    packer = pack.LinePacker(packed)
+    batch = packer.pack_lines(lines)
+    hits = Counter()
+    rules = packed.rules
+    import numpy as np
+
+    for row in batch:
+        if not row[pack.T_VALID]:
+            continue
+        ok = (
+            (rules[:, pack.R_ACL] == row[pack.T_ACL])
+            & (rules[:, pack.R_PLO] <= row[pack.T_PROTO])
+            & (row[pack.T_PROTO] <= rules[:, pack.R_PHI])
+            & (rules[:, pack.R_SLO] <= row[pack.T_SRC])
+            & (row[pack.T_SRC] <= rules[:, pack.R_SHI])
+            & (rules[:, pack.R_SPLO] <= row[pack.T_SPORT])
+            & (row[pack.T_SPORT] <= rules[:, pack.R_SPHI])
+            & (rules[:, pack.R_DLO] <= row[pack.T_DST])
+            & (row[pack.T_DST] <= rules[:, pack.R_DHI])
+            & (rules[:, pack.R_DPLO] <= row[pack.T_DPORT])
+            & (row[pack.T_DPORT] <= rules[:, pack.R_DPHI])
+        )
+        idx = np.nonzero(ok)[0]
+        if len(idx):
+            key_id = int(rules[idx[0], pack.R_KEY])
+        else:
+            key_id = int(packed.deny_key[int(row[pack.T_ACL])])
+        m = packed.key_meta[key_id]
+        hits[(m.firewall, m.acl, m.index)] += 1
+
+    oracle_hits = {k: v for k, v in res.hits.items()}
+    assert hits == Counter(oracle_hits)

@@ -1,0 +1,100 @@
+"""Syslog parser tests across the handled ASA message classes."""
+
+from ruleset_analysis_tpu.hostside import syslog as S
+from ruleset_analysis_tpu.hostside.aclparse import ip_to_u32
+
+
+def test_106100_tcp():
+    line = (
+        "Jul 29 07:48:01 fw1 : %ASA-6-106100: access-list OUT permitted tcp "
+        "inside/10.1.2.3(41000) -> outside/198.51.100.7(443) hit-cnt 1 first hit [0xabc, 0x0]"
+    )
+    p = S.parse_line(line)
+    assert p is not None
+    assert p.firewall == "fw1"
+    assert p.acl == "OUT"
+    assert p.proto == 6
+    assert p.src == ip_to_u32("10.1.2.3")
+    assert p.sport == 41000
+    assert p.dst == ip_to_u32("198.51.100.7")
+    assert p.dport == 443
+    assert p.permitted is True
+
+
+def test_106100_denied_icmp_type_to_dport():
+    line = (
+        "Jul 29 07:48:01 fw9 : %ASA-6-106100: access-list EDGE denied icmp "
+        "outside/192.0.2.1(8) -> inside/10.0.0.1(0) hit-cnt 3 300-second interval [0x0, 0x0]"
+    )
+    p = S.parse_line(line)
+    assert p.proto == 1
+    assert p.sport == 0
+    assert p.dport == 8  # icmp type echoed into the dport column
+    assert p.permitted is False
+
+
+def test_106023_udp():
+    line = (
+        '<164>Jul 29 07:48:02 fw2 %ASA-4-106023: Deny udp src dmz:10.5.5.5/137 '
+        'dst inside:10.0.0.9/137 by access-group "DMZ-IN" [0x0, 0x0]'
+    )
+    p = S.parse_line(line)
+    assert p.firewall == "fw2"
+    assert p.acl == "DMZ-IN"
+    assert p.proto == 17
+    assert (p.sport, p.dport) == (137, 137)
+    assert p.permitted is False
+
+
+def test_106023_icmp_type_code():
+    line = (
+        'Jul 29 07:48:02 fw2 : %ASA-4-106023: Deny icmp src outside:192.0.2.9 '
+        'dst inside:10.0.0.1 (type 8, code 0) by access-group "EDGE" [0x0, 0x0]'
+    )
+    p = S.parse_line(line)
+    assert p.proto == 1
+    assert p.dport == 8
+    assert p.sport == 0
+
+
+def test_302013_inbound():
+    line = (
+        "Jul 29 07:48:03 fw1 : %ASA-6-302013: Built inbound TCP connection 123456 for "
+        "outside:203.0.113.5/51000 (203.0.113.5/51000) to inside:10.0.0.8/22 (10.0.0.8/22)"
+    )
+    p = S.parse_line(line)
+    assert p.acl is None
+    assert p.ingress_if == "outside"
+    assert p.src == ip_to_u32("203.0.113.5")
+    assert p.sport == 51000
+    assert p.dst == ip_to_u32("10.0.0.8")
+    assert p.dport == 22
+
+
+def test_302013_outbound_swaps_direction():
+    line = (
+        "Jul 29 07:48:03 fw1 : %ASA-6-302013: Built outbound TCP connection 7 for "
+        "outside:198.51.100.1/443 (198.51.100.1/443) to inside:10.0.0.4/55123 (10.0.0.4/55123)"
+    )
+    p = S.parse_line(line)
+    assert p.ingress_if == "inside"
+    assert p.src == ip_to_u32("10.0.0.4")
+    assert p.sport == 55123
+    assert p.dst == ip_to_u32("198.51.100.1")
+    assert p.dport == 443
+
+
+def test_302015_udp():
+    line = (
+        "Jul 29 07:48:03 fw3 : %ASA-6-302015: Built inbound UDP connection 9 for "
+        "outside:192.0.2.2/53555 (192.0.2.2/53555) to dmz:10.2.0.2/53 (10.2.0.2/53)"
+    )
+    p = S.parse_line(line)
+    assert p.proto == 17
+    assert p.dport == 53
+
+
+def test_non_asa_lines_skipped():
+    assert S.parse_line("Jul 29 07:48:01 host sshd[123]: Accepted publickey") is None
+    assert S.parse_line("") is None
+    assert S.parse_line("Jul 29 fw1 %ASA-6-305011: Built dynamic TCP translation") is None
