@@ -54,17 +54,19 @@ def _iter_log_lines(paths: list[str]):
 
 
 def _cmd_run(args: argparse.Namespace) -> int:
-    cfg = AnalysisConfig(
-        backend=args.backend,
-        batch_size=args.batch_size,
-        sketch=SketchConfig(
-            cms_width=args.cms_width,
-            cms_depth=args.cms_depth,
-            hll_p=args.hll_p,
-        ),
-        checkpoint_every_chunks=args.checkpoint_every,
-        checkpoint_dir=args.checkpoint_dir,
-    )
+    try:
+        cfg = AnalysisConfig(
+            backend=args.backend,
+            batch_size=args.batch_size,
+            sketch=SketchConfig(
+                cms_width=args.cms_width,
+                cms_depth=args.cms_depth,
+                hll_p=args.hll_p,
+            ),
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     packed = pack.load_packed(args.ruleset)
     lines = _iter_log_lines(args.logs)
 
@@ -151,10 +153,8 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-size", type=int, default=1 << 16)
     p.add_argument("--cms-width", type=int, default=1 << 14)
     p.add_argument("--cms-depth", type=int, default=4)
-    p.add_argument("--hll-p", type=int, default=6)
+    p.add_argument("--hll-p", type=int, default=8)
     p.add_argument("--topk", type=int, default=10)
-    p.add_argument("--checkpoint-every", type=int, default=0, metavar="CHUNKS")
-    p.add_argument("--checkpoint-dir", default="out/ckpt")
     p.add_argument("--json", action="store_true")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_run)

@@ -36,6 +36,11 @@ OUTPUT_DIR = os.environ.get("RA_OUTPUT_DIR", "out")
 # ---------------------------------------------------------------------------
 
 
+#: Maximum CMS depth — ops/hashing.py guarantees this many independent
+#: multiply-shift constants (asserted there against MS_CONSTANTS).
+MAX_CMS_DEPTH = 8
+
+
 @dataclasses.dataclass(frozen=True)
 class SketchConfig:
     """Geometry of the mergeable sketches kept on device.
@@ -44,13 +49,26 @@ class SketchConfig:
     and depth ``d`` over-estimates by at most ``e*N/w`` with probability
     ``1 - exp(-d)``; a HyperLogLog with ``m = 2**hll_p`` registers has
     relative error ``~1.04/sqrt(m)``.
+
+    Validation lives here (not in the CLI) so every entry point — CLI,
+    library callers, tests — gets the same clean errors.
     """
 
     cms_width: int = 1 << 14
     cms_depth: int = 4
-    hll_p: int = 6  # 64 registers/rule -> ~13% per-rule cardinality error
-    topk_capacity: int = 256  # host-side Space-Saving summary size per ACL
+    hll_p: int = 8  # 256 registers/rule -> ~6.5% per-rule cardinality error
+    topk_capacity: int = 256  # host-side talker-summary size per ACL
     topk_chunk_candidates: int = 64  # device top_k candidates fed per chunk
+
+    def __post_init__(self) -> None:
+        if self.cms_width < 2 or self.cms_width & (self.cms_width - 1):
+            raise ValueError(f"cms_width must be a power of two >= 2, got {self.cms_width}")
+        if not 1 <= self.cms_depth <= MAX_CMS_DEPTH:
+            raise ValueError(f"cms_depth must be in 1..{MAX_CMS_DEPTH}, got {self.cms_depth}")
+        if not 1 <= self.hll_p <= 16:
+            raise ValueError(f"hll_p must be in 1..16, got {self.hll_p}")
+        if self.topk_capacity < 1 or self.topk_chunk_candidates < 1:
+            raise ValueError("topk_capacity and topk_chunk_candidates must be >= 1")
 
     @property
     def hll_m(self) -> int:

@@ -1,0 +1,1 @@
+"""The flagship analysis pipeline: device state + one fused jitted step."""

@@ -1,0 +1,198 @@
+"""The analysis pipeline — the "flagship model" of this framework.
+
+One jitted step fuses everything the reference's mapper+reducer pair did
+per line (SURVEY.md §4.3/§4.4), over a whole batch:
+
+  batch -> first-match keys -> { exact 64-bit counts, CMS, per-rule HLL,
+                                 top-K talker candidates }
+
+The state is a pytree of uint32 register files, every component of which
+is mergeable (add for counts/CMS, max for HLL) — the property that makes
+multi-chip scale-out a pair of XLA collectives (psum/pmax) instead of a
+Hadoop shuffle, and makes checkpoint/resume idempotent.
+
+Batches arrive column-major ``[TUPLE_COLS, B]`` so each field is a
+contiguous lane-aligned vector on device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import AnalysisConfig
+from ..hostside.pack import PackedRuleset, T_ACL, T_DPORT, T_DST, T_PROTO, T_SPORT, T_SRC, T_VALID
+from ..ops import cms as cms_ops
+from ..ops import counts as count_ops
+from ..ops import hll as hll_ops
+from ..ops import topk as topk_ops
+from ..ops.match import RULE_BLOCK, match_keys
+
+_U32 = jnp.uint32
+
+
+class DeviceRuleset(NamedTuple):
+    """Device-resident rule tensor (the reference's shipped ACL pickle)."""
+
+    rules: jax.Array  # [R, RULE_COLS] uint32, R % rule_block == 0
+    deny_key: jax.Array  # [n_acls] uint32
+
+
+class AnalysisState(NamedTuple):
+    """All mergeable device registers for one analysis run."""
+
+    counts_lo: jax.Array  # [K] u32   exact hit counts, low word
+    counts_hi: jax.Array  # [K] u32   exact hit counts, high word
+    cms: jax.Array  # [d, w] u32      approximate per-key counts
+    hll: jax.Array  # [K, m] u32      per-key unique-source registers
+    talk_cms: jax.Array  # [d, w] u32 (acl, src) pair counts for top-K
+
+
+class ChunkOut(NamedTuple):
+    """Per-chunk host-bound outputs (top-K candidates)."""
+
+    cand_acl: jax.Array  # [k] u32
+    cand_src: jax.Array  # [k] u32
+    cand_est: jax.Array  # [k] u32
+
+
+def pad_rules(rules: np.ndarray, rule_block: int = RULE_BLOCK) -> np.ndarray:
+    """Pad the host rule matrix to a multiple of the scan block size."""
+    from ..hostside.pack import NO_ACL, R_ACL, RULE_COLS
+
+    r = rules.shape[0]
+    target = max(rule_block, ((r + rule_block - 1) // rule_block) * rule_block)
+    if r == target:
+        return rules
+    out = np.zeros((target, RULE_COLS), dtype=np.uint32)
+    out[:, R_ACL] = NO_ACL
+    out[:r] = rules
+    return out
+
+
+def ship_ruleset(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> DeviceRuleset:
+    return DeviceRuleset(
+        rules=jnp.asarray(pad_rules(packed.rules, rule_block)),
+        deny_key=jnp.asarray(packed.deny_key.astype(np.uint32)),
+    )
+
+
+def init_state(n_keys: int, cfg: AnalysisConfig) -> AnalysisState:
+    s = cfg.sketch
+    return AnalysisState(
+        counts_lo=jnp.zeros(n_keys, dtype=_U32),
+        counts_hi=jnp.zeros(n_keys, dtype=_U32),
+        cms=cms_ops.cms_init(s.cms_width, s.cms_depth),
+        hll=hll_ops.hll_init(n_keys, s.hll_p),
+        talk_cms=cms_ops.cms_init(s.cms_width, s.cms_depth),
+    )
+
+
+def analysis_step(
+    state: AnalysisState,
+    ruleset: DeviceRuleset,
+    batch: jax.Array,  # [TUPLE_COLS, B] uint32, column-major
+    *,
+    n_keys: int,
+    topk_k: int,
+    exact_counts: bool = True,
+    rule_block: int = RULE_BLOCK,
+) -> tuple[AnalysisState, ChunkOut]:
+    """One fused device step over a batch of packed log lines."""
+    cols = {
+        "acl": batch[T_ACL],
+        "proto": batch[T_PROTO],
+        "src": batch[T_SRC],
+        "sport": batch[T_SPORT],
+        "dst": batch[T_DST],
+        "dport": batch[T_DPORT],
+    }
+    valid = batch[T_VALID]
+    keys = match_keys(cols, ruleset.rules, ruleset.deny_key, rule_block)
+
+    if exact_counts:
+        delta = count_ops.segment_counts(keys, valid, n_keys)
+        lo, hi = count_ops.add64(state.counts_lo, state.counts_hi, delta)
+    else:
+        lo, hi = state.counts_lo, state.counts_hi
+    cms = cms_ops.cms_update(state.cms, keys, valid)
+    hll = hll_ops.hll_update(state.hll, keys, cols["src"], valid)
+    talk_cms, ca, cs, ce = topk_ops.talker_chunk_update(
+        state.talk_cms, cols["acl"], cols["src"], valid, topk_k
+    )
+    return (
+        AnalysisState(counts_lo=lo, counts_hi=hi, cms=cms, hll=hll, talk_cms=talk_cms),
+        ChunkOut(cand_acl=ca, cand_src=cs, cand_est=ce),
+    )
+
+
+def make_step(cfg: AnalysisConfig, n_keys: int, rule_block: int = RULE_BLOCK):
+    """Jitted single-device step with state donation (register files are
+    updated in place in HBM across chunks)."""
+    fn = functools.partial(
+        analysis_step,
+        n_keys=n_keys,
+        topk_k=cfg.sketch.topk_chunk_candidates,
+        exact_counts=cfg.exact_counts,
+        rule_block=rule_block,
+    )
+    return jax.jit(fn, donate_argnums=(0,))
+
+
+# ---------------------------------------------------------------------------
+# Finalize: device registers -> report-shaped host results.
+# ---------------------------------------------------------------------------
+
+
+def finalize(
+    state: AnalysisState,
+    packed: PackedRuleset,
+    cfg: AnalysisConfig,
+    tracker: topk_ops.TopKTracker | None = None,
+    *,
+    topk: int = 10,
+    totals: dict | None = None,
+):
+    """Pull registers to host and assemble the Report (SURVEY.md L5)."""
+    from ..runtime.report import build_report
+
+    lo = np.asarray(jax.device_get(state.counts_lo))
+    hi = np.asarray(jax.device_get(state.counts_hi))
+    hll_regs = np.asarray(jax.device_get(state.hll))
+    cms_host = np.asarray(jax.device_get(state.cms))
+
+    if cfg.exact_counts:
+        per_key = count_ops.to_u64(lo, hi)
+    else:
+        per_key = cms_ops.cms_query_np(cms_host, np.arange(packed.n_keys, dtype=np.uint32))
+    card = hll_ops.hll_estimate_np(hll_regs)
+
+    hits = {}
+    uniq = {}
+    for key_id, meta in enumerate(packed.key_meta):
+        k = (meta.firewall, meta.acl, meta.index)
+        hits[k] = int(per_key[key_id])
+        if per_key[key_id] > 0:
+            uniq[k] = int(round(card[key_id]))
+
+    talkers = None
+    if tracker is not None:
+        gid_to_name = {gid: name for name, gid in packed.acl_gid.items()}
+        talkers = {}
+        for gid in tracker.acls():
+            name = gid_to_name.get(gid)
+            if name is not None:
+                talkers[name] = tracker.top(gid, topk)
+
+    return build_report(
+        packed,
+        hits,
+        backend="tpu",
+        totals=totals,
+        unique_sources=uniq,
+        talkers=talkers,
+    )

@@ -1,0 +1,8 @@
+"""JAX device ops: the TPU-native replacements for the reference hot loops.
+
+The reference's per-line Python loops (``mapper.py``'s first-match scan,
+``reducer.py``'s key-sum — SURVEY.md §4.3/§4.4) become batched, branch-free
+array programs here: everything is uint32 arithmetic over packed columns,
+with no data-dependent Python control flow, so XLA can tile it onto the TPU
+vector unit and fuse the reductions.
+"""

@@ -1,0 +1,74 @@
+"""Count-min sketch on device: mergeable approximate per-key counts.
+
+The rebuild's replacement for exact reduce-side counting at scale
+(BASELINE.json config #2): a ``[depth, width]`` uint32 register file;
+update = scatter-add at one multiply-shift bucket per depth row; query =
+min over rows (one-sided overestimate, error <= e*N/width w.p. 1-exp(-depth)).
+Merging across chips is elementwise ``+`` — exactly a ``psum`` over ICI,
+replacing the Hadoop shuffle (SURVEY.md §3c).
+
+Hash constants are fixed module-wide so independently-built sketches merge.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .hashing import MS_CONSTANTS, fmix32, mul_shift
+
+_U32 = jnp.uint32
+
+
+def cms_init(width: int, depth: int) -> jnp.ndarray:
+    if width < 2 or width & (width - 1):
+        raise ValueError(f"cms width must be a power of two >= 2, got {width}")
+    if not 1 <= depth <= len(MS_CONSTANTS):
+        raise ValueError(f"cms depth must be in 1..{len(MS_CONSTANTS)}, got {depth}")
+    return jnp.zeros((depth, width), dtype=_U32)
+
+
+def cms_bucket(keys: jnp.ndarray, width: int, depth: int) -> jnp.ndarray:
+    """[depth, B] bucket indices for each key (mixed then multiply-shifted)."""
+    bits = int(width).bit_length() - 1
+    mixed = fmix32(keys)
+    consts = jnp.asarray(MS_CONSTANTS[:depth])  # [d]
+    return mul_shift(mixed[None, :], consts[:, None], bits)
+
+
+def cms_update(cms: jnp.ndarray, keys: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
+    """Scatter-add ``weights`` for ``keys`` into every depth row."""
+    depth, width = cms.shape
+    buckets = cms_bucket(keys, width, depth)  # [d, B]
+    rows = jnp.arange(depth, dtype=_U32)[:, None]
+    flat_idx = (rows * _U32(width) + buckets).reshape(-1)
+    w = jnp.broadcast_to(weights.astype(_U32)[None, :], buckets.shape).reshape(-1)
+    return (
+        cms.reshape(-1).at[flat_idx].add(w, mode="drop").reshape(depth, width)
+    )
+
+
+def cms_query(cms: jnp.ndarray, keys: jnp.ndarray) -> jnp.ndarray:
+    """Point estimate per key: min over depth rows (device or host via numpy)."""
+    depth, width = cms.shape
+    buckets = cms_bucket(keys, width, depth)  # [d, B]
+    vals = jnp.take_along_axis(jnp.asarray(cms), buckets, axis=1)  # [d, B]
+    return jnp.min(vals, axis=0)
+
+
+def cms_query_np(cms: np.ndarray, keys: np.ndarray) -> np.ndarray:
+    """Pure-numpy query for host-side reporting (no device round trip)."""
+    depth, width = cms.shape
+    bits = int(width).bit_length() - 1
+    x = keys.astype(np.uint32)
+    x ^= x >> np.uint32(16)
+    x *= np.uint32(0x85EBCA6B)
+    x ^= x >> np.uint32(13)
+    x *= np.uint32(0xC2B2AE35)
+    x ^= x >> np.uint32(16)
+    out = None
+    for d in range(depth):
+        b = (x * MS_CONSTANTS[d]) >> np.uint32(32 - bits)
+        v = cms[d, b]
+        out = v if out is None else np.minimum(out, v)
+    return out

@@ -1,0 +1,35 @@
+"""Exact per-key hit counts — the reducer's sum, as a device scatter-add.
+
+The reference reducer (SURVEY.md §4.4) sums sorted ``key\\t1`` pairs.  On
+device this is one ``segment_sum`` of the valid mask over count keys.  To
+stay exact past 2**32 lines without enabling x64 (which would slow every
+uint32 op on TPU), totals are carried as a (lo, hi) uint32 pair with manual
+carry propagation — per-chunk deltas are < 2**32 by construction, so
+``carry = (new_lo < delta)`` detects wrap exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_U32 = jnp.uint32
+
+
+def segment_counts(keys: jnp.ndarray, weights: jnp.ndarray, n_keys: int) -> jnp.ndarray:
+    """[B] keys + [B] uint32 weights -> [n_keys] uint32 per-key sums."""
+    return jnp.zeros(n_keys, dtype=_U32).at[keys].add(
+        weights.astype(_U32), mode="drop"
+    )
+
+
+def add64(lo: jnp.ndarray, hi: jnp.ndarray, delta: jnp.ndarray):
+    """(lo, hi) uint32 pair += delta (uint32), exact 64-bit accumulation."""
+    new_lo = lo + delta
+    carry = (new_lo < delta).astype(_U32)
+    return new_lo, hi + carry
+
+
+def to_u64(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:
+    """Host-side: recombine the pair into numpy uint64."""
+    return np.asarray(hi, dtype=np.uint64) * np.uint64(1 << 32) + np.asarray(lo, dtype=np.uint64)

@@ -1,0 +1,64 @@
+"""uint32 hashing primitives for the device-side sketches.
+
+All sketch ops (CMS bucket choice, HLL register/rank, talker pair codes)
+need cheap, well-mixed uint32 hashes that vectorize on the TPU VPU.  We use
+the murmur3 finalizer (fmix32) seeded per use, and multiply-shift for
+power-of-two bucket ranges — both are a handful of integer ops per lane,
+wrap-around arithmetic being exactly what uint32 gives us under XLA.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+_U32 = jnp.uint32
+
+#: Odd multipliers for multiply-shift hashing, one per CMS depth row.
+#: Fixed (not seeded) so sketches from different runs/devices merge.
+MS_CONSTANTS = np.array(
+    [0x9E3779B1, 0x85EBCA77, 0xC2B2AE3D, 0x27D4EB2F, 0x165667B1, 0x9E3779B9, 0x85EBCA6B, 0xC2B2AE35],
+    dtype=np.uint32,
+)
+
+from ..config import MAX_CMS_DEPTH as _MAX_CMS_DEPTH  # noqa: E402
+
+assert len(MS_CONSTANTS) >= _MAX_CMS_DEPTH, "config.MAX_CMS_DEPTH exceeds hash constants"
+
+
+def fmix32(x: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """murmur3 finalizer: a full-avalanche uint32 -> uint32 mix."""
+    x = x.astype(_U32) ^ _U32(seed)
+    x = x ^ (x >> 16)
+    x = x * _U32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * _U32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def hash_pair(a: jnp.ndarray, b: jnp.ndarray, seed: int = 0) -> jnp.ndarray:
+    """Mix two uint32 streams into one (order-sensitive)."""
+    h = fmix32(a, seed=seed)
+    return fmix32(h ^ b.astype(_U32) * _U32(0x9E3779B1), seed=seed + 0x51ED)
+
+
+def mul_shift(x: jnp.ndarray, const: int | jnp.ndarray, bits: int) -> jnp.ndarray:
+    """Multiply-shift hash onto ``[0, 2**bits)`` — bucket index for sketches."""
+    return (x.astype(_U32) * _U32(const)) >> _U32(32 - bits)
+
+
+def clz32(x: jnp.ndarray) -> jnp.ndarray:
+    """Count leading zeros of uint32, branch-free (5-step binary search).
+
+    Exact integer computation — no float log tricks, which round near
+    powers of two and would bias HLL ranks.
+    """
+    x = x.astype(_U32)
+    n = jnp.full(x.shape, 32, dtype=_U32)
+    for shift in (16, 8, 4, 2, 1):
+        big = x >= (_U32(1) << _U32(shift))
+        n = jnp.where(big, n - _U32(shift), n)
+        x = jnp.where(big, x >> _U32(shift), x)
+    # here x is 0 or 1; subtract the final bit
+    return n - x

@@ -1,0 +1,72 @@
+"""Per-key HyperLogLog registers on device: unique sources per rule.
+
+BASELINE.json config #3: per-rule unique-source cardinality.  Exact per-rule
+source *sets* (the oracle's ``sources``) don't fit device memory at scale;
+HLL gives ~1.04/sqrt(m) relative error in m uint32 registers per key.
+
+Register file: ``[n_keys, m]`` uint32 (m = 2**p).  Update is one
+scatter-max per line: register index from p hash bits, rank = leading-zero
+count of an independent hash + 1.  Merge across chips is elementwise
+``max`` — a ``pmax`` over ICI; rank 0 (invalid lines) is the identity, so
+masking needs no branches.
+
+Estimation runs host-side in numpy at report time (standard HLL estimator
+with linear-counting small-range correction).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .hashing import clz32, fmix32
+
+_U32 = jnp.uint32
+
+_HLL_SEED_IDX = 0xB5297A4D
+_HLL_SEED_RANK = 0x68E31DA4
+
+
+def hll_init(n_keys: int, p: int) -> jnp.ndarray:
+    return jnp.zeros((n_keys, 1 << p), dtype=_U32)
+
+
+def hll_update(
+    hll: jnp.ndarray, keys: jnp.ndarray, values: jnp.ndarray, valid: jnp.ndarray
+) -> jnp.ndarray:
+    """Fold ``values`` (e.g. src IPs) into each line's key's registers."""
+    p = int(hll.shape[1]).bit_length() - 1
+    h_idx = fmix32(values, seed=_HLL_SEED_IDX)
+    h_rank = fmix32(values, seed=_HLL_SEED_RANK)
+    reg = h_idx >> _U32(32 - p)  # high p bits -> register index
+    rank = clz32(h_rank) + _U32(1)  # 1..33
+    rank = rank * valid.astype(_U32)  # invalid -> 0 == identity for max
+    return hll.at[keys, reg].max(rank, mode="drop")
+
+
+# ---------------------------------------------------------------------------
+# Host-side estimation (numpy), SURVEY.md §5 sketch-accuracy contract.
+# ---------------------------------------------------------------------------
+
+
+def _alpha(m: int) -> float:
+    if m == 16:
+        return 0.673
+    if m == 32:
+        return 0.697
+    if m == 64:
+        return 0.709
+    return 0.7213 / (1 + 1.079 / m)
+
+
+def hll_estimate_np(registers: np.ndarray) -> np.ndarray:
+    """[K, m] registers -> [K] cardinality estimates (float64, host)."""
+    reg = np.asarray(registers, dtype=np.float64)
+    k, m = reg.shape
+    raw = _alpha(m) * m * m / np.sum(np.exp2(-reg), axis=1)
+    zeros = np.sum(reg == 0, axis=1)
+    # linear counting when the raw estimate is small and registers remain empty
+    small = (raw <= 2.5 * m) & (zeros > 0)
+    with np.errstate(divide="ignore"):
+        linear = m * np.log(m / np.maximum(zeros, 1e-12))
+    return np.where(small, linear, raw)

@@ -1,0 +1,130 @@
+"""The first-match kernel: the reference mapper's inner loop, TPU-native.
+
+Reference semantics (SURVEY.md §4.3): for each log line, linearly scan the
+named ACL's expanded ACEs *in configuration order*; the first row whose
+five range predicates all hold wins; no row -> the ACL's implicit deny.
+
+TPU realisation: the per-line × per-rule double loop becomes one batched
+``[B, R]`` boolean predicate (pure uint32 compares on the VPU) reduced with
+``min`` over masked row indices — first match == smallest matching row
+index, because pack.py emits rows in global configuration order.  No
+data-dependent control flow; XLA fuses the compare/reduce into a tiled
+loop without materialising [B, R] in HBM.
+
+For large rule tensors the rule axis is processed in fixed-size blocks via
+``lax.scan`` (running-min carry), bounding VMEM pressure while keeping one
+compiled program for any R.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..hostside.pack import (
+    R_ACL,
+    R_DHI,
+    R_DLO,
+    R_DPHI,
+    R_DPLO,
+    R_PHI,
+    R_PLO,
+    R_SHI,
+    R_SLO,
+    R_SPHI,
+    R_SPLO,
+    R_KEY,
+)
+
+_U32 = jnp.uint32
+
+#: Rule-axis block size for the scan path: keeps each [B, RULE_BLOCK]
+#: predicate tile comfortably inside VMEM at B = 64k.
+RULE_BLOCK = 512
+
+
+def _block_min_row(cols: dict, rules: jnp.ndarray, base: jnp.ndarray) -> jnp.ndarray:
+    """Min matching global row index within one rule block; NO_MATCH if none."""
+    r = rules.astype(_U32)
+    # [B, 1] vs [1, Rb] broadcasts -> [B, Rb] predicate on the VPU
+    def col(i):
+        return r[:, i][None, :]
+
+    acl = cols["acl"][:, None]
+    proto = cols["proto"][:, None]
+    src = cols["src"][:, None]
+    sport = cols["sport"][:, None]
+    dst = cols["dst"][:, None]
+    dport = cols["dport"][:, None]
+    ok = (
+        (col(R_ACL) == acl)
+        & (col(R_PLO) <= proto)
+        & (proto <= col(R_PHI))
+        & (col(R_SLO) <= src)
+        & (src <= col(R_SHI))
+        & (col(R_SPLO) <= sport)
+        & (sport <= col(R_SPHI))
+        & (col(R_DLO) <= dst)
+        & (dst <= col(R_DHI))
+        & (col(R_DPLO) <= dport)
+        & (dport <= col(R_DPHI))
+    )
+    rb = rules.shape[0]
+    idx = base + lax.broadcasted_iota(_U32, (1, rb), 1)
+    return jnp.min(jnp.where(ok, idx, NO_MATCH), axis=1)
+
+
+NO_MATCH = _U32(0xFFFFFFFF)
+
+
+@functools.partial(jax.jit, static_argnames=("rule_block",))
+def first_match_rows(
+    cols: dict,
+    rules: jnp.ndarray,
+    rule_block: int = RULE_BLOCK,
+) -> jnp.ndarray:
+    """Global row index of the first matching ACE per line; NO_MATCH if none.
+
+    cols: dict of [B] uint32 arrays (acl, proto, src, sport, dst, dport).
+    rules: [R, RULE_COLS] uint32, R padded to a multiple of rule_block
+    (padding rows carry NO_ACL and never match).
+    """
+    r = rules.shape[0]
+    if r <= rule_block:
+        return _block_min_row(cols, rules, jnp.uint32(0))
+    assert r % rule_block == 0, "pad the rule tensor to a multiple of rule_block"
+    blocks = rules.reshape(r // rule_block, rule_block, rules.shape[1])
+
+    def body(best, xs):
+        block, base = xs
+        m = _block_min_row(cols, block, base)
+        return jnp.minimum(best, m), None
+
+    bases = (jnp.arange(r // rule_block, dtype=_U32) * _U32(rule_block))
+    init = jnp.full(cols["acl"].shape, NO_MATCH, dtype=_U32)
+    best, _ = lax.scan(body, init, (blocks, bases))
+    return best
+
+
+def match_keys(
+    cols: dict,
+    rules: jnp.ndarray,
+    deny_key: jnp.ndarray,
+    rule_block: int = RULE_BLOCK,
+) -> jnp.ndarray:
+    """Count-key per line: first-match rule key, or the line's ACL's
+    implicit-deny key when nothing matches.
+
+    Invalid lines (valid=0) still produce a (meaningless) key; every
+    consumer weights by ``cols["valid"]`` so they contribute nothing.
+    """
+    row = first_match_rows(cols, rules, rule_block)
+    matched = row != NO_MATCH
+    safe_row = jnp.where(matched, row, _U32(0))
+    rule_key = rules[:, R_KEY].astype(_U32)[safe_row]
+    acl = jnp.minimum(cols["acl"], _U32(deny_key.shape[0] - 1))
+    deny = deny_key.astype(_U32)[acl]
+    return jnp.where(matched, rule_key, deny)

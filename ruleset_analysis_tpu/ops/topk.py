@@ -1,0 +1,92 @@
+"""Streaming top-K talkers per ACL (BASELINE.json config #5).
+
+Space-Saving / Misra-Gries is inherently sequential (each update may evict
+the current minimum), so a literal port would serialize the TPU.  The
+TPU-native shape is the standard "sketch + candidate heap" decomposition:
+
+- device: a dedicated count-min sketch over (acl, src) pair hashes absorbs
+  every line (mergeable, psum-able like any CMS); per chunk, ``lax.top_k``
+  over the chunk's own CMS estimates surfaces the strongest candidates —
+  all batched, no data-dependent control flow;
+- host: a small :class:`TopKTracker` folds each chunk's candidates into a
+  bounded per-ACL summary (evict-min, keep-max-estimate), the cheap
+  sequential part that touches only ``k`` items per chunk.
+
+Heavy hitters by definition recur across chunks, so candidates they miss in
+one chunk they get in the next; the tracker's estimates come from the
+global CMS, not per-chunk counts.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .cms import cms_query, cms_update
+from .hashing import hash_pair
+
+_U32 = jnp.uint32
+
+
+def talker_chunk_update(
+    talk_cms: jnp.ndarray,
+    acl: jnp.ndarray,
+    src: jnp.ndarray,
+    valid: jnp.ndarray,
+    k: int,
+):
+    """Absorb one chunk; return (new_cms, cand_acl, cand_src, cand_est).
+
+    The candidate estimates are post-update global CMS estimates, masked to
+    0 for invalid lines so they can never displace real candidates.
+    """
+    pair = hash_pair(acl, src)
+    new_cms = cms_update(talk_cms, pair, valid)
+    est = cms_query(new_cms, pair) * valid.astype(_U32)
+    # Dedup within the chunk: a hot talker fills thousands of lines, and
+    # top_k over raw per-line scores would return k copies of it, crowding
+    # out ranks 2..k.  Keep only each pair's first occurrence (sort once,
+    # mark sorted-adjacent duplicates, scatter the mask back).
+    order = jnp.argsort(pair)
+    sorted_pair = pair[order]
+    first_sorted = jnp.concatenate(
+        [jnp.ones(1, dtype=jnp.bool_), sorted_pair[1:] != sorted_pair[:-1]]
+    )
+    first = jnp.zeros_like(first_sorted).at[order].set(first_sorted)
+    score = jnp.minimum(est * first.astype(_U32), _U32(0x7FFFFFFF)).astype(jnp.int32)
+    _, idx = lax.top_k(score, k)
+    return new_cms, acl[idx], src[idx], est[idx] * first[idx].astype(_U32)
+
+
+class TopKTracker:
+    """Host-side bounded per-ACL talker summary fed by chunk candidates."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self._tables: dict[int, dict[int, int]] = {}
+
+    def offer(self, acl: int, src: int, est: int) -> None:
+        if est <= 0:
+            return
+        t = self._tables.setdefault(acl, {})
+        if src in t:
+            t[src] = max(t[src], est)
+            return
+        if len(t) < self.capacity:
+            t[src] = est
+            return
+        victim = min(t, key=t.get)
+        if est > t[victim]:
+            del t[victim]
+            t[src] = est
+
+    def offer_chunk(self, cand_acl, cand_src, cand_est) -> None:
+        for a, s, e in zip(cand_acl.tolist(), cand_src.tolist(), cand_est.tolist()):
+            self.offer(int(a), int(s), int(e))
+
+    def top(self, acl: int, k: int) -> list[tuple[int, int]]:
+        t = self._tables.get(acl, {})
+        return sorted(t.items(), key=lambda kv: -kv[1])[:k]
+
+    def acls(self) -> list[int]:
+        return list(self._tables)

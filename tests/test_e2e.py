@@ -1,0 +1,105 @@
+"""End-to-end: full TPU-backend stream run vs the exact oracle (SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+from ruleset_analysis_tpu.hostside import aclparse, oracle, pack, synth
+from ruleset_analysis_tpu.runtime.stream import run_stream
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    cfg_text = synth.synth_config(n_acls=3, rules_per_acl=12, seed=21)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    tuples = synth.synth_tuples(packed, 3000, seed=21)
+    lines = synth.render_syslog(packed, tuples, seed=21)
+    res = oracle.Oracle([rs]).consume(list(lines))
+    return packed, rs, lines, res
+
+
+def run_tpu(packed, lines, **kw):
+    cfg = AnalysisConfig(
+        backend="tpu",
+        batch_size=512,
+        sketch=SketchConfig(cms_width=1 << 12, cms_depth=4, hll_p=8),
+        **kw,
+    )
+    return run_stream(packed, iter(lines), cfg, topk=5)
+
+
+def test_tpu_exact_counts_match_oracle(corpus):
+    packed, rs, lines, res = corpus
+    rep = run_tpu(packed, lines)
+    got = {
+        (e["firewall"], e["acl"], e["index"]): e["hits"]
+        for e in rep.per_rule
+        if e["hits"] > 0
+    }
+    exp = {k: v for k, v in res.hits.items()}
+    assert got == exp
+    assert rep.totals["lines_matched"] == res.lines_matched
+
+
+def test_tpu_unused_rules_match_oracle_exactly(corpus):
+    packed, rs, lines, res = corpus
+    rep = run_tpu(packed, lines)
+    exact_unused = res.unused_rules([rs])
+    assert rep.unused == exact_unused
+    assert oracle.unused_rule_recall(exact_unused, rep.unused) == 1.0
+
+
+def test_tpu_sketched_backend_recall(corpus):
+    """CMS-only counts (exact disabled) still recover the unused set (>=99%)."""
+    packed, rs, lines, res = corpus
+    rep = run_tpu(packed, lines, exact_counts=False)
+    exact_unused = res.unused_rules([rs])
+    recall = oracle.unused_rule_recall(exact_unused, rep.unused)
+    assert recall >= 0.99
+    # CMS is one-sided: rules with real hits can never be reported unused
+    # unless CMS says zero — impossible; so only over-counting can occur,
+    # shrinking (never growing) the unused set spuriously... verify direction:
+    assert set(rep.unused) <= set(exact_unused)
+
+
+def test_tpu_unique_sources_close_to_oracle(corpus):
+    packed, rs, lines, res = corpus
+    rep = run_tpu(packed, lines)
+    for e in rep.per_rule:
+        key = (e["firewall"], e["acl"], e["index"])
+        if key in res.sources and "unique_sources" in e:
+            true = len(res.sources[key])
+            est = e["unique_sources"]
+            if true >= 20:
+                assert abs(est - true) / true < 0.25, (key, true, est)
+
+
+def test_tpu_talkers_cover_oracle_heavy_hitters(corpus):
+    packed, rs, lines, res = corpus
+    rep = run_tpu(packed, lines)
+    from ruleset_analysis_tpu.hostside.aclparse import u32_to_ip
+
+    for (fw, acl), counter in res.talkers.items():
+        heavy = [ip for ip, c in counter.most_common(3) if c >= 50]
+        if not heavy:
+            continue
+        got = {ip for ip, _ in rep.talkers.get(f"{fw} {acl}", [])}
+        covered = sum(1 for ip in heavy if u32_to_ip(ip) in got)
+        assert covered >= len(heavy) - 1, (fw, acl, heavy, got)
+
+
+def test_batch_size_invariance(corpus):
+    """Chunking must not change exact results (mergeability/order-invariance)."""
+    packed, rs, lines, res = corpus
+    r1 = run_tpu(packed, lines)
+    cfg2 = AnalysisConfig(
+        backend="tpu", batch_size=257, sketch=SketchConfig(cms_width=1 << 12, cms_depth=4, hll_p=8)
+    )
+    r2 = run_stream(packed, iter(lines), cfg2, topk=5)
+    h1 = {(e["firewall"], e["acl"], e["index"]): e["hits"] for e in r1.per_rule}
+    h2 = {(e["firewall"], e["acl"], e["index"]): e["hits"] for e in r2.per_rule}
+    assert h1 == h2
+    assert r1.unused == r2.unused

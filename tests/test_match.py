@@ -1,0 +1,135 @@
+"""Device first-match kernel vs the exact oracle (golden semantics, SURVEY.md §5)."""
+
+import numpy as np
+import pytest
+
+from ruleset_analysis_tpu.hostside import aclparse, oracle, pack, synth
+from ruleset_analysis_tpu.ops import match as match_ops
+
+jnp = pytest.importorskip("jax.numpy")
+
+
+def cols_from_batch(batch_np):
+    b = jnp.asarray(np.ascontiguousarray(batch_np.T))
+    return (
+        {
+            "acl": b[pack.T_ACL],
+            "proto": b[pack.T_PROTO],
+            "src": b[pack.T_SRC],
+            "sport": b[pack.T_SPORT],
+            "dst": b[pack.T_DST],
+            "dport": b[pack.T_DPORT],
+        },
+        b[pack.T_VALID],
+    )
+
+
+CFG = """\
+hostname fw1
+access-list OUT extended permit tcp any host 10.0.0.5 eq 443
+access-list OUT extended permit tcp any host 10.0.0.5 eq 80
+access-list OUT extended deny tcp any 10.0.0.0 255.255.255.0
+access-list OUT extended permit ip any any
+access-list DMZ extended permit udp 10.9.0.0 255.255.0.0 any eq 53
+"""
+
+
+def make_packed(cfg=CFG):
+    rs = aclparse.parse_asa_config(cfg, "fw1")
+    return pack.pack_rulesets([rs]), rs
+
+
+def tuples(rows):
+    out = np.zeros((len(rows), pack.TUPLE_COLS), dtype=np.uint32)
+    for i, r in enumerate(rows):
+        out[i] = r
+    return out
+
+
+def test_first_match_golden():
+    packed, _ = make_packed()
+    gid = packed.acl_gid[("fw1", "OUT")]
+    ip = aclparse.ip_to_u32
+    batch = tuples(
+        [
+            (gid, 6, ip("1.2.3.4"), 999, ip("10.0.0.5"), 443, 1),  # rule 1
+            (gid, 6, ip("1.2.3.4"), 999, ip("10.0.0.5"), 80, 1),  # rule 2 (not 3)
+            (gid, 6, ip("1.2.3.4"), 999, ip("10.0.0.9"), 80, 1),  # rule 3 deny
+            (gid, 17, ip("9.9.9.9"), 53, ip("8.8.8.8"), 53, 1),  # rule 4 catch-all
+        ]
+    )
+    cols, _ = cols_from_batch(batch)
+    keys = match_ops.match_keys(cols, jnp.asarray(packed.rules), jnp.asarray(packed.deny_key))
+    got = [packed.key_meta[int(k)].index for k in np.asarray(keys)]
+    assert got == [1, 2, 3, 4]
+
+
+def test_implicit_deny_key():
+    packed, _ = make_packed()
+    gid = packed.acl_gid[("fw1", "DMZ")]
+    ip = aclparse.ip_to_u32
+    batch = tuples([(gid, 6, ip("1.1.1.1"), 1, ip("2.2.2.2"), 2, 1)])
+    cols, _ = cols_from_batch(batch)
+    keys = match_ops.match_keys(cols, jnp.asarray(packed.rules), jnp.asarray(packed.deny_key))
+    meta = packed.key_meta[int(keys[0])]
+    assert meta.implicit_deny and meta.acl == "DMZ"
+
+
+def test_acl_isolation():
+    """A line on ACL DMZ must not match OUT's rules even if ranges align."""
+    packed, _ = make_packed()
+    gid_dmz = packed.acl_gid[("fw1", "DMZ")]
+    ip = aclparse.ip_to_u32
+    # would hit OUT rule 4 (permit ip any any) if ACL weren't checked
+    batch = tuples([(gid_dmz, 6, ip("3.3.3.3"), 5, ip("4.4.4.4"), 6, 1)])
+    cols, _ = cols_from_batch(batch)
+    keys = match_ops.match_keys(cols, jnp.asarray(packed.rules), jnp.asarray(packed.deny_key))
+    assert packed.key_meta[int(keys[0])].implicit_deny
+
+
+@pytest.mark.parametrize("rule_block", [4, 512])
+def test_scan_path_equals_single_block(rule_block):
+    """Blocked rule-axis scan must equal the unblocked result (synthetic corpus)."""
+    cfg_text = synth.synth_config(n_acls=3, rules_per_acl=20, seed=11)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    batch_np = synth.synth_tuples(packed, 256, seed=11)
+    cols, _ = cols_from_batch(batch_np)
+    rules_padded = jnp.asarray(
+        np.ascontiguousarray(
+            __import__(
+                "ruleset_analysis_tpu.models.pipeline", fromlist=["pad_rules"]
+            ).pad_rules(packed.rules, rule_block)
+        )
+    )
+    deny = jnp.asarray(packed.deny_key)
+    a = match_ops.match_keys(cols, rules_padded, deny, rule_block)
+    b = match_ops.match_keys(cols, jnp.asarray(packed.rules), deny, packed.rules.shape[0] + 1)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_match_keys_agree_with_oracle_on_synthetic_corpus():
+    cfg_text = synth.synth_config(n_acls=4, rules_per_acl=24, seed=5)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    tuples_np = synth.synth_tuples(packed, 1000, seed=5)
+    lines = synth.render_syslog(packed, tuples_np, seed=5)
+
+    orc = oracle.Oracle([rs])
+    res = orc.consume(lines)
+
+    packer = pack.LinePacker(packed)
+    batch_np = packer.pack_lines(lines, batch_size=1024)
+    cols, valid = cols_from_batch(batch_np)
+    keys = match_ops.match_keys(cols, jnp.asarray(packed.rules), jnp.asarray(packed.deny_key))
+    keys_np = np.asarray(keys)
+    valid_np = np.asarray(valid)
+
+    from collections import Counter
+
+    got = Counter()
+    for k, v in zip(keys_np, valid_np):
+        if v:
+            m = packed.key_meta[int(k)]
+            got[(m.firewall, m.acl, m.index)] += 1
+    assert got == res.hits

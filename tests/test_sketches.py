@@ -1,0 +1,160 @@
+"""Sketch property tests (SURVEY.md §5): CMS one-sided error, HLL accuracy,
+clz exactness, 64-bit carry, merge laws (sum/max) that scale-out relies on."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp
+
+from ruleset_analysis_tpu.ops import cms as cms_ops
+from ruleset_analysis_tpu.ops import counts as count_ops
+from ruleset_analysis_tpu.ops import hashing
+from ruleset_analysis_tpu.ops import hll as hll_ops
+from ruleset_analysis_tpu.ops import topk as topk_ops
+
+
+def test_clz32_exact():
+    xs = np.array([0, 1, 2, 3, 4, 255, 256, 2**16 - 1, 2**16, 2**31, 2**32 - 1], dtype=np.uint32)
+    got = np.asarray(hashing.clz32(jnp.asarray(xs)))
+    exp = np.array([32 - int(x).bit_length() for x in xs], dtype=np.uint32)
+    np.testing.assert_array_equal(got, exp)
+
+
+def test_fmix32_avalanche():
+    """Flipping one input bit should flip ~half the output bits on average."""
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 2**32, size=2000, dtype=np.uint32)
+    h0 = np.asarray(hashing.fmix32(jnp.asarray(x)))
+    h1 = np.asarray(hashing.fmix32(jnp.asarray(x ^ np.uint32(1))))
+    flips = np.unpackbits((h0 ^ h1).view(np.uint8)).mean() * 32
+    assert 14 < flips < 18
+
+
+def test_add64_carry():
+    lo = jnp.asarray(np.array([0xFFFFFFFF, 0xFFFFFFFE, 5], dtype=np.uint32))
+    hi = jnp.asarray(np.array([0, 7, 1], dtype=np.uint32))
+    delta = jnp.asarray(np.array([1, 1, 0], dtype=np.uint32))
+    nlo, nhi = count_ops.add64(lo, hi, delta)
+    total = count_ops.to_u64(np.asarray(nlo), np.asarray(nhi))
+    np.testing.assert_array_equal(
+        total, np.array([1 << 32, (7 << 32) + 0xFFFFFFFF, (1 << 32) + 5], dtype=np.uint64)
+    )
+
+
+def _random_stream(n, n_keys, seed, zipf=1.3):
+    rng = np.random.default_rng(seed)
+    keys = rng.zipf(zipf, size=n).astype(np.uint32) % n_keys
+    return keys
+
+
+def test_cms_one_sided_and_bounded():
+    n, n_keys, width, depth = 20000, 500, 1 << 10, 4
+    keys = _random_stream(n, n_keys, seed=1)
+    true = np.bincount(keys, minlength=n_keys)
+
+    sk = cms_ops.cms_init(width, depth)
+    sk = cms_ops.cms_update(sk, jnp.asarray(keys), jnp.ones(n, dtype=jnp.uint32))
+    est = np.asarray(cms_ops.cms_query(sk, jnp.asarray(np.arange(n_keys, dtype=np.uint32))))
+
+    assert (est >= true).all(), "CMS must never underestimate"
+    # error <= e*N/width with prob 1-exp(-depth); allow 3x slack for a seeded test
+    bound = 3 * np.e * n / width
+    assert (est - true).max() <= bound
+    # numpy query path agrees with device query path
+    est_np = cms_ops.cms_query_np(np.asarray(sk), np.arange(n_keys, dtype=np.uint32))
+    np.testing.assert_array_equal(est, est_np)
+
+
+def test_cms_merge_is_sum():
+    keys = _random_stream(5000, 200, seed=2)
+    half = len(keys) // 2
+    ones = jnp.ones(half, dtype=jnp.uint32)
+    a = cms_ops.cms_update(cms_ops.cms_init(1 << 9, 3), jnp.asarray(keys[:half]), ones)
+    b = cms_ops.cms_update(cms_ops.cms_init(1 << 9, 3), jnp.asarray(keys[half:]), ones)
+    whole = cms_ops.cms_update(
+        cms_ops.cms_init(1 << 9, 3), jnp.asarray(keys), jnp.ones(len(keys), dtype=jnp.uint32)
+    )
+    np.testing.assert_array_equal(np.asarray(a) + np.asarray(b), np.asarray(whole))
+
+
+def test_cms_weights_respected():
+    sk = cms_ops.cms_init(1 << 8, 2)
+    keys = jnp.asarray(np.array([7, 7, 9], dtype=np.uint32))
+    w = jnp.asarray(np.array([1, 0, 1], dtype=np.uint32))  # middle line invalid
+    sk = cms_ops.cms_update(sk, keys, w)
+    est = np.asarray(cms_ops.cms_query(sk, jnp.asarray(np.array([7, 9], dtype=np.uint32))))
+    assert est[0] == 1 and est[1] == 1
+
+
+def test_hll_accuracy():
+    n_keys, p = 8, 10  # m=1024 -> ~3.2% typical error
+    true_cards = [1, 10, 100, 1000, 5000, 20000, 0, 3]
+    keys_list, vals_list = [], []
+    rng = np.random.default_rng(3)
+    for k, c in enumerate(true_cards):
+        if c == 0:
+            continue
+        vals = rng.choice(2**32, size=c, replace=False).astype(np.uint32)
+        # each unique value appears 1-3 times
+        reps = rng.integers(1, 4, size=c)
+        keys_list.append(np.full(int(reps.sum()), k, dtype=np.uint32))
+        vals_list.append(np.repeat(vals, reps))
+    keys = np.concatenate(keys_list)
+    vals = np.concatenate(vals_list)
+    perm = rng.permutation(len(keys))
+    keys, vals = keys[perm], vals[perm]
+
+    hll = hll_ops.hll_init(n_keys, p)
+    hll = hll_ops.hll_update(
+        hll, jnp.asarray(keys), jnp.asarray(vals), jnp.ones(len(keys), dtype=jnp.uint32)
+    )
+    est = hll_ops.hll_estimate_np(np.asarray(hll))
+    for k, c in enumerate(true_cards):
+        if c == 0:
+            assert est[k] == 0
+        else:
+            assert abs(est[k] - c) / c < 0.15, (k, c, est[k])
+
+
+def test_hll_merge_is_max_and_order_invariant():
+    rng = np.random.default_rng(4)
+    vals = rng.integers(0, 2**32, size=4000, dtype=np.uint32)
+    keys = rng.integers(0, 4, size=4000).astype(np.uint32)
+    ones = jnp.ones(2000, dtype=jnp.uint32)
+    a = hll_ops.hll_update(hll_ops.hll_init(4, 6), jnp.asarray(keys[:2000]), jnp.asarray(vals[:2000]), ones)
+    b = hll_ops.hll_update(hll_ops.hll_init(4, 6), jnp.asarray(keys[2000:]), jnp.asarray(vals[2000:]), ones)
+    whole = hll_ops.hll_update(
+        hll_ops.hll_init(4, 6), jnp.asarray(keys), jnp.asarray(vals), jnp.ones(4000, dtype=jnp.uint32)
+    )
+    np.testing.assert_array_equal(np.maximum(np.asarray(a), np.asarray(b)), np.asarray(whole))
+
+
+def test_hll_invalid_lines_are_identity():
+    hll0 = hll_ops.hll_init(2, 6)
+    keys = jnp.asarray(np.array([0, 1], dtype=np.uint32))
+    vals = jnp.asarray(np.array([123, 456], dtype=np.uint32))
+    out = hll_ops.hll_update(hll0, keys, vals, jnp.zeros(2, dtype=jnp.uint32))
+    assert (np.asarray(out) == 0).all()
+
+
+def test_topk_tracker_finds_heavy_hitters():
+    rng = np.random.default_rng(5)
+    # skewed stream over one ACL: talker i has weight ~ 1/i
+    n = 30000
+    srcs = rng.zipf(1.5, size=n).astype(np.uint32) % 1000
+    acls = np.zeros(n, dtype=np.uint32)
+    true = np.bincount(srcs, minlength=1000)
+    true_top = set(np.argsort(true)[-5:])
+
+    sk = cms_ops.cms_init(1 << 12, 4)
+    tracker = topk_ops.TopKTracker(capacity=64)
+    for i in range(0, n, 4096):
+        a = jnp.asarray(acls[i : i + 4096])
+        s = jnp.asarray(srcs[i : i + 4096])
+        v = jnp.ones(a.shape[0], dtype=jnp.uint32)
+        sk, ca, cs, ce = topk_ops.talker_chunk_update(sk, a, s, v, 32)
+        tracker.offer_chunk(np.asarray(ca), np.asarray(cs), np.asarray(ce))
+
+    got_top = {src for src, _ in tracker.top(0, 5)}
+    assert len(true_top & got_top) >= 4  # at least 4/5 of true heavy hitters
