@@ -1,0 +1,102 @@
+"""Headline benchmark: ASA syslog lines/sec/chip through the device pipeline.
+
+Measures the steady-state fused analysis step (first-match + exact counts +
+CMS + HLL + top-K candidates) on pre-packed batches resident in HBM, with
+state donation — the device half of the BASELINE.json headline metric
+("ASA syslog lines/sec/chip").  The north star is 1e9 lines/min on a
+v5e-8, i.e. ~2.083e6 lines/sec/chip: vs_baseline is measured against that
+per-chip target (the reference itself publishes no numbers — BASELINE.md).
+
+Prints exactly ONE JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+    from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+    from ruleset_analysis_tpu.models import pipeline
+    from ruleset_analysis_tpu.parallel import mesh as mesh_lib
+    from ruleset_analysis_tpu.parallel.step import make_parallel_step
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    log(f"devices: {devices}")
+
+    # BASELINE.json config #1 geometry: one realistic ruleset
+    cfg_text = synth.synth_config(n_acls=4, rules_per_acl=64, seed=0)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    log(f"ruleset: {packed.n_rules} rules, {packed.rules.shape[0]} expanded rows")
+
+    per_chip_batch = 1 << 20
+    batch_size = per_chip_batch * n_dev
+    cfg = AnalysisConfig(
+        batch_size=batch_size,
+        sketch=SketchConfig(cms_width=1 << 14, cms_depth=4, hll_p=8),
+    )
+
+    mesh = mesh_lib.make_mesh(devices)
+    step = make_parallel_step(mesh, cfg, packed.n_keys)
+    rules = pipeline.ship_ruleset(packed)
+    state = pipeline.init_state(packed.n_keys, cfg)
+
+    n_feed = 4
+    feeds = []
+    for i in range(n_feed):
+        b = np.ascontiguousarray(synth.synth_tuples(packed, batch_size, seed=i).T)
+        feeds.append(mesh_lib.shard_batch(mesh, b))
+    log(f"batch: {batch_size} lines x {n_feed} resident feed buffers")
+
+    # warmup (compile + first runs)
+    t0 = time.perf_counter()
+    for i in range(3):
+        state, out = step(state, rules, feeds[i % n_feed])
+    jax.block_until_ready(state)
+    log(f"warmup+compile: {time.perf_counter() - t0:.1f}s")
+
+    iters = 20
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, out = step(state, rules, feeds[i % n_feed])
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    lines_per_sec = iters * batch_size / dt
+    per_chip = lines_per_sec / n_dev
+    north_star_per_chip = 1e9 / 60.0 / 8.0
+    result = {
+        "metric": "asa_syslog_lines_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "lines/sec/chip",
+        "vs_baseline": round(per_chip / north_star_per_chip, 4),
+        "detail": {
+            "devices": n_dev,
+            "total_lines_per_sec": round(lines_per_sec, 1),
+            "batch_size": batch_size,
+            "iters": iters,
+            "rules": int(packed.n_rules),
+            "expanded_rows": int(packed.rules.shape[0]),
+            "elapsed_sec": round(dt, 3),
+        },
+    }
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -84,9 +84,11 @@ class AnalysisConfig:
     sketch: SketchConfig = dataclasses.field(default_factory=SketchConfig)
     exact_counts: bool = True  # keep the exact per-rule bincount alongside sketches
     mesh_axis: str = "data"
-    checkpoint_every_chunks: int = 0  # 0 = no checkpointing
-    checkpoint_dir: str = os.path.join(OUTPUT_DIR, "ckpt")
     seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {self.batch_size}")
 
     def replace(self, **kw) -> "AnalysisConfig":
         return dataclasses.replace(self, **kw)

@@ -130,19 +130,6 @@ def analysis_step(
     )
 
 
-def make_step(cfg: AnalysisConfig, n_keys: int, rule_block: int = RULE_BLOCK):
-    """Jitted single-device step with state donation (register files are
-    updated in place in HBM across chunks)."""
-    fn = functools.partial(
-        analysis_step,
-        n_keys=n_keys,
-        topk_k=cfg.sketch.topk_chunk_candidates,
-        exact_counts=cfg.exact_counts,
-        rule_block=rule_block,
-    )
-    return jax.jit(fn, donate_argnums=(0,))
-
-
 # ---------------------------------------------------------------------------
 # Finalize: device registers -> report-shaped host results.
 # ---------------------------------------------------------------------------

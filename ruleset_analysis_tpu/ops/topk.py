@@ -42,11 +42,20 @@ def talker_chunk_update(
     """
     pair = hash_pair(acl, src)
     new_cms = cms_update(talk_cms, pair, valid)
-    est = cms_query(new_cms, pair) * valid.astype(_U32)
-    # Dedup within the chunk: a hot talker fills thousands of lines, and
-    # top_k over raw per-line scores would return k copies of it, crowding
-    # out ranks 2..k.  Keep only each pair's first occurrence (sort once,
-    # mark sorted-adjacent duplicates, scatter the mask back).
+    cand = select_candidates(new_cms, acl, src, valid, min(k, acl.shape[0]))
+    return (new_cms, *cand)
+
+
+def select_candidates(talk_cms, acl, src, valid, k):
+    """Top-k distinct (acl, src) candidates of this batch by CMS estimate.
+
+    Dedup within the chunk first: a hot talker fills thousands of lines,
+    and top_k over raw per-line scores would return k copies of it,
+    crowding out ranks 2..k.  Keep only each pair's first occurrence
+    (sort once, mark sorted-adjacent duplicates, scatter the mask back).
+    """
+    pair = hash_pair(acl, src)
+    est = cms_query(talk_cms, pair) * valid.astype(_U32)
     order = jnp.argsort(pair)
     sorted_pair = pair[order]
     first_sorted = jnp.concatenate(
@@ -55,7 +64,7 @@ def talker_chunk_update(
     first = jnp.zeros_like(first_sorted).at[order].set(first_sorted)
     score = jnp.minimum(est * first.astype(_U32), _U32(0x7FFFFFFF)).astype(jnp.int32)
     _, idx = lax.top_k(score, k)
-    return new_cms, acl[idx], src[idx], est[idx] * first[idx].astype(_U32)
+    return acl[idx], src[idx], est[idx] * first[idx].astype(_U32)
 
 
 class TopKTracker:

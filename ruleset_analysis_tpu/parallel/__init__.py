@@ -1,0 +1,9 @@
+"""Scale-out: device meshes, shard_map'd steps, XLA collectives over ICI/DCN.
+
+The reference's entire distributed substrate is the Hadoop shuffle —
+mappers spill partitioned key/count pairs, reducers pull and merge-sort
+(SURVEY.md §3c).  Here the same dataflow is two XLA collectives on
+mergeable registers: ``psum`` for additive state (exact counts, CMS),
+``pmax`` for HLL registers — riding ICI within a pod and the DCN mesh axis
+across hosts, with no serialization, sorting, or disk in between.
+"""

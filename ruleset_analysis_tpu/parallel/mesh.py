@@ -1,0 +1,39 @@
+"""Mesh construction and batch sharding helpers.
+
+One logical axis, ``data``: log lines are independent records (SURVEY.md
+§3b — data parallelism is the reference's single strategy), so the batch
+axis shards across every chip and all state stays replicated.  The code is
+mesh-generic: the same program runs on 1 chip, a v5e-8's 8 chips, or a
+multi-host DCN×ICI mesh (see distributed.py) without modification.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(devices: list | None = None, axis: str = "data") -> Mesh:
+    devs = devices if devices is not None else jax.devices()
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Column-major [TUPLE_COLS, B] batches shard along B."""
+    return NamedSharding(mesh, P(None, axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch_np: np.ndarray, axis: str = "data") -> jax.Array:
+    """Host [TUPLE_COLS, B] -> device array sharded over the data axis."""
+    return jax.device_put(batch_np, batch_sharding(mesh, axis))
+
+
+def pad_batch_size(batch_size: int, mesh: Mesh, axis: str = "data") -> int:
+    """Round batch_size up to a multiple of the data-axis size."""
+    n = mesh.shape[axis]
+    return ((batch_size + n - 1) // n) * n

@@ -1,0 +1,126 @@
+"""The data-parallel analysis step: shard_map + explicit ICI collectives.
+
+Per chunk, each device runs the single-device hot path on its batch shard
+and produces *delta* registers from zero; the deltas then merge with one
+collective each — ``psum`` for counts/CMS (addition is the merge law),
+``pmax`` for HLL (max is the merge law) — and fold into the replicated
+state.  This is the exact seam BASELINE.json's north star names: the
+Hadoop shuffle/sort/merge replaced by two XLA collectives over ICI.
+
+Integer adds are associative and commutative, so the merged state is
+bit-identical to a single-device run over the concatenated batch — the
+property tests/test_parallel.py asserts (SURVEY.md §5 "multi-node without
+a cluster"), and what makes resume-by-re-merge idempotent.
+
+shard_map (not GSPMD auto-sharding) because the collective placement here
+is the design: scatter locally into small replicated registers, reduce the
+registers — never all-gather the (huge) batch.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..config import AnalysisConfig
+from ..hostside.pack import T_ACL, T_DPORT, T_DST, T_PROTO, T_SPORT, T_SRC, T_VALID
+from ..models.pipeline import AnalysisState, ChunkOut, DeviceRuleset
+from ..ops import cms as cms_ops
+from ..ops import counts as count_ops
+from ..ops import hll as hll_ops
+from ..ops import topk as topk_ops
+from ..ops.match import RULE_BLOCK, match_keys
+
+_U32 = jnp.uint32
+
+
+def _local_shard_step(
+    state: AnalysisState,
+    ruleset: DeviceRuleset,
+    batch: jax.Array,  # [TUPLE_COLS, B/n] local shard
+    *,
+    axis: str,
+    n_keys: int,
+    topk_k: int,
+    exact_counts: bool,
+    rule_block: int,
+) -> tuple[AnalysisState, ChunkOut]:
+    cols = {
+        "acl": batch[T_ACL],
+        "proto": batch[T_PROTO],
+        "src": batch[T_SRC],
+        "sport": batch[T_SPORT],
+        "dst": batch[T_DST],
+        "dport": batch[T_DPORT],
+    }
+    valid = batch[T_VALID]
+    keys = match_keys(cols, ruleset.rules, ruleset.deny_key, rule_block)
+
+    if exact_counts:
+        delta = count_ops.segment_counts(keys, valid, n_keys)
+        delta = lax.psum(delta, axis)
+        lo, hi = count_ops.add64(state.counts_lo, state.counts_hi, delta)
+    else:
+        lo, hi = state.counts_lo, state.counts_hi
+
+    d, w = state.cms.shape
+    delta_cms = cms_ops.cms_update(jnp.zeros((d, w), _U32), keys, valid)
+    cms = state.cms + lax.psum(delta_cms, axis)
+
+    delta_hll = hll_ops.hll_update(
+        jnp.zeros_like(state.hll), keys, cols["src"], valid
+    )
+    hll = jnp.maximum(state.hll, lax.pmax(delta_hll, axis))
+
+    dt, wt = state.talk_cms.shape
+    delta_talk = cms_ops.cms_update(
+        jnp.zeros((dt, wt), _U32), topk_ops.hash_pair(cols["acl"], cols["src"]), valid
+    )
+    talk_cms = state.talk_cms + lax.psum(delta_talk, axis)
+    # candidate selection against the *merged* global talker sketch, then
+    # gather every device's candidates so the host sees them all, replicated
+    ca, cs, ce = topk_ops.select_candidates(
+        talk_cms, cols["acl"], cols["src"], valid, min(topk_k, valid.shape[0])
+    )
+    cand_acl = lax.all_gather(ca, axis, tiled=True)
+    cand_src = lax.all_gather(cs, axis, tiled=True)
+    cand_est = lax.all_gather(ce, axis, tiled=True)
+
+    return (
+        AnalysisState(counts_lo=lo, counts_hi=hi, cms=cms, hll=hll, talk_cms=talk_cms),
+        ChunkOut(cand_acl=cand_acl, cand_src=cand_src, cand_est=cand_est),
+    )
+
+
+def make_parallel_step(
+    mesh: Mesh,
+    cfg: AnalysisConfig,
+    n_keys: int,
+    rule_block: int = RULE_BLOCK,
+):
+    """Build the jitted data-parallel step for `mesh`.
+
+    state/ruleset replicated, batch sharded on the data axis; the returned
+    state and candidates are replicated (identical on every device).
+    """
+    axis = cfg.mesh_axis
+    local = functools.partial(
+        _local_shard_step,
+        axis=axis,
+        n_keys=n_keys,
+        topk_k=cfg.sketch.topk_chunk_candidates,
+        exact_counts=cfg.exact_counts,
+        rule_block=rule_block,
+    )
+    sharded = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(None, axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(sharded, donate_argnums=(0,))

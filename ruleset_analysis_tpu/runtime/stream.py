@@ -8,17 +8,17 @@ the jitted analysis step.
 
 Overlap comes from JAX's async dispatch: ``step`` returns immediately with
 futures, so host parsing of chunk N+1 runs while the device crunches chunk
-N.  Top-K candidates are kept as device arrays and drained once at the end
-(or at checkpoint boundaries) to avoid per-chunk synchronisation.
+N.  Top-K candidates drain through a short lag queue so fetching them
+never synchronises the host with the in-flight chunk.
 """
 
 from __future__ import annotations
 
 import time
+from collections import deque
 from collections.abc import Iterable, Iterator
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..config import AnalysisConfig
@@ -44,33 +44,54 @@ def run_stream(
     cfg: AnalysisConfig,
     *,
     topk: int = 10,
+    mesh=None,
 ):
-    """Run the full analysis over a stream of raw syslog lines; return Report."""
+    """Run the full analysis over a stream of raw syslog lines; return Report.
+
+    With a multi-device mesh (or by default when several devices are
+    visible), the batch shards over the data axis and registers merge via
+    ICI collectives; on one device this degenerates to the single-chip
+    step.  Results are bit-identical either way (mergeable registers).
+    """
+    from ..parallel import mesh as mesh_lib
+    from ..parallel.step import make_parallel_step
+
+    if mesh is None:
+        mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
+    batch_size = mesh_lib.pad_batch_size(cfg.batch_size, mesh, cfg.mesh_axis)
+
     dev_rules = pipeline.ship_ruleset(packed)
     state = pipeline.init_state(packed.n_keys, cfg)
-    step = pipeline.make_step(cfg, packed.n_keys)
+    step = make_parallel_step(mesh, cfg, packed.n_keys)
     packer = LinePacker(packed)
     tracker = TopKTracker(cfg.sketch.topk_capacity)
 
-    chunk_outs: list[pipeline.ChunkOut] = []
+    def drain(out: pipeline.ChunkOut) -> None:
+        tracker.offer_chunk(
+            np.asarray(out.cand_acl), np.asarray(out.cand_src), np.asarray(out.cand_est)
+        )
+
+    # Candidates drain with a 2-chunk lag: by the time chunk N-2's arrays
+    # are fetched, their compute is long done, so the host never stalls on
+    # the device — and memory stays O(1) chunks instead of O(n_chunks).
+    pending: deque[pipeline.ChunkOut] = deque()
     n_chunks = 0
     t0 = time.perf_counter()
-    for chunk in chunked(lines, cfg.batch_size):
+    for chunk in chunked(lines, batch_size):
         batch_np = np.ascontiguousarray(
-            packer.pack_lines(chunk, batch_size=cfg.batch_size).T
+            packer.pack_lines(chunk, batch_size=batch_size).T
         )
-        batch = jnp.asarray(batch_np)
+        batch = mesh_lib.shard_batch(mesh, batch_np, cfg.mesh_axis)
         state, out = step(state, dev_rules, batch)
-        chunk_outs.append(out)
+        pending.append(out)
+        if len(pending) > 2:
+            drain(pending.popleft())
         n_chunks += 1
 
     jax.block_until_ready(state)
     elapsed = time.perf_counter() - t0
-
-    for out in chunk_outs:
-        tracker.offer_chunk(
-            np.asarray(out.cand_acl), np.asarray(out.cand_src), np.asarray(out.cand_est)
-        )
+    while pending:
+        drain(pending.popleft())
 
     lines_total = packer.parsed + packer.skipped
     totals = {
