@@ -19,6 +19,7 @@ from __future__ import annotations
 import argparse
 import sys
 
+from . import errors
 from .config import AnalysisConfig, SketchConfig
 from .hostside import aclparse, oracle, pack, synth
 from .runtime import report as report_mod
@@ -63,6 +64,10 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 cms_depth=args.cms_depth,
                 hll_p=args.hll_p,
             ),
+            checkpoint_every_chunks=args.checkpoint_every,
+            resume=args.resume,
+            report_every_chunks=args.report_every,
+            **({"checkpoint_dir": args.checkpoint_dir} if args.checkpoint_dir else {}),
         )
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
@@ -100,7 +105,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
         except ImportError as e:
             print(f"error: tpu backend unavailable ({e})", file=sys.stderr)
             return 1
-        rep = run_stream(packed, lines, cfg, topk=args.topk)
+        rep = run_stream(packed, lines, cfg, topk=args.topk, profile_dir=args.profile_dir)
     else:
         print(f"unknown backend {args.backend!r}", file=sys.stderr)
         return 2
@@ -155,6 +160,16 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--cms-depth", type=int, default=4)
     p.add_argument("--hll-p", type=int, default=8)
     p.add_argument("--topk", type=int, default=10)
+    p.add_argument("--checkpoint-every", type=int, default=0, metavar="CHUNKS",
+                   help="snapshot (offset, registers) every N chunks")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="default: $RA_OUTPUT_DIR/ckpt (see config.py)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from --checkpoint-dir if a snapshot exists")
+    p.add_argument("--report-every", type=int, default=0, metavar="CHUNKS",
+                   help="print throughput to stderr every N chunks")
+    p.add_argument("--profile-dir", default=None,
+                   help="write a jax.profiler trace here (TensorBoard profile)")
     p.add_argument("--json", action="store_true")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_run)
@@ -175,6 +190,9 @@ def main(argv: list[str] | None = None) -> int:
     try:
         return args.fn(args)
     except aclparse.AclParseError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except errors.AnalysisError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
     except FileNotFoundError as e:

@@ -84,11 +84,17 @@ class AnalysisConfig:
     sketch: SketchConfig = dataclasses.field(default_factory=SketchConfig)
     exact_counts: bool = True  # keep the exact per-rule bincount alongside sketches
     mesh_axis: str = "data"
+    checkpoint_every_chunks: int = 0  # 0 = no checkpointing
+    checkpoint_dir: str = os.path.join(OUTPUT_DIR, "ckpt")
+    resume: bool = False  # resume from checkpoint_dir if a snapshot exists
+    report_every_chunks: int = 0  # 0 = no periodic throughput lines on stderr
     seed: int = 0
 
     def __post_init__(self) -> None:
         if self.batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {self.batch_size}")
+        if self.checkpoint_every_chunks < 0:
+            raise ValueError("checkpoint_every_chunks must be >= 0")
 
     def replace(self, **kw) -> "AnalysisConfig":
         return dataclasses.replace(self, **kw)

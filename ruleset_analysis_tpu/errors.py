@@ -1,0 +1,13 @@
+"""Framework error types (jax-free so the CLI can import them cheaply)."""
+
+
+class AnalysisError(RuntimeError):
+    """Base class for user-facing runtime errors."""
+
+
+class CheckpointMismatch(AnalysisError):
+    """Snapshot belongs to a different ruleset or sketch geometry."""
+
+
+class ResumeInputMismatch(AnalysisError):
+    """Input stream is shorter than the snapshot's consumed-line offset."""

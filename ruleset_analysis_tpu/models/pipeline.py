@@ -17,7 +17,6 @@ contiguous lane-aligned vector on device.
 
 from __future__ import annotations
 
-import functools
 from typing import NamedTuple
 
 import jax

@@ -99,3 +99,7 @@ class TopKTracker:
 
     def acls(self) -> list[int]:
         return list(self._tables)
+
+    def tables(self) -> dict[int, dict[int, int]]:
+        """Snapshot-serializable view of the per-ACL summaries."""
+        return {acl: dict(t) for acl, t in self._tables.items()}

@@ -58,9 +58,17 @@ def local_batch_slice(global_batch_size: int) -> tuple[int, int]:
 
     The streaming driver on each host parses only its own slice of the
     input (the analog of HDFS input splits), then forms the global sharded
-    array with jax.make_array_from_process_local_data.
+    array with jax.make_array_from_process_local_data.  Uniform sharding
+    requires equal per-process slices, so the global batch size must
+    divide evenly (pad_batch_size over the global mesh guarantees a
+    device-count multiple; device counts are equal per host on TPU pods).
     """
     n = jax.process_count()
+    if global_batch_size % n:
+        raise ValueError(
+            f"global batch size {global_batch_size} not divisible by "
+            f"{n} processes; round it with parallel.mesh.pad_batch_size"
+        )
     i = jax.process_index()
     per = global_batch_size // n
-    return i * per, (i + 1) * per if i < n - 1 else global_batch_size
+    return i * per, (i + 1) * per

@@ -1,0 +1,151 @@
+"""Checkpoint/resume: (stream offset, register files) snapshots.
+
+The reference has no checkpointing — a failed Hadoop job reruns from
+scratch, with YARN re-executing failed tasks (SURVEY.md §6).  The rebuild
+does better with almost no machinery *because the state is mergeable*:
+a snapshot is the exact analysis of lines ``[0, offset)``, so resume =
+load registers + skip ``offset`` raw lines + keep streaming.  No replay
+log, no partial-output reconciliation; killing a run between (or during)
+chunks and resuming yields bit-identical final registers.
+
+Format: a versioned snapshot directory (``snap-<n>/`` holding the register
+``.npz`` plus a ``.json`` manifest with offset, chunk count, packer
+counters, top-K tracker tables, and a config/ruleset fingerprint that
+refuses resumes against a different ruleset or sketch geometry), published
+by atomically renaming a ``LATEST`` pointer file.  A crash at ANY point of
+a save — including between writing the snapshot files — leaves the
+previous pointer (and therefore a consistent offset/register pair) intact;
+superseded snapshot dirs are pruned only after the pointer moves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import tempfile
+
+import numpy as np
+
+from ..config import AnalysisConfig
+from ..errors import CheckpointMismatch  # re-export: raised on foreign snapshots
+from ..hostside.pack import PackedRuleset
+from ..ops.topk import TopKTracker
+
+__all__ = [
+    "CheckpointMismatch",
+    "Snapshot",
+    "fingerprint",
+    "load",
+    "restore_tracker",
+    "save",
+]
+
+STATE_FILE = "state.npz"
+MANIFEST_FILE = "manifest.json"
+POINTER_FILE = "LATEST"
+
+
+def fingerprint(packed: PackedRuleset, cfg: AnalysisConfig) -> str:
+    """Identity of (ruleset, sketch geometry) a snapshot is valid for."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(packed.rules).tobytes())
+    h.update(np.ascontiguousarray(packed.deny_key).tobytes())
+    s = cfg.sketch
+    h.update(f"{s.cms_width},{s.cms_depth},{s.hll_p},{cfg.exact_counts}".encode())
+    return h.hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class Snapshot:
+    """Host-side image of one checkpoint."""
+
+    arrays: dict[str, np.ndarray]  # AnalysisState fields
+    lines_consumed: int  # raw lines taken from the input iterator
+    n_chunks: int
+    parsed: int
+    skipped: int
+    tracker_tables: dict[int, dict[int, int]]
+    fingerprint: str
+
+
+def save(ckpt_dir: str, snap: Snapshot) -> None:
+    os.makedirs(ckpt_dir, exist_ok=True)
+    snap_name = f"snap-{snap.n_chunks}"
+    tmp_dir = tempfile.mkdtemp(dir=ckpt_dir, prefix=".tmp-")
+    with open(os.path.join(tmp_dir, STATE_FILE), "wb") as f:
+        np.savez(f, **snap.arrays)
+    manifest = {
+        "lines_consumed": snap.lines_consumed,
+        "n_chunks": snap.n_chunks,
+        "parsed": snap.parsed,
+        "skipped": snap.skipped,
+        "fingerprint": snap.fingerprint,
+        "tracker": [
+            [acl, list(table.items())] for acl, table in snap.tracker_tables.items()
+        ],
+    }
+    with open(os.path.join(tmp_dir, MANIFEST_FILE), "w", encoding="utf-8") as f:
+        json.dump(manifest, f)
+    snap_dir = os.path.join(ckpt_dir, snap_name)
+    if os.path.exists(snap_dir):  # same-chunk re-save (idempotent)
+        _rmtree(snap_dir)
+    os.replace(tmp_dir, snap_dir)
+    # publish: the pointer rename is the commit point
+    fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".ptr.tmp")
+    with os.fdopen(fd, "w") as f:
+        f.write(snap_name)
+    prev = _read_pointer(ckpt_dir)
+    os.replace(tmp, os.path.join(ckpt_dir, POINTER_FILE))
+    # prune superseded snapshots only after the new pointer is durable
+    if prev and prev != snap_name:
+        _rmtree(os.path.join(ckpt_dir, prev))
+
+
+def _read_pointer(ckpt_dir: str) -> str | None:
+    try:
+        with open(os.path.join(ckpt_dir, POINTER_FILE), "r", encoding="utf-8") as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def _rmtree(path: str) -> None:
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def load(ckpt_dir: str) -> Snapshot | None:
+    name = _read_pointer(ckpt_dir)
+    if not name:
+        return None
+    snap_dir = os.path.join(ckpt_dir, name)
+    state_path = os.path.join(snap_dir, STATE_FILE)
+    manifest_path = os.path.join(snap_dir, MANIFEST_FILE)
+    if not (os.path.exists(state_path) and os.path.exists(manifest_path)):
+        return None
+    with open(manifest_path, "r", encoding="utf-8") as f:
+        m = json.load(f)
+    z = np.load(state_path)
+    return Snapshot(
+        arrays={k: z[k] for k in z.files},
+        lines_consumed=int(m["lines_consumed"]),
+        n_chunks=int(m["n_chunks"]),
+        parsed=int(m["parsed"]),
+        skipped=int(m["skipped"]),
+        tracker_tables={
+            int(acl): {int(k): int(v) for k, v in items}
+            for acl, items in m["tracker"]
+        },
+        fingerprint=m["fingerprint"],
+    )
+
+
+def restore_tracker(snap: Snapshot, capacity: int) -> TopKTracker:
+    t = TopKTracker(capacity)
+    for acl, table in snap.tracker_tables.items():
+        for src, est in table.items():
+            t.offer(acl, src, est)
+    return t

@@ -1,0 +1,64 @@
+"""Observability: throughput metering and optional device profiling.
+
+The reference's only visibility is Hadoop's job counters and stdout
+(SURVEY.md §6).  Here: a periodic stderr throughput line (lines/sec,
+instantaneous and cumulative) and an opt-in ``jax.profiler`` trace whose
+output loads in TensorBoard's profile plugin for per-op device timing.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+class ThroughputMeter:
+    """Periodic lines/sec reporting without per-chunk host/device syncs."""
+
+    def __init__(self, report_every_chunks: int = 0, out=sys.stderr):
+        self.every = report_every_chunks
+        self.out = out
+        self.t0 = time.perf_counter()
+        self.t_last = self.t0
+        self.lines = 0
+        self.lines_last = 0
+        self.chunks = 0
+
+    def tick(self, n_lines: int) -> None:
+        self.lines += n_lines
+        self.chunks += 1
+        if self.every and self.chunks % self.every == 0:
+            now = time.perf_counter()
+            inst = (self.lines - self.lines_last) / max(now - self.t_last, 1e-9)
+            cum = self.lines / max(now - self.t0, 1e-9)
+            print(
+                f"[chunk {self.chunks}] {self.lines} lines, "
+                f"{inst:,.0f} lines/s (inst), {cum:,.0f} lines/s (cum)",
+                file=self.out,
+                flush=True,
+            )
+            self.t_last, self.lines_last = now, self.lines
+
+    def elapsed(self) -> float:
+        return time.perf_counter() - self.t0
+
+
+class Profiler:
+    """Context manager around jax.profiler tracing (no-op when dir is None)."""
+
+    def __init__(self, trace_dir: str | None):
+        self.trace_dir = trace_dir
+
+    def __enter__(self):
+        if self.trace_dir:
+            import jax
+
+            jax.profiler.start_trace(self.trace_dir)
+        return self
+
+    def __exit__(self, *exc):
+        if self.trace_dir:
+            import jax
+
+            jax.profiler.stop_trace()
+        return False

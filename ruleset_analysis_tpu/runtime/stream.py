@@ -27,6 +27,9 @@ from ..models import pipeline
 from ..ops.topk import TopKTracker
 
 
+_SENTINEL = object()
+
+
 def chunked(it: Iterable[str], size: int) -> Iterator[list[str]]:
     buf: list[str] = []
     for x in it:
@@ -45,6 +48,8 @@ def run_stream(
     *,
     topk: int = 10,
     mesh=None,
+    profile_dir: str | None = None,
+    max_chunks: int | None = None,
 ):
     """Run the full analysis over a stream of raw syslog lines; return Report.
 
@@ -52,46 +57,125 @@ def run_stream(
     visible), the batch shards over the data axis and registers merge via
     ICI collectives; on one device this degenerates to the single-chip
     step.  Results are bit-identical either way (mergeable registers).
+
+    With ``cfg.checkpoint_every_chunks`` set, an atomic (offset, registers)
+    snapshot lands in ``cfg.checkpoint_dir`` every N chunks; with
+    ``cfg.resume``, an existing snapshot is loaded and that many raw input
+    lines are skipped before streaming continues — final registers are
+    bit-identical to an uninterrupted run (mergeable state).
+
+    ``max_chunks`` stops after N chunks (fault-injection in tests; also a
+    cheap "analyze a prefix" knob).
     """
     from ..parallel import mesh as mesh_lib
     from ..parallel.step import make_parallel_step
+    from . import checkpoint as ckpt
+    from .metrics import Profiler, ThroughputMeter
 
     if mesh is None:
         mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
     batch_size = mesh_lib.pad_batch_size(cfg.batch_size, mesh, cfg.mesh_axis)
 
     dev_rules = pipeline.ship_ruleset(packed)
-    state = pipeline.init_state(packed.n_keys, cfg)
     step = make_parallel_step(mesh, cfg, packed.n_keys)
     packer = LinePacker(packed)
-    tracker = TopKTracker(cfg.sketch.topk_capacity)
+    fp = ckpt.fingerprint(packed, cfg)
+    lines_consumed = 0
+    n_chunks = 0
+
+    snap = ckpt.load(cfg.checkpoint_dir) if cfg.resume else None
+    if snap is not None:
+        if snap.fingerprint != fp:
+            raise ckpt.CheckpointMismatch(
+                f"snapshot in {cfg.checkpoint_dir!r} was taken with a different "
+                "ruleset or sketch geometry; refusing to merge"
+            )
+        state = pipeline.AnalysisState(
+            **{k: jax.device_put(v, mesh_lib.replicated(mesh)) for k, v in snap.arrays.items()}
+        )
+        tracker = ckpt.restore_tracker(snap, cfg.sketch.topk_capacity)
+        packer.parsed, packer.skipped = snap.parsed, snap.skipped
+        lines_consumed = snap.lines_consumed
+        n_chunks = snap.n_chunks
+        it = iter(lines)
+        skipped_ok = 0
+        for _ in range(lines_consumed):
+            if next(it, _SENTINEL) is _SENTINEL:
+                break
+            skipped_ok += 1
+        if skipped_ok < lines_consumed:
+            from ..errors import ResumeInputMismatch
+
+            raise ResumeInputMismatch(
+                f"snapshot consumed {lines_consumed} lines but the input "
+                f"stream has only {skipped_ok}; wrong or truncated log input"
+            )
+        lines = it
+    else:
+        state = pipeline.init_state(packed.n_keys, cfg)
+        tracker = TopKTracker(cfg.sketch.topk_capacity)
 
     def drain(out: pipeline.ChunkOut) -> None:
         tracker.offer_chunk(
             np.asarray(out.cand_acl), np.asarray(out.cand_src), np.asarray(out.cand_est)
         )
 
+    def save_snapshot() -> None:
+        while pending:
+            drain(pending.popleft())
+        jax.block_until_ready(state)
+        ckpt.save(
+            cfg.checkpoint_dir,
+            ckpt.Snapshot(
+                arrays={
+                    k: np.asarray(jax.device_get(getattr(state, k)))
+                    for k in pipeline.AnalysisState._fields
+                },
+                lines_consumed=lines_consumed,
+                n_chunks=n_chunks,
+                parsed=packer.parsed,
+                skipped=packer.skipped,
+                tracker_tables=tracker.tables(),
+                fingerprint=fp,
+            ),
+        )
+
     # Candidates drain with a 2-chunk lag: by the time chunk N-2's arrays
     # are fetched, their compute is long done, so the host never stalls on
     # the device — and memory stays O(1) chunks instead of O(n_chunks).
     pending: deque[pipeline.ChunkOut] = deque()
-    n_chunks = 0
+    meter = ThroughputMeter(cfg.report_every_chunks)
+    chunks_this_run = 0
     t0 = time.perf_counter()
-    for chunk in chunked(lines, batch_size):
-        batch_np = np.ascontiguousarray(
-            packer.pack_lines(chunk, batch_size=batch_size).T
-        )
-        batch = mesh_lib.shard_batch(mesh, batch_np, cfg.mesh_axis)
-        state, out = step(state, dev_rules, batch)
-        pending.append(out)
-        if len(pending) > 2:
-            drain(pending.popleft())
-        n_chunks += 1
+    with Profiler(profile_dir):
+        for chunk in chunked(lines, batch_size):
+            batch_np = np.ascontiguousarray(
+                packer.pack_lines(chunk, batch_size=batch_size).T
+            )
+            batch = mesh_lib.shard_batch(mesh, batch_np, cfg.mesh_axis)
+            state, out = step(state, dev_rules, batch)
+            pending.append(out)
+            if len(pending) > 2:
+                drain(pending.popleft())
+            lines_consumed += len(chunk)
+            n_chunks += 1
+            chunks_this_run += 1
+            meter.tick(len(chunk))
+            if cfg.checkpoint_every_chunks and n_chunks % cfg.checkpoint_every_chunks == 0:
+                save_snapshot()
+            if max_chunks is not None and chunks_this_run >= max_chunks:
+                aborted = True
+                break
+        else:
+            aborted = False
 
     jax.block_until_ready(state)
     elapsed = time.perf_counter() - t0
     while pending:
         drain(pending.popleft())
+    # a max_chunks stop simulates a crash: only periodic snapshots survive
+    if cfg.checkpoint_every_chunks and not aborted:
+        save_snapshot()
 
     lines_total = packer.parsed + packer.skipped
     totals = {

@@ -1,0 +1,134 @@
+"""Checkpoint/resume + fault injection (SURVEY.md §6: "kill between chunks
+in tests").  The contract: kill a run anywhere, resume from the snapshot,
+and the final registers/report are bit-identical to an uninterrupted run."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+from ruleset_analysis_tpu.runtime import checkpoint as ckpt
+from ruleset_analysis_tpu.runtime.stream import run_stream
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    cfg_text = synth.synth_config(n_acls=2, rules_per_acl=10, seed=41)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    tuples = synth.synth_tuples(packed, 2000, seed=41)
+    lines = synth.render_syslog(packed, tuples, seed=41)
+    return packed, lines
+
+
+def make_cfg(tmp, every=2, resume=False):
+    return AnalysisConfig(
+        batch_size=256,
+        sketch=SketchConfig(cms_width=1 << 10, cms_depth=4, hll_p=6),
+        checkpoint_every_chunks=every,
+        checkpoint_dir=str(tmp),
+        resume=resume,
+    )
+
+
+def hits_of(rep):
+    return {(e["firewall"], e["acl"], e["index"]): e["hits"] for e in rep.per_rule}
+
+
+def test_kill_and_resume_bit_identical(corpus, tmp_path):
+    packed, lines = corpus
+    # uninterrupted reference run (no checkpointing at all)
+    ref = run_stream(packed, iter(lines), make_cfg(tmp_path / "none", every=0))
+
+    # "crash" after 3 chunks (max_chunks cuts the run mid-stream)
+    run_stream(packed, iter(lines), make_cfg(tmp_path / "ck"), max_chunks=3)
+    snap = ckpt.load(str(tmp_path / "ck"))
+    assert snap is not None
+    assert snap.n_chunks == 2  # snapshots at chunk 2; chunk 3 was "lost"
+
+    # resume from the snapshot over the same stream
+    rep = run_stream(packed, iter(lines), make_cfg(tmp_path / "ck", resume=True))
+    assert hits_of(rep) == hits_of(ref)
+    assert rep.unused == ref.unused
+    assert rep.talkers == ref.talkers
+    assert rep.totals["lines_matched"] == ref.totals["lines_matched"]
+
+
+def test_resume_without_snapshot_starts_fresh(corpus, tmp_path):
+    packed, lines = corpus
+    rep = run_stream(packed, iter(lines), make_cfg(tmp_path / "empty", resume=True))
+    ref = run_stream(packed, iter(lines), make_cfg(tmp_path / "none2", every=0))
+    assert hits_of(rep) == hits_of(ref)
+
+
+def test_fingerprint_mismatch_refused(corpus, tmp_path):
+    packed, lines = corpus
+    run_stream(packed, iter(lines), make_cfg(tmp_path / "fp"), max_chunks=3)
+    bad = AnalysisConfig(
+        batch_size=256,
+        sketch=SketchConfig(cms_width=1 << 11, cms_depth=4, hll_p=6),  # different geometry
+        checkpoint_every_chunks=2,
+        checkpoint_dir=str(tmp_path / "fp"),
+        resume=True,
+    )
+    with pytest.raises(ckpt.CheckpointMismatch):
+        run_stream(packed, iter(lines), bad)
+
+
+def test_snapshot_roundtrip(tmp_path):
+    snap = ckpt.Snapshot(
+        arrays={"a": np.arange(5, dtype=np.uint32), "b": np.ones((2, 3), np.uint32)},
+        lines_consumed=123,
+        n_chunks=4,
+        parsed=100,
+        skipped=23,
+        tracker_tables={7: {111: 9, 222: 3}},
+        fingerprint="abc",
+    )
+    ckpt.save(str(tmp_path), snap)
+    got = ckpt.load(str(tmp_path))
+    assert got.lines_consumed == 123 and got.n_chunks == 4
+    assert got.tracker_tables == {7: {111: 9, 222: 3}}
+    np.testing.assert_array_equal(got.arrays["b"], snap.arrays["b"])
+    # overwrite is atomic: save again and reload
+    snap.lines_consumed = 456
+    ckpt.save(str(tmp_path), snap)
+    assert ckpt.load(str(tmp_path)).lines_consumed == 456
+
+
+def test_load_missing_dir_returns_none(tmp_path):
+    assert ckpt.load(str(tmp_path / "nothing")) is None
+
+
+def test_save_is_crash_atomic_pairwise(corpus, tmp_path):
+    """A torn save (snapshot dir written, pointer not moved) must resume
+    from the PREVIOUS consistent (offset, registers) pair."""
+    import os
+
+    packed, lines = corpus
+    d = tmp_path / "atomic"
+    run_stream(packed, iter(lines), make_cfg(d), max_chunks=3)
+    before = ckpt.load(str(d))
+    # simulate a crash between snapshot-dir creation and pointer rename:
+    # drop a newer snapshot dir WITHOUT updating LATEST
+    os.makedirs(d / "snap-99")
+    (d / "snap-99" / "state.npz").write_bytes(b"garbage")
+    (d / "snap-99" / "manifest.json").write_text("{broken")
+    after = ckpt.load(str(d))
+    assert after is not None
+    assert after.n_chunks == before.n_chunks
+    assert after.lines_consumed == before.lines_consumed
+
+
+def test_resume_input_too_short_is_refused(corpus, tmp_path):
+    from ruleset_analysis_tpu.errors import ResumeInputMismatch
+
+    packed, lines = corpus
+    d = tmp_path / "short"
+    run_stream(packed, iter(lines), make_cfg(d), max_chunks=3)
+    snap = ckpt.load(str(d))
+    too_short = lines[: snap.lines_consumed - 10]
+    with pytest.raises(ResumeInputMismatch, match="truncated"):
+        run_stream(packed, iter(too_short), make_cfg(d, resume=True))
