@@ -76,6 +76,20 @@ def _cmd_run(args: argparse.Namespace) -> int:
     lines = _iter_log_lines(args.logs)
 
     if args.backend == "oracle":
+        # These only plumb into the device stream driver; accepting them
+        # silently would let a user believe an oracle run is checkpointed.
+        tpu_only = {
+            "--checkpoint-every": args.checkpoint_every,
+            "--resume": args.resume,
+            "--report-every": args.report_every,
+            "--profile-dir": args.profile_dir,
+        }
+        bad = [k for k, v in tpu_only.items() if v]
+        if bad:
+            print(
+                f"{', '.join(bad)} only apply to --backend=tpu", file=sys.stderr
+            )
+            return 2
         # Exact path: rebuild Ruleset objects is not possible from packed form
         # alone; the oracle needs the original configs.
         if not args.acl_configs:

@@ -47,13 +47,23 @@ MANIFEST_FILE = "manifest.json"
 POINTER_FILE = "LATEST"
 
 
-def fingerprint(packed: PackedRuleset, cfg: AnalysisConfig) -> str:
-    """Identity of (ruleset, sketch geometry) a snapshot is valid for."""
+def fingerprint(packed: PackedRuleset, cfg: AnalysisConfig, n_shards: int = 1) -> str:
+    """Identity of (ruleset, sketch geometry, chunking) a snapshot is valid for.
+
+    ``n_shards`` is the data-axis size of the mesh the stream actually runs
+    on: both the padded chunk size and the per-chunk candidate count scale
+    with it, so resuming on a different device count must be refused to
+    keep talker tables bit-identical to an uninterrupted run.
+    """
     h = hashlib.sha256()
     h.update(np.ascontiguousarray(packed.rules).tobytes())
     h.update(np.ascontiguousarray(packed.deny_key).tobytes())
     s = cfg.sketch
-    h.update(f"{s.cms_width},{s.cms_depth},{s.hll_p},{cfg.exact_counts}".encode())
+    padded = ((cfg.batch_size + n_shards - 1) // n_shards) * n_shards
+    h.update(
+        f"{s.cms_width},{s.cms_depth},{s.hll_p},{cfg.exact_counts},"
+        f"{padded},{n_shards},{s.topk_chunk_candidates},{s.topk_capacity}".encode()
+    )
     return h.hexdigest()[:16]
 
 
@@ -76,6 +86,8 @@ def save(ckpt_dir: str, snap: Snapshot) -> None:
     tmp_dir = tempfile.mkdtemp(dir=ckpt_dir, prefix=".tmp-")
     with open(os.path.join(tmp_dir, STATE_FILE), "wb") as f:
         np.savez(f, **snap.arrays)
+        f.flush()
+        os.fsync(f.fileno())
     manifest = {
         "lines_consumed": snap.lines_consumed,
         "n_chunks": snap.n_chunks,
@@ -88,19 +100,43 @@ def save(ckpt_dir: str, snap: Snapshot) -> None:
     }
     with open(os.path.join(tmp_dir, MANIFEST_FILE), "w", encoding="utf-8") as f:
         json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # Never delete an existing dir (LATEST may point at it): a same-chunk
+    # re-save lands under a fresh name and the old one is pruned only
+    # after the pointer moves.
     snap_dir = os.path.join(ckpt_dir, snap_name)
-    if os.path.exists(snap_dir):  # same-chunk re-save (idempotent)
-        _rmtree(snap_dir)
+    retry = 0
+    while os.path.exists(snap_dir):
+        retry += 1
+        snap_name = f"snap-{snap.n_chunks}-r{retry}"
+        snap_dir = os.path.join(ckpt_dir, snap_name)
+    # Snapshot data and its directory entries must be durable BEFORE the
+    # pointer moves, or a power loss could persist a pointer to truncated
+    # files (the small rename often hits disk first).
+    _fsync_dir(tmp_dir)
     os.replace(tmp_dir, snap_dir)
+    _fsync_dir(ckpt_dir)
     # publish: the pointer rename is the commit point
     fd, tmp = tempfile.mkstemp(dir=ckpt_dir, suffix=".ptr.tmp")
     with os.fdopen(fd, "w") as f:
         f.write(snap_name)
+        f.flush()
+        os.fsync(f.fileno())
     prev = _read_pointer(ckpt_dir)
     os.replace(tmp, os.path.join(ckpt_dir, POINTER_FILE))
+    _fsync_dir(ckpt_dir)
     # prune superseded snapshots only after the new pointer is durable
     if prev and prev != snap_name:
         _rmtree(os.path.join(ckpt_dir, prev))
+
+
+def _fsync_dir(path: str) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 def _read_pointer(ckpt_dir: str) -> str | None:
@@ -128,9 +164,10 @@ def load(ckpt_dir: str) -> Snapshot | None:
         return None
     with open(manifest_path, "r", encoding="utf-8") as f:
         m = json.load(f)
-    z = np.load(state_path)
+    with np.load(state_path) as z:
+        arrays = {k: z[k] for k in z.files}
     return Snapshot(
-        arrays={k: z[k] for k in z.files},
+        arrays=arrays,
         lines_consumed=int(m["lines_consumed"]),
         n_chunks=int(m["n_chunks"]),
         parsed=int(m["parsed"]),

@@ -14,7 +14,6 @@ never synchronises the host with the in-flight chunk.
 
 from __future__ import annotations
 
-import time
 from collections import deque
 from collections.abc import Iterable, Iterator
 
@@ -79,7 +78,7 @@ def run_stream(
     dev_rules = pipeline.ship_ruleset(packed)
     step = make_parallel_step(mesh, cfg, packed.n_keys)
     packer = LinePacker(packed)
-    fp = ckpt.fingerprint(packed, cfg)
+    fp = ckpt.fingerprint(packed, cfg, mesh.shape[cfg.mesh_axis])
     lines_consumed = 0
     n_chunks = 0
 
@@ -144,9 +143,9 @@ def run_stream(
     # are fetched, their compute is long done, so the host never stalls on
     # the device — and memory stays O(1) chunks instead of O(n_chunks).
     pending: deque[pipeline.ChunkOut] = deque()
+    lines_at_start = packer.parsed + packer.skipped  # nonzero after resume
     meter = ThroughputMeter(cfg.report_every_chunks)
     chunks_this_run = 0
-    t0 = time.perf_counter()
     with Profiler(profile_dir):
         for chunk in chunked(lines, batch_size):
             batch_np = np.ascontiguousarray(
@@ -170,21 +169,24 @@ def run_stream(
             aborted = False
 
     jax.block_until_ready(state)
-    elapsed = time.perf_counter() - t0
+    elapsed = meter.elapsed()
     while pending:
         drain(pending.popleft())
     # a max_chunks stop simulates a crash: only periodic snapshots survive
     if cfg.checkpoint_every_chunks and not aborted:
         save_snapshot()
 
+    # lines_total/matched/skipped/chunks are cumulative across resumes;
+    # throughput is this run's lines over this run's wall time only.
     lines_total = packer.parsed + packer.skipped
+    lines_this_run = lines_total - lines_at_start
     totals = {
         "lines_total": lines_total,
         "lines_matched": packer.parsed,
         "lines_skipped": packer.skipped,
         "chunks": n_chunks,
         "elapsed_sec": round(elapsed, 4),
-        "lines_per_sec": round(lines_total / elapsed, 1) if elapsed > 0 else 0.0,
+        "lines_per_sec": round(lines_this_run / elapsed, 1) if elapsed > 0 else 0.0,
     }
     return pipeline.finalize(
         state, packed, cfg, tracker, topk=topk, totals=totals

@@ -98,6 +98,32 @@ def test_snapshot_roundtrip(tmp_path):
     assert ckpt.load(str(tmp_path)).lines_consumed == 456
 
 
+def test_same_chunk_resave_never_deletes_live_snapshot(tmp_path):
+    """Re-saving at the same chunk count (e.g. end-of-run save right after
+    a periodic one) must not delete the dir LATEST points at — the re-save
+    lands under a fresh name and the old dir is pruned only after the
+    pointer moves."""
+    import os
+
+    snap = ckpt.Snapshot(
+        arrays={"a": np.arange(3, dtype=np.uint32)},
+        lines_consumed=10,
+        n_chunks=2,
+        parsed=10,
+        skipped=0,
+        tracker_tables={},
+        fingerprint="fp",
+    )
+    ckpt.save(str(tmp_path), snap)
+    first = (tmp_path / "LATEST").read_text().strip()
+    snap.lines_consumed = 20
+    ckpt.save(str(tmp_path), snap)
+    second = (tmp_path / "LATEST").read_text().strip()
+    assert first != second  # fresh name, not an in-place overwrite
+    assert not (tmp_path / first).exists()  # pruned after the pointer moved
+    assert ckpt.load(str(tmp_path)).lines_consumed == 20
+
+
 def test_load_missing_dir_returns_none(tmp_path):
     assert ckpt.load(str(tmp_path / "nothing")) is None
 

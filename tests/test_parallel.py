@@ -20,9 +20,19 @@ from ruleset_analysis_tpu.parallel import mesh as mesh_lib
 from ruleset_analysis_tpu.parallel.step import make_parallel_step
 
 
+def cpu_devices():
+    """8 fake CPU devices (conftest's --xla_force_host_platform_device_count).
+
+    Under the axon tunnel the default platform stays TPU regardless of
+    JAX_PLATFORMS, so meshes are built from the explicit cpu backend.
+    """
+    devs = jax.devices("cpu")
+    assert len(devs) == 8, "conftest must provide 8 fake CPU devices"
+    return devs
+
+
 @pytest.fixture(scope="module")
 def setup():
-    assert len(jax.devices()) == 8, "conftest must provide 8 fake CPU devices"
     cfg_text = synth.synth_config(n_acls=3, rules_per_acl=12, seed=31)
     rs = aclparse.parse_asa_config(cfg_text, "fw1")
     packed = pack.pack_rulesets([rs])
@@ -45,8 +55,8 @@ def run_on_mesh(packed, cfg, batch_np, devices):
 
 def test_eight_device_state_bit_identical_to_single(setup):
     packed, rs, cfg, batch_np = setup
-    s8, _ = run_on_mesh(packed, cfg, batch_np, jax.devices())
-    s1, _ = run_on_mesh(packed, cfg, batch_np, jax.devices()[:1])
+    s8, _ = run_on_mesh(packed, cfg, batch_np, cpu_devices())
+    s1, _ = run_on_mesh(packed, cfg, batch_np, cpu_devices()[:1])
     for name in pipeline.AnalysisState._fields:
         np.testing.assert_array_equal(
             np.asarray(getattr(s8, name)), np.asarray(getattr(s1, name)), err_msg=name
@@ -58,8 +68,8 @@ def test_shard_order_invariance(setup):
     packed, rs, cfg, batch_np = setup
     rng = np.random.default_rng(0)
     perm = rng.permutation(batch_np.shape[1])
-    s_a, _ = run_on_mesh(packed, cfg, batch_np, jax.devices())
-    s_b, _ = run_on_mesh(packed, cfg, np.ascontiguousarray(batch_np[:, perm]), jax.devices())
+    s_a, _ = run_on_mesh(packed, cfg, batch_np, cpu_devices())
+    s_b, _ = run_on_mesh(packed, cfg, np.ascontiguousarray(batch_np[:, perm]), cpu_devices())
     for name in ("counts_lo", "counts_hi", "cms", "hll", "talk_cms"):
         np.testing.assert_array_equal(
             np.asarray(getattr(s_a, name)), np.asarray(getattr(s_b, name)), err_msg=name
@@ -68,7 +78,7 @@ def test_shard_order_invariance(setup):
 
 def test_parallel_counts_match_oracle(setup):
     packed, rs, cfg, batch_np = setup
-    s8, _ = run_on_mesh(packed, cfg, batch_np, jax.devices())
+    s8, _ = run_on_mesh(packed, cfg, batch_np, cpu_devices())
     # oracle over the same tuples (render -> parse round trip)
     lines = synth.render_syslog(packed, np.ascontiguousarray(batch_np.T), seed=31)
     res = oracle.Oracle([rs]).consume(lines)
@@ -84,7 +94,7 @@ def test_parallel_counts_match_oracle(setup):
 
 def test_candidates_are_replicated_and_cover_all_shards(setup):
     packed, rs, cfg, batch_np = setup
-    _, out = run_on_mesh(packed, cfg, batch_np, jax.devices())
+    _, out = run_on_mesh(packed, cfg, batch_np, cpu_devices())
     k = cfg.sketch.topk_chunk_candidates
     assert out.cand_acl.shape == (8 * k,)
     assert out.cand_src.shape == (8 * k,)
@@ -96,7 +106,9 @@ def test_run_stream_uses_mesh_and_matches_single(setup):
 
     packed, rs, cfg, batch_np = setup
     lines = synth.render_syslog(packed, np.ascontiguousarray(batch_np.T), seed=31)
-    rep = run_stream(packed, iter(lines), cfg, topk=5)
+    rep = run_stream(
+        packed, iter(lines), cfg, topk=5, mesh=mesh_lib.make_mesh(cpu_devices())
+    )
     res = oracle.Oracle([rs]).consume(lines)
     got = {
         (e["firewall"], e["acl"], e["index"]): e["hits"] for e in rep.per_rule if e["hits"]
