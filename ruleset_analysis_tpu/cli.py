@@ -83,6 +83,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--resume": args.resume,
             "--report-every": args.report_every,
             "--profile-dir": args.profile_dir,
+            "--native-parse": args.native_parse,
+            "--checkpoint-dir": args.checkpoint_dir,
         }
         bad = [k for k, v in tpu_only.items() if v]
         if bad:
@@ -115,11 +117,27 @@ def _cmd_run(args: argparse.Namespace) -> int:
         )
     elif args.backend == "tpu":
         try:
-            from .runtime.stream import run_stream  # deferred: imports JAX
+            from .runtime.stream import run_stream, run_stream_file  # deferred: imports JAX
         except ImportError as e:
             print(f"error: tpu backend unavailable ({e})", file=sys.stderr)
             return 1
-        rep = run_stream(packed, lines, cfg, topk=args.topk, profile_dir=args.profile_dir)
+        file_input = all(p != "-" for p in args.logs)
+        if args.native_parse and not file_input:
+            print("--native-parse requires file inputs (not '-')", file=sys.stderr)
+            return 2
+        if file_input:
+            # forced --native-parse with no C++ toolchain raises
+            # NativeParserUnavailable, handled as AnalysisError in main()
+            rep = run_stream_file(
+                packed,
+                args.logs,
+                cfg,
+                native=args.native_parse,  # None = auto
+                topk=args.topk,
+                profile_dir=args.profile_dir,
+            )
+        else:
+            rep = run_stream(packed, lines, cfg, topk=args.topk, profile_dir=args.profile_dir)
     else:
         print(f"unknown backend {args.backend!r}", file=sys.stderr)
         return 2
@@ -182,6 +200,8 @@ def make_parser() -> argparse.ArgumentParser:
                    help="resume from --checkpoint-dir if a snapshot exists")
     p.add_argument("--report-every", type=int, default=0, metavar="CHUNKS",
                    help="print throughput to stderr every N chunks")
+    p.add_argument("--native-parse", action=argparse.BooleanOptionalAction, default=None,
+                   help="use the C++ host parser (default: auto when logs are files)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here (TensorBoard profile)")
     p.add_argument("--json", action="store_true")

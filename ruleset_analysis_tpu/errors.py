@@ -11,3 +11,7 @@ class CheckpointMismatch(AnalysisError):
 
 class ResumeInputMismatch(AnalysisError):
     """Input stream is shorter than the snapshot's consumed-line offset."""
+
+
+class NativeParserUnavailable(AnalysisError):
+    """The C++ parser was requested but its library cannot be built/loaded."""

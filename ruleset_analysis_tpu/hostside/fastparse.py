@@ -1,0 +1,335 @@
+"""Native fast path for the host parse: ctypes binding to _asaparse.so.
+
+The reference's mapper spends its host CPU in regex parsing (SURVEY.md
+§4.3); at TPU-scale feed rates that parse is the end-to-end bottleneck
+(SURVEY.md §8.2).  This module loads the C++ parser/packer from
+``ruleset_analysis_tpu/native/`` (building it with make/g++ on first use)
+and exposes:
+
+- :class:`NativePacker` — drop-in producer of the same column-major
+  ``[TUPLE_COLS, B]`` uint32 batches as ``LinePacker.pack_lines(...).T``,
+  but straight from raw bytes;
+- :func:`batches_from_file` — stream a syslog file (or byte stream) as
+  device-ready batches of ``batch_size`` raw lines each.
+
+If no C++ toolchain is available the import still succeeds and
+``available()`` returns False; callers fall back to the pure-Python path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from collections.abc import Iterator
+
+import numpy as np
+
+from .pack import PackedRuleset, TUPLE_COLS
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "_asaparse.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+#: Bytes per read when streaming a file through the native parser.
+READ_BLOCK = 8 << 20
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(
+            ["make", "-C", _NATIVE_DIR],
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        return r.returncode == 0 and os.path.exists(_LIB_PATH)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.asa_packer_new.restype = ctypes.c_void_p
+        lib.asa_packer_free.argtypes = [ctypes.c_void_p]
+        lib.asa_packer_add_acl.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.asa_packer_add_binding.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint32,
+        ]
+        lib.asa_packer_parsed.argtypes = [ctypes.c_void_p]
+        lib.asa_packer_parsed.restype = ctypes.c_int64
+        lib.asa_packer_skipped.argtypes = [ctypes.c_void_p]
+        lib.asa_packer_skipped.restype = ctypes.c_int64
+        lib.asa_packer_set_counts.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        lib.asa_pack_chunk.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_int,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.asa_pack_chunk.restype = ctypes.c_int64
+        lib.asa_count_lines.argtypes = [
+            ctypes.c_char_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.asa_count_lines.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True if the native parser library is loadable (building if needed)."""
+    return _load() is not None
+
+
+class NativePacker:
+    """Raw syslog bytes -> column-major [TUPLE_COLS, B] uint32 batches.
+
+    Mirrors ``LinePacker`` exactly: the (firewall, acl)->gid and
+    (firewall, iface)->gid resolution tables come from the same
+    PackedRuleset, unresolvable or unparseable lines count as skipped,
+    and valid tuples are packed densely from row 0.
+    """
+
+    def __init__(self, packed: PackedRuleset):
+        from ..errors import NativeParserUnavailable
+
+        lib = _load()
+        if lib is None:
+            raise NativeParserUnavailable(
+                "native parser unavailable (no C++ toolchain to build "
+                "ruleset_analysis_tpu/native/_asaparse.so?)"
+            )
+        self._lib = lib
+        self._h = ctypes.c_void_p(lib.asa_packer_new())
+        for (fw, acl), gid in packed.acl_gid.items():
+            lib.asa_packer_add_acl(self._h, fw.encode(), acl.encode(), gid)
+        for (fw, iface), gid in packed.bindings.items():
+            lib.asa_packer_add_binding(self._h, fw.encode(), iface.encode(), gid)
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.asa_packer_free(h)
+            self._h = None
+
+    @property
+    def parsed(self) -> int:
+        return int(self._lib.asa_packer_parsed(self._h))
+
+    @property
+    def skipped(self) -> int:
+        return int(self._lib.asa_packer_skipped(self._h))
+
+    def set_counts(self, parsed: int, skipped: int) -> None:
+        """Restore cumulative counters (checkpoint resume)."""
+        self._lib.asa_packer_set_counts(self._h, parsed, skipped)
+
+    def pack_chunk(
+        self,
+        data: bytes | bytearray | memoryview,
+        batch_size: int,
+        *,
+        final: bool,
+        max_lines: int | None = None,
+    ) -> tuple[np.ndarray, int, int]:
+        """Parse up to ``max_lines`` (default batch_size) lines from data.
+
+        Returns (batch [TUPLE_COLS, batch_size] uint32, lines_consumed,
+        bytes_consumed).  With ``final=False`` a trailing fragment without
+        a newline is left unconsumed — feed it back with the next block.
+        """
+        buf = bytes(data) if not isinstance(data, bytes) else data
+        out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
+        n_lines = ctypes.c_int64(0)
+        n_valid = ctypes.c_int64(0)
+        used = self._lib.asa_pack_chunk(
+            self._h,
+            buf,
+            len(buf),
+            1 if final else 0,
+            max_lines if max_lines is not None else batch_size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            batch_size,
+            ctypes.byref(n_lines),
+            ctypes.byref(n_valid),
+        )
+        return out, int(n_lines.value), int(used)
+
+    def pack_lines(self, lines: list[str], batch_size: int | None = None) -> np.ndarray:
+        """LinePacker-compatible helper (row-major [B, TUPLE_COLS])."""
+        data = "".join(ln if ln.endswith("\n") else ln + "\n" for ln in lines).encode()
+        b = batch_size or len(lines)
+        out, _, _ = self.pack_chunk(data, b, final=True, max_lines=len(lines))
+        return np.ascontiguousarray(out.T)
+
+
+class _ChainedReader:
+    """Several files as one byte stream, with line-boundary parity.
+
+    A file whose last line is unterminated still contributes that line as
+    a line of its own on the text path (``yield from f``); to keep the
+    byte stream identical, a ``\\n`` is synthesized at any file boundary
+    where the previous file did not end with one.
+    """
+
+    def __init__(self, paths: list[str]):
+        self._paths = list(paths)
+        self._i = 0
+        self._f = None
+        self._last = b"\n"
+
+    def read(self, n: int) -> bytes:
+        while True:
+            if self._f is None:
+                if self._i >= len(self._paths):
+                    return b""
+                self._f = open(self._paths[self._i], "rb")
+                self._i += 1
+            block = self._f.read(n)
+            if block:
+                self._last = block[-1:]
+                return block
+            self._f.close()
+            self._f = None
+            if self._last != b"\n":
+                self._last = b"\n"
+                return b"\n"
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+def batches_from_files(
+    paths: list[str],
+    packer: NativePacker,
+    batch_size: int,
+    *,
+    skip_lines: int = 0,
+    read_block: int = READ_BLOCK,
+) -> Iterator[tuple[np.ndarray, int]]:
+    """Yield (batch [TUPLE_COLS, batch_size], raw_line_count) over files.
+
+    The files are chained into one stream, so batch boundaries fall
+    exactly where the pure-Python text path puts them — per-chunk outputs
+    (top-K candidates) match, not just the merged registers.
+    ``skip_lines`` raw lines are skipped first without parsing
+    (checkpoint resume); raises if the input has fewer lines than that.
+    """
+    lib = packer._lib
+    reader = _ChainedReader(paths)
+    try:
+        rem = b""
+        eof = False
+
+        def fill() -> None:
+            nonlocal rem, eof
+            if eof:
+                return
+            block = reader.read(read_block)
+            if not block:
+                eof = True
+            else:
+                rem += block
+
+        # ---- resume fast-skip
+        to_skip = skip_lines
+        while to_skip > 0:
+            if not rem and not eof:
+                fill()
+            if not rem and eof:
+                from ..errors import ResumeInputMismatch
+
+                raise ResumeInputMismatch(
+                    f"snapshot consumed {skip_lines} lines but the input has "
+                    f"only {skip_lines - to_skip}; wrong or truncated log input"
+                )
+            bytes_used = ctypes.c_int64(0)
+            skipped = lib.asa_count_lines(
+                rem, len(rem), 1 if eof else 0, to_skip, ctypes.byref(bytes_used)
+            )
+            to_skip -= int(skipped)
+            rem = rem[int(bytes_used.value):]
+            if to_skip > 0 and int(skipped) == 0:
+                # newline-free fragment: grow the buffer to make progress
+                fill()
+        # ---- stream batches
+        # Buffer until batch_size COMPLETE lines are in rem (not merely
+        # read_block bytes): every mid-stream batch must hold exactly
+        # batch_size raw lines so chunk boundaries — and therefore
+        # per-chunk top-K candidates and resume offsets — land exactly
+        # where the pure-Python text path puts them.
+        nl = rem.count(b"\n")
+        while True:
+            while not eof and nl < batch_size:
+                n0 = len(rem)
+                fill()
+                nl += rem.count(b"\n", n0)
+            if not rem and eof:
+                return
+            batch, n_lines, used = packer.pack_chunk(rem, batch_size, final=eof)
+            rem = rem[used:]
+            nl = rem.count(b"\n")
+            if n_lines == 0:
+                if eof:
+                    return
+                # no complete line yet (line longer than the buffered
+                # bytes): force another read so we always make progress
+                n0 = len(rem)
+                fill()
+                nl += rem.count(b"\n", n0)
+                continue
+            yield batch, n_lines
+    finally:
+        reader.close()
+
+
+def batches_from_file(
+    path: str,
+    packer: NativePacker,
+    batch_size: int,
+    *,
+    skip_lines: int = 0,
+    read_block: int = READ_BLOCK,
+) -> Iterator[tuple[np.ndarray, int]]:
+    """Single-file convenience wrapper over :func:`batches_from_files`."""
+    return batches_from_files(
+        [path], packer, batch_size, skip_lines=skip_lines, read_block=read_block
+    )
+
+
+def count_lines_in_file(path: str, read_block: int = READ_BLOCK) -> int:
+    """Raw line count (trailing unterminated fragment counts as a line)."""
+    n = 0
+    tail_fragment = False
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(read_block)
+            if not block:
+                break
+            n += block.count(b"\n")
+            tail_fragment = not block.endswith(b"\n")
+    return n + (1 if tail_fragment else 0)

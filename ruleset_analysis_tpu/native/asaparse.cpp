@@ -1,0 +1,528 @@
+// Native ASA syslog parser + tuple packer (the host-side hot loop).
+//
+// SURVEY.md §8.2 names host-side syslog parsing as the end-to-end
+// bottleneck at target rates: the device pipeline sustains millions of
+// lines/sec/chip, so a Python regex parser starves it.  This library is
+// the native tier of the runtime: it parses raw ASA syslog bytes and
+// packs valid lines directly into the column-major [TUPLE_COLS, B]
+// uint32 batch layout the device step consumes — one pass, no Python
+// objects, no regex engine.
+//
+// Semantics mirror ruleset_analysis_tpu/hostside/syslog.py (parse_line)
+// and pack.py (LinePacker) exactly; tests/test_fastparse.py asserts the
+// two paths produce identical batches on synthetic and edge-case
+// corpora.  Known (deliberate) divergence: lines whose IPv4 octets are
+// out of range or whose ports exceed 2^32-1 are *skipped* here, where
+// the Python path raises — robustness over crash-parity.
+//
+// C ABI only (loaded via ctypes; no pybind11 in this image).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+
+namespace {
+
+constexpr int64_t TUPLE_COLS = 7;
+
+struct Packer {
+    // key: firewall + '\x01' + acl  -> acl gid   (106100/106023 path)
+    //      firewall + '\x02' + iface -> acl gid  (302013/302015 path)
+    std::unordered_map<std::string, uint32_t> resolve;
+    int64_t parsed = 0;   // valid tuples emitted (LinePacker.parsed)
+    int64_t skipped = 0;  // lines not parsed/resolved (LinePacker.skipped)
+    std::string keybuf;
+};
+
+inline bool is_sp(char c) { return c == ' ' || c == '\t' || c == '\v' || c == '\f' || c == '\r' || c == '\n'; }
+inline bool is_dig(char c) { return c >= '0' && c <= '9'; }
+
+const char* find_sub(const char* p, const char* end, const char* pat, size_t n) {
+    if (end - p < (std::ptrdiff_t)n) return nullptr;
+    return (const char*)memmem(p, end - p, pat, n);
+}
+
+// Parse a decimal run; false if no digits or value > 2^32-1.
+bool parse_u32(const char*& p, const char* end, uint32_t* out) {
+    if (p >= end || !is_dig(*p)) return false;
+    uint64_t v = 0;
+    const char* q = p;
+    while (q < end && is_dig(*q)) {
+        v = v * 10 + (uint64_t)(*q - '0');
+        if (v > 0xFFFFFFFFull) return false;
+        ++q;
+    }
+    *out = (uint32_t)v;
+    p = q;
+    return true;
+}
+
+// Dotted-quad IPv4 over a [0-9.] run: exactly 4 octets, each 0..255
+// (hostside.aclparse.ip_to_u32 semantics).  Advances p past the run on
+// success; on failure leaves p unspecified and returns false.
+bool parse_ipv4_run(const char*& p, const char* end, uint32_t* out) {
+    uint32_t v = 0;
+    int octets = 0;
+    const char* q = p;
+    while (octets < 4) {
+        if (q >= end || !is_dig(*q)) return false;
+        uint64_t o = 0;
+        while (q < end && is_dig(*q)) {
+            o = o * 10 + (uint64_t)(*q - '0');
+            if (o > 0xFFFFFFFFull) return false;
+            ++q;
+        }
+        if (o > 255) return false;
+        v = (v << 8) | (uint32_t)o;
+        ++octets;
+        if (octets < 4) {
+            if (q >= end || *q != '.') return false;
+            ++q;
+        }
+    }
+    // the regex run [\d.]+ is maximal: a trailing '.' or digit means the
+    // run does not parse as exactly four octets
+    if (q < end && (*q == '.' || is_dig(*q))) return false;
+    *out = v;
+    p = q;
+    return true;
+}
+
+void skip_ws(const char*& p, const char* end) {
+    while (p < end && is_sp(*p)) ++p;
+}
+
+bool skip_ws1(const char*& p, const char* end) {  // require at least one
+    if (p >= end || !is_sp(*p)) return false;
+    skip_ws(p, end);
+    return true;
+}
+
+// Token = maximal non-space run.
+bool token(const char*& p, const char* end, const char** t0, const char** t1) {
+    if (p >= end || is_sp(*p)) return false;
+    *t0 = p;
+    while (p < end && !is_sp(*p)) ++p;
+    *t1 = p;
+    return true;
+}
+
+bool tok_eq(const char* t0, const char* t1, const char* s) {
+    size_t n = strlen(s);
+    return (size_t)(t1 - t0) == n && memcmp(t0, s, n) == 0;
+}
+
+// _proto_num: PROTO_NUMBERS name (case-insensitive) -> number; else
+// decimal; else 0.
+uint32_t proto_num(const char* t0, const char* t1) {
+    char buf[16];
+    size_t n = (size_t)(t1 - t0);
+    if (n < sizeof(buf)) {
+        for (size_t i = 0; i < n; ++i) {
+            char c = t0[i];
+            buf[i] = (c >= 'A' && c <= 'Z') ? (char)(c + 32) : c;
+        }
+        buf[n] = 0;
+        struct { const char* name; uint32_t v; } static const tbl[] = {
+            {"ip", 0},   {"icmp", 1},  {"igmp", 2},  {"ipinip", 4},
+            {"tcp", 6},  {"udp", 17},  {"gre", 47},  {"esp", 50},
+            {"ah", 51},  {"icmp6", 58}, {"eigrp", 88}, {"ospf", 89},
+            {"nos", 94}, {"pim", 103}, {"pcp", 108}, {"snp", 109},
+            {"sctp", 132},
+        };
+        for (auto& e : tbl)
+            if (strcmp(buf, e.name) == 0) return e.v;
+    }
+    const char* p = t0;
+    uint32_t v = 0;
+    if (parse_u32(p, t1, &v) && p == t1) return v;
+    return 0;
+}
+
+struct Parsed {
+    const char* fw0; const char* fw1;
+    const char* acl0; const char* acl1;   // acl0 == nullptr: resolve by iface
+    const char* if0; const char* if1;
+    uint32_t proto, src, sport, dst, dport;
+};
+
+// "if/ip(port)" endpoint of 106100: iface is the shortest prefix whose
+// '/' is followed by a parseable "ip(port)".
+bool endpoint_slash_paren(const char*& p, const char* end,
+                          const char** if0, const char** if1,
+                          uint32_t* ip, uint32_t* port) {
+    const char* t0; const char* t1;
+    const char* q = p;
+    if (!token(q, end, &t0, &t1)) return false;
+    for (const char* s = t0; s < t1; ++s) {
+        if (*s != '/') continue;
+        const char* c = s + 1;
+        uint32_t ipv;
+        if (!parse_ipv4_run(c, t1, &ipv)) continue;
+        if (c >= t1 || *c != '(') continue;
+        ++c;
+        uint32_t pv;
+        if (!parse_u32(c, t1, &pv)) continue;
+        if (c >= t1 || *c != ')') continue;
+        ++c;
+        if (s == t0) continue;  // iface must be non-empty
+        *if0 = t0; *if1 = s; *ip = ipv; *port = pv;
+        p = c;  // just past ')': an extra paren group may follow unspaced
+        return true;
+    }
+    return false;
+}
+
+// "if:ip[/port]" endpoint of 106023 (port optional, defaults 0) and
+// 302013 (port required).
+bool endpoint_colon(const char*& p, const char* end, bool port_required,
+                    const char** if0, const char** if1,
+                    uint32_t* ip, uint32_t* port) {
+    const char* t0; const char* t1;
+    const char* q = p;
+    if (!token(q, end, &t0, &t1)) return false;
+    for (const char* s = t0; s < t1; ++s) {
+        if (*s != ':') continue;
+        const char* c = s + 1;
+        uint32_t ipv;
+        if (!parse_ipv4_run(c, t1, &ipv)) continue;
+        uint32_t pv = 0;
+        if (c < t1 && *c == '/') {
+            const char* c2 = c + 1;
+            if (parse_u32(c2, t1, &pv)) c = c2; else if (port_required) continue;
+        } else if (port_required) {
+            continue;
+        }
+        if (s == t0) continue;
+        *if0 = t0; *if1 = s; *ip = ipv; *port = pv;
+        p = c;
+        return true;
+    }
+    return false;
+}
+
+bool parse_106100(const char* b, const char* be, Parsed* out) {
+    const char* pos = b;
+    while (true) {
+        const char* hit = find_sub(pos, be, "access-list", 11);
+        if (!hit) return false;
+        pos = hit + 1;
+        const char* p = hit + 11;
+        const char* a0; const char* a1; const char* v0; const char* v1;
+        const char* pr0; const char* pr1;
+        if (!skip_ws1(p, be)) continue;
+        if (!token(p, be, &a0, &a1)) continue;
+        if (!skip_ws1(p, be)) continue;
+        if (!token(p, be, &v0, &v1)) continue;
+        if (!(tok_eq(v0, v1, "permitted") || tok_eq(v0, v1, "denied") ||
+              tok_eq(v0, v1, "est-allowed")))
+            continue;
+        if (!skip_ws1(p, be)) continue;
+        if (!token(p, be, &pr0, &pr1)) continue;
+        if (!skip_ws1(p, be)) continue;
+        const char* i0; const char* i1; uint32_t sip, spo;
+        if (!endpoint_slash_paren(p, be, &i0, &i1, &sip, &spo)) continue;
+        if (p < be && *p == '(') {  // optional "(...)" (e.g. identity info)
+            const char* c = (const char*)memchr(p, ')', be - p);
+            if (c) p = c + 1;
+        }
+        skip_ws(p, be);
+        if (p + 1 >= be || p[0] != '-' || p[1] != '>') continue;
+        p += 2;
+        skip_ws(p, be);
+        const char* j0; const char* j1; uint32_t dip, dpo;
+        if (!endpoint_slash_paren(p, be, &j0, &j1, &dip, &dpo)) continue;
+        uint32_t proto = proto_num(pr0, pr1);
+        // ICMP: parenthesised values are type/code; type -> dport, sport=0
+        if (proto == 1) { dpo = spo; spo = 0; }
+        out->acl0 = a0; out->acl1 = a1;
+        out->if0 = i0; out->if1 = i1;
+        out->proto = proto; out->src = sip; out->sport = spo;
+        out->dst = dip; out->dport = dpo;
+        return true;
+    }
+}
+
+bool parse_106023(const char* b, const char* be, Parsed* out) {
+    const char* pos = b;
+    while (true) {
+        const char* hit = find_sub(pos, be, "Deny", 4);
+        if (!hit) return false;
+        pos = hit + 1;
+        const char* p = hit + 4;
+        const char* pr0; const char* pr1; const char* s0; const char* s1;
+        if (!skip_ws1(p, be)) continue;
+        if (!token(p, be, &pr0, &pr1)) continue;
+        if (!skip_ws1(p, be)) continue;
+        if (!token(p, be, &s0, &s1) || !tok_eq(s0, s1, "src")) continue;
+        if (!skip_ws1(p, be)) continue;
+        const char* i0; const char* i1; uint32_t sip, spo;
+        if (!endpoint_colon(p, be, false, &i0, &i1, &sip, &spo)) continue;
+        if (!skip_ws1(p, be)) continue;
+        if (!token(p, be, &s0, &s1) || !tok_eq(s0, s1, "dst")) continue;
+        if (!skip_ws1(p, be)) continue;
+        const char* j0; const char* j1; uint32_t dip, dpo;
+        if (!endpoint_colon(p, be, false, &j0, &j1, &dip, &dpo)) continue;
+        // optional " (type T, code C)"
+        bool have_type = false;
+        uint32_t icmp_type = 0, tmp;
+        {
+            const char* q = p;
+            if (skip_ws1(q, be) && q + 5 <= be && memcmp(q, "(type", 5) == 0) {
+                const char* c = q + 5;
+                if (skip_ws1(c, be) && parse_u32(c, be, &icmp_type) &&
+                    c < be && *c == ',') {
+                    ++c;
+                    skip_ws(c, be);
+                    if (c + 4 <= be && memcmp(c, "code", 4) == 0) {
+                        c += 4;
+                        if (skip_ws1(c, be) && parse_u32(c, be, &tmp) &&
+                            c < be && *c == ')') {
+                            have_type = true;
+                            p = c + 1;
+                        }
+                    }
+                }
+            }
+        }
+        // .*?by\s+access-group\s+"<acl>"
+        const char* scan = p;
+        const char* a0 = nullptr; const char* a1 = nullptr;
+        while (true) {
+            const char* ag = find_sub(scan, be, "access-group", 12);
+            if (!ag) break;
+            scan = ag + 1;
+            const char* back = ag;
+            if (back <= p || !is_sp(back[-1])) continue;
+            while (back > p && is_sp(back[-1])) --back;
+            if (back - p < 2 || back[-1] != 'y' || back[-2] != 'b') continue;
+            const char* c = ag + 12;
+            if (!skip_ws1(c, be)) continue;
+            if (c >= be || *c != '"') continue;
+            ++c;
+            const char* close = (const char*)memchr(c, '"', be - c);
+            if (!close || close == c) continue;  // regex [^"]+ needs >=1 char
+            a0 = c; a1 = close;
+            break;
+        }
+        if (!a0) continue;
+        uint32_t proto = proto_num(pr0, pr1);
+        if (proto == 1 && have_type) { dpo = icmp_type; spo = 0; }
+        out->acl0 = a0; out->acl1 = a1;
+        out->if0 = i0; out->if1 = i1;
+        out->proto = proto; out->src = sip; out->sport = spo;
+        out->dst = dip; out->dport = dpo;
+        return true;
+    }
+}
+
+bool parse_302013(const char* b, const char* be, Parsed* out) {
+    const char* pos = b;
+    while (true) {
+        const char* hit = find_sub(pos, be, "Built", 5);
+        if (!hit) return false;
+        pos = hit + 1;
+        const char* p = hit + 5;
+        const char* t0; const char* t1;
+        if (!skip_ws1(p, be)) continue;
+        if (!token(p, be, &t0, &t1)) continue;
+        bool inbound;
+        if (tok_eq(t0, t1, "inbound")) inbound = true;
+        else if (tok_eq(t0, t1, "outbound")) inbound = false;
+        else continue;
+        if (!skip_ws1(p, be)) continue;
+        if (!token(p, be, &t0, &t1)) continue;
+        uint32_t proto;
+        if (tok_eq(t0, t1, "TCP")) proto = 6;
+        else if (tok_eq(t0, t1, "UDP")) proto = 17;
+        else continue;
+        if (!skip_ws1(p, be)) continue;
+        if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "connection")) continue;
+        if (!skip_ws1(p, be)) continue;
+        if (!token(p, be, &t0, &t1)) continue;  // connection id
+        if (!skip_ws1(p, be)) continue;
+        if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "for")) continue;
+        if (!skip_ws1(p, be)) continue;
+        const char* ia0; const char* ia1; uint32_t ipa, poa;
+        if (!endpoint_colon(p, be, true, &ia0, &ia1, &ipa, &poa)) continue;
+        skip_ws(p, be);
+        if (p < be && *p == '(') {
+            const char* c = (const char*)memchr(p, ')', be - p);
+            if (c) p = c + 1;
+        }
+        skip_ws(p, be);
+        if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "to")) continue;
+        if (!skip_ws1(p, be)) continue;
+        const char* ib0; const char* ib1; uint32_t ipb, pob;
+        if (!endpoint_colon(p, be, true, &ib0, &ib1, &ipb, &pob)) continue;
+        out->acl0 = nullptr; out->acl1 = nullptr;
+        // inbound: initiated at A (src=A, ingress=ifA); outbound: src=B
+        if (inbound) {
+            out->if0 = ia0; out->if1 = ia1;
+            out->src = ipa; out->sport = poa; out->dst = ipb; out->dport = pob;
+        } else {
+            out->if0 = ib0; out->if1 = ib1;
+            out->src = ipb; out->sport = pob; out->dst = ipa; out->dport = poa;
+        }
+        out->proto = proto;
+        return true;
+    }
+}
+
+// Parse one line; emit into the column-major output if valid+resolved.
+//
+// Parity note (syslog.parse_line): _TAG_RE.search finds the FIRST
+// well-formed "%ASA-<d>-<dddddd>:" marker that has a host token before
+// it; the line's fate is then decided by that one tag — an unhandled
+// msgid or a failed body parse means the line is skipped, with no retry
+// against later markers.  Only malformed markers keep the scan going.
+bool handle_line(Packer* pk, const char* ls, const char* le,
+                 uint32_t* out, int64_t cap, int64_t row) {
+    const char* pos = ls;
+    const char* msgid = nullptr;
+    const char* body = nullptr;
+    const char* h0 = nullptr; const char* h1 = nullptr;
+    while (true) {
+        const char* tag = find_sub(pos, le, "%ASA-", 5);
+        if (!tag) return false;
+        pos = tag + 1;
+        const char* t = tag + 5;
+        if (t >= le || !is_dig(*t)) continue;
+        ++t;
+        if (t >= le || *t != '-') continue;
+        ++t;
+        const char* mid = t;
+        int nd = 0;
+        while (t < le && is_dig(*t) && nd < 7) { ++t; ++nd; }
+        if (nd != 6 || t >= le || *t != ':') continue;
+
+        // host: last token (one optional trailing ':') before the marker
+        const char* q = tag;
+        while (q > ls && is_sp(q[-1])) --q;
+        if (q > ls && q[-1] == ':') {
+            --q;
+            while (q > ls && is_sp(q[-1])) --q;
+        }
+        const char* he = q;
+        while (q > ls && !is_sp(q[-1])) --q;
+        if (he == q) continue;  // no host token; try a later marker
+
+        msgid = mid;
+        body = t + 1;
+        skip_ws(body, le);
+        h0 = q; h1 = he;
+        break;
+    }
+
+    Parsed pr;
+    bool ok;
+    if (memcmp(msgid, "106100", 6) == 0) ok = parse_106100(body, le, &pr);
+    else if (memcmp(msgid, "106023", 6) == 0) ok = parse_106023(body, le, &pr);
+    else if (memcmp(msgid, "302013", 6) == 0 || memcmp(msgid, "302015", 6) == 0)
+        ok = parse_302013(body, le, &pr);
+    else return false;  // unhandled message class
+    if (!ok) return false;
+
+    // resolve: named ACL first, else ingress-interface binding
+    std::string& k = pk->keybuf;
+    k.assign(h0, h1 - h0);
+    if (pr.acl0) {
+        k.push_back('\x01');
+        k.append(pr.acl0, pr.acl1 - pr.acl0);
+    } else {
+        k.push_back('\x02');
+        k.append(pr.if0, pr.if1 - pr.if0);
+    }
+    auto it = pk->resolve.find(k);
+    if (it == pk->resolve.end()) return false;
+    if (row >= cap) return false;  // caller guards; belt-and-braces
+    out[0 * cap + row] = it->second;
+    out[1 * cap + row] = pr.proto;
+    out[2 * cap + row] = pr.src;
+    out[3 * cap + row] = pr.sport;
+    out[4 * cap + row] = pr.dst;
+    out[5 * cap + row] = pr.dport;
+    out[6 * cap + row] = 1;
+    return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* asa_packer_new() { return new Packer(); }
+
+void asa_packer_free(void* h) { delete (Packer*)h; }
+
+void asa_packer_add_acl(void* h, const char* fw, const char* acl, uint32_t gid) {
+    Packer* pk = (Packer*)h;
+    std::string k(fw);
+    k.push_back('\x01');
+    k += acl;
+    pk->resolve[k] = gid;
+}
+
+void asa_packer_add_binding(void* h, const char* fw, const char* iface, uint32_t gid) {
+    Packer* pk = (Packer*)h;
+    std::string k(fw);
+    k.push_back('\x02');
+    k += iface;
+    pk->resolve[k] = gid;
+}
+
+int64_t asa_packer_parsed(void* h) { return ((Packer*)h)->parsed; }
+int64_t asa_packer_skipped(void* h) { return ((Packer*)h)->skipped; }
+void asa_packer_set_counts(void* h, int64_t parsed, int64_t skipped) {
+    ((Packer*)h)->parsed = parsed;
+    ((Packer*)h)->skipped = skipped;
+}
+
+// Parse up to max_lines newline-terminated lines from buf[0:len) into the
+// column-major uint32 out[TUPLE_COLS][cap].  With final==0 a trailing
+// fragment without '\n' is left unconsumed; with final!=0 it is parsed
+// as the last line.  Returns bytes consumed; *n_lines_out lines were
+// consumed, *n_valid_out tuples written (rows 0..n_valid-1).
+int64_t asa_pack_chunk(void* h, const char* buf, int64_t len, int final_,
+                       int64_t max_lines, uint32_t* out, int64_t cap,
+                       int64_t* n_lines_out, int64_t* n_valid_out) {
+    Packer* pk = (Packer*)h;
+    const char* p = buf;
+    const char* end = buf + len;
+    int64_t lines = 0, valid = 0;
+    while (p < end && lines < max_lines && valid < cap) {
+        const char* nl = (const char*)memchr(p, '\n', end - p);
+        const char* le = nl ? nl : end;
+        if (!nl && !final_) break;  // incomplete tail line
+        if (handle_line(pk, p, le, out, cap, valid)) {
+            ++valid;
+            ++pk->parsed;
+        } else {
+            ++pk->skipped;
+        }
+        ++lines;
+        p = nl ? nl + 1 : end;
+    }
+    *n_lines_out = lines;
+    *n_valid_out = valid;
+    return p - buf;
+}
+
+// Count newline-terminated lines in buf (resume fast-skip helper).
+int64_t asa_count_lines(const char* buf, int64_t len, int final_,
+                        int64_t max_lines, int64_t* bytes_out) {
+    const char* p = buf;
+    const char* end = buf + len;
+    int64_t lines = 0;
+    while (p < end && lines < max_lines) {
+        const char* nl = (const char*)memchr(p, '\n', end - p);
+        if (!nl && !final_) break;
+        ++lines;
+        p = nl ? nl + 1 : end;
+    }
+    *bytes_out = p - buf;
+    return lines;
+}
+
+}  // extern "C"

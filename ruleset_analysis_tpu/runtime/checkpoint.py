@@ -123,12 +123,22 @@ def save(ckpt_dir: str, snap: Snapshot) -> None:
         f.write(snap_name)
         f.flush()
         os.fsync(f.fileno())
-    prev = _read_pointer(ckpt_dir)
     os.replace(tmp, os.path.join(ckpt_dir, POINTER_FILE))
     _fsync_dir(ckpt_dir)
-    # prune superseded snapshots only after the new pointer is durable
-    if prev and prev != snap_name:
-        _rmtree(os.path.join(ckpt_dir, prev))
+    # Prune everything the new pointer does not reference — superseded
+    # snapshots, orphans from a crash between snapshot rename and pointer
+    # commit, and stale tmp dirs/files — only after the pointer is durable.
+    for entry in os.listdir(ckpt_dir):
+        if entry in (snap_name, POINTER_FILE):
+            continue
+        p = os.path.join(ckpt_dir, entry)
+        if entry.startswith("snap-") or entry.startswith(".tmp-"):
+            _rmtree(p)
+        elif entry.endswith(".ptr.tmp"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
 
 def _fsync_dir(path: str) -> None:

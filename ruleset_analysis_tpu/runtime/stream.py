@@ -40,6 +40,57 @@ def chunked(it: Iterable[str], size: int) -> Iterator[list[str]]:
         yield buf
 
 
+class _TextSource:
+    """Batch source over an iterable of decoded lines (pure-Python parse)."""
+
+    def __init__(self, packed: PackedRuleset, lines: Iterable[str]):
+        self.packer = LinePacker(packed)
+        self._lines = lines
+
+    def set_counts(self, parsed: int, skipped: int) -> None:
+        self.packer.parsed, self.packer.skipped = parsed, skipped
+
+    def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
+        it = iter(self._lines)
+        skipped_ok = 0
+        for _ in range(skip_lines):
+            if next(it, _SENTINEL) is _SENTINEL:
+                break
+            skipped_ok += 1
+        if skipped_ok < skip_lines:
+            from ..errors import ResumeInputMismatch
+
+            raise ResumeInputMismatch(
+                f"snapshot consumed {skip_lines} lines but the input "
+                f"stream has only {skipped_ok}; wrong or truncated log input"
+            )
+        for chunk in chunked(it, batch_size):
+            batch_np = np.ascontiguousarray(
+                self.packer.pack_lines(chunk, batch_size=batch_size).T
+            )
+            yield batch_np, len(chunk)
+
+
+class _FileSource:
+    """Batch source over syslog file(s) via the native C++ parser."""
+
+    def __init__(self, packed: PackedRuleset, paths: list[str]):
+        from ..hostside import fastparse
+
+        self.packer = fastparse.NativePacker(packed)
+        self._paths = paths
+
+    def set_counts(self, parsed: int, skipped: int) -> None:
+        self.packer.set_counts(parsed, skipped)
+
+    def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
+        from ..hostside import fastparse
+
+        return fastparse.batches_from_files(
+            self._paths, self.packer, batch_size, skip_lines=skip_lines
+        )
+
+
 def run_stream(
     packed: PackedRuleset,
     lines: Iterable[str],
@@ -66,6 +117,70 @@ def run_stream(
     ``max_chunks`` stops after N chunks (fault-injection in tests; also a
     cheap "analyze a prefix" knob).
     """
+    return _run_core(
+        packed,
+        _TextSource(packed, lines),
+        cfg,
+        topk=topk,
+        mesh=mesh,
+        profile_dir=profile_dir,
+        max_chunks=max_chunks,
+    )
+
+
+def run_stream_file(
+    packed: PackedRuleset,
+    paths: str | list[str],
+    cfg: AnalysisConfig,
+    *,
+    native: bool | None = None,
+    topk: int = 10,
+    mesh=None,
+    profile_dir: str | None = None,
+    max_chunks: int | None = None,
+):
+    """Analyze syslog file(s), using the native C++ parser when available.
+
+    ``native=None`` auto-selects: the C++ fast path if its library loads
+    (building it on first use), else the pure-Python line path.  Results
+    are identical either way; only host-side parse throughput differs.
+    """
+    from ..hostside import fastparse
+
+    if isinstance(paths, str):
+        paths = [paths]
+    if native is None:
+        native = fastparse.available()
+    if native:
+        source = _FileSource(packed, paths)
+    else:
+        def _lines():
+            for path in paths:
+                with open(path, "r", encoding="utf-8", errors="replace") as f:
+                    yield from f
+
+        source = _TextSource(packed, _lines())
+    return _run_core(
+        packed,
+        source,
+        cfg,
+        topk=topk,
+        mesh=mesh,
+        profile_dir=profile_dir,
+        max_chunks=max_chunks,
+    )
+
+
+def _run_core(
+    packed: PackedRuleset,
+    source,
+    cfg: AnalysisConfig,
+    *,
+    topk: int,
+    mesh,
+    profile_dir: str | None,
+    max_chunks: int | None,
+):
     from ..parallel import mesh as mesh_lib
     from ..parallel.step import make_parallel_step
     from . import checkpoint as ckpt
@@ -77,7 +192,7 @@ def run_stream(
 
     dev_rules = pipeline.ship_ruleset(packed)
     step = make_parallel_step(mesh, cfg, packed.n_keys)
-    packer = LinePacker(packed)
+    packer = source.packer
     fp = ckpt.fingerprint(packed, cfg, mesh.shape[cfg.mesh_axis])
     lines_consumed = 0
     n_chunks = 0
@@ -87,29 +202,16 @@ def run_stream(
         if snap.fingerprint != fp:
             raise ckpt.CheckpointMismatch(
                 f"snapshot in {cfg.checkpoint_dir!r} was taken with a different "
-                "ruleset or sketch geometry; refusing to merge"
+                "ruleset, sketch geometry, batch size, or device count; "
+                "refusing to merge"
             )
         state = pipeline.AnalysisState(
             **{k: jax.device_put(v, mesh_lib.replicated(mesh)) for k, v in snap.arrays.items()}
         )
         tracker = ckpt.restore_tracker(snap, cfg.sketch.topk_capacity)
-        packer.parsed, packer.skipped = snap.parsed, snap.skipped
+        source.set_counts(snap.parsed, snap.skipped)
         lines_consumed = snap.lines_consumed
         n_chunks = snap.n_chunks
-        it = iter(lines)
-        skipped_ok = 0
-        for _ in range(lines_consumed):
-            if next(it, _SENTINEL) is _SENTINEL:
-                break
-            skipped_ok += 1
-        if skipped_ok < lines_consumed:
-            from ..errors import ResumeInputMismatch
-
-            raise ResumeInputMismatch(
-                f"snapshot consumed {lines_consumed} lines but the input "
-                f"stream has only {skipped_ok}; wrong or truncated log input"
-            )
-        lines = it
     else:
         state = pipeline.init_state(packed.n_keys, cfg)
         tracker = TopKTracker(cfg.sketch.topk_capacity)
@@ -147,19 +249,16 @@ def run_stream(
     meter = ThroughputMeter(cfg.report_every_chunks)
     chunks_this_run = 0
     with Profiler(profile_dir):
-        for chunk in chunked(lines, batch_size):
-            batch_np = np.ascontiguousarray(
-                packer.pack_lines(chunk, batch_size=batch_size).T
-            )
+        for batch_np, n_raw_lines in source.batches(lines_consumed, batch_size):
             batch = mesh_lib.shard_batch(mesh, batch_np, cfg.mesh_axis)
             state, out = step(state, dev_rules, batch)
             pending.append(out)
             if len(pending) > 2:
                 drain(pending.popleft())
-            lines_consumed += len(chunk)
+            lines_consumed += n_raw_lines
             n_chunks += 1
             chunks_this_run += 1
-            meter.tick(len(chunk))
+            meter.tick(n_raw_lines)
             if cfg.checkpoint_every_chunks and n_chunks % cfg.checkpoint_every_chunks == 0:
                 save_snapshot()
             if max_chunks is not None and chunks_this_run >= max_chunks:

@@ -128,6 +128,33 @@ def test_load_missing_dir_returns_none(tmp_path):
     assert ckpt.load(str(tmp_path / "nothing")) is None
 
 
+def test_orphans_swept_after_pointer_commit(tmp_path):
+    """A crash between snapshot rename and pointer commit leaves an
+    unreferenced snap dir (and possibly tmp litter); the next successful
+    save must sweep everything the new pointer does not reference."""
+    import os
+
+    snap = ckpt.Snapshot(
+        arrays={"a": np.arange(3, dtype=np.uint32)},
+        lines_consumed=10,
+        n_chunks=2,
+        parsed=10,
+        skipped=0,
+        tracker_tables={},
+        fingerprint="fp",
+    )
+    # simulate crash leftovers
+    (tmp_path / "snap-99").mkdir()
+    (tmp_path / "snap-99" / "state.npz").write_bytes(b"x")
+    (tmp_path / ".tmp-dead").mkdir()
+    (tmp_path / "dead.ptr.tmp").write_text("snap-99")
+    ckpt.save(str(tmp_path), snap)
+    live = (tmp_path / "LATEST").read_text().strip()
+    entries = set(os.listdir(tmp_path))
+    assert entries == {live, "LATEST"}
+    assert ckpt.load(str(tmp_path)).lines_consumed == 10
+
+
 def test_save_is_crash_atomic_pairwise(corpus, tmp_path):
     """A torn save (snapshot dir written, pointer not moved) must resume
     from the PREVIOUS consistent (offset, registers) pair."""

@@ -1,0 +1,266 @@
+"""Native C++ parser parity: fastparse must match the pure-Python path.
+
+The native parser is a from-scratch reimplementation of
+hostside/syslog.py + LinePacker (SURVEY.md §4.3 mapper parse semantics);
+these tests hold the two paths bit-identical on batches, counters,
+streaming chunking, and the full run_stream driver incl. resume.
+"""
+
+import numpy as np
+import pytest
+
+from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+from ruleset_analysis_tpu.hostside import aclparse, fastparse, pack, synth
+
+pytestmark = pytest.mark.skipif(
+    not fastparse.available(), reason="native parser not buildable here"
+)
+
+
+EDGE_CFG = """
+access-list OUT extended permit tcp any host 10.0.0.5 eq 443
+access-list OUT extended deny ip any any
+access-list IN extended permit udp 192.168.0.0 255.255.0.0 any range 5000 6000
+access-group OUT in interface outside
+access-group IN in interface inside
+"""
+
+EDGE_LINES = [
+    "Jul 29 01:02:03 fw9 : %ASA-6-106100: access-list OUT permitted tcp "
+    "outside/1.2.3.4(1234) -> inside/10.0.0.5(443) hit-cnt 1 first hit [0x0, 0x0]",
+    "Jul 29 01:02:03 fw9 : %ASA-6-106100: access-list OUT est-allowed tcp "
+    "outside/1.2.3.4(999) -> inside/10.0.0.5(443) hit-cnt 1",
+    # icmp 106100: parenthesised values are type/code -> type lands in dport
+    "Jul 29 01:02:03 fw9 : %ASA-6-106100: access-list OUT denied icmp "
+    "outside/1.2.3.4(8) -> inside/10.0.0.5(0) hit-cnt 1",
+    'Jul 29 01:02:03 fw9 : %ASA-4-106023: Deny tcp src outside:5.6.7.8/55 '
+    'dst inside:10.0.0.5/443 by access-group "OUT" [0x0, 0x0]',
+    'Jul 29 01:02:03 fw9 : %ASA-4-106023: Deny icmp src outside:5.6.7.8 '
+    'dst inside:10.0.0.5 (type 3, code 1) by access-group "OUT"',
+    "<166>Jul 29 01:02:03 fw9 : %ASA-6-302013: Built inbound TCP connection 123 "
+    "for outside:9.9.9.9/1000 (9.9.9.9/1000) to inside:10.0.0.5/443 (10.0.0.5/443)",
+    "<166>Jul 29 01:02:03 fw9 : %ASA-6-302013: Built outbound UDP connection 9 "
+    "for outside:8.8.8.8/53 (8.8.8.8/53) to inside:192.168.1.7/5500 (192.168.1.7/5500)",
+    "fw9: %ASA-6-302015: Built outbound UDP connection 9 for outside:8.8.8.8/53 "
+    "to inside:192.168.1.7/5501",
+    # unknown host / unknown acl / unhandled msgid / garbage / no host token
+    "Jul 29 01:02:03 other : %ASA-6-106100: access-list OUT permitted tcp "
+    "outside/1.2.3.4(1) -> inside/10.0.0.5(443) x",
+    "Jul 29 01:02:03 fw9 : %ASA-6-106100: access-list NOPE permitted tcp "
+    "outside/1.2.3.4(1) -> inside/10.0.0.5(443) x",
+    "Jul 29 01:02:03 fw9 : %ASA-6-305011: Built dynamic TCP translation",
+    "totally not a syslog line",
+    "%ASA-6-106100: access-list OUT permitted tcp outside/1.2.3.4(1) -> "
+    "inside/10.0.0.5(443) x",
+    # hostname with attached colon, no space before the tag
+    'fw9:%ASA-4-106023: Deny udp src inside:192.168.2.2/5000 '
+    'dst outside:1.1.1.1/6000 by access-group "IN"',
+    # malformed bodies
+    "Jul 29 fw9 : %ASA-6-106100: access-list OUT permitted tcp garbage",
+    'Jul 29 fw9 : %ASA-4-106023: Deny tcp src outside:5.6.7.8 dst missing-group',
+    "Jul 29 fw9 : %ASA-6-302013: Built sideways TCP connection 1 for a:1.2.3.4/5 to b:6.7.8.9/10",
+    # 302013 hitting an interface with no access-group binding
+    "Jul 29 fw9 : %ASA-6-302013: Built inbound TCP connection 5 for "
+    "dmz:9.9.9.9/1000 to inside:10.0.0.5(443)",
+    "",
+]
+
+
+def _edge_packed():
+    rs = aclparse.parse_asa_config(EDGE_CFG, "fw9")
+    return pack.pack_rulesets([rs])
+
+
+def _synth_case(n=4000, seed=0):
+    cfg_text = synth.synth_config(n_acls=3, rules_per_acl=24, seed=seed)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    tuples = synth.synth_tuples(packed, n, seed=seed + 1)
+    lines = synth.render_syslog(packed, tuples, seed=seed + 2)
+    return packed, lines
+
+
+def _both(packed, lines, batch_size):
+    py = pack.LinePacker(packed)
+    ref = py.pack_lines(lines, batch_size=batch_size)
+    nat = fastparse.NativePacker(packed)
+    got = nat.pack_lines(lines, batch_size=batch_size)
+    return py, ref, nat, got
+
+
+class TestParity:
+    def test_edge_corpus(self):
+        packed = _edge_packed()
+        py, ref, nat, got = _both(packed, EDGE_LINES, 32)
+        np.testing.assert_array_equal(ref, got)
+        assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
+        assert py.parsed > 0 and py.skipped > 0  # corpus exercises both
+
+    def test_synth_corpus(self):
+        packed, lines = _synth_case()
+        py, ref, nat, got = _both(packed, lines, len(lines))
+        np.testing.assert_array_equal(ref, got)
+        assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
+
+    def test_crlf_and_no_trailing_newline(self):
+        packed = _edge_packed()
+        data = ("\r\n".join(EDGE_LINES[:6])).encode()  # CRLF, unterminated tail
+        nat = fastparse.NativePacker(packed)
+        out, n_lines, used = nat.pack_chunk(data, 16, final=True)
+        py = pack.LinePacker(packed)
+        ref = py.pack_lines(EDGE_LINES[:6], batch_size=16).T
+        assert n_lines == 6 and used == len(data)
+        np.testing.assert_array_equal(np.ascontiguousarray(ref), out)
+
+    def test_partial_tail_held_back(self):
+        packed = _edge_packed()
+        line = EDGE_LINES[0] + "\n"
+        data = (line + "Jul 29 01:02:03 fw9 : %ASA-6-1061").encode()
+        nat = fastparse.NativePacker(packed)
+        out, n_lines, used = nat.pack_chunk(data, 8, final=False)
+        assert n_lines == 1 and used == len(line.encode())
+        assert out[6, 0] == 1 and out[6, 1] == 0
+
+
+class TestFileStream:
+    def _write(self, tmp_path, lines, name="a.log"):
+        p = tmp_path / name
+        p.write_text("\n".join(lines) + "\n", encoding="utf-8")
+        return str(p)
+
+    def test_batches_match_text_path(self, tmp_path):
+        packed, lines = _synth_case(n=1000)
+        path = self._write(tmp_path, lines)
+        bs = 128  # 1000 lines -> 7 full batches + partial
+        nat = fastparse.NativePacker(packed)
+        got = list(fastparse.batches_from_file(path, nat, bs))
+        py = pack.LinePacker(packed)
+        from ruleset_analysis_tpu.runtime.stream import chunked
+
+        ref = [
+            (np.ascontiguousarray(py.pack_lines(c, batch_size=bs).T), len(c))
+            for c in chunked(iter(lines), bs)
+        ]
+        assert len(got) == len(ref)
+        for (gb, gn), (rb, rn) in zip(got, ref):
+            assert gn == rn
+            np.testing.assert_array_equal(gb, rb)
+        assert (nat.parsed, nat.skipped) == (py.parsed, py.skipped)
+
+    def test_skip_lines_resume(self, tmp_path):
+        packed, lines = _synth_case(n=600)
+        path = self._write(tmp_path, lines)
+        nat = fastparse.NativePacker(packed)
+        got = list(fastparse.batches_from_file(path, nat, 100, skip_lines=250))
+        assert sum(n for _, n in got) == 350
+
+    def test_skip_past_eof_raises(self, tmp_path):
+        from ruleset_analysis_tpu.errors import ResumeInputMismatch
+
+        packed, lines = _synth_case(n=50)
+        path = self._write(tmp_path, lines)
+        nat = fastparse.NativePacker(packed)
+        with pytest.raises(ResumeInputMismatch):
+            list(fastparse.batches_from_file(path, nat, 100, skip_lines=51))
+
+    def test_multi_file_chain_and_skip(self, tmp_path):
+        packed, lines = _synth_case(n=300)
+        p1 = self._write(tmp_path, lines[:120], "a.log")
+        p2 = self._write(tmp_path, lines[120:], "b.log")
+        nat = fastparse.NativePacker(packed)
+        got = list(fastparse.batches_from_files([p1, p2], nat, 64, skip_lines=150))
+        assert sum(n for _, n in got) == 150
+
+    def test_multi_file_batches_identical_to_text_path(self, tmp_path):
+        # files chain into ONE stream: batches straddle the file boundary
+        # exactly like the text path, incl. an unterminated last line
+        packed, lines = _synth_case(n=500)
+        p1 = tmp_path / "a.log"
+        p1.write_text("\n".join(lines[:333]), encoding="utf-8")  # no trailing \n
+        p2 = self._write(tmp_path, lines[333:], "b.log")
+        nat = fastparse.NativePacker(packed)
+        got = list(fastparse.batches_from_files([str(p1), p2], nat, 128))
+        py = pack.LinePacker(packed)
+        from ruleset_analysis_tpu.runtime.stream import chunked
+
+        ref = [
+            (np.ascontiguousarray(py.pack_lines(c, batch_size=128).T), len(c))
+            for c in chunked(iter(lines), 128)
+        ]
+        assert [n for _, n in got] == [n for _, n in ref]
+        for (gb, _), (rb, _) in zip(got, ref):
+            np.testing.assert_array_equal(gb, rb)
+
+    def test_skip_spanning_many_read_blocks(self, tmp_path):
+        # regression: the resume fast-skip must refill mid-fragment when
+        # the skip region spans multiple read blocks
+        packed, lines = _synth_case(n=400)
+        path = self._write(tmp_path, lines)
+        nat = fastparse.NativePacker(packed)
+        got = list(
+            fastparse.batches_from_file(path, nat, 64, skip_lines=301, read_block=64)
+        )
+        assert sum(n for _, n in got) == 99
+
+    def test_full_batches_with_tiny_read_block(self, tmp_path):
+        # regression: when batch_size lines span many read blocks, the
+        # reader must keep buffering until a FULL batch of raw lines is
+        # available — mid-stream chunk boundaries must match the text path
+        packed, lines = _synth_case(n=400)
+        path = self._write(tmp_path, lines)
+        nat = fastparse.NativePacker(packed)
+        got = list(fastparse.batches_from_file(path, nat, 128, read_block=256))
+        assert [n for _, n in got] == [128, 128, 128, 16]
+        py = pack.LinePacker(packed)
+        from ruleset_analysis_tpu.runtime.stream import chunked
+
+        for (gb, _), c in zip(got, chunked(iter(lines), 128)):
+            rb = np.ascontiguousarray(py.pack_lines(c, batch_size=128).T)
+            np.testing.assert_array_equal(gb, rb)
+
+    def test_count_lines(self, tmp_path):
+        p = tmp_path / "c.log"
+        p.write_bytes(b"a\nb\nc")
+        assert fastparse.count_lines_in_file(str(p)) == 3
+        p.write_bytes(b"a\nb\n")
+        assert fastparse.count_lines_in_file(str(p)) == 2
+
+
+class TestDriver:
+    def _cfg(self, **kw):
+        return AnalysisConfig(
+            batch_size=256,
+            sketch=SketchConfig(cms_width=1 << 10, cms_depth=4, hll_p=6),
+            **kw,
+        )
+
+    def test_run_stream_file_matches_text(self, tmp_path):
+        from ruleset_analysis_tpu.runtime.stream import run_stream, run_stream_file
+
+        packed, lines = _synth_case(n=900)
+        path = tmp_path / "s.log"
+        path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+
+        rep_text = run_stream(packed, (ln for ln in lines), self._cfg())
+        rep_nat = run_stream_file(packed, str(path), self._cfg(), native=True)
+        assert rep_nat.per_rule == rep_text.per_rule
+        assert rep_nat.unused == rep_text.unused
+        assert rep_nat.totals["lines_total"] == rep_text.totals["lines_total"]
+        assert rep_nat.totals["lines_matched"] == rep_text.totals["lines_matched"]
+
+    def test_checkpoint_resume_native(self, tmp_path):
+        from ruleset_analysis_tpu.runtime.stream import run_stream_file
+
+        packed, lines = _synth_case(n=1024)
+        path = tmp_path / "s.log"
+        path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+        ckdir = str(tmp_path / "ck")
+
+        full = run_stream_file(packed, str(path), self._cfg(), native=True)
+        # crash after 2 chunks (snapshot each chunk), then resume
+        cfg1 = self._cfg(checkpoint_every_chunks=1, checkpoint_dir=ckdir)
+        run_stream_file(packed, str(path), cfg1, native=True, max_chunks=2)
+        cfg2 = self._cfg(checkpoint_every_chunks=1, checkpoint_dir=ckdir, resume=True)
+        resumed = run_stream_file(packed, str(path), cfg2, native=True)
+        assert resumed.per_rule == full.per_rule
+        assert resumed.totals["lines_total"] == full.totals["lines_total"]
