@@ -1,0 +1,347 @@
+"""Benchmark suite: one JSON line per BASELINE.json config.
+
+The headline driver benchmark stays in bench.py (single JSON line); this
+suite stands up the five configs BASELINE.json names so throughput AND
+accuracy claims are reproducible on real hardware:
+
+  1 exact     single-ruleset exact hit-count throughput + oracle equality
+  2 cms       exact -> count-min sketch width x depth sweep (error, recall)
+  3 hll       per-rule unique-source HLL relative error
+  4 multifw   multi-firewall batched match: flat vs stacked (vmap) paths
+  5 topk      streaming top-K talkers precision vs exact
+
+Run all: ``python bench_suite.py``; one: ``python bench_suite.py cms``.
+Each config prints exactly one JSON line on stdout.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _setup(n_acls=4, rules_per_acl=64, seed=0, firewalls=1):
+    from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+
+    rulesets = [
+        aclparse.parse_asa_config(
+            synth.synth_config(n_acls=n_acls, rules_per_acl=rules_per_acl, seed=seed + i),
+            f"fw{i}",
+        )
+        for i in range(firewalls)
+    ]
+    return pack.pack_rulesets(rulesets)
+
+
+def _tuples(packed, n, seed=0):
+    from ruleset_analysis_tpu.hostside import synth
+
+    return synth.synth_tuples(packed, n, seed=seed)
+
+
+def _time_steps(step, state, rules, feeds, iters):
+    import jax
+
+    state, _ = step(state, rules, feeds[0])  # warmup/compile
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, _ = step(state, rules, feeds[i % len(feeds)])
+    jax.block_until_ready(state)
+    return state, time.perf_counter() - t0
+
+
+# ---------------------------------------------------------------------------
+
+
+def bench_exact() -> dict:
+    """Config #1: single-ruleset exact hit-count; correctness vs oracle."""
+    import jax
+    import jax.numpy as jnp
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+    from ruleset_analysis_tpu.hostside import pack
+    from ruleset_analysis_tpu.models import pipeline
+    from ruleset_analysis_tpu.ops.match import match_keys
+
+    packed = _setup()
+    b = 1 << 20
+    cfg = AnalysisConfig(batch_size=b, sketch=SketchConfig(cms_width=1 << 14, cms_depth=4))
+    state = pipeline.init_state(packed.n_keys, cfg)
+    rules = pipeline.ship_ruleset(packed)
+    feeds = [jnp.asarray(np.ascontiguousarray(_tuples(packed, b, seed=i).T)) for i in range(2)]
+    step = jax.jit(
+        functools.partial(
+            pipeline.analysis_step,
+            n_keys=packed.n_keys,
+            topk_k=cfg.sketch.topk_chunk_candidates,
+        ),
+        donate_argnums=(0,),
+    )
+    iters = 20
+    state, dt = _time_steps(step, state, rules, feeds, iters)
+
+    # correctness: a fresh state stepped over a small batch must hold
+    # exactly the bincount of the device-matched keys (oracle equality of
+    # the match itself is pinned by tests/)
+    t = _tuples(packed, 4096, seed=99)
+    cols = {
+        "acl": jnp.asarray(t[:, pack.T_ACL]), "proto": jnp.asarray(t[:, pack.T_PROTO]),
+        "src": jnp.asarray(t[:, pack.T_SRC]), "sport": jnp.asarray(t[:, pack.T_SPORT]),
+        "dst": jnp.asarray(t[:, pack.T_DST]), "dport": jnp.asarray(t[:, pack.T_DPORT]),
+    }
+    keys = np.asarray(match_keys(cols, rules.rules, rules.deny_key))
+    check_state = pipeline.init_state(packed.n_keys, cfg)
+    check_state, _ = pipeline.analysis_step(
+        check_state, rules, jnp.asarray(np.ascontiguousarray(t.T)),
+        n_keys=packed.n_keys, topk_k=cfg.sketch.topk_chunk_candidates,
+    )
+    want = np.bincount(keys[t[:, pack.T_VALID] == 1], minlength=packed.n_keys)
+    exact_ok = bool((np.asarray(check_state.counts_lo) == want.astype(np.uint32)).all())
+    lines_per_sec = iters * b / dt
+    return {
+        "metric": "config1_exact_hitcount_lines_per_sec_per_chip",
+        "value": round(lines_per_sec / len(jax.devices()), 1),
+        "unit": "lines/sec/chip",
+        "vs_baseline": round(lines_per_sec / len(jax.devices()) / (1e9 / 60 / 8), 4),
+        "detail": {"batch": b, "iters": iters, "rules_rows": int(packed.rules.shape[0]),
+                   "exact_path_ok": exact_ok},
+    }
+
+
+def bench_cms() -> dict:
+    """Config #2: CMS width x depth sweep — one-sided error + unused recall."""
+    import jax.numpy as jnp
+
+    from ruleset_analysis_tpu.ops import cms as cms_ops
+
+    rng = np.random.default_rng(0)
+    n_keys = 4096
+    # zipf-ish key stream: heavy head, long tail, plus keys that never occur
+    raw = rng.zipf(1.3, size=1 << 20).astype(np.uint64)
+    keys = (raw % (n_keys // 2)).astype(np.uint32)  # half the keyspace never hit
+    exact = np.bincount(keys, minlength=n_keys).astype(np.uint64)
+    valid = np.ones_like(keys)
+
+    sweep = []
+    for width in (1 << 10, 1 << 12, 1 << 14, 1 << 16):
+        for depth in (2, 4, 6):
+            cms = cms_ops.cms_init(width, depth)
+            cms = cms_ops.cms_update(cms, jnp.asarray(keys), jnp.asarray(valid))
+            est = cms_ops.cms_query_np(np.asarray(cms), np.arange(n_keys, dtype=np.uint32))
+            over = est.astype(np.int64) - exact.astype(np.int64)
+            assert (over >= 0).all(), "CMS one-sided error violated"
+            # unused-rule recall: of truly-zero keys, fraction estimated zero
+            zero = exact == 0
+            recall = float((est[zero] == 0).mean())
+            sweep.append({
+                "width": width, "depth": depth,
+                "recall_unused": round(recall, 4),
+                "mean_overcount": round(float(over.mean()), 2),
+                "p99_overcount": round(float(np.percentile(over, 99)), 1),
+            })
+            log(f"cms w={width} d={depth} recall={recall:.4f} mean_over={over.mean():.2f}")
+    best = [s for s in sweep if s["recall_unused"] >= 0.99]
+    return {
+        "metric": "config2_cms_unused_recall_at_16k_x4",
+        "value": next(s["recall_unused"] for s in sweep if s["width"] == 1 << 14 and s["depth"] == 4),
+        "unit": "recall",
+        "vs_baseline": round(
+            next(s["recall_unused"] for s in sweep if s["width"] == 1 << 14 and s["depth"] == 4) / 0.99, 4
+        ),
+        "detail": {"stream": int(keys.size), "n_keys": n_keys, "sweep": sweep,
+                   "configs_meeting_99pct": len(best)},
+    }
+
+
+def bench_hll() -> dict:
+    """Config #3: per-rule unique-source HLL relative error."""
+    import jax.numpy as jnp
+
+    from ruleset_analysis_tpu.ops import hll as hll_ops
+
+    rng = np.random.default_rng(1)
+    n_keys = 256
+    p = 8
+    # per-key unique-source populations spanning 4 decades
+    true_cards = np.unique(np.round(np.logspace(1, 5, n_keys)).astype(np.int64))
+    n_keys = len(true_cards)
+    regs = hll_ops.hll_init(n_keys, p)
+    batch = 1 << 20
+    keys_all, src_all = [], []
+    for k, card in enumerate(true_cards):
+        pool = rng.integers(0, 1 << 32, size=card, dtype=np.uint32)
+        draws = pool[rng.integers(0, card, size=min(4 * card, 1 << 18))]
+        keys_all.append(np.full(draws.size, k, dtype=np.uint32))
+        src_all.append(draws)
+    keys = np.concatenate(keys_all)
+    srcs = np.concatenate(src_all)
+    order = rng.permutation(keys.size)
+    keys, srcs = keys[order], srcs[order]
+    for i in range(0, keys.size, batch):
+        regs = hll_ops.hll_update(
+            regs, jnp.asarray(keys[i:i + batch]), jnp.asarray(srcs[i:i + batch]),
+            jnp.ones(keys[i:i + batch].size, dtype=np.uint32),
+        )
+    est = hll_ops.hll_estimate_np(np.asarray(regs))
+    # true uniques actually seen (sampling may miss some of the pool)
+    true_seen = np.array([
+        len(np.unique(srcs[keys == k])) for k in range(n_keys)
+    ])
+    rel = np.abs(est - true_seen) / np.maximum(true_seen, 1)
+    theory = 1.04 / np.sqrt(1 << p)
+    return {
+        "metric": "config3_hll_median_rel_error",
+        "value": round(float(np.median(rel)), 4),
+        "unit": "relative_error",
+        "vs_baseline": round(theory / max(float(np.median(rel)), 1e-9), 4),
+        "detail": {"p": p, "m": 1 << p, "theory_rse": round(theory, 4),
+                   "p90_rel_error": round(float(np.percentile(rel, 90)), 4),
+                   "n_keys": int(n_keys), "stream": int(keys.size)},
+    }
+
+
+def bench_multifw() -> dict:
+    """Config #4: multi-firewall batched match — flat vs stacked (vmap)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+    from ruleset_analysis_tpu.hostside import pack
+    from ruleset_analysis_tpu.models import pipeline
+
+    # Large rulesets: the regime where per-line match cost dominates and
+    # slab-grouping pays (small rulesets are sketch-bound, where grouping
+    # only adds lane padding).
+    firewalls = 8
+    packed = _setup(n_acls=2, rules_per_acl=1024, firewalls=firewalls)
+    g = packed.n_acls
+    total = 1 << 21
+    cfg = AnalysisConfig(batch_size=total, sketch=SketchConfig(cms_width=1 << 14, cms_depth=4))
+
+    raw = [_tuples(packed, total, seed=i) for i in range(2)]
+    # lane = observed max group fill (padded to 256): minimal slack so the
+    # stacked step's padding overhead reflects real skew, not a guess
+    fills = max(
+        int(np.bincount(t[:, pack.T_ACL].astype(np.int64), minlength=g).max())
+        for t in raw
+    )
+    lane = ((fills + 255) // 256) * 256
+    log(f"multifw: {firewalls} firewalls, {g} ACL groups, "
+        f"{packed.rules.shape[0]} flat rows, lane={lane} (max fill {fills})")
+
+    flat_feed = [jnp.asarray(np.ascontiguousarray(t.T)) for t in raw]
+    grouped_feed = [jnp.asarray(pack.group_tuples(t, g, lane)) for t in raw]
+
+    state = pipeline.init_state(packed.n_keys, cfg)
+    rules = pipeline.ship_ruleset(packed)
+    flat_step = jax.jit(
+        functools.partial(pipeline.analysis_step, n_keys=packed.n_keys,
+                          topk_k=cfg.sketch.topk_chunk_candidates),
+        donate_argnums=(0,),
+    )
+    iters = 8
+    _, dt_flat = _time_steps(flat_step, state, rules, flat_feed, iters)
+    flat_lps = iters * total / dt_flat
+
+    state2 = pipeline.init_state(packed.n_keys, cfg)
+    rules3d = pipeline.ship_ruleset_stacked(packed)
+    g_step = jax.jit(
+        functools.partial(pipeline.analysis_step_stacked, n_keys=packed.n_keys,
+                          topk_k=cfg.sketch.topk_chunk_candidates),
+        donate_argnums=(0,),
+    )
+    per_batch_valid = int(np.asarray(grouped_feed[0][:, pack.T_VALID, :]).sum())
+    _, dt_g = _time_steps(g_step, state2, rules3d, grouped_feed, iters)
+    stacked_lps = iters * per_batch_valid / dt_g
+
+    return {
+        "metric": "config4_multifw_stacked_lines_per_sec_per_chip",
+        "value": round(stacked_lps / len(jax.devices()), 1),
+        "unit": "lines/sec/chip",
+        "vs_baseline": round(stacked_lps / max(flat_lps, 1.0), 4),  # speedup vs flat
+        "detail": {
+            "firewalls": firewalls, "groups": g,
+            "flat_rows": int(packed.rules.shape[0]),
+            "slab_rows": int(np.asarray(rules3d.rules3d).shape[1]),
+            "flat_lines_per_sec": round(flat_lps, 1),
+            "stacked_lines_per_sec": round(stacked_lps, 1),
+        },
+    }
+
+
+def bench_topk() -> dict:
+    """Config #5: streaming top-K talkers precision vs exact."""
+    import jax.numpy as jnp
+
+    from ruleset_analysis_tpu.ops import cms as cms_ops
+    from ruleset_analysis_tpu.ops import topk as topk_ops
+
+    rng = np.random.default_rng(2)
+    n_chunks, chunk = 32, 1 << 16
+    k = 10
+    acls = rng.integers(0, 4, size=n_chunks * chunk).astype(np.uint32)
+    # zipf sources: the heavy hitters we must recover
+    src = (rng.zipf(1.2, size=n_chunks * chunk) % 50000).astype(np.uint32)
+    talk = cms_ops.cms_init(1 << 14, 4)
+    tracker = topk_ops.TopKTracker(capacity=4096)
+    valid = np.ones(chunk, dtype=np.uint32)
+    for c in range(n_chunks):
+        sl = slice(c * chunk, (c + 1) * chunk)
+        talk, ca, cs, ce = topk_ops.talker_chunk_update(
+            talk, jnp.asarray(acls[sl]), jnp.asarray(src[sl]), jnp.asarray(valid), 64
+        )
+        tracker.offer_chunk(np.asarray(ca), np.asarray(cs), np.asarray(ce))
+    # exact top-K per acl
+    import collections
+
+    precisions = []
+    for a in range(4):
+        cnt = collections.Counter(src[acls == a].tolist())
+        exact_top = {s for s, _ in cnt.most_common(k)}
+        got_top = {s for s, _ in tracker.top(a, k)}
+        precisions.append(len(exact_top & got_top) / k)
+        log(f"topk acl={a} precision@{k}={precisions[-1]:.2f}")
+    return {
+        "metric": "config5_topk_precision_at_10",
+        "value": round(float(np.mean(precisions)), 4),
+        "unit": "precision",
+        "vs_baseline": round(float(np.mean(precisions)) / 0.9, 4),
+        "detail": {"chunks": n_chunks, "chunk": chunk,
+                   "per_acl": [round(p, 3) for p in precisions]},
+    }
+
+
+BENCHES = {
+    "exact": bench_exact,
+    "cms": bench_cms,
+    "hll": bench_hll,
+    "multifw": bench_multifw,
+    "topk": bench_topk,
+}
+
+
+def main(argv: list[str]) -> int:
+    names = argv or list(BENCHES)
+    for name in names:
+        if name not in BENCHES:
+            log(f"unknown bench {name!r}; choices: {list(BENCHES)}")
+            return 2
+        log(f"=== {name} ===")
+        t0 = time.perf_counter()
+        result = BENCHES[name]()
+        result["detail"]["bench_wall_sec"] = round(time.perf_counter() - t0, 1)
+        print(json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -40,6 +40,12 @@ from .syslog import ParsedLine, parse_line
 RULE_COLS = 12
 TUPLE_COLS = 7
 
+#: Rule-axis block size for the match kernel's scan path (defined here,
+#: jax-free, so host-side packing/stacking and the device kernel share
+#: one padding granularity).  Keeps each [B, RULE_BLOCK] predicate tile
+#: comfortably inside VMEM at B = 64k.
+RULE_BLOCK = 512
+
 # rule matrix columns
 R_ACL, R_PLO, R_PHI, R_SLO, R_SHI, R_SPLO, R_SPHI, R_DLO, R_DHI, R_DPLO, R_DPHI, R_KEY = range(12)
 # tuple columns
@@ -190,6 +196,124 @@ class LinePacker:
 
     def pack_lines(self, lines: list[str], batch_size: int | None = None) -> np.ndarray:
         return self.pack_parsed([parse_line(ln) for ln in lines], batch_size)
+
+
+# ---------------------------------------------------------------------------
+# Stacked (grouped) form: BASELINE.json config #4 "multi-firewall batched
+# ruleset match (vmap over rulesets)".  The flat rule matrix scans EVERY
+# firewall's rows for every line; grouping lines by their ACL and stacking
+# each ACL's rows into one padded slab drops the per-line cost from
+# O(total rows) to O(max slab rows), with the match kernel vmapped over
+# the group axis.  Grouping lines host-side is the rebuilt analog of the
+# reference's shuffle partitioning (SURVEY.md §3c).
+# ---------------------------------------------------------------------------
+
+
+def stack_rules(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> np.ndarray:
+    """[G, Rmax, RULE_COLS] uint32: each ACL's expanded rows, padded.
+
+    Row order inside each slab preserves global config order, so the
+    first-match == min-local-row-index invariant carries over.  Rmax is
+    padded to ``rule_block`` granularity when any slab exceeds one block
+    (the scan path of the match kernel requires it).
+    """
+    g = max(packed.n_acls, 1)
+    real = packed.rules[packed.rules[:, R_ACL] != NO_ACL]
+    counts = np.bincount(real[:, R_ACL].astype(np.int64), minlength=g) if real.size else np.zeros(g, np.int64)
+    rmax = max(int(counts.max()) if counts.size else 0, 1)
+    if rmax > rule_block:
+        rmax = ((rmax + rule_block - 1) // rule_block) * rule_block
+    out = np.zeros((g, rmax, RULE_COLS), dtype=np.uint32)
+    out[:, :, R_ACL] = NO_ACL
+    fill = np.zeros(g, dtype=np.int64)
+    for row in real:
+        gid = int(row[R_ACL])
+        out[gid, fill[gid]] = row
+        fill[gid] += 1
+    return out
+
+
+def group_tuples(batch: np.ndarray, n_groups: int, lane: int) -> np.ndarray:
+    """One-shot grouping: [B, TUPLE_COLS] rows -> [G, TUPLE_COLS, lane].
+
+    Valid rows are bucketed by their ACL gid; raises if any bucket
+    overflows ``lane`` (streaming callers use :class:`GroupBuffer`, which
+    carries overflow to the next grouped batch instead).
+    """
+    out = np.zeros((n_groups, TUPLE_COLS, lane), dtype=np.uint32)
+    valid = batch[batch[:, T_VALID] == 1]
+    if not valid.size:
+        return out
+    gids = valid[:, T_ACL].astype(np.int64)
+    if gids.max() >= n_groups or np.bincount(gids, minlength=n_groups).max() > lane:
+        raise ValueError("bucket overflow: raise lane or use GroupBuffer")
+    order = np.argsort(gids, kind="stable")
+    sv, sg = valid[order], gids[order]
+    starts = np.searchsorted(sg, np.arange(n_groups))
+    ends = np.searchsorted(sg, np.arange(n_groups), side="right")
+    for gid in range(n_groups):
+        n = ends[gid] - starts[gid]
+        if n:
+            out[gid, :, :n] = sv[starts[gid]:ends[gid]].T
+    return out
+
+
+class GroupBuffer:
+    """Streaming per-ACL bucketing with overflow carry.
+
+    Feed packed row-major batches; grouped batches ``[G, TUPLE_COLS,
+    lane]`` are emitted whenever some bucket has a full lane (draining all
+    buckets simultaneously, shorter ones padded with valid=0), so memory
+    stays bounded under group skew.
+    """
+
+    def __init__(self, n_groups: int, lane: int):
+        self.n_groups = n_groups
+        self.lane = lane
+        self._q: list[list[np.ndarray]] = [[] for _ in range(n_groups)]
+        self._qlen = np.zeros(n_groups, dtype=np.int64)
+
+    def add(self, batch: np.ndarray) -> list[np.ndarray]:
+        """Add a [B, TUPLE_COLS] batch; return any full grouped batches."""
+        valid = batch[batch[:, T_VALID] == 1]
+        if valid.size:
+            gids = valid[:, T_ACL].astype(np.int64)
+            order = np.argsort(gids, kind="stable")
+            sv, sg = valid[order], gids[order]
+            starts = np.searchsorted(sg, np.arange(self.n_groups))
+            ends = np.searchsorted(sg, np.arange(self.n_groups), side="right")
+            for gid in np.unique(sg):
+                rows = sv[starts[gid]:ends[gid]]
+                self._q[gid].append(rows)
+                self._qlen[gid] += rows.shape[0]
+        out = []
+        while self._qlen.max(initial=0) >= self.lane:
+            out.append(self._emit())
+        return out
+
+    def flush(self) -> list[np.ndarray]:
+        """Emit remaining buffered lines as (padded) grouped batches."""
+        out = []
+        while self._qlen.max(initial=0) > 0:
+            out.append(self._emit())
+        return out
+
+    def _emit(self) -> np.ndarray:
+        out = np.zeros((self.n_groups, TUPLE_COLS, self.lane), dtype=np.uint32)
+        for gid in range(self.n_groups):
+            take = min(self.lane, int(self._qlen[gid]))
+            filled = 0
+            while filled < take:
+                head = self._q[gid][0]
+                n = min(head.shape[0], take - filled)
+                out[gid, :, filled:filled + n] = head[:n].T
+                filled += n
+                if n == head.shape[0]:
+                    self._q[gid].pop(0)
+                else:
+                    self._q[gid][0] = head[n:]
+            self._qlen[gid] -= take
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -29,7 +29,7 @@ from ..ops import cms as cms_ops
 from ..ops import counts as count_ops
 from ..ops import hll as hll_ops
 from ..ops import topk as topk_ops
-from ..ops.match import RULE_BLOCK, match_keys
+from ..ops.match import RULE_BLOCK, match_keys, match_keys_stacked
 
 _U32 = jnp.uint32
 
@@ -91,6 +91,34 @@ def init_state(n_keys: int, cfg: AnalysisConfig) -> AnalysisState:
     )
 
 
+def _update_registers(
+    state: AnalysisState,
+    keys: jax.Array,  # [B] u32 count keys (matched rule / implicit deny)
+    valid: jax.Array,  # [B] u32 mask
+    src: jax.Array,  # [B] u32 source IPs
+    acl: jax.Array,  # [B] u32 ACL gids
+    *,
+    n_keys: int,
+    topk_k: int,
+    exact_counts: bool,
+) -> tuple[AnalysisState, ChunkOut]:
+    """Shared register tail: the reducer's whole job, for any match layout."""
+    if exact_counts:
+        delta = count_ops.segment_counts(keys, valid, n_keys)
+        lo, hi = count_ops.add64(state.counts_lo, state.counts_hi, delta)
+    else:
+        lo, hi = state.counts_lo, state.counts_hi
+    cms = cms_ops.cms_update(state.cms, keys, valid)
+    hll = hll_ops.hll_update(state.hll, keys, src, valid)
+    talk_cms, ca, cs, ce = topk_ops.talker_chunk_update(
+        state.talk_cms, acl, src, valid, topk_k
+    )
+    return (
+        AnalysisState(counts_lo=lo, counts_hi=hi, cms=cms, hll=hll, talk_cms=talk_cms),
+        ChunkOut(cand_acl=ca, cand_src=cs, cand_est=ce),
+    )
+
+
 def analysis_step(
     state: AnalysisState,
     ruleset: DeviceRuleset,
@@ -110,22 +138,63 @@ def analysis_step(
         "dst": batch[T_DST],
         "dport": batch[T_DPORT],
     }
-    valid = batch[T_VALID]
     keys = match_keys(cols, ruleset.rules, ruleset.deny_key, rule_block)
-
-    if exact_counts:
-        delta = count_ops.segment_counts(keys, valid, n_keys)
-        lo, hi = count_ops.add64(state.counts_lo, state.counts_hi, delta)
-    else:
-        lo, hi = state.counts_lo, state.counts_hi
-    cms = cms_ops.cms_update(state.cms, keys, valid)
-    hll = hll_ops.hll_update(state.hll, keys, cols["src"], valid)
-    talk_cms, ca, cs, ce = topk_ops.talker_chunk_update(
-        state.talk_cms, cols["acl"], cols["src"], valid, topk_k
+    return _update_registers(
+        state, keys, batch[T_VALID], cols["src"], cols["acl"],
+        n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts,
     )
-    return (
-        AnalysisState(counts_lo=lo, counts_hi=hi, cms=cms, hll=hll, talk_cms=talk_cms),
-        ChunkOut(cand_acl=ca, cand_src=cs, cand_est=ce),
+
+
+class DeviceRulesetStacked(NamedTuple):
+    """Device-resident stacked rule slabs (BASELINE.json config #4)."""
+
+    rules3d: jax.Array  # [G, Rmax, RULE_COLS] uint32
+    deny_key: jax.Array  # [n_acls] uint32
+
+
+def ship_ruleset_stacked(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> DeviceRulesetStacked:
+    from ..hostside.pack import stack_rules
+
+    return DeviceRulesetStacked(
+        rules3d=jnp.asarray(stack_rules(packed, rule_block)),
+        deny_key=jnp.asarray(packed.deny_key.astype(np.uint32)),
+    )
+
+
+def analysis_step_stacked(
+    state: AnalysisState,
+    ruleset: DeviceRulesetStacked,
+    batch: jax.Array,  # [G, TUPLE_COLS, Bg] uint32, grouped by ACL gid
+    *,
+    n_keys: int,
+    topk_k: int,
+    exact_counts: bool = True,
+    rule_block: int = RULE_BLOCK,
+) -> tuple[AnalysisState, ChunkOut]:
+    """Grouped-batch variant of analysis_step (vmap over rule slabs).
+
+    The match runs per-group against only that ACL's slab; the mergeable
+    register updates are order-invariant, so the resulting state is
+    identical to the flat step fed the same multiset of lines.
+    """
+    cols = {
+        "acl": batch[:, T_ACL, :],
+        "proto": batch[:, T_PROTO, :],
+        "src": batch[:, T_SRC, :],
+        "sport": batch[:, T_SPORT, :],
+        "dst": batch[:, T_DST, :],
+        "dport": batch[:, T_DPORT, :],
+    }
+    keys = match_keys_stacked(cols, ruleset.rules3d, ruleset.deny_key, rule_block).reshape(-1)
+    return _update_registers(
+        state,
+        keys,
+        batch[:, T_VALID, :].reshape(-1),
+        cols["src"].reshape(-1),
+        cols["acl"].reshape(-1),
+        n_keys=n_keys,
+        topk_k=topk_k,
+        exact_counts=exact_counts,
     )
 
 

@@ -37,13 +37,10 @@ from ..hostside.pack import (
     R_SPHI,
     R_SPLO,
     R_KEY,
+    RULE_BLOCK,  # re-export: the kernel-facing name for the block size
 )
 
 _U32 = jnp.uint32
-
-#: Rule-axis block size for the scan path: keeps each [B, RULE_BLOCK]
-#: predicate tile comfortably inside VMEM at B = 64k.
-RULE_BLOCK = 512
 
 
 def _block_min_row(cols: dict, rules: jnp.ndarray, base: jnp.ndarray) -> jnp.ndarray:
@@ -107,6 +104,42 @@ def first_match_rows(
     init = jnp.full(cols["acl"].shape, NO_MATCH, dtype=_U32)
     best, _ = lax.scan(body, init, (blocks, bases))
     return best
+
+
+def first_match_rows_stacked(
+    cols: dict,
+    rules3d: jnp.ndarray,
+    rule_block: int = RULE_BLOCK,
+) -> jnp.ndarray:
+    """Grouped first-match: vmap of the kernel over stacked rule slabs.
+
+    cols: dict of [G, Bg] uint32 arrays, lines pre-bucketed by ACL gid
+    (pack.group_tuples / pack.GroupBuffer); rules3d: [G, Rmax, RULE_COLS]
+    from pack.stack_rules.  Returns [G, Bg] LOCAL slab row indices
+    (NO_MATCH where nothing matches).  Each line only scans its own ACL's
+    slab — O(Rmax) per line instead of the flat path's O(total rows)
+    (BASELINE.json config #4).
+    """
+    return jax.vmap(
+        lambda c, r: first_match_rows(c, r, rule_block), in_axes=(0, 0)
+    )(cols, rules3d)
+
+
+def match_keys_stacked(
+    cols: dict,
+    rules3d: jnp.ndarray,
+    deny_key: jnp.ndarray,
+    rule_block: int = RULE_BLOCK,
+) -> jnp.ndarray:
+    """Count-key per line for the grouped layout ([G, Bg] in and out)."""
+    row = first_match_rows_stacked(cols, rules3d, rule_block)
+    matched = row != NO_MATCH
+    safe_row = jnp.where(matched, row, _U32(0))
+    keys3 = rules3d[:, :, R_KEY].astype(_U32)  # [G, Rmax]
+    rule_key = jnp.take_along_axis(keys3, safe_row, axis=1)
+    acl = jnp.minimum(cols["acl"], _U32(deny_key.shape[0] - 1))
+    deny = deny_key.astype(_U32)[acl]
+    return jnp.where(matched, rule_key, deny)
 
 
 def match_keys(
