@@ -59,12 +59,21 @@ class SketchConfig:
     hll_p: int = 8  # 256 registers/rule -> ~6.5% per-rule cardinality error
     topk_capacity: int = 256  # host-side talker-summary size per ACL
     topk_chunk_candidates: int = 64  # device top_k candidates fed per chunk
+    #: Depth of the (acl, src) talker CMS.  Unlike the per-rule CMS, its
+    #: estimates only rank talkers (the tracker keeps the max estimate
+    #: across chunks), so a shallow sketch suffices — and its scatter cost
+    #: scales with depth x batch, a large share of the whole device step.
+    talk_cms_depth: int = 2
 
     def __post_init__(self) -> None:
         if self.cms_width < 2 or self.cms_width & (self.cms_width - 1):
             raise ValueError(f"cms_width must be a power of two >= 2, got {self.cms_width}")
         if not 1 <= self.cms_depth <= MAX_CMS_DEPTH:
             raise ValueError(f"cms_depth must be in 1..{MAX_CMS_DEPTH}, got {self.cms_depth}")
+        if not 1 <= self.talk_cms_depth <= MAX_CMS_DEPTH:
+            raise ValueError(
+                f"talk_cms_depth must be in 1..{MAX_CMS_DEPTH}, got {self.talk_cms_depth}"
+            )
         if not 1 <= self.hll_p <= 16:
             raise ValueError(f"hll_p must be in 1..16, got {self.hll_p}")
         if self.topk_capacity < 1 or self.topk_chunk_candidates < 1:

@@ -247,15 +247,25 @@ def group_tuples(batch: np.ndarray, n_groups: int, lane: int) -> np.ndarray:
     gids = valid[:, T_ACL].astype(np.int64)
     if gids.max() >= n_groups or np.bincount(gids, minlength=n_groups).max() > lane:
         raise ValueError("bucket overflow: raise lane or use GroupBuffer")
-    order = np.argsort(gids, kind="stable")
-    sv, sg = valid[order], gids[order]
-    starts = np.searchsorted(sg, np.arange(n_groups))
-    ends = np.searchsorted(sg, np.arange(n_groups), side="right")
+    sv, starts, ends = _bucket_by_gid(valid, gids, n_groups)
     for gid in range(n_groups):
         n = ends[gid] - starts[gid]
         if n:
             out[gid, :, :n] = sv[starts[gid]:ends[gid]].T
     return out
+
+
+def _bucket_by_gid(valid_rows: np.ndarray, gids: np.ndarray, n_groups: int):
+    """Stable-sort rows by gid; return (sorted_rows, starts, ends).
+
+    The STABLE sort is load-bearing: intra-group line order must survive
+    bucketing so grouped and flat paths see the same per-group sequences.
+    """
+    order = np.argsort(gids, kind="stable")
+    sg = gids[order]
+    starts = np.searchsorted(sg, np.arange(n_groups))
+    ends = np.searchsorted(sg, np.arange(n_groups), side="right")
+    return valid_rows[order], starts, ends
 
 
 class GroupBuffer:
@@ -278,14 +288,12 @@ class GroupBuffer:
         valid = batch[batch[:, T_VALID] == 1]
         if valid.size:
             gids = valid[:, T_ACL].astype(np.int64)
-            order = np.argsort(gids, kind="stable")
-            sv, sg = valid[order], gids[order]
-            starts = np.searchsorted(sg, np.arange(self.n_groups))
-            ends = np.searchsorted(sg, np.arange(self.n_groups), side="right")
-            for gid in np.unique(sg):
-                rows = sv[starts[gid]:ends[gid]]
-                self._q[gid].append(rows)
-                self._qlen[gid] += rows.shape[0]
+            sv, starts, ends = _bucket_by_gid(valid, gids, self.n_groups)
+            for gid in range(self.n_groups):
+                if ends[gid] > starts[gid]:
+                    rows = sv[starts[gid]:ends[gid]]
+                    self._q[gid].append(rows)
+                    self._qlen[gid] += rows.shape[0]
         out = []
         while self._qlen.max(initial=0) >= self.lane:
             out.append(self._emit())

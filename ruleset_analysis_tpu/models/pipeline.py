@@ -87,7 +87,7 @@ def init_state(n_keys: int, cfg: AnalysisConfig) -> AnalysisState:
         counts_hi=jnp.zeros(n_keys, dtype=_U32),
         cms=cms_ops.cms_init(s.cms_width, s.cms_depth),
         hll=hll_ops.hll_init(n_keys, s.hll_p),
-        talk_cms=cms_ops.cms_init(s.cms_width, s.cms_depth),
+        talk_cms=cms_ops.cms_init(s.cms_width, s.talk_cms_depth),
     )
 
 
@@ -101,17 +101,24 @@ def _update_registers(
     n_keys: int,
     topk_k: int,
     exact_counts: bool,
+    salt: jax.Array | int = 0,
 ) -> tuple[AnalysisState, ChunkOut]:
     """Shared register tail: the reducer's whole job, for any match layout."""
+    # One bincount into the (small) key space feeds BOTH the exact counts
+    # and the CMS: count-min updates are linear in per-key increments, so
+    # updating from [n_keys] aggregated deltas instead of [B] raw lines is
+    # bit-identical and turns the batch-sized CMS scatter into a
+    # key-space-sized one (~free; the batch-sized scatter dominated the
+    # whole step at 1M-line chunks).
+    delta = count_ops.segment_counts(keys, valid, n_keys)
     if exact_counts:
-        delta = count_ops.segment_counts(keys, valid, n_keys)
         lo, hi = count_ops.add64(state.counts_lo, state.counts_hi, delta)
     else:
         lo, hi = state.counts_lo, state.counts_hi
-    cms = cms_ops.cms_update(state.cms, keys, valid)
+    cms = cms_ops.cms_update(state.cms, jnp.arange(n_keys, dtype=_U32), delta)
     hll = hll_ops.hll_update(state.hll, keys, src, valid)
     talk_cms, ca, cs, ce = topk_ops.talker_chunk_update(
-        state.talk_cms, acl, src, valid, topk_k
+        state.talk_cms, acl, src, valid, topk_k, salt=salt
     )
     return (
         AnalysisState(counts_lo=lo, counts_hi=hi, cms=cms, hll=hll, talk_cms=talk_cms),
@@ -128,6 +135,7 @@ def analysis_step(
     topk_k: int,
     exact_counts: bool = True,
     rule_block: int = RULE_BLOCK,
+    salt: jax.Array | int = 0,
 ) -> tuple[AnalysisState, ChunkOut]:
     """One fused device step over a batch of packed log lines."""
     cols = {
@@ -141,7 +149,7 @@ def analysis_step(
     keys = match_keys(cols, ruleset.rules, ruleset.deny_key, rule_block)
     return _update_registers(
         state, keys, batch[T_VALID], cols["src"], cols["acl"],
-        n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts,
+        n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts, salt=salt,
     )
 
 
@@ -170,6 +178,7 @@ def analysis_step_stacked(
     topk_k: int,
     exact_counts: bool = True,
     rule_block: int = RULE_BLOCK,
+    salt: jax.Array | int = 0,
 ) -> tuple[AnalysisState, ChunkOut]:
     """Grouped-batch variant of analysis_step (vmap over rule slabs).
 
@@ -195,6 +204,7 @@ def analysis_step_stacked(
         n_keys=n_keys,
         topk_k=topk_k,
         exact_counts=exact_counts,
+        salt=salt,
     )
 
 

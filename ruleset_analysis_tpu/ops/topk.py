@@ -23,9 +23,16 @@ import jax.numpy as jnp
 from jax import lax
 
 from .cms import cms_query, cms_update
-from .hashing import hash_pair
+from .hashing import fmix32, hash_pair
 
 _U32 = jnp.uint32
+
+
+#: Chunk-local candidate table size.  Far larger than any realistic k, so
+#: within one chunk a heavy hitter rarely loses its slot to a collision;
+#: across chunks the slot hash is re-salted (see ``salt``), so no pair of
+#: talkers can collide persistently.
+CAND_SLOTS = 1 << 15
 
 
 def talker_chunk_update(
@@ -34,37 +41,61 @@ def talker_chunk_update(
     src: jnp.ndarray,
     valid: jnp.ndarray,
     k: int,
+    salt: jnp.ndarray | int = 0,
 ):
     """Absorb one chunk; return (new_cms, cand_acl, cand_src, cand_est).
 
     The candidate estimates are post-update global CMS estimates, masked to
-    0 for invalid lines so they can never displace real candidates.
+    0 for suppressed/empty slots so they can never displace real candidates.
+    ``salt`` re-randomizes the candidate table's slot assignment; stream
+    drivers pass the chunk counter so collisions cannot persist across
+    chunks while staying deterministic for checkpoint resume.
     """
     pair = hash_pair(acl, src)
     new_cms = cms_update(talk_cms, pair, valid)
-    cand = select_candidates(new_cms, acl, src, valid, min(k, acl.shape[0]))
+    cand = select_candidates(new_cms, acl, src, valid, min(k, acl.shape[0]), salt=salt)
     return (new_cms, *cand)
 
 
-def select_candidates(talk_cms, acl, src, valid, k):
-    """Top-k distinct (acl, src) candidates of this batch by CMS estimate.
+def select_candidates(talk_cms, acl, src, valid, k, slots: int = CAND_SLOTS,
+                      salt: jnp.ndarray | int = 0):
+    """Top-k distinct (acl, src) candidates of this chunk.
 
-    Dedup within the chunk first: a hot talker fills thousands of lines,
-    and top_k over raw per-line scores would return k copies of it,
-    crowding out ranks 2..k.  Keep only each pair's first occurrence
-    (sort once, mark sorted-adjacent duplicates, scatter the mask back).
+    A naive "dedup then top_k over the batch" costs a full argsort of the
+    batch (the old implementation dominated the whole analysis step).
+    Instead, pairs hash into a ``slots``-sized chunk-local table with two
+    scatters — per-slot frequency (add) and a representative line index
+    (max) — and ``top_k`` runs over the small table, not the batch:
+
+      batch-sized work: 2 scatters + 1 hash  (vs argsort + scatter + top_k)
+      table-sized work: one top_k over ``slots``
+
+    Selection ranks by in-chunk frequency (Misra-Gries flavored); the
+    reported estimate is the global post-update CMS estimate of each
+    winner, so the host tracker's values stay chunk-order invariant.
+    Distinct pairs colliding in a slot suppress the rarer pair — for that
+    chunk AND every chunk with the same ``salt``, which is why streaming
+    callers pass a per-chunk salt (the suppressed pair then surfaces
+    under the next salt).
     """
+    b = acl.shape[0]
     pair = hash_pair(acl, src)
-    est = cms_query(talk_cms, pair) * valid.astype(_U32)
-    order = jnp.argsort(pair)
-    sorted_pair = pair[order]
-    first_sorted = jnp.concatenate(
-        [jnp.ones(1, dtype=jnp.bool_), sorted_pair[1:] != sorted_pair[:-1]]
+    slot = fmix32(pair ^ jnp.asarray(salt, dtype=_U32)) & _U32(slots - 1)
+    v32 = valid.astype(_U32)
+    cnt = jnp.zeros(slots, dtype=_U32).at[slot].add(v32, mode="drop")
+    iota = lax.broadcasted_iota(jnp.int32, (b,), 0)
+    rep = (
+        jnp.full(slots, -1, dtype=jnp.int32)
+        .at[slot]
+        .max(jnp.where(v32 > 0, iota, -1), mode="drop")
     )
-    first = jnp.zeros_like(first_sorted).at[order].set(first_sorted)
-    score = jnp.minimum(est * first.astype(_U32), _U32(0x7FFFFFFF)).astype(jnp.int32)
-    _, idx = lax.top_k(score, k)
-    return acl[idx], src[idx], est[idx] * first[idx].astype(_U32)
+    top_cnt, top_slot = lax.top_k(cnt.astype(jnp.int32), k)
+    rep_idx = rep[top_slot]
+    safe = jnp.maximum(rep_idx, 0)
+    ca, cs = acl[safe], src[safe]
+    est = cms_query(talk_cms, hash_pair(ca, cs))
+    ok = ((rep_idx >= 0) & (top_cnt > 0)).astype(_U32)
+    return ca * ok, cs * ok, est * ok
 
 
 class TopKTracker:

@@ -42,6 +42,7 @@ def _local_shard_step(
     state: AnalysisState,
     ruleset: DeviceRuleset,
     batch: jax.Array,  # [TUPLE_COLS, B/n] local shard
+    salt: jax.Array,  # u32 scalar (chunk counter), replicated
     *,
     axis: str,
     n_keys: int,
@@ -49,6 +50,10 @@ def _local_shard_step(
     exact_counts: bool,
     rule_block: int,
 ) -> tuple[AnalysisState, ChunkOut]:
+    # Mirrors pipeline._update_registers with the collective merges
+    # interleaved at the law-of-merge seams (psum for adds, pmax for max);
+    # tests/test_parallel.py pins it bit-identical to the single-device
+    # step over the concatenated batch.
     cols = {
         "acl": batch[T_ACL],
         "proto": batch[T_PROTO],
@@ -60,16 +65,15 @@ def _local_shard_step(
     valid = batch[T_VALID]
     keys = match_keys(cols, ruleset.rules, ruleset.deny_key, rule_block)
 
+    # one globally-merged bincount feeds exact counts AND the per-rule CMS
+    # (linear in per-key increments — see pipeline._update_registers);
+    # the batch-sized CMS scatter this replaces dominated the shard step
+    delta = lax.psum(count_ops.segment_counts(keys, valid, n_keys), axis)
     if exact_counts:
-        delta = count_ops.segment_counts(keys, valid, n_keys)
-        delta = lax.psum(delta, axis)
         lo, hi = count_ops.add64(state.counts_lo, state.counts_hi, delta)
     else:
         lo, hi = state.counts_lo, state.counts_hi
-
-    d, w = state.cms.shape
-    delta_cms = cms_ops.cms_update(jnp.zeros((d, w), _U32), keys, valid)
-    cms = state.cms + lax.psum(delta_cms, axis)
+    cms = cms_ops.cms_update(state.cms, jnp.arange(n_keys, dtype=_U32), delta)
 
     delta_hll = hll_ops.hll_update(
         jnp.zeros_like(state.hll), keys, cols["src"], valid
@@ -84,7 +88,8 @@ def _local_shard_step(
     # candidate selection against the *merged* global talker sketch, then
     # gather every device's candidates so the host sees them all, replicated
     ca, cs, ce = topk_ops.select_candidates(
-        talk_cms, cols["acl"], cols["src"], valid, min(topk_k, valid.shape[0])
+        talk_cms, cols["acl"], cols["src"], valid, min(topk_k, valid.shape[0]),
+        salt=salt,
     )
     cand_acl = lax.all_gather(ca, axis, tiled=True)
     cand_src = lax.all_gather(cs, axis, tiled=True)
@@ -119,8 +124,13 @@ def make_parallel_step(
     sharded = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(), P(), P(None, axis)),
+        in_specs=(P(), P(), P(None, axis), P()),
         out_specs=(P(), P()),
         check_vma=False,
     )
-    return jax.jit(sharded, donate_argnums=(0,))
+    jitted = jax.jit(sharded, donate_argnums=(0,))
+
+    def step(state, ruleset, batch, salt: int | jax.Array = 0):
+        return jitted(state, ruleset, batch, jnp.asarray(salt, dtype=_U32))
+
+    return step

@@ -61,7 +61,7 @@ def fingerprint(packed: PackedRuleset, cfg: AnalysisConfig, n_shards: int = 1) -
     s = cfg.sketch
     padded = ((cfg.batch_size + n_shards - 1) // n_shards) * n_shards
     h.update(
-        f"{s.cms_width},{s.cms_depth},{s.hll_p},{cfg.exact_counts},"
+        f"{s.cms_width},{s.cms_depth},{s.talk_cms_depth},{s.hll_p},{cfg.exact_counts},"
         f"{padded},{n_shards},{s.topk_chunk_candidates},{s.topk_capacity}".encode()
     )
     return h.hexdigest()[:16]

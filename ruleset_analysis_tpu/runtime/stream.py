@@ -251,7 +251,10 @@ def _run_core(
     with Profiler(profile_dir):
         for batch_np, n_raw_lines in source.batches(lines_consumed, batch_size):
             batch = mesh_lib.shard_batch(mesh, batch_np, cfg.mesh_axis)
-            state, out = step(state, dev_rules, batch)
+            # salt = chunk index: re-randomizes candidate-table slots per
+            # chunk (no persistent talker collisions) yet replays exactly
+            # on resume since n_chunks is restored from the snapshot
+            state, out = step(state, dev_rules, batch, n_chunks)
             pending.append(out)
             if len(pending) > 2:
                 drain(pending.popleft())

@@ -53,10 +53,37 @@ class TestStackRules:
             want = real[real[:, pack.R_ACL] == gid]
             np.testing.assert_array_equal(rows, want)  # order preserved
 
-    def test_padding_never_matches(self, multi_fw):
-        rules3d = pack.stack_rules(multi_fw)
-        pad = rules3d[rules3d[:, :, pack.R_ACL] == pack.NO_ACL]
-        assert (pad[:, pack.R_ACL] == pack.NO_ACL).all()
+    def test_padding_never_matches(self):
+        """Slab padding rows must lose to the implicit deny, even for
+        all-zero tuple fields (which a zeroed padding row would 'match'
+        if its ACL id were left real)."""
+        import jax.numpy as jnp
+
+        from ruleset_analysis_tpu.ops.match import match_keys_stacked
+
+        cfg = (
+            "access-list A extended permit tcp host 10.0.0.1 host 10.0.0.2 eq 80\n"
+            "access-list B extended permit ip any any\n"
+            "access-list B extended deny ip any any\n"
+        )
+        rs = aclparse.parse_asa_config(cfg, "fw1")
+        packed = pack.pack_rulesets([rs])
+        rules3d = pack.stack_rules(packed)
+        assert rules3d.shape[1] >= 2  # ACL A's slab has >= 1 padding row
+        # a line on ACL A (gid 0) with all-zero fields: matches nothing
+        # real, and must NOT match A's zero-valued padding rows
+        cols = {
+            k: jnp.zeros((packed.n_acls, 4), dtype=jnp.uint32)
+            for k in ["acl", "proto", "src", "sport", "dst", "dport"]
+        }
+        cols["acl"] = jnp.broadcast_to(
+            jnp.arange(packed.n_acls, dtype=jnp.uint32)[:, None], (packed.n_acls, 4)
+        )
+        keys = np.asarray(
+            match_keys_stacked(cols, jnp.asarray(rules3d), jnp.asarray(packed.deny_key))
+        )
+        gid_a = packed.acl_gid[("fw1", "A")]
+        assert (keys[gid_a] == packed.deny_key[gid_a]).all()
 
 
 class TestGrouping:
