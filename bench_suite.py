@@ -320,12 +320,118 @@ def bench_topk() -> dict:
     }
 
 
+def bench_pallas() -> dict:
+    """Match-kernel shootout: XLA-fused vs pallas, small and large rulesets."""
+    import jax
+    import jax.numpy as jnp
+
+    from ruleset_analysis_tpu.hostside import pack
+    from ruleset_analysis_tpu.ops import pallas_match
+    from ruleset_analysis_tpu.ops.match import first_match_rows
+
+    b = 1 << 20
+    results = {}
+    from ruleset_analysis_tpu.models import pipeline
+
+    for tag, rules_per_acl in (("small", 64), ("large", 1024)):
+        packed = _setup(n_acls=4, rules_per_acl=rules_per_acl)
+        t = _tuples(packed, b, seed=0)
+        cols = {
+            k: jnp.asarray(t[:, i])
+            for k, i in zip(["acl", "proto", "src", "sport", "dst", "dport"], range(6))
+        }
+        # block-padded exactly as the pipeline ships it (the scan path of
+        # first_match_rows asserts rule_block alignment)
+        shipped = pipeline.ship_ruleset(packed, match_impl="pallas")
+        rules, fm = shipped.rules, shipped.rules_fm
+
+        def run(fn, *args):
+            out = fn(*args)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            n = 10
+            for _ in range(n):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / n
+
+        xla_fn = jax.jit(lambda c: first_match_rows(c, rules))
+        pl_fn = jax.jit(lambda c: pallas_match.first_match_rows_pallas(c, fm))
+        got = np.asarray(pl_fn(cols))
+        want = np.asarray(xla_fn(cols))
+        assert (got == want).all(), f"pallas/xla mismatch ({tag})"
+        dt_x, dt_p = run(xla_fn, cols), run(pl_fn, cols)
+        results[tag] = {
+            "rows": int(rules.shape[0]),
+            "xla_mlines_per_sec": round(b / dt_x / 1e6, 1),
+            "pallas_mlines_per_sec": round(b / dt_p / 1e6, 1),
+            "pallas_speedup": round(dt_x / dt_p, 3),
+        }
+        log(f"pallas[{tag}]: xla {b/dt_x/1e6:.1f}M vs pallas {b/dt_p/1e6:.1f}M lines/s")
+    return {
+        "metric": "pallas_match_speedup_vs_xla_large_ruleset",
+        "value": results["large"]["pallas_speedup"],
+        "unit": "speedup",
+        "vs_baseline": results["large"]["pallas_speedup"],
+        "detail": results,
+    }
+
+
+def bench_e2e() -> dict:
+    """Full system: raw syslog text file -> report (host parse + device).
+
+    The north-star metric is END-TO-END lines/min, so this measures the
+    whole path the CLI takes: native C++ parse of raw bytes, packing,
+    device analysis, report assembly.  The host parse runs on one CPU
+    core here; on multi-core v5e hosts it scales per-process/per-core.
+    """
+    import os
+    import tempfile
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+    from ruleset_analysis_tpu.hostside import fastparse, synth
+    from ruleset_analysis_tpu.runtime.stream import run_stream_file
+
+    packed = _setup()
+    n = 2_000_000
+    log(f"rendering {n} syslog lines...")
+    tuples = _tuples(packed, n, seed=0)
+    lines = synth.render_syslog(packed, tuples, seed=1)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bench.log")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+        del lines
+        size_mb = os.path.getsize(path) / 1e6
+        cfg = AnalysisConfig(
+            batch_size=1 << 19,
+            sketch=SketchConfig(cms_width=1 << 14, cms_depth=4, hll_p=8),
+        )
+        rep = run_stream_file(packed, path, cfg, native=None)  # auto-select
+    lps = rep.totals["lines_per_sec"]
+    return {
+        "metric": "e2e_text_to_report_lines_per_sec",
+        "value": lps,
+        "unit": "lines/sec",
+        "vs_baseline": round(lps / (1e9 / 60 / 8), 4),  # vs north-star/chip
+        "detail": {
+            "lines": n,
+            "file_mb": round(size_mb, 1),
+            "native_parse": fastparse.available(),
+            "host_cores": os.cpu_count(),
+            "totals": rep.totals,
+        },
+    }
+
+
 BENCHES = {
     "exact": bench_exact,
     "cms": bench_cms,
     "hll": bench_hll,
     "multifw": bench_multifw,
     "topk": bench_topk,
+    "pallas": bench_pallas,
+    "e2e": bench_e2e,
 }
 
 

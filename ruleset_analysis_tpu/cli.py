@@ -67,6 +67,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             checkpoint_every_chunks=args.checkpoint_every,
             resume=args.resume,
             report_every_chunks=args.report_every,
+            match_impl=args.match_impl,
             **({"checkpoint_dir": args.checkpoint_dir} if args.checkpoint_dir else {}),
         )
     except ValueError as e:
@@ -202,6 +203,8 @@ def make_parser() -> argparse.ArgumentParser:
                    help="print throughput to stderr every N chunks")
     p.add_argument("--native-parse", action=argparse.BooleanOptionalAction, default=None,
                    help="use the C++ host parser (default: auto when logs are files)")
+    p.add_argument("--match-impl", choices=["xla", "pallas"], default="xla",
+                   help="first-match kernel (bench_suite.py pallas compares them)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here (TensorBoard profile)")
     p.add_argument("--json", action="store_true")

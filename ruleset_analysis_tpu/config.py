@@ -98,12 +98,18 @@ class AnalysisConfig:
     resume: bool = False  # resume from checkpoint_dir if a snapshot exists
     report_every_chunks: int = 0  # 0 = no periodic throughput lines on stderr
     seed: int = 0
+    #: First-match kernel implementation: "xla" (fused predicate, default)
+    #: or "pallas" (explicit-layout TPU kernel, ops/pallas_match.py).
+    #: ``bench_suite.py pallas`` compares them on the deployment hardware.
+    match_impl: str = "xla"
 
     def __post_init__(self) -> None:
         if self.batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {self.batch_size}")
         if self.checkpoint_every_chunks < 0:
             raise ValueError("checkpoint_every_chunks must be >= 0")
+        if self.match_impl not in ("xla", "pallas"):
+            raise ValueError(f"match_impl must be 'xla' or 'pallas', got {self.match_impl!r}")
 
     def replace(self, **kw) -> "AnalysisConfig":
         return dataclasses.replace(self, **kw)

@@ -39,6 +39,9 @@ class DeviceRuleset(NamedTuple):
 
     rules: jax.Array  # [R, RULE_COLS] uint32, R % rule_block == 0
     deny_key: jax.Array  # [n_acls] uint32
+    #: field-major lane-padded twin for the pallas kernel; None on the
+    #: default XLA path (ship_ruleset(match_impl="pallas") fills it)
+    rules_fm: jax.Array | None = None
 
 
 class AnalysisState(NamedTuple):
@@ -73,10 +76,21 @@ def pad_rules(rules: np.ndarray, rule_block: int = RULE_BLOCK) -> np.ndarray:
     return out
 
 
-def ship_ruleset(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> DeviceRuleset:
+def ship_ruleset(
+    packed: PackedRuleset,
+    rule_block: int = RULE_BLOCK,
+    match_impl: str = "xla",
+) -> DeviceRuleset:
+    rules = jnp.asarray(pad_rules(packed.rules, rule_block))
+    rules_fm = None
+    if match_impl == "pallas":
+        from ..ops import pallas_match
+
+        rules_fm = pallas_match.prep_rules(rules)
     return DeviceRuleset(
-        rules=jnp.asarray(pad_rules(packed.rules, rule_block)),
+        rules=rules,
         deny_key=jnp.asarray(packed.deny_key.astype(np.uint32)),
+        rules_fm=rules_fm,
     )
 
 
@@ -136,6 +150,7 @@ def analysis_step(
     exact_counts: bool = True,
     rule_block: int = RULE_BLOCK,
     salt: jax.Array | int = 0,
+    match_impl: str = "xla",
 ) -> tuple[AnalysisState, ChunkOut]:
     """One fused device step over a batch of packed log lines."""
     cols = {
@@ -146,7 +161,14 @@ def analysis_step(
         "dst": batch[T_DST],
         "dport": batch[T_DPORT],
     }
-    keys = match_keys(cols, ruleset.rules, ruleset.deny_key, rule_block)
+    if match_impl == "pallas" and ruleset.rules_fm is not None:
+        from ..ops import pallas_match
+
+        keys = pallas_match.match_keys_pallas(
+            cols, ruleset.rules, ruleset.rules_fm, ruleset.deny_key
+        )
+    else:
+        keys = match_keys(cols, ruleset.rules, ruleset.deny_key, rule_block)
     return _update_registers(
         state, keys, batch[T_VALID], cols["src"], cols["acl"],
         n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts, salt=salt,

@@ -1,0 +1,194 @@
+"""Pallas TPU kernel for the first-match scan (alternative to ops.match).
+
+The XLA-fused predicate (ops/match.py) keeps the VPU reasonably busy,
+but it re-decides tiling per shape and materializes block temporaries at
+the compiler's discretion.  This kernel pins the layout explicitly:
+
+- line fields live along SUBLANES ([BLOCK_LINES, 1] per field), rule
+  fields along LANES ([1, 128] per rule tile), so one VPU op evaluates
+  128 rules for 8 lines;
+- the whole (transposed, lane-padded) rule tensor stays resident in
+  VMEM across the batch grid; the running min over rule tiles is a
+  register carry in a ``fori_loop`` — nothing [B, R]-shaped ever exists;
+- first-match == min matching global rule index, as in ops.match
+  (pack.py emits rows in config order — the parity-critical invariant).
+
+Use :func:`first_match_rows_pallas` as a drop-in for
+``ops.match.first_match_rows``; ``tests/test_pallas_match.py`` pins
+equality (interpret mode on CPU, compiled on TPU) and ``bench_suite.py
+pallas`` compares throughput.  Select per deployment with
+``AnalysisConfig(match_impl="pallas")`` (or ``--match-impl pallas`` on
+the CLI); the default stays "xla".
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..hostside.pack import (
+    R_ACL,
+    R_DHI,
+    R_DLO,
+    R_DPHI,
+    R_DPLO,
+    R_PHI,
+    R_PLO,
+    R_SHI,
+    R_SLO,
+    R_SPHI,
+    R_SPLO,
+    RULE_COLS,
+)
+from .match import NO_MATCH
+
+_U32 = jnp.uint32
+#: Python-int twin of ops.match.NO_MATCH — pallas kernels cannot capture
+#: module-level jax arrays, only literals.
+_NO_MATCH = 0xFFFFFFFF
+
+#: Lines per grid step (sublane-major).  4096 lines x 128-rule tiles keeps
+#: the compare temporary at 2 MB and the six field blocks at 96 KB.
+BLOCK_LINES = 4096
+
+#: Rules per lane tile — the VPU lane width.
+RULE_TILE = 128
+
+
+def _kernel(acl, proto, src, sport, dst, dport, rules, out, *, n_tiles: int):
+    """One batch block vs every rule tile; running-min carry over tiles.
+
+    Refs: six [BLOCK_LINES, 1] u32 line fields; rules [RULE_COLS, R]
+    u32 (field-major, lane-padded); out [BLOCK_LINES, 1] u32.
+    """
+    a = acl[:]
+    p = proto[:]
+    s = src[:]
+    sp = sport[:]
+    d = dst[:]
+    dp = dport[:]
+
+    def body(t, best):
+        sl = pl.ds(t * RULE_TILE, RULE_TILE)
+
+        def row(c):
+            return rules[c, sl][None, :]  # [1, RULE_TILE]
+
+        ok = (
+            (row(R_ACL) == a)
+            & (row(R_PLO) <= p)
+            & (p <= row(R_PHI))
+            & (row(R_SLO) <= s)
+            & (s <= row(R_SHI))
+            & (row(R_SPLO) <= sp)
+            & (sp <= row(R_SPHI))
+            & (row(R_DLO) <= d)
+            & (d <= row(R_DHI))
+            & (row(R_DPLO) <= dp)
+            & (dp <= row(R_DPHI))
+        )
+        idx = (
+            lax.broadcasted_iota(_U32, (1, RULE_TILE), 1)
+            + (t * RULE_TILE).astype(_U32)
+        )
+        cand = jnp.where(ok, jnp.broadcast_to(idx, ok.shape), _U32(_NO_MATCH))
+        return jnp.minimum(best, jnp.min(cand, axis=1, keepdims=True))
+
+    init = jnp.full((a.shape[0], 1), _NO_MATCH, dtype=_U32)
+    out[:] = lax.fori_loop(0, n_tiles, body, init)
+
+
+def prep_rules(rules: jnp.ndarray) -> jnp.ndarray:
+    """[R, RULE_COLS] row-major -> [RULE_COLS, Rp] field-major, lane-padded.
+
+    Padding columns carry NO_MATCH in the ACL field so they never match
+    (mirrors pack.py's NO_ACL padding rows).
+    """
+    r = rules.shape[0]
+    rp = ((r + RULE_TILE - 1) // RULE_TILE) * RULE_TILE
+    t = jnp.transpose(rules.astype(_U32))  # [RULE_COLS, R]
+    if rp != r:
+        pad = jnp.zeros((RULE_COLS, rp - r), dtype=_U32).at[R_ACL].set(NO_MATCH)
+        t = jnp.concatenate([t, pad], axis=1)
+    return t
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_lines", "interpret")
+)
+def first_match_rows_pallas(
+    cols: dict,
+    rules_fm: jnp.ndarray,  # [RULE_COLS, Rp] from prep_rules
+    block_lines: int = BLOCK_LINES,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Global row index of the first matching ACE per line (pallas path).
+
+    cols: dict of [B] uint32 arrays (acl/proto/src/sport/dst/dport).
+    Returns [B] u32, NO_MATCH where no rule matches — bit-compatible
+    with ops.match.first_match_rows.  ``interpret=None`` auto-selects:
+    compiled on TPU, the pallas interpreter on the CPU test backend
+    (pallas_call has no compiled CPU lowering).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    b = cols["acl"].shape[0]
+    rp = rules_fm.shape[1]
+    assert rp % RULE_TILE == 0
+    block_lines = min(block_lines, _ceil_to(b, 8))
+    bp = _ceil_to(b, block_lines)
+
+    def field(name):
+        v = cols[name]
+        if bp != b:  # padded lines produce garbage rows, sliced off below
+            v = jnp.concatenate([v, jnp.zeros(bp - b, dtype=_U32)])
+        return v.reshape(bp, 1)
+
+    line_spec = pl.BlockSpec((block_lines, 1), lambda i: (i, 0))
+    out = pl.pallas_call(
+        functools.partial(_kernel, n_tiles=rp // RULE_TILE),
+        grid=(bp // block_lines,),
+        in_specs=[line_spec] * 6
+        + [pl.BlockSpec((RULE_COLS, rp), lambda i: (0, 0))],
+        out_specs=line_spec,
+        out_shape=jax.ShapeDtypeStruct((bp, 1), _U32),
+        interpret=interpret,
+    )(
+        field("acl"),
+        field("proto"),
+        field("src"),
+        field("sport"),
+        field("dst"),
+        field("dport"),
+        rules_fm,
+    )
+    return out.reshape(bp)[:b]
+
+
+def _ceil_to(n: int, m: int) -> int:
+    return ((n + m - 1) // m) * m
+
+
+def match_keys_pallas(
+    cols: dict,
+    rules: jnp.ndarray,  # [R, RULE_COLS] row-major (DeviceRuleset.rules)
+    rules_fm: jnp.ndarray,  # prep_rules(rules)
+    deny_key: jnp.ndarray,
+    block_lines: int = BLOCK_LINES,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Count-key per line via the pallas kernel (ops.match.match_keys twin)."""
+    from ..hostside.pack import R_KEY
+
+    row = first_match_rows_pallas(cols, rules_fm, block_lines, interpret)
+    matched = row != NO_MATCH
+    safe_row = jnp.where(matched, row, _U32(0))
+    rule_key = rules[:, R_KEY].astype(_U32)[safe_row]
+    acl = jnp.minimum(cols["acl"], _U32(deny_key.shape[0] - 1))
+    deny = deny_key.astype(_U32)[acl]
+    return jnp.where(matched, rule_key, deny)

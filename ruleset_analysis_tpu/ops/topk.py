@@ -73,10 +73,12 @@ def select_candidates(talk_cms, acl, src, valid, k, slots: int = CAND_SLOTS,
     Selection ranks by in-chunk frequency (Misra-Gries flavored); the
     reported estimate is the global post-update CMS estimate of each
     winner, so the host tracker's values stay chunk-order invariant.
-    Distinct pairs colliding in a slot suppress the rarer pair — for that
-    chunk AND every chunk with the same ``salt``, which is why streaming
-    callers pass a per-chunk salt (the suppressed pair then surfaces
-    under the next salt).
+    Distinct pairs colliding in a slot: the pair whose LAST occurrence in
+    the chunk is later holds the representative (the max-line-index
+    scatter), the other is suppressed — and the slot's rank is inflated
+    by both pairs' counts.  The same two pairs collide in every chunk
+    with the same ``salt``, which is why streaming callers pass a
+    per-chunk salt: the suppressed pair surfaces under the next salt.
     """
     b = acl.shape[0]
     pair = hash_pair(acl, src)

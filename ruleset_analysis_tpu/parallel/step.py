@@ -49,6 +49,7 @@ def _local_shard_step(
     topk_k: int,
     exact_counts: bool,
     rule_block: int,
+    match_impl: str = "xla",
 ) -> tuple[AnalysisState, ChunkOut]:
     # Mirrors pipeline._update_registers with the collective merges
     # interleaved at the law-of-merge seams (psum for adds, pmax for max);
@@ -63,7 +64,14 @@ def _local_shard_step(
         "dport": batch[T_DPORT],
     }
     valid = batch[T_VALID]
-    keys = match_keys(cols, ruleset.rules, ruleset.deny_key, rule_block)
+    if match_impl == "pallas" and ruleset.rules_fm is not None:
+        from ..ops import pallas_match
+
+        keys = pallas_match.match_keys_pallas(
+            cols, ruleset.rules, ruleset.rules_fm, ruleset.deny_key
+        )
+    else:
+        keys = match_keys(cols, ruleset.rules, ruleset.deny_key, rule_block)
 
     # one globally-merged bincount feeds exact counts AND the per-rule CMS
     # (linear in per-key increments — see pipeline._update_registers);
@@ -120,6 +128,7 @@ def make_parallel_step(
         topk_k=cfg.sketch.topk_chunk_candidates,
         exact_counts=cfg.exact_counts,
         rule_block=rule_block,
+        match_impl=cfg.match_impl,
     )
     sharded = jax.shard_map(
         local,

@@ -190,7 +190,7 @@ def _run_core(
         mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
     batch_size = mesh_lib.pad_batch_size(cfg.batch_size, mesh, cfg.mesh_axis)
 
-    dev_rules = pipeline.ship_ruleset(packed)
+    dev_rules = pipeline.ship_ruleset(packed, match_impl=cfg.match_impl)
     step = make_parallel_step(mesh, cfg, packed.n_keys)
     packer = source.packer
     fp = ckpt.fingerprint(packed, cfg, mesh.shape[cfg.mesh_axis])
