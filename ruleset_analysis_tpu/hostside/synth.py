@@ -153,6 +153,32 @@ def synth_tuples(
     return out
 
 
+def synth_syslog_file(
+    packed: PackedRuleset,
+    path: str,
+    n_lines: int,
+    seed: int = 0,
+    miss_fraction: float = 0.1,
+    chunk: int = 1 << 18,
+) -> None:
+    """Write ``n_lines`` of synthetic ASA syslog text to ``path``.
+
+    Chunked generation keeps memory bounded; the text round-trips the real
+    parse path (text tier), so this is the feedstock for end-to-end
+    benchmarks and tests.
+    """
+    with open(path, "w", encoding="utf-8") as f:
+        remaining = n_lines
+        i = 0
+        while remaining > 0:
+            m = min(chunk, remaining)
+            t = synth_tuples(packed, m, seed=seed + i, miss_fraction=miss_fraction)
+            f.write("\n".join(render_syslog(packed, t, seed=seed + i)))
+            f.write("\n")
+            remaining -= m
+            i += 1
+
+
 _PROTO_NAMES = {6: "tcp", 17: "udp", 1: "icmp"}
 
 

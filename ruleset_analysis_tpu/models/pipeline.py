@@ -105,6 +105,33 @@ def init_state(n_keys: int, cfg: AnalysisConfig) -> AnalysisState:
     )
 
 
+def init_state_host(n_keys: int, cfg: AnalysisConfig) -> AnalysisState:
+    """Numpy twin of :func:`init_state` — same pytree, no JAX backend touched.
+
+    Lets entry points build example arguments without initializing any
+    device plugin (jax.jit accepts numpy leaves); the driver's own jit call
+    is then the first and only backend contact.
+    """
+    s = cfg.sketch
+    u32 = np.uint32
+    return AnalysisState(
+        counts_lo=np.zeros(n_keys, dtype=u32),
+        counts_hi=np.zeros(n_keys, dtype=u32),
+        cms=np.zeros((s.cms_depth, s.cms_width), dtype=u32),
+        hll=np.zeros((n_keys, s.hll_m), dtype=u32),
+        talk_cms=np.zeros((s.talk_cms_depth, s.cms_width), dtype=u32),
+    )
+
+
+def ship_ruleset_host(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> DeviceRuleset:
+    """Numpy twin of :func:`ship_ruleset` (XLA match path only) — no backend."""
+    return DeviceRuleset(
+        rules=pad_rules(packed.rules, rule_block),
+        deny_key=packed.deny_key.astype(np.uint32),
+        rules_fm=None,
+    )
+
+
 def _update_registers(
     state: AnalysisState,
     keys: jax.Array,  # [B] u32 count keys (matched rule / implicit deny)

@@ -21,7 +21,9 @@
 #include <cstdint>
 #include <cstring>
 #include <string>
+#include <thread>
 #include <unordered_map>
+#include <vector>
 
 namespace {
 
@@ -33,7 +35,17 @@ struct Packer {
     std::unordered_map<std::string, uint32_t> resolve;
     int64_t parsed = 0;   // valid tuples emitted (LinePacker.parsed)
     int64_t skipped = 0;  // lines not parsed/resolved (LinePacker.skipped)
+};
+
+// Per-thread parse context: the shared resolve table is read-only during a
+// parse; everything mutable is thread-local so N workers can parse one
+// batch's line ranges concurrently (the Hadoop input-split analog,
+// SURVEY.md §2 L2).
+struct LocalCtx {
+    const std::unordered_map<std::string, uint32_t>* resolve;
     std::string keybuf;
+    int64_t parsed = 0;
+    int64_t skipped = 0;
 };
 
 inline bool is_sp(char c) { return c == ' ' || c == '\t' || c == '\v' || c == '\f' || c == '\r' || c == '\n'; }
@@ -125,9 +137,10 @@ uint32_t proto_num(const char* t0, const char* t1) {
             buf[i] = (c >= 'A' && c <= 'Z') ? (char)(c + 32) : c;
         }
         buf[n] = 0;
+        // ordered by real-traffic frequency: tcp/udp dominate ASA logs
         struct { const char* name; uint32_t v; } static const tbl[] = {
-            {"ip", 0},   {"icmp", 1},  {"igmp", 2},  {"ipinip", 4},
-            {"tcp", 6},  {"udp", 17},  {"gre", 47},  {"esp", 50},
+            {"tcp", 6},  {"udp", 17},  {"icmp", 1},  {"ip", 0},
+            {"igmp", 2}, {"ipinip", 4}, {"gre", 47},  {"esp", 50},
             {"ah", 51},  {"icmp6", 58}, {"eigrp", 88}, {"ospf", 89},
             {"nos", 94}, {"pim", 103}, {"pcp", 108}, {"snp", 109},
             {"sctp", 132},
@@ -378,7 +391,7 @@ bool parse_302013(const char* b, const char* be, Parsed* out) {
 // it; the line's fate is then decided by that one tag — an unhandled
 // msgid or a failed body parse means the line is skipped, with no retry
 // against later markers.  Only malformed markers keep the scan going.
-bool handle_line(Packer* pk, const char* ls, const char* le,
+bool handle_line(LocalCtx* pk, const char* ls, const char* le,
                  uint32_t* out, int64_t cap, int64_t row) {
     const char* pos = ls;
     const char* msgid = nullptr;
@@ -435,8 +448,8 @@ bool handle_line(Packer* pk, const char* ls, const char* le,
         k.push_back('\x02');
         k.append(pr.if0, pr.if1 - pr.if0);
     }
-    auto it = pk->resolve.find(k);
-    if (it == pk->resolve.end()) return false;
+    auto it = pk->resolve->find(k);
+    if (it == pk->resolve->end()) return false;
     if (row >= cap) return false;  // caller guards; belt-and-braces
     out[0 * cap + row] = it->second;
     out[1 * cap + row] = pr.proto;
@@ -479,34 +492,168 @@ void asa_packer_set_counts(void* h, int64_t parsed, int64_t skipped) {
     ((Packer*)h)->skipped = skipped;
 }
 
+// Zero the padding rows [valid, cap) of every column.  Callers allocate
+// the output uninitialized (np.empty); the contract is "padding rows are
+// all-zero", matching the pure-Python LinePacker exactly while memsetting
+// only the (usually small) tail instead of the whole 28 MB buffer.
+void zero_tail(uint32_t* out, int64_t cap, int64_t valid) {
+    for (int64_t c = 0; c < TUPLE_COLS; ++c)
+        memset(out + c * cap + valid, 0, (size_t)(cap - valid) * sizeof(uint32_t));
+}
+
 // Parse up to max_lines newline-terminated lines from buf[0:len) into the
-// column-major uint32 out[TUPLE_COLS][cap].  With final==0 a trailing
-// fragment without '\n' is left unconsumed; with final!=0 it is parsed
-// as the last line.  Returns bytes consumed; *n_lines_out lines were
-// consumed, *n_valid_out tuples written (rows 0..n_valid-1).
+// column-major uint32 out[TUPLE_COLS][cap], using up to n_threads parse
+// workers over contiguous line ranges.  With final==0 a trailing fragment
+// without '\n' is left unconsumed; with final!=0 it is parsed as the last
+// line.  Returns bytes consumed; *n_lines_out lines were consumed,
+// *n_valid_out tuples written (rows 0..n_valid-1; rows beyond are zero).
+//
+// Parallel structure (SURVEY.md §2 L2 — the input-split analog): one
+// memchr pass builds the line-offset index; lines split evenly across
+// workers; each worker parses its range into a private column-major slab
+// with a thread-local context; a sequential compaction then concatenates
+// the slabs' valid rows in range order.  The output — tuple order, counts,
+// consumed bytes — is bit-identical to the single-threaded parse.
+int64_t asa_pack_chunk_mt(void* h, const char* buf, int64_t len, int final_,
+                          int64_t max_lines, uint32_t* out, int64_t cap,
+                          int64_t* n_lines_out, int64_t* n_valid_out,
+                          int n_threads) {
+    Packer* pk = (Packer*)h;
+    const char* end = buf + len;
+    int64_t want = max_lines < cap ? max_lines : cap;
+
+    // the parallel path indexes lines with uint32 offsets, and its
+    // even-line split can't honor the "keep consuming raw lines while
+    // valid < cap" contract that binds when max_lines > cap — route both
+    // cases through the exact sequential loop
+    if (n_threads != 1 && (len > (int64_t)0xFFFFFFFF || max_lines > cap))
+        n_threads = 1;
+
+    if (n_threads == 1) {
+        // direct streaming loop: no line index, no scratch — the
+        // fastest path for one core and the reference semantics for the
+        // parity tests
+        LocalCtx cx{&pk->resolve, {}, 0, 0};
+        const char* p = buf;
+        int64_t lines = 0, valid = 0;
+        while (p < end && lines < max_lines && valid < cap) {
+            const char* nl = (const char*)memchr(p, '\n', end - p);
+            const char* le = nl ? nl : end;
+            if (!nl && !final_) break;  // incomplete tail line
+            if (handle_line(&cx, p, le, out, cap, valid)) {
+                ++valid;
+                ++cx.parsed;
+            } else {
+                ++cx.skipped;
+            }
+            ++lines;
+            p = nl ? nl + 1 : end;
+        }
+        pk->parsed += cx.parsed;
+        pk->skipped += cx.skipped;
+        zero_tail(out, cap, valid);
+        *n_lines_out = lines;
+        *n_valid_out = valid;
+        return p - buf;
+    }
+
+    // ---- pass 1: line-offset index (off[i] = start of line i; off[L] =
+    // one past the consumed region)
+    std::vector<uint32_t> off;
+    off.reserve((size_t)(want > 0 ? want + 1 : 1));
+    const char* p = buf;
+    while (p < end && (int64_t)off.size() < want) {
+        const char* nl = (const char*)memchr(p, '\n', end - p);
+        if (!nl && !final_) break;  // incomplete tail line
+        off.push_back((uint32_t)(p - buf));
+        p = nl ? nl + 1 : end;
+    }
+    const int64_t L = (int64_t)off.size();
+    if (L == 0) {
+        zero_tail(out, cap, 0);  // same "padding rows are zero" contract
+        *n_lines_out = 0;
+        *n_valid_out = 0;
+        return 0;
+    }
+    const int64_t consumed = p - buf;
+    off.push_back((uint32_t)consumed);
+    // line i spans [buf+off[i], buf+off[i+1]) minus the trailing '\n'
+    auto line_end = [&](int64_t i) {
+        const char* q = buf + off[i + 1];
+        return (q > buf + off[i] && q[-1] == '\n') ? q - 1 : q;
+    };
+
+    int W = n_threads;
+    if (W <= 0) W = (int)std::thread::hardware_concurrency();
+    if (W < 1) W = 1;
+    if (W > (int)(L / 1024) + 1) W = (int)(L / 1024) + 1;  // tiny batches: few
+
+    // ---- workers: private slabs, thread-local contexts
+    std::vector<uint32_t> scratch((size_t)(TUPLE_COLS * L));
+    std::vector<int64_t> lo(W + 1);
+    for (int w = 0; w <= W; ++w) lo[w] = L * w / W;
+    std::vector<LocalCtx> ctx((size_t)W);
+    std::vector<int64_t> valid_w((size_t)W, 0);
+    std::vector<std::thread> threads;
+    threads.reserve((size_t)W);
+    for (int w = 0; w < W; ++w) {
+        ctx[w].resolve = &pk->resolve;
+        threads.emplace_back([&, w]() {
+            const int64_t i0 = lo[w], i1 = lo[w + 1];
+            const int64_t slab_cap = i1 - i0;
+            uint32_t* slab = scratch.data() + (size_t)(i0 * TUPLE_COLS);
+            LocalCtx* cx = &ctx[w];
+            int64_t v = 0;
+            for (int64_t i = i0; i < i1; ++i) {
+                if (handle_line(cx, buf + off[i], line_end(i), slab, slab_cap, v)) {
+                    ++v;
+                    ++cx->parsed;
+                } else {
+                    ++cx->skipped;
+                }
+            }
+            valid_w[w] = v;
+        });
+    }
+    for (auto& t : threads) t.join();
+
+    // ---- compaction: concatenate slabs' valid rows, preserving order
+    int64_t valid = 0;
+    for (int w = 0; w < W; ++w) {
+        const int64_t i0 = lo[w], slab_cap = lo[w + 1] - i0;
+        const uint32_t* slab = scratch.data() + (size_t)(i0 * TUPLE_COLS);
+        for (int64_t c = 0; c < TUPLE_COLS; ++c)
+            memcpy(out + c * cap + valid, slab + c * slab_cap,
+                   (size_t)valid_w[w] * sizeof(uint32_t));
+        valid += valid_w[w];
+        pk->parsed += ctx[w].parsed;
+        pk->skipped += ctx[w].skipped;
+    }
+    zero_tail(out, cap, valid);
+    *n_lines_out = L;
+    *n_valid_out = valid;
+    return consumed;
+}
+
+// Single-threaded ABI kept for compatibility.
 int64_t asa_pack_chunk(void* h, const char* buf, int64_t len, int final_,
                        int64_t max_lines, uint32_t* out, int64_t cap,
                        int64_t* n_lines_out, int64_t* n_valid_out) {
-    Packer* pk = (Packer*)h;
+    return asa_pack_chunk_mt(h, buf, len, final_, max_lines, out, cap,
+                             n_lines_out, n_valid_out, 1);
+}
+
+// Plain newline count (streaming buffer bookkeeping; memchr is ~5-10x
+// faster than Python-level bytes.count here).
+int64_t asa_count_nl(const char* buf, int64_t len) {
+    int64_t n = 0;
     const char* p = buf;
     const char* end = buf + len;
-    int64_t lines = 0, valid = 0;
-    while (p < end && lines < max_lines && valid < cap) {
-        const char* nl = (const char*)memchr(p, '\n', end - p);
-        const char* le = nl ? nl : end;
-        if (!nl && !final_) break;  // incomplete tail line
-        if (handle_line(pk, p, le, out, cap, valid)) {
-            ++valid;
-            ++pk->parsed;
-        } else {
-            ++pk->skipped;
-        }
-        ++lines;
-        p = nl ? nl + 1 : end;
+    while ((p = (const char*)memchr(p, '\n', end - p)) != nullptr) {
+        ++n;
+        ++p;
     }
-    *n_lines_out = lines;
-    *n_valid_out = valid;
-    return p - buf;
+    return n;
 }
 
 // Count newline-terminated lines in buf (resume fast-skip helper).

@@ -22,6 +22,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from ..hostside.pack import (
@@ -74,7 +75,10 @@ def _block_min_row(cols: dict, rules: jnp.ndarray, base: jnp.ndarray) -> jnp.nda
     return jnp.min(jnp.where(ok, idx, NO_MATCH), axis=1)
 
 
-NO_MATCH = _U32(0xFFFFFFFF)
+# numpy scalar, NOT jnp: a module-level jnp scalar would initialize the
+# JAX backend at import time (it hangs this process when the TPU tunnel
+# is down); np.uint32 participates in jnp expressions identically.
+NO_MATCH = np.uint32(0xFFFFFFFF)
 
 
 @functools.partial(jax.jit, static_argnames=("rule_block",))

@@ -3,14 +3,56 @@
 Must run before any jax import: force the CPU backend with 8 fake devices so
 multi-chip sharding tests (SURVEY.md §5 "multi-node without a cluster") run
 anywhere, exactly as they would on a real v5e-8 mesh.
+
+This environment injects a TPU plugin via a ``sitecustomize`` on
+``PYTHONPATH`` that registers itself at interpreter start — before any of
+this runs — and can hang the whole process at backend init when the device
+tunnel is down (setting ``JAX_PLATFORMS=cpu`` here is too late to stop it).
+So when that hook is detected, pytest re-execs itself once in a scrubbed
+environment (in ``pytest_configure``, after restoring the captured stdout
+fds); the fresh process never sees the plugin.  No test module imports jax
+before configure time, so the hostile process never reaches backend init.
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+_AXON_MARKER = ".axon_site"
+
+
+def _needs_reexec() -> bool:
+    return (
+        _AXON_MARKER in os.environ.get("PYTHONPATH", "")
+        and os.environ.get("RA_TEST_REEXEC") != "1"
+    )
+
+
+def _scrubbed_env() -> dict:
+    # one source of truth for the scrub recipe: the driver entry module
+    from __graft_entry__ import scrubbed_cpu_env
+
+    env = scrubbed_cpu_env(8)
+    env["RA_TEST_REEXEC"] = "1"
+    return env
+
+
+def pytest_configure(config):
+    if not _needs_reexec():
+        return
+    # restore the real stdout/stderr fds that pytest's global capture
+    # dup2'ed away, so the re-exec'd run is visible to the invoker
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()
+    os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], _scrubbed_env())
+
+
+if not _needs_reexec():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

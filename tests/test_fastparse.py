@@ -121,6 +121,37 @@ class TestParity:
         assert n_lines == 1 and used == len(line.encode())
         assert out[6, 0] == 1 and out[6, 1] == 0
 
+    @pytest.mark.parametrize("n_threads", [2, 3, 8])
+    def test_multithread_bit_identical(self, n_threads):
+        """The parallel parse (worker slabs + compaction) must produce the
+        same batch, counters, and consumed bytes as one thread — for any
+        thread count, including more workers than lines per split."""
+        packed, lines = _synth_case(n=5000, seed=3)
+        corpus = lines + EDGE_LINES  # include skipped/edge lines mid-stream
+        data = ("\n".join(corpus) + "\n").encode()
+        nat1 = fastparse.NativePacker(packed)
+        out1, l1, u1 = nat1.pack_chunk(data, len(corpus), final=True, n_threads=1)
+        natn = fastparse.NativePacker(packed)
+        outn, ln, un = natn.pack_chunk(data, len(corpus), final=True, n_threads=n_threads)
+        np.testing.assert_array_equal(out1, outn)
+        assert (l1, u1) == (ln, un)
+        assert (nat1.parsed, nat1.skipped) == (natn.parsed, natn.skipped)
+
+    def test_multithread_respects_max_lines(self):
+        packed, lines = _synth_case(n=3000, seed=4)
+        data = ("\n".join(lines) + "\n").encode()
+        nat = fastparse.NativePacker(packed)
+        out, n_lines, used = nat.pack_chunk(
+            data, 3000, final=True, max_lines=1500, n_threads=4
+        )
+        assert n_lines == 1500
+        nat2 = fastparse.NativePacker(packed)
+        out2, n2, used2 = nat2.pack_chunk(
+            data, 3000, final=True, max_lines=1500, n_threads=1
+        )
+        np.testing.assert_array_equal(out, out2)
+        assert (n_lines, used) == (n2, used2)
+
 
 class TestFileStream:
     def _write(self, tmp_path, lines, name="a.log"):
