@@ -68,6 +68,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
             resume=args.resume,
             report_every_chunks=args.report_every,
             match_impl=args.match_impl,
+            layout=args.layout,
+            stacked_lane=args.stacked_lane,
             **({"checkpoint_dir": args.checkpoint_dir} if args.checkpoint_dir else {}),
         )
     except ValueError as e:
@@ -86,6 +88,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--profile-dir": args.profile_dir,
             "--native-parse": args.native_parse,
             "--checkpoint-dir": args.checkpoint_dir,
+            "--layout=stacked": args.layout != "flat",
         }
         bad = [k for k, v in tpu_only.items() if v]
         if bad:
@@ -203,6 +206,12 @@ def make_parser() -> argparse.ArgumentParser:
                    help="print throughput to stderr every N chunks")
     p.add_argument("--native-parse", action=argparse.BooleanOptionalAction, default=None,
                    help="use the C++ host parser (default: auto when logs are files)")
+    p.add_argument("--layout", choices=["flat", "stacked"], default="flat",
+                   help="rule-match layout: flat scans all rules per line; stacked "
+                        "buckets lines by ACL and vmaps over per-ACL rule slabs "
+                        "(faster for many firewalls/ACLs)")
+    p.add_argument("--stacked-lane", type=int, default=0, metavar="N",
+                   help="per-ACL lane width for --layout=stacked (0 = auto)")
     p.add_argument("--match-impl", choices=["xla", "pallas"], default="xla",
                    help="first-match kernel (bench_suite.py pallas compares them)")
     p.add_argument("--profile-dir", default=None,

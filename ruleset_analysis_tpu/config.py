@@ -102,6 +102,15 @@ class AnalysisConfig:
     #: or "pallas" (explicit-layout TPU kernel, ops/pallas_match.py).
     #: ``bench_suite.py pallas`` compares them on the deployment hardware.
     match_impl: str = "xla"
+    #: Batch layout: "flat" scans every line against the whole rule
+    #: tensor; "stacked" buckets lines by ACL host-side (pack.GroupBuffer)
+    #: and vmaps the match over per-ACL rule slabs — O(max slab rows)
+    #: per line instead of O(total rows) (BASELINE.json config #4).
+    #: Registers are mergeable, so reports agree between layouts.
+    layout: str = "flat"
+    #: Per-ACL lane width of a stacked grouped batch; 0 = auto
+    #: (~batch_size / n_acls, padded to the mesh).
+    stacked_lane: int = 0
 
     def __post_init__(self) -> None:
         if self.batch_size < 1:
@@ -110,6 +119,15 @@ class AnalysisConfig:
             raise ValueError("checkpoint_every_chunks must be >= 0")
         if self.match_impl not in ("xla", "pallas"):
             raise ValueError(f"match_impl must be 'xla' or 'pallas', got {self.match_impl!r}")
+        if self.layout not in ("flat", "stacked"):
+            raise ValueError(f"layout must be 'flat' or 'stacked', got {self.layout!r}")
+        if self.stacked_lane < 0:
+            raise ValueError("stacked_lane must be >= 0")
+        if self.layout == "stacked" and self.match_impl == "pallas":
+            raise ValueError(
+                "match_impl='pallas' supports layout='flat' only; the stacked "
+                "path always uses the XLA vmapped kernel"
+            )
 
     def replace(self, **kw) -> "AnalysisConfig":
         return dataclasses.replace(self, **kw)

@@ -209,6 +209,17 @@ class LinePacker:
 # ---------------------------------------------------------------------------
 
 
+def stacked_slab_rows(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> int:
+    """Rmax of :func:`stack_rules` without building the slab tensor."""
+    g = max(packed.n_acls, 1)
+    real = packed.rules[packed.rules[:, R_ACL] != NO_ACL]
+    counts = np.bincount(real[:, R_ACL].astype(np.int64), minlength=g) if real.size else np.zeros(g, np.int64)
+    rmax = max(int(counts.max()) if counts.size else 0, 1)
+    if rmax > rule_block:
+        rmax = ((rmax + rule_block - 1) // rule_block) * rule_block
+    return rmax
+
+
 def stack_rules(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> np.ndarray:
     """[G, Rmax, RULE_COLS] uint32: each ACL's expanded rows, padded.
 
@@ -219,10 +230,7 @@ def stack_rules(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> np.ndarr
     """
     g = max(packed.n_acls, 1)
     real = packed.rules[packed.rules[:, R_ACL] != NO_ACL]
-    counts = np.bincount(real[:, R_ACL].astype(np.int64), minlength=g) if real.size else np.zeros(g, np.int64)
-    rmax = max(int(counts.max()) if counts.size else 0, 1)
-    if rmax > rule_block:
-        rmax = ((rmax + rule_block - 1) // rule_block) * rule_block
+    rmax = stacked_slab_rows(packed, rule_block)
     out = np.zeros((g, rmax, RULE_COLS), dtype=np.uint32)
     out[:, :, R_ACL] = NO_ACL
     fill = np.zeros(g, dtype=np.int64)

@@ -33,6 +33,11 @@ def shard_batch(mesh: Mesh, batch_np: np.ndarray, axis: str = "data") -> jax.Arr
     return jax.device_put(batch_np, batch_sharding(mesh, axis))
 
 
+def shard_grouped(mesh: Mesh, grouped_np: np.ndarray, axis: str = "data") -> jax.Array:
+    """Host [G, TUPLE_COLS, lane] -> device array, lane axis sharded."""
+    return jax.device_put(grouped_np, NamedSharding(mesh, P(None, None, axis)))
+
+
 def pad_batch_size(batch_size: int, mesh: Mesh, axis: str = "data") -> int:
     """Round batch_size up to a multiple of the data-axis size."""
     n = mesh.shape[axis]

@@ -28,14 +28,69 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import AnalysisConfig
 from ..hostside.pack import T_ACL, T_DPORT, T_DST, T_PROTO, T_SPORT, T_SRC, T_VALID
-from ..models.pipeline import AnalysisState, ChunkOut, DeviceRuleset
+from ..models.pipeline import AnalysisState, ChunkOut, DeviceRuleset, DeviceRulesetStacked
 from ..ops import cms as cms_ops
 from ..ops import counts as count_ops
 from ..ops import hll as hll_ops
 from ..ops import topk as topk_ops
-from ..ops.match import RULE_BLOCK, match_keys
+from ..ops.match import RULE_BLOCK, match_keys, match_keys_stacked
 
 _U32 = jnp.uint32
+
+
+def _merge_tail(
+    state: AnalysisState,
+    keys: jax.Array,  # [b] u32 count keys, local shard
+    valid: jax.Array,  # [b] u32
+    src: jax.Array,  # [b] u32
+    acl: jax.Array,  # [b] u32
+    salt: jax.Array,
+    *,
+    axis: str,
+    n_keys: int,
+    topk_k: int,
+    exact_counts: bool,
+) -> tuple[AnalysisState, ChunkOut]:
+    # The register-update tail shared by the flat and stacked shard steps:
+    # mirrors pipeline._update_registers with the collective merges
+    # interleaved at the law-of-merge seams (psum for adds, pmax for max);
+    # tests/test_parallel.py pins it bit-identical to the single-device
+    # step over the concatenated batch.
+
+    # one globally-merged bincount feeds exact counts AND the per-rule CMS
+    # (linear in per-key increments — see pipeline._update_registers);
+    # the batch-sized CMS scatter this replaces dominated the shard step
+    delta = lax.psum(count_ops.segment_counts(keys, valid, n_keys), axis)
+    if exact_counts:
+        lo, hi = count_ops.add64(state.counts_lo, state.counts_hi, delta)
+    else:
+        lo, hi = state.counts_lo, state.counts_hi
+    cms = cms_ops.cms_update(state.cms, jnp.arange(n_keys, dtype=_U32), delta)
+
+    delta_hll = hll_ops.hll_update(
+        jnp.zeros_like(state.hll), keys, src, valid
+    )
+    hll = jnp.maximum(state.hll, lax.pmax(delta_hll, axis))
+
+    dt, wt = state.talk_cms.shape
+    delta_talk = cms_ops.cms_update(
+        jnp.zeros((dt, wt), _U32), topk_ops.hash_pair(acl, src), valid
+    )
+    talk_cms = state.talk_cms + lax.psum(delta_talk, axis)
+    # candidate selection against the *merged* global talker sketch, then
+    # gather every device's candidates so the host sees them all, replicated
+    ca, cs, ce = topk_ops.select_candidates(
+        talk_cms, acl, src, valid, min(topk_k, valid.shape[0]),
+        salt=salt,
+    )
+    cand_acl = lax.all_gather(ca, axis, tiled=True)
+    cand_src = lax.all_gather(cs, axis, tiled=True)
+    cand_est = lax.all_gather(ce, axis, tiled=True)
+
+    return (
+        AnalysisState(counts_lo=lo, counts_hi=hi, cms=cms, hll=hll, talk_cms=talk_cms),
+        ChunkOut(cand_acl=cand_acl, cand_src=cand_src, cand_est=cand_est),
+    )
 
 
 def _local_shard_step(
@@ -51,10 +106,6 @@ def _local_shard_step(
     rule_block: int,
     match_impl: str = "xla",
 ) -> tuple[AnalysisState, ChunkOut]:
-    # Mirrors pipeline._update_registers with the collective merges
-    # interleaved at the law-of-merge seams (psum for adds, pmax for max);
-    # tests/test_parallel.py pins it bit-identical to the single-device
-    # step over the concatenated batch.
     cols = {
         "acl": batch[T_ACL],
         "proto": batch[T_PROTO],
@@ -72,40 +123,47 @@ def _local_shard_step(
         )
     else:
         keys = match_keys(cols, ruleset.rules, ruleset.deny_key, rule_block)
-
-    # one globally-merged bincount feeds exact counts AND the per-rule CMS
-    # (linear in per-key increments — see pipeline._update_registers);
-    # the batch-sized CMS scatter this replaces dominated the shard step
-    delta = lax.psum(count_ops.segment_counts(keys, valid, n_keys), axis)
-    if exact_counts:
-        lo, hi = count_ops.add64(state.counts_lo, state.counts_hi, delta)
-    else:
-        lo, hi = state.counts_lo, state.counts_hi
-    cms = cms_ops.cms_update(state.cms, jnp.arange(n_keys, dtype=_U32), delta)
-
-    delta_hll = hll_ops.hll_update(
-        jnp.zeros_like(state.hll), keys, cols["src"], valid
+    return _merge_tail(
+        state, keys, valid, cols["src"], cols["acl"], salt,
+        axis=axis, n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts,
     )
-    hll = jnp.maximum(state.hll, lax.pmax(delta_hll, axis))
 
-    dt, wt = state.talk_cms.shape
-    delta_talk = cms_ops.cms_update(
-        jnp.zeros((dt, wt), _U32), topk_ops.hash_pair(cols["acl"], cols["src"]), valid
-    )
-    talk_cms = state.talk_cms + lax.psum(delta_talk, axis)
-    # candidate selection against the *merged* global talker sketch, then
-    # gather every device's candidates so the host sees them all, replicated
-    ca, cs, ce = topk_ops.select_candidates(
-        talk_cms, cols["acl"], cols["src"], valid, min(topk_k, valid.shape[0]),
-        salt=salt,
-    )
-    cand_acl = lax.all_gather(ca, axis, tiled=True)
-    cand_src = lax.all_gather(cs, axis, tiled=True)
-    cand_est = lax.all_gather(ce, axis, tiled=True)
 
-    return (
-        AnalysisState(counts_lo=lo, counts_hi=hi, cms=cms, hll=hll, talk_cms=talk_cms),
-        ChunkOut(cand_acl=cand_acl, cand_src=cand_src, cand_est=cand_est),
+def _local_shard_step_stacked(
+    state: AnalysisState,
+    ruleset: DeviceRulesetStacked,
+    batch: jax.Array,  # [G, TUPLE_COLS, lane/n] local shard (lane sharded)
+    salt: jax.Array,
+    *,
+    axis: str,
+    n_keys: int,
+    topk_k: int,
+    exact_counts: bool,
+    rule_block: int,
+) -> tuple[AnalysisState, ChunkOut]:
+    # Grouped twin of _local_shard_step: each line scans only its own
+    # ACL's slab (vmapped match over the group axis); the mergeable
+    # register tail — and therefore the final report — is identical.
+    cols = {
+        "acl": batch[:, T_ACL, :],
+        "proto": batch[:, T_PROTO, :],
+        "src": batch[:, T_SRC, :],
+        "sport": batch[:, T_SPORT, :],
+        "dst": batch[:, T_DST, :],
+        "dport": batch[:, T_DPORT, :],
+    }
+    keys = match_keys_stacked(cols, ruleset.rules3d, ruleset.deny_key, rule_block).reshape(-1)
+    return _merge_tail(
+        state,
+        keys,
+        batch[:, T_VALID, :].reshape(-1),
+        cols["src"].reshape(-1),
+        cols["acl"].reshape(-1),
+        salt,
+        axis=axis,
+        n_keys=n_keys,
+        topk_k=topk_k,
+        exact_counts=exact_counts,
     )
 
 
@@ -134,6 +192,44 @@ def make_parallel_step(
         local,
         mesh=mesh,
         in_specs=(P(), P(), P(None, axis), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    jitted = jax.jit(sharded, donate_argnums=(0,))
+
+    def step(state, ruleset, batch, salt: int | jax.Array = 0):
+        return jitted(state, ruleset, batch, jnp.asarray(salt, dtype=_U32))
+
+    return step
+
+
+def make_parallel_step_stacked(
+    mesh: Mesh,
+    cfg: AnalysisConfig,
+    n_keys: int,
+    rule_block: int = RULE_BLOCK,
+):
+    """Build the jitted data-parallel STACKED step for `mesh`.
+
+    The grouped batch ``[G, TUPLE_COLS, lane]`` shards along the lane
+    (per-group line) axis — every device holds a slice of every ACL's
+    bucket plus the full (replicated) slab tensor, so the match needs no
+    rule-side communication and the register merges are the same two
+    collectives as the flat path.  ``lane`` must divide by the mesh size.
+    """
+    axis = cfg.mesh_axis
+    local = functools.partial(
+        _local_shard_step_stacked,
+        axis=axis,
+        n_keys=n_keys,
+        topk_k=cfg.sketch.topk_chunk_candidates,
+        exact_counts=cfg.exact_counts,
+        rule_block=rule_block,
+    )
+    sharded = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(None, None, axis), P()),
         out_specs=(P(), P()),
         check_vma=False,
     )

@@ -47,13 +47,17 @@ MANIFEST_FILE = "manifest.json"
 POINTER_FILE = "LATEST"
 
 
-def fingerprint(packed: PackedRuleset, cfg: AnalysisConfig, n_shards: int = 1) -> str:
+def fingerprint(
+    packed: PackedRuleset, cfg: AnalysisConfig, n_shards: int = 1, lane: int = 0
+) -> str:
     """Identity of (ruleset, sketch geometry, chunking) a snapshot is valid for.
 
     ``n_shards`` is the data-axis size of the mesh the stream actually runs
     on: both the padded chunk size and the per-chunk candidate count scale
     with it, so resuming on a different device count must be refused to
-    keep talker tables bit-identical to an uninterrupted run.
+    keep talker tables bit-identical to an uninterrupted run.  ``lane`` is
+    the resolved per-ACL lane width when the stream runs the stacked
+    layout (0 for flat) — layouts must not cross-resume.
     """
     h = hashlib.sha256()
     h.update(np.ascontiguousarray(packed.rules).tobytes())
@@ -62,7 +66,8 @@ def fingerprint(packed: PackedRuleset, cfg: AnalysisConfig, n_shards: int = 1) -
     padded = ((cfg.batch_size + n_shards - 1) // n_shards) * n_shards
     h.update(
         f"{s.cms_width},{s.cms_depth},{s.talk_cms_depth},{s.hll_p},{cfg.exact_counts},"
-        f"{padded},{n_shards},{s.topk_chunk_candidates},{s.topk_capacity}".encode()
+        f"{padded},{n_shards},{s.topk_chunk_candidates},{s.topk_capacity},"
+        f"{cfg.layout},{lane}".encode()
     )
     return h.hexdigest()[:16]
 

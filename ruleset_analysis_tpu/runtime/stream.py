@@ -71,6 +71,98 @@ class _TextSource:
             yield batch_np, len(chunk)
 
 
+class _PackedCounters:
+    """parsed/skipped counters for sources that skip the text parse."""
+
+    def __init__(self):
+        self.parsed = 0
+        self.skipped = 0
+
+
+class _PackedSource:
+    """Batch source over pre-packed ``[TUPLE_COLS, n]`` tuple arrays.
+
+    The packed tier (SURVEY.md synth §"two tiers"): feeds the device
+    pipeline at rates the text renderer can't reach — used by the scale
+    benchmarks and the sketch-accuracy-at-scale validation.  Incoming
+    arrays are re-chunked to exactly ``batch_size`` columns so chunk
+    boundaries are identical to a text-path run over the same tuples.
+    """
+
+    def __init__(self, arrays: Iterable[np.ndarray]):
+        self._arrays = arrays
+        self.packer = _PackedCounters()
+
+    def set_counts(self, parsed: int, skipped: int) -> None:
+        self.packer.parsed, self.packer.skipped = parsed, skipped
+
+    def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
+        from ..hostside.pack import T_VALID, TUPLE_COLS
+
+        buf = np.empty((TUPLE_COLS, batch_size), dtype=np.uint32)
+        fill = 0
+        to_skip = skip_lines
+        for arr in self._arrays:
+            pos = 0
+            n = arr.shape[1]
+            if to_skip:
+                take = min(to_skip, n)
+                pos += take
+                to_skip -= take
+            while pos < n:
+                m = min(batch_size - fill, n - pos)
+                buf[:, fill : fill + m] = arr[:, pos : pos + m]
+                fill += m
+                pos += m
+                if fill == batch_size:
+                    yield self._emit(buf, fill, batch_size, T_VALID)
+                    fill = 0
+        if to_skip:
+            from ..errors import ResumeInputMismatch
+
+            raise ResumeInputMismatch(
+                f"snapshot consumed {skip_lines} lines but the packed input "
+                f"ran short by {to_skip}"
+            )
+        if fill:
+            yield self._emit(buf, fill, batch_size, T_VALID)
+
+    def _emit(self, buf, fill, batch_size, t_valid):
+        # always a fresh array: the reusable fill buffer must not be
+        # mutated under an in-flight async device_put of a prior chunk
+        if fill == batch_size:
+            out = buf.copy()
+        else:
+            out = np.zeros_like(buf)
+            out[:, :fill] = buf[:, :fill]
+        valid = int(out[t_valid].sum())
+        self.packer.parsed += valid
+        self.packer.skipped += fill - valid
+        return out, fill
+
+
+def run_stream_packed(
+    packed: PackedRuleset,
+    arrays: Iterable[np.ndarray],
+    cfg: AnalysisConfig,
+    *,
+    topk: int = 10,
+    mesh=None,
+    profile_dir: str | None = None,
+    max_chunks: int | None = None,
+):
+    """Analyze pre-packed ``[TUPLE_COLS, n]`` tuple arrays (packed tier)."""
+    return _run_core(
+        packed,
+        _PackedSource(arrays),
+        cfg,
+        topk=topk,
+        mesh=mesh,
+        profile_dir=profile_dir,
+        max_chunks=max_chunks,
+    )
+
+
 class _FileSource:
     """Batch source over syslog file(s) via the native C++ parser."""
 
@@ -190,10 +282,23 @@ def _run_core(
         mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
     batch_size = mesh_lib.pad_batch_size(cfg.batch_size, mesh, cfg.mesh_axis)
 
-    dev_rules = pipeline.ship_ruleset(packed, match_impl=cfg.match_impl)
-    step = make_parallel_step(mesh, cfg, packed.n_keys)
+    stacked = cfg.layout == "stacked"
+    lane = 0
+    if stacked:
+        from ..hostside.pack import GroupBuffer
+        from ..parallel.step import make_parallel_step_stacked
+
+        lane = cfg.stacked_lane or max(1, cfg.batch_size // max(1, packed.n_acls))
+        lane = mesh_lib.pad_batch_size(lane, mesh, cfg.mesh_axis)
+        dev_rules = pipeline.ship_ruleset_stacked(packed)
+        step = make_parallel_step_stacked(mesh, cfg, packed.n_keys)
+        gbuf = GroupBuffer(max(packed.n_acls, 1), lane)
+    else:
+        dev_rules = pipeline.ship_ruleset(packed, match_impl=cfg.match_impl)
+        step = make_parallel_step(mesh, cfg, packed.n_keys)
+        gbuf = None
     packer = source.packer
-    fp = ckpt.fingerprint(packed, cfg, mesh.shape[cfg.mesh_axis])
+    fp = ckpt.fingerprint(packed, cfg, mesh.shape[cfg.mesh_axis], lane)
     lines_consumed = 0
     n_chunks = 0
 
@@ -222,6 +327,15 @@ def _run_core(
         )
 
     def save_snapshot() -> None:
+        nonlocal last_snap_chunks
+        # Stacked layout: step any buffered lines out first so the
+        # registers cover exactly lines_consumed (the buffer holds lines
+        # back until an ACL's lane fills; a snapshot with lines in limbo
+        # would silently drop them on resume).
+        if gbuf is not None:
+            for grouped in gbuf.flush():
+                run_grouped(grouped)
+        last_snap_chunks = n_chunks
         while pending:
             drain(pending.popleft())
         jax.block_until_ready(state)
@@ -241,6 +355,20 @@ def _run_core(
             ),
         )
 
+    def run_chunk(batch_dev) -> None:
+        # salt = chunk index: re-randomizes candidate-table slots per
+        # chunk (no persistent talker collisions) yet replays exactly on
+        # resume since n_chunks is restored from the snapshot
+        nonlocal state, n_chunks
+        state, out = step(state, dev_rules, batch_dev, n_chunks)
+        pending.append(out)
+        if len(pending) > 2:
+            drain(pending.popleft())
+        n_chunks += 1
+
+    def run_grouped(grouped_np: np.ndarray) -> None:
+        run_chunk(mesh_lib.shard_grouped(mesh, grouped_np, cfg.mesh_axis))
+
     # Candidates drain with a 2-chunk lag: by the time chunk N-2's arrays
     # are fetched, their compute is long done, so the host never stalls on
     # the device — and memory stays O(1) chunks instead of O(n_chunks).
@@ -248,27 +376,37 @@ def _run_core(
     lines_at_start = packer.parsed + packer.skipped  # nonzero after resume
     meter = ThroughputMeter(cfg.report_every_chunks)
     chunks_this_run = 0
-    with Profiler(profile_dir):
+    last_snap_chunks = n_chunks  # snapshot cadence is device chunks SINCE
+    with Profiler(profile_dir):  # the last save (stacked emits unevenly)
         for batch_np, n_raw_lines in source.batches(lines_consumed, batch_size):
-            batch = mesh_lib.shard_batch(mesh, batch_np, cfg.mesh_axis)
-            # salt = chunk index: re-randomizes candidate-table slots per
-            # chunk (no persistent talker collisions) yet replays exactly
-            # on resume since n_chunks is restored from the snapshot
-            state, out = step(state, dev_rules, batch, n_chunks)
-            pending.append(out)
-            if len(pending) > 2:
-                drain(pending.popleft())
+            if gbuf is not None:
+                # bucket by ACL; grouped batches emit when a lane fills
+                for grouped in gbuf.add(np.ascontiguousarray(batch_np.T)):
+                    run_grouped(grouped)
+            else:
+                run_chunk(mesh_lib.shard_batch(mesh, batch_np, cfg.mesh_axis))
             lines_consumed += n_raw_lines
-            n_chunks += 1
             chunks_this_run += 1
             meter.tick(n_raw_lines)
-            if cfg.checkpoint_every_chunks and n_chunks % cfg.checkpoint_every_chunks == 0:
+            if (
+                cfg.checkpoint_every_chunks
+                and n_chunks - last_snap_chunks >= cfg.checkpoint_every_chunks
+            ):
                 save_snapshot()
             if max_chunks is not None and chunks_this_run >= max_chunks:
                 aborted = True
                 break
         else:
             aborted = False
+    if gbuf is not None:
+        # Drain buffered lines (padded grouped batches) — also on a
+        # max_chunks abort: those lines are already in lines_consumed and
+        # the packer counters, so leaving them unstepped would return a
+        # report whose totals claim lines the registers never saw.  (The
+        # crash simulation lives in the SKIPPED final snapshot below, not
+        # in losing buffered work from the returned report.)
+        for grouped in gbuf.flush():
+            run_grouped(grouped)
 
     jax.block_until_ready(state)
     elapsed = meter.elapsed()

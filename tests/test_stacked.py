@@ -203,3 +203,92 @@ class TestStackedStep:
         )
         state, out = step(state, rules, jnp.asarray(grouped))
         assert np.asarray(state.counts_lo).sum() == (tuples[:, pack.T_VALID] == 1).sum()
+
+
+def _rule_stats(report):
+    """Exact (hits, uniq) per rule + the unused set — the register-derived
+    report content that must agree between layouts (talker candidates are
+    chunk-composition-dependent approximations and may differ)."""
+    per = {
+        (e["firewall"], e["acl"], e["index"]): (e["hits"], e.get("unique_sources"))
+        for e in report.per_rule
+    }
+    return per, set(report.unused)
+
+
+class TestStackedStream:
+    """The productionized stacked path: runtime/stream.py layout='stacked'."""
+
+    def _lines(self, multi_fw, n=3000, seed=5):
+        tuples = synth.synth_tuples(multi_fw, n, seed=seed)
+        return synth.render_syslog(multi_fw, tuples, seed=seed + 1)
+
+    def test_stream_report_matches_flat(self, multi_fw):
+        from ruleset_analysis_tpu.runtime.stream import run_stream
+
+        lines = self._lines(multi_fw)
+        cfg = _cfg(512)
+        rep_flat = run_stream(multi_fw, iter(lines), cfg, topk=5)
+        rep_st = run_stream(
+            multi_fw, iter(lines), cfg.replace(layout="stacked"), topk=5
+        )
+        assert _rule_stats(rep_flat) == _rule_stats(rep_st)
+        assert rep_st.totals["lines_matched"] == rep_flat.totals["lines_matched"]
+
+    def test_stream_stacked_lane_override(self, multi_fw):
+        from ruleset_analysis_tpu.runtime.stream import run_stream
+
+        lines = self._lines(multi_fw, n=1500, seed=9)
+        cfg = _cfg(512)
+        rep_a = run_stream(
+            multi_fw, iter(lines), cfg.replace(layout="stacked"), topk=5
+        )
+        rep_b = run_stream(
+            multi_fw,
+            iter(lines),
+            cfg.replace(layout="stacked", stacked_lane=64),
+            topk=5,
+        )
+        assert _rule_stats(rep_a) == _rule_stats(rep_b)
+
+    def test_sharded_stacked_step_matches_single_device(self, multi_fw):
+        import jax
+        import jax.numpy as jnp
+
+        from ruleset_analysis_tpu.parallel import mesh as mesh_lib
+        from ruleset_analysis_tpu.parallel.step import make_parallel_step_stacked
+
+        cfg = _cfg(1024)
+        tuples = synth.synth_tuples(multi_fw, 1024, seed=11)
+        lane = 256  # divisible by the 8-device fake mesh
+        grouped = pack.group_tuples(tuples, multi_fw.n_acls, lane=lane)
+
+        mesh8 = mesh_lib.make_mesh(jax.devices("cpu")[:8])
+        step8 = make_parallel_step_stacked(mesh8, cfg, multi_fw.n_keys)
+        st8 = pipeline.init_state(multi_fw.n_keys, cfg)
+        rules = pipeline.ship_ruleset_stacked(multi_fw)
+        st8, _ = step8(st8, rules, mesh_lib.shard_grouped(mesh8, grouped), 0)
+
+        st1 = pipeline.init_state(multi_fw.n_keys, cfg)
+        st1, _ = pipeline.analysis_step_stacked(
+            st1, rules, jnp.asarray(grouped),
+            n_keys=multi_fw.n_keys, topk_k=cfg.sketch.topk_chunk_candidates,
+        )
+        _states_equal(st8, st1)
+
+    def test_stacked_checkpoint_kill_resume(self, multi_fw, tmp_path):
+        from ruleset_analysis_tpu.runtime.stream import run_stream
+
+        lines = self._lines(multi_fw, n=2000, seed=13)
+        cfg = _cfg(256).replace(
+            layout="stacked",
+            checkpoint_every_chunks=1,
+            checkpoint_dir=str(tmp_path / "ck"),
+        )
+        # uninterrupted reference
+        ref = run_stream(multi_fw, iter(lines), _cfg(256).replace(layout="stacked"), topk=5)
+        # crash after 3 source chunks, then resume
+        run_stream(multi_fw, iter(lines), cfg, topk=5, max_chunks=3)
+        rep = run_stream(multi_fw, iter(lines), cfg.replace(resume=True), topk=5)
+        assert _rule_stats(rep) == _rule_stats(ref)
+        assert rep.totals["lines_total"] == ref.totals["lines_total"]
