@@ -47,16 +47,19 @@ def _tuples(packed, n, seed=0):
     return synth.synth_tuples(packed, n, seed=seed)
 
 
-def _time_steps(step, state, rules, feeds, iters):
-    import jax
+def _time_steps(step, state, rules, feeds, iters, valid_per_feed):
+    """Counts-validated timed loop (shared sync discipline with bench.py)."""
+    from ruleset_analysis_tpu.runtime.timing import timed_validated_steps
 
     state, _ = step(state, rules, feeds[0])  # warmup/compile
-    jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        state, _ = step(state, rules, feeds[i % len(feeds)])
-    jax.block_until_ready(state)
-    return state, time.perf_counter() - t0
+    state, dt, delta, expect = timed_validated_steps(
+        step, state, rules, feeds, valid_per_feed, iters
+    )
+    if delta != expect:
+        raise AssertionError(
+            f"timed window did not execute: counts moved {delta}, expected {expect}"
+        )
+    return state, dt
 
 
 # ---------------------------------------------------------------------------
@@ -77,7 +80,9 @@ def bench_exact() -> dict:
     cfg = AnalysisConfig(batch_size=b, sketch=SketchConfig(cms_width=1 << 14, cms_depth=4))
     state = pipeline.init_state(packed.n_keys, cfg)
     rules = pipeline.ship_ruleset(packed)
-    feeds = [jnp.asarray(np.ascontiguousarray(_tuples(packed, b, seed=i).T)) for i in range(2)]
+    feeds_np = [np.ascontiguousarray(_tuples(packed, b, seed=i).T) for i in range(2)]
+    valid_per_feed = [int(f[pack.T_VALID].sum()) for f in feeds_np]
+    feeds = [jnp.asarray(f) for f in feeds_np]
     step = jax.jit(
         functools.partial(
             pipeline.analysis_step,
@@ -87,7 +92,7 @@ def bench_exact() -> dict:
         donate_argnums=(0,),
     )
     iters = 20
-    state, dt = _time_steps(step, state, rules, feeds, iters)
+    state, dt = _time_steps(step, state, rules, feeds, iters, valid_per_feed)
 
     # correctness: a fresh state stepped over a small batch must hold
     # exactly the bincount of the device-matched keys (oracle equality of
@@ -248,8 +253,8 @@ def bench_multifw() -> dict:
         )
         # each run_stream_packed call builds a fresh jit wrapper, so a
         # cold full run (same shapes) populates the persistent XLA
-        # compilation cache (enabled in main) and only the second,
-        # timed run reflects steady state
+        # compilation cache (enable_persistent_cache in main) and only
+        # the second, timed run reflects steady state
         run_stream_packed(packed, arrays(), cfg)
         t0 = time.perf_counter()
         rep = run_stream_packed(packed, arrays(), cfg)
@@ -344,13 +349,16 @@ def bench_pallas() -> dict:
         rules, fm = shipped.rules, shipped.rules_fm
 
         def run(fn, *args):
+            # sync via a 4-byte readback of the LAST output: device
+            # programs execute FIFO, so its completion bounds the loop
+            # (block_until_ready is unreliable on the tunnel plugin)
             out = fn(*args)
-            jax.block_until_ready(out)
+            np.asarray(out[:1])
             t0 = time.perf_counter()
             n = 10
             for _ in range(n):
                 out = fn(*args)
-            jax.block_until_ready(out)
+            np.asarray(out[:1])
             return (time.perf_counter() - t0) / n
 
         xla_fn = jax.jit(lambda c: first_match_rows(c, rules))
@@ -434,6 +442,9 @@ BENCHES = {
 
 
 def main(argv: list[str]) -> int:
+    from ruleset_analysis_tpu.runtime.compcache import enable_persistent_cache
+
+    log(f"compilation cache: {enable_persistent_cache()}")
     names = argv or list(BENCHES)
     for name in names:
         if name not in BENCHES:

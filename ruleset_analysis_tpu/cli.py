@@ -121,10 +121,12 @@ def _cmd_run(args: argparse.Namespace) -> int:
         )
     elif args.backend == "tpu":
         try:
+            from .runtime.compcache import enable_persistent_cache
             from .runtime.stream import run_stream, run_stream_file  # deferred: imports JAX
         except ImportError as e:
             print(f"error: tpu backend unavailable ({e})", file=sys.stderr)
             return 1
+        enable_persistent_cache()  # skip the ~15s recompile on repeat runs
         file_input = all(p != "-" for p in args.logs)
         if args.native_parse and not file_input:
             print("--native-parse requires file inputs (not '-')", file=sys.stderr)

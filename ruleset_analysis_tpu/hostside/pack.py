@@ -39,6 +39,10 @@ from .syslog import ParsedLine, parse_line
 
 RULE_COLS = 12
 TUPLE_COLS = 7
+#: Wire-format columns (see :func:`compact_batch`): the host->device feed
+#: is the e2e bottleneck on PCIe-starved links, so batches cross the wire
+#: bit-packed at 16 B/line instead of the working layout's 28 B/line.
+WIRE_COLS = 4
 
 #: Rule-axis block size for the match kernel's scan path (defined here,
 #: jax-free, so host-side packing/stacking and the device kernel share
@@ -50,6 +54,11 @@ RULE_BLOCK = 512
 R_ACL, R_PLO, R_PHI, R_SLO, R_SHI, R_SPLO, R_SPHI, R_DLO, R_DHI, R_DPLO, R_DPHI, R_KEY = range(12)
 # tuple columns
 T_ACL, T_PROTO, T_SRC, T_SPORT, T_DST, T_DPORT, T_VALID = range(7)
+# wire columns (compact_batch): src | dst | sport<<16|dport | proto<<24|valid<<23|acl
+W_SRC, W_DST, W_PORTS, W_META = range(4)
+
+#: acl gid budget in the wire meta word: 23 bits (proto takes 8, valid 1).
+WIRE_MAX_ACLS = 1 << 23
 
 NO_ACL = np.uint32(0xFFFFFFFF)
 
@@ -97,6 +106,11 @@ def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> P
     for rs in rulesets:
         for acl in rs.acls:
             acl_gid[(rs.firewall, acl)] = len(acl_gid)
+    if len(acl_gid) > WIRE_MAX_ACLS:
+        raise ValueError(
+            f"{len(acl_gid)} ACLs exceed the wire format's {WIRE_MAX_ACLS} "
+            "acl-gid budget (23 bits of the packed meta word)"
+        )
 
     for rs in rulesets:
         for acl, rules in rs.acls.items():
@@ -151,6 +165,53 @@ def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> P
         deny_key=deny_key,
         bindings=bindings,
     )
+
+
+# ---------------------------------------------------------------------------
+# Wire format: the host->device transfer layout.  Host parsing and tests
+# work in the 7-column uint32 layout (one field per lane, convenient to
+# index); batches cross PCIe / the dev tunnel bit-packed into 4 words per
+# line, and the device step unpacks with three shifts on the VPU.  Field
+# widths: src/dst 32, sport/dport 16, proto 8, valid 1, acl gid 23
+# (WIRE_MAX_ACLS; pack_rulesets refuses larger inventories).
+# ---------------------------------------------------------------------------
+
+
+def compact_batch(batch: np.ndarray) -> np.ndarray:
+    """Column-major working batch ``[TUPLE_COLS, B]`` -> wire ``[WIRE_COLS, B]``."""
+    u32 = np.uint32
+    out = np.empty((WIRE_COLS, batch.shape[1]), dtype=u32)
+    out[W_SRC] = batch[T_SRC]
+    out[W_DST] = batch[T_DST]
+    out[W_PORTS] = (batch[T_SPORT] << u32(16)) | (batch[T_DPORT] & u32(0xFFFF))
+    out[W_META] = (
+        (batch[T_PROTO] << u32(24))
+        | ((batch[T_VALID] & u32(1)) << u32(23))
+        | (batch[T_ACL] & u32(WIRE_MAX_ACLS - 1))
+    )
+    return out
+
+
+def compact_grouped(grouped: np.ndarray) -> np.ndarray:
+    """Grouped ``[G, TUPLE_COLS, lane]`` -> wire ``[G, WIRE_COLS, lane]``."""
+    g, _, lane = grouped.shape
+    flat = compact_batch(grouped.transpose(1, 0, 2).reshape(TUPLE_COLS, g * lane))
+    return flat.reshape(WIRE_COLS, g, lane).transpose(1, 0, 2)
+
+
+def expand_batch(wire: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`compact_batch` (tests / debugging)."""
+    u32 = np.uint32
+    out = np.zeros((TUPLE_COLS, wire.shape[1]), dtype=u32)
+    meta = wire[W_META]
+    out[T_SRC] = wire[W_SRC]
+    out[T_DST] = wire[W_DST]
+    out[T_SPORT] = wire[W_PORTS] >> u32(16)
+    out[T_DPORT] = wire[W_PORTS] & u32(0xFFFF)
+    out[T_PROTO] = meta >> u32(24)
+    out[T_VALID] = (meta >> u32(23)) & u32(1)
+    out[T_ACL] = meta & u32(WIRE_MAX_ACLS - 1)
+    return out
 
 
 class LinePacker:

@@ -24,7 +24,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config import AnalysisConfig
-from ..hostside.pack import PackedRuleset, T_ACL, T_DPORT, T_DST, T_PROTO, T_SPORT, T_SRC, T_VALID
+from ..hostside.pack import (
+    PackedRuleset,
+    T_ACL, T_DPORT, T_DST, T_PROTO, T_SPORT, T_SRC, T_VALID,
+    TUPLE_COLS, W_DST, W_META, W_PORTS, W_SRC, WIRE_COLS, WIRE_MAX_ACLS,
+)
 from ..ops import cms as cms_ops
 from ..ops import counts as count_ops
 from ..ops import hll as hll_ops
@@ -60,6 +64,45 @@ class ChunkOut(NamedTuple):
     cand_acl: jax.Array  # [k] u32
     cand_src: jax.Array  # [k] u32
     cand_est: jax.Array  # [k] u32
+
+
+def batch_cols(batch: jax.Array) -> tuple[dict, jax.Array]:
+    """Field columns + valid mask from a batch in EITHER layout.
+
+    Accepts the working layout ``[TUPLE_COLS, B]`` (one uint32 lane per
+    field) or the wire layout ``[WIRE_COLS, B]`` (bit-packed, 16 B/line —
+    what the stream driver ships over PCIe; see pack.compact_batch).  The
+    layout is static shape information, so under jit this is a free
+    Python branch; the wire unpack is three shifts and three ands on the
+    VPU — noise next to the match itself.
+    """
+    u32 = jnp.uint32
+    if batch.shape[-2] == WIRE_COLS:
+        meta = batch[..., W_META, :]
+        ports = batch[..., W_PORTS, :]
+        cols = {
+            "acl": meta & u32(WIRE_MAX_ACLS - 1),
+            "proto": meta >> u32(24),
+            "src": batch[..., W_SRC, :],
+            "sport": ports >> u32(16),
+            "dst": batch[..., W_DST, :],
+            "dport": ports & u32(0xFFFF),
+        }
+        return cols, (meta >> u32(23)) & u32(1)
+    if batch.shape[-2] == TUPLE_COLS:
+        cols = {
+            "acl": batch[..., T_ACL, :],
+            "proto": batch[..., T_PROTO, :],
+            "src": batch[..., T_SRC, :],
+            "sport": batch[..., T_SPORT, :],
+            "dst": batch[..., T_DST, :],
+            "dport": batch[..., T_DPORT, :],
+        }
+        return cols, batch[..., T_VALID, :]
+    raise ValueError(
+        f"batch field axis must be TUPLE_COLS={TUPLE_COLS} or "
+        f"WIRE_COLS={WIRE_COLS}, got shape {batch.shape}"
+    )
 
 
 def pad_rules(rules: np.ndarray, rule_block: int = RULE_BLOCK) -> np.ndarray:
@@ -179,15 +222,12 @@ def analysis_step(
     salt: jax.Array | int = 0,
     match_impl: str = "xla",
 ) -> tuple[AnalysisState, ChunkOut]:
-    """One fused device step over a batch of packed log lines."""
-    cols = {
-        "acl": batch[T_ACL],
-        "proto": batch[T_PROTO],
-        "src": batch[T_SRC],
-        "sport": batch[T_SPORT],
-        "dst": batch[T_DST],
-        "dport": batch[T_DPORT],
-    }
+    """One fused device step over a batch of packed log lines.
+
+    ``batch`` may be the working ``[TUPLE_COLS, B]`` layout or the wire
+    ``[WIRE_COLS, B]`` layout (see :func:`batch_cols`).
+    """
+    cols, valid = batch_cols(batch)
     if match_impl == "pallas" and ruleset.rules_fm is not None:
         from ..ops import pallas_match
 
@@ -197,7 +237,7 @@ def analysis_step(
     else:
         keys = match_keys(cols, ruleset.rules, ruleset.deny_key, rule_block)
     return _update_registers(
-        state, keys, batch[T_VALID], cols["src"], cols["acl"],
+        state, keys, valid, cols["src"], cols["acl"],
         n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts, salt=salt,
     )
 
@@ -235,19 +275,12 @@ def analysis_step_stacked(
     register updates are order-invariant, so the resulting state is
     identical to the flat step fed the same multiset of lines.
     """
-    cols = {
-        "acl": batch[:, T_ACL, :],
-        "proto": batch[:, T_PROTO, :],
-        "src": batch[:, T_SRC, :],
-        "sport": batch[:, T_SPORT, :],
-        "dst": batch[:, T_DST, :],
-        "dport": batch[:, T_DPORT, :],
-    }
+    cols, valid = batch_cols(batch)
     keys = match_keys_stacked(cols, ruleset.rules3d, ruleset.deny_key, rule_block).reshape(-1)
     return _update_registers(
         state,
         keys,
-        batch[:, T_VALID, :].reshape(-1),
+        valid.reshape(-1),
         cols["src"].reshape(-1),
         cols["acl"].reshape(-1),
         n_keys=n_keys,
@@ -255,6 +288,33 @@ def analysis_step_stacked(
         exact_counts=exact_counts,
         salt=salt,
     )
+
+
+def counts_total(state: AnalysisState) -> int:
+    """Total hits across all keys, fetched to host — and therefore a hard
+    synchronization point.
+
+    ``jax.block_until_ready`` is not a reliable barrier on every PJRT
+    plugin (the remote-tunnel plugin used in development returns
+    immediately for shard_map outputs); a device_get of a register is: no
+    bytes can arrive before every step that wrote them has executed.
+    Benchmarks close their timed sections with this and assert the delta
+    equals the number of valid lines stepped (each valid line contributes
+    exactly one count — a rule key or its ACL's implicit deny).
+    """
+    lo = np.asarray(jax.device_get(state.counts_lo), dtype=np.uint64)
+    hi = np.asarray(jax.device_get(state.counts_hi), dtype=np.uint64)
+    return int((lo + (hi << np.uint64(32))).sum())
+
+
+def sync_state(state: AnalysisState) -> None:
+    """Force completion of every pending step writing into ``state``.
+
+    See :func:`counts_total` for why this is a device_get rather than
+    ``jax.block_until_ready``; the fetched register is small ([n_keys]
+    uint32), so the transfer cost is negligible.
+    """
+    np.asarray(jax.device_get(state.counts_lo))
 
 
 # ---------------------------------------------------------------------------

@@ -27,8 +27,9 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import AnalysisConfig
-from ..hostside.pack import T_ACL, T_DPORT, T_DST, T_PROTO, T_SPORT, T_SRC, T_VALID
-from ..models.pipeline import AnalysisState, ChunkOut, DeviceRuleset, DeviceRulesetStacked
+from ..models.pipeline import (
+    AnalysisState, ChunkOut, DeviceRuleset, DeviceRulesetStacked, batch_cols,
+)
 from ..ops import cms as cms_ops
 from ..ops import counts as count_ops
 from ..ops import hll as hll_ops
@@ -96,7 +97,7 @@ def _merge_tail(
 def _local_shard_step(
     state: AnalysisState,
     ruleset: DeviceRuleset,
-    batch: jax.Array,  # [TUPLE_COLS, B/n] local shard
+    batch: jax.Array,  # [TUPLE_COLS or WIRE_COLS, B/n] local shard
     salt: jax.Array,  # u32 scalar (chunk counter), replicated
     *,
     axis: str,
@@ -106,15 +107,7 @@ def _local_shard_step(
     rule_block: int,
     match_impl: str = "xla",
 ) -> tuple[AnalysisState, ChunkOut]:
-    cols = {
-        "acl": batch[T_ACL],
-        "proto": batch[T_PROTO],
-        "src": batch[T_SRC],
-        "sport": batch[T_SPORT],
-        "dst": batch[T_DST],
-        "dport": batch[T_DPORT],
-    }
-    valid = batch[T_VALID]
+    cols, valid = batch_cols(batch)
     if match_impl == "pallas" and ruleset.rules_fm is not None:
         from ..ops import pallas_match
 
@@ -132,7 +125,7 @@ def _local_shard_step(
 def _local_shard_step_stacked(
     state: AnalysisState,
     ruleset: DeviceRulesetStacked,
-    batch: jax.Array,  # [G, TUPLE_COLS, lane/n] local shard (lane sharded)
+    batch: jax.Array,  # [G, TUPLE_COLS or WIRE_COLS, lane/n] local shard
     salt: jax.Array,
     *,
     axis: str,
@@ -144,19 +137,12 @@ def _local_shard_step_stacked(
     # Grouped twin of _local_shard_step: each line scans only its own
     # ACL's slab (vmapped match over the group axis); the mergeable
     # register tail — and therefore the final report — is identical.
-    cols = {
-        "acl": batch[:, T_ACL, :],
-        "proto": batch[:, T_PROTO, :],
-        "src": batch[:, T_SRC, :],
-        "sport": batch[:, T_SPORT, :],
-        "dst": batch[:, T_DST, :],
-        "dport": batch[:, T_DPORT, :],
-    }
+    cols, valid = batch_cols(batch)
     keys = match_keys_stacked(cols, ruleset.rules3d, ruleset.deny_key, rule_block).reshape(-1)
     return _merge_tail(
         state,
         keys,
-        batch[:, T_VALID, :].reshape(-1),
+        valid.reshape(-1),
         cols["src"].reshape(-1),
         cols["acl"].reshape(-1),
         salt,

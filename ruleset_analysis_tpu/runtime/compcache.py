@@ -1,0 +1,42 @@
+"""Persistent XLA compilation cache.
+
+The analysis step compiles once per (mesh, batch geometry, sketch
+geometry); a fresh process pays that compile again (~15s on the TPU
+tunnel) unless the persistent cache is on.  Entry points (CLI, bench.py,
+bench_suite.py) call :func:`enable_persistent_cache` before first
+compile; libraries never touch global JAX config themselves.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join(
+    os.path.expanduser("~"), ".cache", "ruleset_analysis_tpu", "xla_cache"
+)
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
+    """Point JAX at an on-disk compilation cache; return the dir (or None).
+
+    Safe to call multiple times and before/after jax import; failures
+    (read-only filesystem, old jax) degrade to no caching rather than
+    erroring — the cache is an optimization, never a requirement.
+    """
+    path = cache_dir or os.environ.get("RA_XLA_CACHE_DIR") or _DEFAULT_DIR
+    # namespace by backend selection: axon/tpu and cpu-fallback runs must
+    # not share AOT entries (XLA:CPU loads cached code compiled with
+    # different machine-feature sets and warns of possible SIGILL)
+    platforms = os.environ.get("JAX_PLATFORMS", "default") or "default"
+    path = os.path.join(path, platforms.replace(",", "+"))
+    try:
+        os.makedirs(path, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache even fast compiles: the step compiles in ~1s on CPU but
+        # the suite builds dozens of fresh jit wrappers per run
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        return path
+    except Exception:
+        return None

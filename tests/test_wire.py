@@ -1,0 +1,122 @@
+"""Wire format: 16 B/line bit-packed batches (pack.compact_batch).
+
+The stream driver ships batches to the device bit-packed (4 uint32 words
+per line instead of 7) because host->device transfer is the narrowest e2e
+stage on PCIe-starved links; the device step unpacks with shifts
+(pipeline.batch_cols).  These tests pin the packing as lossless and the
+device results as bit-identical between layouts.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+from ruleset_analysis_tpu.models import pipeline
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg_text = synth.synth_config(n_acls=3, rules_per_acl=16, seed=5)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    cfg = AnalysisConfig(
+        batch_size=1024,
+        sketch=SketchConfig(cms_width=1 << 10, cms_depth=4, hll_p=6),
+    )
+    batch = np.ascontiguousarray(synth.synth_tuples(packed, 1024, seed=5).T)
+    return packed, cfg, batch
+
+
+def test_compact_roundtrip(setup):
+    _, _, batch = setup
+    wire = pack.compact_batch(batch)
+    assert wire.shape == (pack.WIRE_COLS, batch.shape[1])
+    assert wire.dtype == np.uint32
+    np.testing.assert_array_equal(pack.expand_batch(wire), batch)
+
+
+def test_compact_roundtrip_extremes():
+    """Boundary field values survive the bit pack exactly."""
+    rows = np.array(
+        [
+            # acl,            proto, src,        sport, dst,        dport, valid
+            [0,               0,     0,          0,     0,          0,     0],
+            [pack.WIRE_MAX_ACLS - 1, 255, 0xFFFFFFFF, 65535, 0xFFFFFFFF, 65535, 1],
+            [7,               6,     0x0A000001, 1024,  0xC0A80101, 443,   1],
+        ],
+        dtype=np.uint32,
+    )
+    batch = np.ascontiguousarray(rows.T)
+    np.testing.assert_array_equal(pack.expand_batch(pack.compact_batch(batch)), batch)
+
+
+def test_grouped_compact_roundtrip(setup):
+    packed, _, batch = setup
+    lane = 1024
+    grouped = pack.group_tuples(
+        np.ascontiguousarray(batch.T), max(packed.n_acls, 1), lane
+    )
+    wire = pack.compact_grouped(grouped)
+    assert wire.shape == (grouped.shape[0], pack.WIRE_COLS, lane)
+    # expand each group and compare
+    for g in range(grouped.shape[0]):
+        np.testing.assert_array_equal(pack.expand_batch(wire[g]), grouped[g])
+
+
+def test_step_bit_identical_between_layouts(setup):
+    """analysis_step(wide batch) == analysis_step(wire batch), bit for bit."""
+    packed, cfg, batch = setup
+    rules = pipeline.ship_ruleset(packed)
+    kw = dict(
+        n_keys=packed.n_keys,
+        topk_k=cfg.sketch.topk_chunk_candidates,
+        exact_counts=True,
+    )
+    s_wide, o_wide = pipeline.analysis_step(
+        pipeline.init_state(packed.n_keys, cfg), rules, batch, **kw
+    )
+    s_wire, o_wire = pipeline.analysis_step(
+        pipeline.init_state(packed.n_keys, cfg), rules, pack.compact_batch(batch), **kw
+    )
+    for a, b in zip(s_wide, s_wire):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(o_wide, o_wire):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_step_accepts_wire(setup):
+    """The production parallel step runs on wire batches across the mesh."""
+    import jax
+
+    from ruleset_analysis_tpu.parallel import mesh as mesh_lib
+    from ruleset_analysis_tpu.parallel.step import make_parallel_step
+
+    packed, cfg, batch = setup
+    mesh = mesh_lib.make_mesh()
+    n = mesh.shape[cfg.mesh_axis]
+    b = (batch.shape[1] // n) * n
+    batch = batch[:, :b]
+    step = make_parallel_step(mesh, cfg, packed.n_keys)
+    state = pipeline.init_state(packed.n_keys, cfg)
+    rules = pipeline.ship_ruleset(packed)
+    wire_dev = mesh_lib.shard_batch(mesh, pack.compact_batch(batch))
+    state, _ = step(state, rules, wire_dev)
+    total = pipeline.counts_total(state)
+    assert total == int(batch[pack.T_VALID].sum())
+
+
+def test_counts_total_is_exact_sync(setup):
+    """counts_total returns the exact number of valid lines stepped."""
+    packed, cfg, batch = setup
+    rules = pipeline.ship_ruleset(packed)
+    state = pipeline.init_state(packed.n_keys, cfg)
+    for i in range(3):
+        state, _ = pipeline.analysis_step(
+            state, rules, pack.compact_batch(batch),
+            n_keys=packed.n_keys,
+            topk_k=cfg.sketch.topk_chunk_candidates,
+        )
+    assert pipeline.counts_total(state) == 3 * int(batch[pack.T_VALID].sum())
