@@ -28,12 +28,15 @@ from .runtime import report as report_mod
 def _cmd_parse_acls(args: argparse.Namespace) -> int:
     rulesets = []
     for path in args.configs:
-        rs = aclparse.parse_config_file(path)
+        rs = aclparse.parse_config_file(path, strict=not args.lenient)
+        skipped = f" skipped={len(rs.skipped)}" if rs.skipped else ""
         print(
             f"{path}: firewall={rs.firewall} acls={len(rs.acls)} "
-            f"rules={rs.rule_count()} expanded_aces={rs.ace_count()}",
+            f"rules={rs.rule_count()} expanded_aces={rs.ace_count()}{skipped}",
             file=sys.stderr,
         )
+        for lineno, reason, line in rs.skipped:
+            print(f"{path}:{lineno}: skipped: {reason}: {line}", file=sys.stderr)
         rulesets.append(rs)
     packed = pack.pack_rulesets(rulesets)
     pack.save_packed(packed, args.out)
@@ -64,6 +67,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 cms_depth=args.cms_depth,
                 hll_p=args.hll_p,
             ),
+            exact_counts=args.exact_counts,
+            register_memory_budget_bytes=args.register_budget_mb << 20,
             checkpoint_every_chunks=args.checkpoint_every,
             resume=args.resume,
             report_every_chunks=args.report_every,
@@ -89,6 +94,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--native-parse": args.native_parse,
             "--checkpoint-dir": args.checkpoint_dir,
             "--layout=stacked": args.layout != "flat",
+            "--no-exact-counts": not args.exact_counts,
         }
         bad = [k for k, v in tpu_only.items() if v]
         if bad:
@@ -101,7 +107,10 @@ def _cmd_run(args: argparse.Namespace) -> int:
         if not args.acl_configs:
             print("--backend=oracle requires --acl-configs (original config files)", file=sys.stderr)
             return 2
-        rulesets = [aclparse.parse_config_file(p) for p in args.acl_configs]
+        rulesets = [
+            aclparse.parse_config_file(p, strict=not args.lenient)
+            for p in args.acl_configs
+        ]
         orc = oracle.Oracle(rulesets)
         res = orc.consume(lines)
         talkers = {
@@ -131,7 +140,29 @@ def _cmd_run(args: argparse.Namespace) -> int:
         if args.native_parse and not file_input:
             print("--native-parse requires file inputs (not '-')", file=sys.stderr)
             return 2
-        if file_input:
+        if args.distributed:
+            # multi-process job: this process joins the cluster and feeds
+            # only ITS OWN --logs (the input-split analog); every process
+            # computes the identical report, only rank 0 prints it
+            if not file_input:
+                print("--distributed requires file inputs (not '-')", file=sys.stderr)
+                return 2
+            import jax
+
+            from .parallel.distributed import init_distributed
+            from .runtime.stream import run_stream_file_distributed
+
+            init_distributed(
+                coordinator_address=args.coordinator,
+                num_processes=args.num_processes,
+                process_id=args.process_id,
+            )
+            rep = run_stream_file_distributed(
+                packed, args.logs, cfg, native=args.native_parse, topk=args.topk
+            )
+            if jax.process_index() != 0:
+                return 0
+        elif file_input:
             # forced --native-parse with no C++ toolchain raises
             # NativeParserUnavailable, handled as AnalysisError in main()
             rep = run_stream_file(
@@ -186,6 +217,10 @@ def make_parser() -> argparse.ArgumentParser:
     p = sub.add_parser("parse-acls", help="parse ASA configs into a packed ruleset")
     p.add_argument("configs", nargs="+")
     p.add_argument("--out", required=True, help="output path prefix")
+    p.add_argument("--lenient", action="store_true",
+                   help="skip (and count) unsupported access-list entries — "
+                        "IPv6, exotic object members — instead of aborting; "
+                        "skipped entries keep their rule positions")
     p.set_defaults(fn=_cmd_parse_acls)
 
     p = sub.add_parser("run", help="run the analysis over syslog")
@@ -193,10 +228,19 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--logs", nargs="+", required=True, help="syslog file(s), '-' for stdin")
     p.add_argument("--backend", choices=["oracle", "tpu"], default="tpu")
     p.add_argument("--acl-configs", nargs="*", default=[], help="original configs (oracle backend)")
+    p.add_argument("--lenient", action="store_true",
+                   help="parse --acl-configs leniently (see parse-acls --lenient)")
     p.add_argument("--batch-size", type=int, default=1 << 16)
     p.add_argument("--cms-width", type=int, default=1 << 14)
     p.add_argument("--cms-depth", type=int, default=4)
     p.add_argument("--hll-p", type=int, default=8)
+    p.add_argument("--exact-counts", action=argparse.BooleanOptionalAction, default=True,
+                   help="--no-exact-counts drops the exact per-rule bincount and "
+                        "reports CMS estimates instead (the BASELINE.json "
+                        "north-star configuration: sketches only)")
+    p.add_argument("--register-budget-mb", type=int, default=4096, metavar="MB",
+                   help="ceiling on device register memory (counts+CMS+HLL); "
+                        "oversized geometries fail fast with a suggested --hll-p")
     p.add_argument("--topk", type=int, default=10)
     p.add_argument("--checkpoint-every", type=int, default=0, metavar="CHUNKS",
                    help="snapshot (offset, registers) every N chunks")
@@ -218,6 +262,13 @@ def make_parser() -> argparse.ArgumentParser:
                    help="first-match kernel (bench_suite.py pallas compares them)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here (TensorBoard profile)")
+    p.add_argument("--distributed", action="store_true",
+                   help="join a jax.distributed multi-process job; --logs are "
+                        "THIS process's input split (rank 0 prints the report)")
+    p.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                   help="jax.distributed coordinator (default: environment)")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--json", action="store_true")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_run)

@@ -92,6 +92,12 @@ class AnalysisConfig:
     batch_size: int = 1 << 16  # log lines per device step (per global batch)
     sketch: SketchConfig = dataclasses.field(default_factory=SketchConfig)
     exact_counts: bool = True  # keep the exact per-rule bincount alongside sketches
+    #: Ceiling on total device register memory (counts + CMS + per-key HLL
+    #: + talker CMS).  The per-key HLL file is the dangerous term —
+    #: ``n_keys * 2**hll_p * 4`` bytes grows with the ruleset — so
+    #: init_state refuses geometries that exceed this, with a suggested
+    #: smaller ``hll_p``, instead of silently OOMing the chip.
+    register_memory_budget_bytes: int = 4 << 30
     mesh_axis: str = "data"
     checkpoint_every_chunks: int = 0  # 0 = no checkpointing
     checkpoint_dir: str = os.path.join(OUTPUT_DIR, "ckpt")
@@ -123,6 +129,8 @@ class AnalysisConfig:
             raise ValueError(f"layout must be 'flat' or 'stacked', got {self.layout!r}")
         if self.stacked_lane < 0:
             raise ValueError("stacked_lane must be >= 0")
+        if self.register_memory_budget_bytes < 1:
+            raise ValueError("register_memory_budget_bytes must be >= 1")
         if self.layout == "stacked" and self.match_impl == "pallas":
             raise ValueError(
                 "match_impl='pallas' supports layout='flat' only; the stacked "

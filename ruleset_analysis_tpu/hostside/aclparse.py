@@ -244,8 +244,17 @@ class Ruleset:
 
     firewall: str
     acls: dict[str, list[AclRule]] = dataclasses.field(default_factory=dict)
-    #: interface name -> (acl name, direction) from ``access-group`` lines.
-    bindings: dict[str, tuple[str, str]] = dataclasses.field(default_factory=dict)
+    #: (interface name, direction) -> acl name, from ``access-group`` lines.
+    #: Keyed by direction too: one interface can carry BOTH an ``in`` and
+    #: an ``out`` ACL, and egress bindings are evaluated for connection
+    #: messages just like ingress ones.
+    bindings: dict[tuple[str, str], str] = dataclasses.field(default_factory=dict)
+    #: Lenient-mode skips: (line number, reason, raw line) for every
+    #: access-list entry ``parse_asa_config(strict=False)`` could not
+    #: support (IPv6, exotic object members, ...).  A skipped entry still
+    #: consumes its rule index, so later rules keep their device-side
+    #: positions.  Empty in strict mode (errors raise instead).
+    skipped: list[tuple[int, str, str]] = dataclasses.field(default_factory=list)
 
     def rule_count(self) -> int:
         return sum(len(rules) for rules in self.acls.values())
@@ -706,21 +715,29 @@ def parse_ace_line(
 _STANDARD_RE = re.compile(r"^access-list\s+(\S+)\s+standard\s+(permit|deny)\s+(.*)$")
 
 
-def parse_asa_config(text: str, firewall: str) -> Ruleset:
-    """Parse one firewall's ASA configuration into a :class:`Ruleset`."""
+def parse_asa_config(text: str, firewall: str, strict: bool = True) -> Ruleset:
+    """Parse one firewall's ASA configuration into a :class:`Ruleset`.
+
+    ``strict=True`` (default) raises :class:`AclParseError` on any
+    unsupported construct.  ``strict=False`` is the ops-tool mode: an
+    unsupported access-list entry (IPv6, exotic object members, ...) is
+    skipped and recorded in ``Ruleset.skipped`` — it still consumes its
+    rule index so later rules keep their device-side positions — and the
+    IPv4 analysis proceeds.
+    """
     lines = text.splitlines()
     groups, rest = _collect_blocks(lines)
     rs = Ruleset(firewall=firewall)
     indices: dict[str, int] = {}
 
-    for _lineno, line in rest:
+    for lineno, line in rest:
         toks = line.split()
         if not toks:
             continue
         if toks[0] == "access-group":
             # access-group NAME in|out interface IFNAME
-            if len(toks) >= 5 and toks[3] == "interface":
-                rs.bindings[toks[4]] = (toks[1], toks[2])
+            if len(toks) >= 5 and toks[3] == "interface" and toks[2] in ("in", "out"):
+                rs.bindings[(toks[4], toks[2])] = toks[1]
             continue
         if toks[0] != "access-list" or len(toks) < 3:
             continue
@@ -728,39 +745,54 @@ def parse_asa_config(text: str, firewall: str) -> Ruleset:
         if toks[2] == "remark":
             continue
         m = _STANDARD_RE.match(line)
-        if m:
-            # standard ACL: source-address-only match
-            acl, action_tok, addr = m.groups()
-            indices[acl] = indices.get(acl, 0) + 1
-            rule = AclRule(acl=acl, index=indices[acl], text=line)
-            atoks = addr.split()
-            if atoks[0] in ("any", "any4"):
-                ranges = [FULL_ADDR]
-            elif atoks[0] == "host":
-                a = ip_to_u32(atoks[1])
-                ranges = [(a, a)]
-            else:
-                ranges = [subnet_range(atoks[0], atoks[1])]
-            action = PERMIT if action_tok == "permit" else DENY
-            for lo, hi in ranges:
-                rule.aces.append(
-                    Ace(action, *FULL_PROTO, lo, hi, *FULL_PORTS, *FULL_ADDR, *FULL_PORTS)
-                )
-            rs.acls.setdefault(acl, []).append(rule)
-            continue
-        indices[acl] = indices.get(acl, 0) + 1
         try:
+            if m:
+                # standard ACL: source-address-only match
+                acl, action_tok, addr = m.groups()
+                indices[acl] = indices.get(acl, 0) + 1
+                rule = AclRule(acl=acl, index=indices[acl], text=line)
+                atoks = addr.split()
+                if atoks[0] in ("any", "any4"):
+                    ranges = [FULL_ADDR]
+                elif atoks[0] == "host":
+                    a = ip_to_u32(atoks[1])
+                    ranges = [(a, a)]
+                else:
+                    ranges = [subnet_range(atoks[0], atoks[1])]
+                action = PERMIT if action_tok == "permit" else DENY
+                for lo, hi in ranges:
+                    rule.aces.append(
+                        Ace(action, *FULL_PROTO, lo, hi, *FULL_PORTS, *FULL_ADDR, *FULL_PORTS)
+                    )
+                rs.acls.setdefault(acl, []).append(rule)
+                continue
+            indices[acl] = indices.get(acl, 0) + 1
             rule = parse_ace_line(groups, acl, indices[acl], line, toks)
         except IndexError:
-            raise AclParseError(f"truncated access-list entry: {line!r}") from None
+            # truncated entry (either branch); same skip/raise policy
+            err = AclParseError(f"truncated access-list entry: {line!r}")
+            if strict:
+                raise err from None
+            rs.acls.setdefault(acl, [])
+            rs.skipped.append((lineno, str(err), line))
+            continue
+        except AclParseError as e:
+            if strict:
+                raise
+            # the index was consumed above; ensure the ACL exists so its
+            # implicit deny / bindings still resolve even if every entry
+            # was skipped
+            rs.acls.setdefault(acl, [])
+            rs.skipped.append((lineno, str(e), line))
+            continue
         rs.acls.setdefault(acl, []).append(rule)
     return rs
 
 
-def parse_config_file(path: str, firewall: str | None = None) -> Ruleset:
+def parse_config_file(path: str, firewall: str | None = None, strict: bool = True) -> Ruleset:
     with open(path, "r", encoding="utf-8", errors="replace") as f:
         text = f.read()
     if firewall is None:
         m = re.search(r"^hostname\s+(\S+)", text, re.MULTILINE)
         firewall = m.group(1) if m else path.rsplit("/", 1)[-1]
-    return parse_asa_config(text, firewall)
+    return parse_asa_config(text, firewall, strict=strict)

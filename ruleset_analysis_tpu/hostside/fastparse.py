@@ -115,6 +115,9 @@ def _bind(lib: ctypes.CDLL) -> None:
         lib.asa_packer_add_binding.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint32,
         ]
+        lib.asa_packer_add_binding_out.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_uint32,
+        ]
         lib.asa_packer_parsed.argtypes = [ctypes.c_void_p]
         lib.asa_packer_parsed.restype = ctypes.c_int64
         lib.asa_packer_skipped.argtypes = [ctypes.c_void_p]
@@ -154,9 +157,12 @@ class NativePacker:
     """Raw syslog bytes -> column-major [TUPLE_COLS, B] uint32 batches.
 
     Mirrors ``LinePacker`` exactly: the (firewall, acl)->gid and
-    (firewall, iface)->gid resolution tables come from the same
-    PackedRuleset, unresolvable or unparseable lines count as skipped,
-    and valid tuples are packed densely from row 0.
+    (firewall, iface)->gid resolution tables (both in- and out-direction)
+    come from the same PackedRuleset, unresolvable or unparseable lines
+    count as skipped, and valid tuples are packed densely from row 0.
+    A connection line whose ingress interface has an ``in`` ACL and whose
+    egress interface has an ``out`` ACL emits two rows; ``parsed`` counts
+    evaluations, ``skipped`` counts lines that produced none.
     """
 
     def __init__(self, packed: PackedRuleset):
@@ -174,6 +180,11 @@ class NativePacker:
             lib.asa_packer_add_acl(self._h, fw.encode(), acl.encode(), gid)
         for (fw, iface), gid in packed.bindings.items():
             lib.asa_packer_add_binding(self._h, fw.encode(), iface.encode(), gid)
+        for (fw, iface), gid in packed.bindings_out.items():
+            lib.asa_packer_add_binding_out(self._h, fw.encode(), iface.encode(), gid)
+        #: with out-bindings a connection line can emit two rows; sizes
+        #: the default pack_lines capacity like LinePacker.pack_parsed
+        self._rows_per_line = 2 if packed.bindings_out else 1
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -236,7 +247,7 @@ class NativePacker:
     def pack_lines(self, lines: list[str], batch_size: int | None = None) -> np.ndarray:
         """LinePacker-compatible helper (row-major [B, TUPLE_COLS])."""
         data = "".join(ln if ln.endswith("\n") else ln + "\n" for ln in lines).encode()
-        b = batch_size or len(lines)
+        b = batch_size if batch_size is not None else self._rows_per_line * len(lines)
         out, _, _ = self.pack_chunk(data, b, final=True, max_lines=len(lines))
         return np.ascontiguousarray(out.T)
 

@@ -60,58 +60,74 @@ class Oracle:
             hits=Counter(), sources=defaultdict(set), talkers=defaultdict(Counter)
         )
 
-    def resolve_acl(self, p: ParsedLine) -> tuple[Ruleset, str] | None:
+    def resolve_acls(self, p: ParsedLine) -> list[tuple[Ruleset, str]]:
+        """Every ACL this line is evaluated against (possibly two).
+
+        A connection message is filtered by the ingress interface's ``in``
+        ACL and, independently, by the egress interface's ``out`` ACL —
+        one evaluation each, exactly like LinePacker.resolve_gids.
+        """
         rs = self.by_fw.get(p.firewall)
         if rs is None:
-            return None
+            return []
         if p.acl is not None:
-            return (rs, p.acl) if p.acl in rs.acls else None
+            return [(rs, p.acl)] if p.acl in rs.acls else []
+        out: list[tuple[Ruleset, str]] = []
         if p.ingress_if is not None:
-            bound = rs.bindings.get(p.ingress_if)
-            if bound and bound[1] == "in" and bound[0] in rs.acls:
-                return rs, bound[0]
-        return None
+            acl = rs.bindings.get((p.ingress_if, "in"))
+            if acl is not None and acl in rs.acls:
+                out.append((rs, acl))
+        if p.egress_if is not None:
+            acl = rs.bindings.get((p.egress_if, "out"))
+            if acl is not None and acl in rs.acls:
+                out.append((rs, acl))
+        return out
 
-    def match_line(self, p: ParsedLine) -> RuleKey | None:
-        """First-match key for one parsed line (None = line not analyzable)."""
-        resolved = self.resolve_acl(p)
-        if resolved is None:
-            return None
-        rs, acl = resolved
+    def resolve_acl(self, p: ParsedLine) -> tuple[Ruleset, str] | None:
+        """First resolved ACL (compatibility helper; prefer resolve_acls)."""
+        acls = self.resolve_acls(p)
+        return acls[0] if acls else None
+
+    def _match_one(self, rs: Ruleset, acl: str, p: ParsedLine) -> RuleKey:
         for rule in rs.acls[acl]:
             for ace in rule.aces:
                 if ace.matches(p.proto, p.src, p.sport, p.dst, p.dport):
                     return (rs.firewall, acl, rule.index)
         return (rs.firewall, acl, 0)  # implicit deny
 
-    def consume(self, lines: Iterable[str]) -> OracleResult:
+    def match_keys(self, p: ParsedLine) -> list[RuleKey]:
+        """First-match key per resolved ACL evaluation (empty = skipped)."""
+        return [self._match_one(rs, acl, p) for rs, acl in self.resolve_acls(p)]
+
+    def match_line(self, p: ParsedLine) -> RuleKey | None:
+        """First evaluation's key (compatibility helper; prefer match_keys)."""
+        keys = self.match_keys(p)
+        return keys[0] if keys else None
+
+    def _fold(self, p: ParsedLine | None) -> None:
         r = self.result
-        for line in lines:
-            r.lines_total += 1
-            p = parse_line(line)
-            key = None if p is None else self.match_line(p)
-            if key is None:
-                r.lines_skipped += 1
-                continue
+        r.lines_total += 1
+        keys = [] if p is None else self.match_keys(p)
+        if not keys:
+            r.lines_skipped += 1
+            return
+        # lines_matched counts ACL evaluations (a dual-bound connection
+        # line contributes two), matching the packers' `parsed` counter
+        for key in keys:
             r.lines_matched += 1
             r.hits[key] += 1
             r.sources[key].add(p.src)
             r.talkers[(key[0], key[1])][p.src] += 1
-        return r
+
+    def consume(self, lines: Iterable[str]) -> OracleResult:
+        for line in lines:
+            self._fold(parse_line(line))
+        return self.result
 
     def consume_parsed(self, parsed: Iterable[ParsedLine]) -> OracleResult:
-        r = self.result
         for p in parsed:
-            r.lines_total += 1
-            key = self.match_line(p)
-            if key is None:
-                r.lines_skipped += 1
-                continue
-            r.lines_matched += 1
-            r.hits[key] += 1
-            r.sources[key].add(p.src)
-            r.talkers[(key[0], key[1])][p.src] += 1
-        return r
+            self._fold(p)
+        return self.result
 
 
 def unused_rule_recall(exact_unused: list[RuleKey], estimated_unused: list[RuleKey]) -> float:

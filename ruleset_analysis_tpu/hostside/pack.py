@@ -85,6 +85,10 @@ class PackedRuleset:
     acl_gid: dict[tuple[str, str], int]  # (firewall, acl name) -> gid
     deny_key: np.ndarray  # [n_acls] uint32: acl_gid -> implicit-deny key
     bindings: dict[tuple[str, str], int]  # (firewall, iface) -> acl_gid ('in')
+    #: (firewall, iface) -> acl_gid for ``out``-direction access-groups;
+    #: connection messages are evaluated against the egress interface's
+    #: out ACL in addition to the ingress in ACL.
+    bindings_out: dict[tuple[str, str], int] = dataclasses.field(default_factory=dict)
 
     @property
     def n_keys(self) -> int:
@@ -102,6 +106,7 @@ def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> P
     key_meta: list[KeyMeta] = []
     rows: list[list[int]] = []
     bindings: dict[tuple[str, str], int] = {}
+    bindings_out: dict[tuple[str, str], int] = {}
 
     for rs in rulesets:
         for acl in rs.acls:
@@ -137,9 +142,14 @@ def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> P
                             key,
                         ]
                     )
-        for iface, (acl, direction) in rs.bindings.items():
-            if direction == "in" and (rs.firewall, acl) in acl_gid:
-                bindings[(rs.firewall, iface)] = acl_gid[(rs.firewall, acl)]
+        for (iface, direction), acl in rs.bindings.items():
+            if (rs.firewall, acl) not in acl_gid:
+                continue
+            gid = acl_gid[(rs.firewall, acl)]
+            if direction == "in":
+                bindings[(rs.firewall, iface)] = gid
+            else:
+                bindings_out[(rs.firewall, iface)] = gid
 
     n_rules = len(key_meta)
     n_acls = len(acl_gid)
@@ -164,6 +174,7 @@ def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> P
         acl_gid=acl_gid,
         deny_key=deny_key,
         bindings=bindings,
+        bindings_out=bindings_out,
     )
 
 
@@ -218,9 +229,16 @@ class LinePacker:
     """Parses raw syslog lines into packed tuple batches against a PackedRuleset.
 
     Lines that don't parse, reference an unknown firewall/ACL, or (for
-    connection messages) hit an interface with no ``access-group`` binding
+    connection messages) hit interfaces with no ``access-group`` binding
     are packed with ``valid=0`` — the mapper analog of silently skipping
     non-matching input lines.
+
+    One line can produce MORE than one tuple: a connection message whose
+    ingress interface has an ``in`` ACL and whose egress interface has an
+    ``out`` ACL is evaluated against both (each evaluation is its own
+    tuple row, exactly as the reference mapper would scan both ACLs).
+    ``parsed`` counts evaluations emitted; ``skipped`` counts lines that
+    produced none.
     """
 
     def __init__(self, packed: PackedRuleset):
@@ -228,31 +246,55 @@ class LinePacker:
         self.skipped = 0
         self.parsed = 0
 
-    def resolve_acl(self, p: ParsedLine) -> int | None:
+    def resolve_gids(self, p: ParsedLine) -> list[int]:
+        """ACL gids this line must be evaluated against (possibly two)."""
         if p.acl is not None:
-            return self.packed.acl_gid.get((p.firewall, p.acl))
+            gid = self.packed.acl_gid.get((p.firewall, p.acl))
+            return [] if gid is None else [gid]
+        out: list[int] = []
         if p.ingress_if is not None:
-            return self.packed.bindings.get((p.firewall, p.ingress_if))
-        return None
+            gid = self.packed.bindings.get((p.firewall, p.ingress_if))
+            if gid is not None:
+                out.append(gid)
+        if p.egress_if is not None:
+            gid = self.packed.bindings_out.get((p.firewall, p.egress_if))
+            if gid is not None:
+                out.append(gid)
+        return out
+
+    def resolve_acl(self, p: ParsedLine) -> int | None:
+        """First resolved gid (compatibility helper; prefer resolve_gids)."""
+        gids = self.resolve_gids(p)
+        return gids[0] if gids else None
 
     def pack_parsed(self, parsed: list[ParsedLine | None], batch_size: int | None = None) -> np.ndarray:
-        """Pack parsed lines into a [B, TUPLE_COLS] uint32 batch (padded)."""
-        b = batch_size or len(parsed)
+        """Pack parsed lines into a [B, TUPLE_COLS] uint32 batch (padded).
+
+        The default capacity is one row per line — two when any
+        out-direction binding exists, since a connection line can then
+        emit two evaluations.
+        """
+        if batch_size is not None:
+            b = batch_size
+        else:
+            b = (2 if self.packed.bindings_out else 1) * len(parsed)
         out = np.zeros((b, TUPLE_COLS), dtype=np.uint32)
         i = 0
         for p in parsed:
-            gid = None if p is None else self.resolve_acl(p)
-            if gid is None:
+            gids = [] if p is None else self.resolve_gids(p)
+            if not gids:
                 self.skipped += 1
                 continue
-            if i >= b:
+            if i + len(gids) > b:
                 raise ValueError(
-                    f"more than batch_size={b} valid lines in chunk; "
-                    "feed chunks of at most batch_size lines"
+                    f"more than batch_size={b} evaluations in chunk; "
+                    "feed fewer lines per chunk (each connection line can "
+                    "emit two rows when both in and out ACLs are bound)"
                 )
-            out[i] = (gid, p.proto, p.src, p.sport, p.dst, p.dport, 1)
-            i += 1
-            self.parsed += 1
+            for gid in gids:
+                out[i] = (gid, p.proto, p.src, p.sport, p.dst, p.dport, 1)
+                i += 1
+                self.parsed += 1
         return out
 
     def pack_lines(self, lines: list[str], batch_size: int | None = None) -> np.ndarray:
@@ -412,6 +454,9 @@ def save_packed(packed: PackedRuleset, path_prefix: str) -> None:
         "key_meta": [dataclasses.asdict(m) for m in packed.key_meta],
         "acl_gid": [[fw, acl, gid] for (fw, acl), gid in packed.acl_gid.items()],
         "bindings": [[fw, iface, gid] for (fw, iface), gid in packed.bindings.items()],
+        "bindings_out": [
+            [fw, iface, gid] for (fw, iface), gid in packed.bindings_out.items()
+        ],
     }
     with open(path_prefix + ".json", "w", encoding="utf-8") as f:
         json.dump(meta, f)
@@ -429,4 +474,7 @@ def load_packed(path_prefix: str) -> PackedRuleset:
         acl_gid={(fw, acl): gid for fw, acl, gid in meta["acl_gid"]},
         deny_key=z["deny_key"],
         bindings={(fw, iface): gid for fw, iface, gid in meta["bindings"]},
+        bindings_out={
+            (fw, iface): gid for fw, iface, gid in meta.get("bindings_out", [])
+        },
     )

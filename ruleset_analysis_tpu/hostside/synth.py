@@ -47,6 +47,7 @@ def synth_config(
     n_groups: int = 4,
     seed: int = 0,
     hostname: str = "fw1",
+    egress_acls: bool = False,
 ) -> str:
     """Generate ASA configuration text with object-groups and varied ACEs."""
     rng = np.random.default_rng(seed)
@@ -101,6 +102,11 @@ def synth_config(
                     port = " object-group WEBPORTS"
             lines.append(f"access-list {acl} extended {action} {proto} {src} {dst}{port}")
         lines.append(f"access-group ACL{a} in interface if{a}")
+        if egress_acls:
+            # the same ACL also filters traffic EXITING interface eg{a}:
+            # connection lines whose egress side is eg{a} get a second
+            # evaluation against it (SURVEY.md §4.3 mapper semantics)
+            lines.append(f"access-group ACL{a} out interface eg{a}")
     return "\n".join(lines) + "\n"
 
 
@@ -187,30 +193,100 @@ def render_syslog(
     tuples: np.ndarray,
     seed: int = 0,
     timestamp: str = "Jul 29 07:48:01",
+    variety: float = 0.0,
 ) -> list[str]:
-    """Render packed tuples back into raw ASA 106100 syslog text.
+    """Render packed tuples back into raw ASA syslog text.
 
-    106100 names the ACL directly, so rendering needs no interface-binding
-    inverse lookup; the text round-trips through the real parse path.
+    By default every valid tuple renders as a 106100 line (names the ACL
+    directly — no binding inverse needed).  With ``variety`` > 0, that
+    fraction of eligible lines render as other handled message classes
+    (106023, 302013, 106001, 106006, 106015), constrained by protocol and
+    by which interfaces the packed bindings make resolvable.  A 302013
+    rendered with an out-bound egress interface yields TWO evaluations
+    downstream — the oracle remains ground truth for every statistic.
     """
     gid_to_name = {gid: (fw, acl) for (fw, acl), gid in packed.acl_gid.items()}
+    # binding inverses: (fw, gid) -> an ingress iface; fw -> egress ifaces
+    in_iface = {}
+    for (fw, iface), gid in packed.bindings.items():
+        in_iface.setdefault((fw, gid), iface)
+    out_ifaces: dict[str, list[str]] = {}
+    for (fw, iface), _gid in packed.bindings_out.items():
+        out_ifaces.setdefault(fw, []).append(iface)
     rng = np.random.default_rng(seed)
     verdicts = rng.random(tuples.shape[0])
+    kinds = rng.random(tuples.shape[0])
+    picks = rng.integers(0, 1 << 30, size=tuples.shape[0])
     out = []
     for i, row in enumerate(tuples):
         if not row[T_VALID]:
             out.append(f"{timestamp} noise : not an ASA message")
             continue
-        fw, acl = gid_to_name[int(row[0])]
+        gid = int(row[0])
+        fw, acl = gid_to_name[gid]
         proto = int(row[1])
         pname = _PROTO_NAMES.get(proto, str(proto))
-        verdict = "permitted" if verdicts[i] < 0.8 else "denied"
         src, dst = u32_to_ip(int(row[2])), u32_to_ip(int(row[4]))
+        sport, dport = int(row[3]), int(row[5])
+        iface = in_iface.get((fw, gid))
+
+        if variety and kinds[i] < variety:
+            eligible = ["106023"]
+            if iface is not None and proto in (6, 17):
+                eligible.append("302013")
+                eligible.append("106001" if proto == 6 else "106006")
+                if proto == 6:
+                    eligible.append("106015")
+            kind = eligible[int(picks[i]) % len(eligible)]
+            if kind == "106023":
+                if proto == 1:
+                    ep = (f"src inside:{src} dst outside:{dst} "
+                          f"(type {dport}, code 0)")
+                else:
+                    ep = f"src inside:{src}/{sport} dst outside:{dst}/{dport}"
+                out.append(
+                    f'{timestamp} {fw} : %ASA-4-106023: Deny {pname} {ep} '
+                    f'by access-group "{acl}" [0x0, 0x0]'
+                )
+                continue
+            if kind == "302013":
+                egs = out_ifaces.get(fw)
+                egress = egs[int(picks[i]) % len(egs)] if egs else "outside"
+                tname = "TCP" if proto == 6 else "UDP"
+                mid = "302013" if proto == 6 else "302015"
+                out.append(
+                    f"{timestamp} {fw} : %ASA-6-{mid}: Built inbound {tname} "
+                    f"connection {int(picks[i])} for {iface}:{src}/{sport} "
+                    f"({src}/{sport}) to {egress}:{dst}/{dport} ({dst}/{dport})"
+                )
+                continue
+            if kind == "106001":
+                out.append(
+                    f"{timestamp} {fw} : %ASA-2-106001: Inbound TCP connection "
+                    f"denied from {src}/{sport} to {dst}/{dport} flags SYN "
+                    f"on interface {iface}"
+                )
+                continue
+            if kind == "106015":
+                out.append(
+                    f"{timestamp} {fw} : %ASA-6-106015: Deny TCP (no connection) "
+                    f"from {src}/{sport} to {dst}/{dport} flags RST "
+                    f"on interface {iface}"
+                )
+                continue
+            # 106006
+            out.append(
+                f"{timestamp} {fw} : %ASA-2-106006: Deny inbound UDP "
+                f"from {src}/{sport} to {dst}/{dport} on interface {iface}"
+            )
+            continue
+
+        verdict = "permitted" if verdicts[i] < 0.8 else "denied"
         if proto == 1:
             # icmp: type travels in the dport column; render as (type)(code 0)
-            paren_s, paren_d = int(row[5]), 0
+            paren_s, paren_d = dport, 0
         else:
-            paren_s, paren_d = int(row[3]), int(row[5])
+            paren_s, paren_d = sport, dport
         out.append(
             f"{timestamp} {fw} : %ASA-6-106100: access-list {acl} {verdict} {pname} "
             f"inside/{src}({paren_s}) -> outside/{dst}({paren_d}) hit-cnt 1 first hit [0x0, 0x0]"

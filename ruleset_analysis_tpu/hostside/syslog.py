@@ -5,16 +5,27 @@ extracts the connection 5-tuple plus the firewall identity, and decides which
 ACL to evaluate.  This module is that parse step, host-side and backend-
 agnostic: both the exact oracle and the TPU packer consume its output.
 
-Message classes handled (the classes SURVEY.md §4.3 names):
+Message classes handled (the ASA access-list / connection family SURVEY.md
+§4.3 names):
 
 - ``%ASA-n-106100``: ``access-list <acl> permitted|denied <proto>
   <if>/<src>(<sport>) -> <if>/<dst>(<dport>) hit-cnt ...`` — names the ACL
   directly.
 - ``%ASA-n-106023``: ``Deny <proto> src <if>:<src>[/<sport>] dst
   <if>:<dst>[/<dport>] [(type <t>, code <c>)] by access-group "<acl>"``.
+- ``%ASA-n-106001``: ``Inbound TCP connection denied from <src>/<sport> to
+  <dst>/<dport> flags <f> on interface <if>`` — resolved via the
+  interface's ``in`` binding.
+- ``%ASA-n-106006``: ``Deny inbound UDP from <src>/<sport> to <dst>/<dport>
+  on interface <if>`` — resolved via the ``in`` binding.
+- ``%ASA-n-106015``: ``Deny TCP (no connection) from <src>/<sport> to
+  <dst>/<dport> flags <f> on interface <if>`` — resolved via the ``in``
+  binding.
 - ``%ASA-n-302013/302015``: ``Built inbound|outbound TCP|UDP connection <id>
   for <if>:<a>/<p> (...) to <if>:<b>/<q> (...)`` — no ACL in the message;
-  the ACL is resolved from the ingress interface's ``access-group`` binding.
+  resolved from the ingress interface's ``in`` binding AND (when
+  configured) the egress interface's ``out`` binding — one connection line
+  can be evaluated against both.
 
 ICMP convention (shared with aclparse): the ICMP *type* travels in the
 destination-port column and the source port is 0, so one packed tuple layout
@@ -42,6 +53,9 @@ class ParsedLine:
     dst: int
     dport: int
     permitted: bool | None  # what the firewall says it did (106100/106023)
+    #: exit interface (302013/302015 only): evaluated against that
+    #: interface's ``out`` access-group binding, when one exists
+    egress_if: str | None = None
 
 
 _PROTO_BY_NAME = {k: (v if v is not None else 0) for k, v in PROTO_NUMBERS.items()}
@@ -80,9 +94,43 @@ _M302013_RE = re.compile(
     r"(\S+?):([\d.]+)/(\d+)"
 )
 
+_M106001_RE = re.compile(
+    r"Inbound\s+TCP\s+connection\s+denied\s+from\s+([\d.]+)/(\d+)\s+to\s+"
+    r"([\d.]+)/(\d+)\s+flags\s+.*?\bon\s+interface\s+(\S+)"
+)
+
+_M106006_RE = re.compile(
+    r"Deny\s+inbound\s+UDP\s+from\s+([\d.]+)/(\d+)\s+to\s+"
+    r"([\d.]+)/(\d+)\s+on\s+interface\s+(\S+)"
+)
+
+_M106015_RE = re.compile(
+    r"Deny\s+TCP\s+\(no connection\)\s+from\s+([\d.]+)/(\d+)\s+to\s+"
+    r"([\d.]+)/(\d+)\s+flags\s+.*?\bon\s+interface\s+(\S+)"
+)
+
+
+def _field_ranges_ok(p: ParsedLine) -> ParsedLine | None:
+    """Skip lines whose numeric fields exceed their wire widths.
+
+    Ports are 16-bit and protocol numbers 8-bit on the wire (and in the
+    bit-packed device batch layout, pack.compact_batch); a syslog line
+    claiming port 70000 is malformed, and silently truncating it could
+    make it match a rule it shouldn't.  The native C++ parser applies the
+    identical post-parse check, keeping the two paths line-for-line equal.
+    """
+    if p.sport > 0xFFFF or p.dport > 0xFFFF or p.proto > 0xFF:
+        return None
+    return p
+
 
 def parse_line(line: str) -> ParsedLine | None:
     """Parse one raw syslog line; None if it is not a handled ASA message."""
+    p = _parse_line_raw(line)
+    return None if p is None else _field_ranges_ok(p)
+
+
+def _parse_line_raw(line: str) -> ParsedLine | None:
     m = _TAG_RE.search(line)
     if not m:
         return None
@@ -144,11 +192,15 @@ def parse_line(line: str) -> ParsedLine | None:
         if_b, ip_b, port_b = b.group(6), ip_to_u32(b.group(7)), int(b.group(8))
         # "Built ... for A to B": A is the lower-security side.  Inbound
         # connections are initiated at A (src=A); outbound are initiated at B
-        # (src=B) with A as the destination side.
+        # (src=B) with A as the destination side.  The packet enters on the
+        # initiator's interface and exits on the other — the egress side's
+        # ``out`` ACL (if bound) also filters it.
         if direction == "inbound":
-            src, sport, dst, dport, ingress = ip_a, port_a, ip_b, port_b, if_a
+            src, sport, dst, dport = ip_a, port_a, ip_b, port_b
+            ingress, egress = if_a, if_b
         else:
-            src, sport, dst, dport, ingress = ip_b, port_b, ip_a, port_a, if_b
+            src, sport, dst, dport = ip_b, port_b, ip_a, port_a
+            ingress, egress = if_b, if_a
         return ParsedLine(
             firewall=host,
             acl=None,
@@ -159,6 +211,24 @@ def parse_line(line: str) -> ParsedLine | None:
             dst=dst,
             dport=dport,
             permitted=True,
+            egress_if=egress,
+        )
+
+    if msgid in ("106001", "106006", "106015"):
+        rx = {"106001": _M106001_RE, "106006": _M106006_RE, "106015": _M106015_RE}[msgid]
+        b = rx.search(body)
+        if not b:
+            return None
+        return ParsedLine(
+            firewall=host,
+            acl=None,
+            ingress_if=b.group(5),
+            proto=17 if msgid == "106006" else 6,
+            src=ip_to_u32(b.group(1)),
+            sport=int(b.group(2)),
+            dst=ip_to_u32(b.group(3)),
+            dport=int(b.group(4)),
+            permitted=False,
         )
 
     return None

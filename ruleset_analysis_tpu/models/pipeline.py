@@ -137,7 +137,52 @@ def ship_ruleset(
     )
 
 
+def register_bytes(n_keys: int, cfg: AnalysisConfig) -> dict[str, int]:
+    """Per-register-file device memory for this geometry, in bytes."""
+    s = cfg.sketch
+    return {
+        "counts": 2 * 4 * n_keys,
+        "cms": 4 * s.cms_depth * s.cms_width,
+        "hll": 4 * n_keys * s.hll_m,
+        "talk_cms": 4 * s.talk_cms_depth * s.cms_width,
+    }
+
+
+def check_register_budget(n_keys: int, cfg: AnalysisConfig) -> None:
+    """Refuse geometries whose registers exceed the configured budget.
+
+    The per-key HLL file (``n_keys * 2**hll_p * 4`` bytes) scales with the
+    ruleset: 1M expanded rule keys at the default hll_p=8 is already 1 GiB
+    of HBM.  Failing here with a concrete suggestion beats an opaque
+    device OOM mid-run.
+    """
+    sizes = register_bytes(n_keys, cfg)
+    total = sum(sizes.values())
+    budget = cfg.register_memory_budget_bytes
+    if total <= budget:
+        return
+    non_hll = total - sizes["hll"]
+    fit_p = -1
+    for p in range(cfg.sketch.hll_p, 0, -1):
+        if non_hll + 4 * n_keys * (1 << p) <= budget:
+            fit_p = p
+            break
+    hint = (
+        f"try --hll-p {fit_p}"
+        if fit_p > 0
+        else "even hll_p=1 does not fit; raise register_memory_budget_bytes "
+        "or shrink the ruleset/cms geometry"
+    )
+    raise ValueError(
+        f"sketch registers need {total / 2**20:.0f} MiB "
+        f"(hll {sizes['hll'] / 2**20:.0f} MiB = {n_keys} keys x "
+        f"{cfg.sketch.hll_m} registers x 4 B) but the budget is "
+        f"{budget / 2**20:.0f} MiB; {hint}"
+    )
+
+
 def init_state(n_keys: int, cfg: AnalysisConfig) -> AnalysisState:
+    check_register_budget(n_keys, cfg)
     s = cfg.sketch
     return AnalysisState(
         counts_lo=jnp.zeros(n_keys, dtype=_U32),
@@ -155,6 +200,7 @@ def init_state_host(n_keys: int, cfg: AnalysisConfig) -> AnalysisState:
     device plugin (jax.jit accepts numpy leaves); the driver's own jit call
     is then the first and only backend contact.
     """
+    check_register_budget(n_keys, cfg)
     s = cfg.sketch
     u32 = np.uint32
     return AnalysisState(

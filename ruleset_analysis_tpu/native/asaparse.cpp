@@ -11,9 +11,8 @@
 // Semantics mirror ruleset_analysis_tpu/hostside/syslog.py (parse_line)
 // and pack.py (LinePacker) exactly; tests/test_fastparse.py asserts the
 // two paths produce identical batches on synthetic and edge-case
-// corpora.  Known (deliberate) divergence: lines whose IPv4 octets are
-// out of range or whose ports exceed 2^32-1 are *skipped* here, where
-// the Python path raises — robustness over crash-parity.
+// corpora.  Both paths skip lines whose IPv4 octets, ports (> 65535) or
+// protocol numbers (> 255) exceed their field widths.
 //
 // C ABI only (loaded via ctypes; no pybind11 in this image).
 
@@ -30,11 +29,12 @@ namespace {
 constexpr int64_t TUPLE_COLS = 7;
 
 struct Packer {
-    // key: firewall + '\x01' + acl  -> acl gid   (106100/106023 path)
-    //      firewall + '\x02' + iface -> acl gid  (302013/302015 path)
+    // key: firewall + '\x01' + acl   -> acl gid  (named-ACL messages)
+    //      firewall + '\x02' + iface -> acl gid  (in-direction binding)
+    //      firewall + '\x03' + iface -> acl gid  (out-direction binding)
     std::unordered_map<std::string, uint32_t> resolve;
-    int64_t parsed = 0;   // valid tuples emitted (LinePacker.parsed)
-    int64_t skipped = 0;  // lines not parsed/resolved (LinePacker.skipped)
+    int64_t parsed = 0;   // ACL evaluations emitted (LinePacker.parsed)
+    int64_t skipped = 0;  // lines yielding none (LinePacker.skipped)
 };
 
 // Per-thread parse context: the shared resolve table is read-only during a
@@ -44,8 +44,6 @@ struct Packer {
 struct LocalCtx {
     const std::unordered_map<std::string, uint32_t>* resolve;
     std::string keybuf;
-    int64_t parsed = 0;
-    int64_t skipped = 0;
 };
 
 inline bool is_sp(char c) { return c == ' ' || c == '\t' || c == '\v' || c == '\f' || c == '\r' || c == '\n'; }
@@ -157,7 +155,9 @@ uint32_t proto_num(const char* t0, const char* t1) {
 struct Parsed {
     const char* fw0; const char* fw1;
     const char* acl0; const char* acl1;   // acl0 == nullptr: resolve by iface
-    const char* if0; const char* if1;
+    const char* if0; const char* if1;     // ingress interface (in binding)
+    const char* eif0 = nullptr;           // egress interface (out binding);
+    const char* eif1 = nullptr;           // 302013/302015 only
     uint32_t proto, src, sport, dst, dport;
 };
 
@@ -371,12 +371,16 @@ bool parse_302013(const char* b, const char* be, Parsed* out) {
         const char* ib0; const char* ib1; uint32_t ipb, pob;
         if (!endpoint_colon(p, be, true, &ib0, &ib1, &ipb, &pob)) continue;
         out->acl0 = nullptr; out->acl1 = nullptr;
-        // inbound: initiated at A (src=A, ingress=ifA); outbound: src=B
+        // inbound: initiated at A (src=A, ingress=ifA, egress=ifB);
+        // outbound: initiated at B (src=B, ingress=ifB, egress=ifA).
+        // The egress side's out-direction ACL (if bound) also filters.
         if (inbound) {
             out->if0 = ia0; out->if1 = ia1;
+            out->eif0 = ib0; out->eif1 = ib1;
             out->src = ipa; out->sport = poa; out->dst = ipb; out->dport = pob;
         } else {
             out->if0 = ib0; out->if1 = ib1;
+            out->eif0 = ia0; out->eif1 = ia1;
             out->src = ipb; out->sport = pob; out->dst = ipa; out->dport = poa;
         }
         out->proto = proto;
@@ -384,22 +388,136 @@ bool parse_302013(const char* b, const char* be, Parsed* out) {
     }
 }
 
-// Parse one line; emit into the column-major output if valid+resolved.
+// "ip/port" endpoint of the 106001/106006/106015 family ("from A/p to
+// B/q"): a bare dotted quad, '/', decimal port — no interface prefix.
+bool endpoint_bare(const char*& p, const char* end, uint32_t* ip, uint32_t* port) {
+    const char* q = p;
+    uint32_t ipv;
+    if (!parse_ipv4_run(q, end, &ipv)) return false;
+    if (q >= end || *q != '/') return false;
+    ++q;
+    uint32_t pv;
+    if (!parse_u32(q, end, &pv)) return false;
+    *ip = ipv; *port = pv;
+    p = q;
+    return true;
+}
+
+// First "on interface <if>" at or after p (the 106001/106015 regexes use
+// a lazy ".*?", so the FIRST occurrence wins, matching syslog.py).
+bool on_interface_scan(const char* p, const char* be, const char** i0, const char** i1) {
+    const char* scan = p;
+    while (true) {
+        const char* hit = find_sub(scan, be, "on", 2);
+        if (!hit) return false;
+        scan = hit + 1;
+        // \bon: previous char must not be a word char (regex \b semantics)
+        char prev = hit > p ? hit[-1] : ' ';
+        if ((prev >= 'a' && prev <= 'z') || (prev >= 'A' && prev <= 'Z') ||
+            (prev >= '0' && prev <= '9') || prev == '_')
+            continue;
+        const char* c = hit + 2;
+        if (!skip_ws1(c, be)) continue;
+        const char* t0; const char* t1;
+        if (!token(c, be, &t0, &t1) || !tok_eq(t0, t1, "interface")) continue;
+        if (!skip_ws1(c, be)) continue;
+        if (!token(c, be, &t0, &t1)) continue;
+        *i0 = t0; *i1 = t1;
+        return true;
+    }
+}
+
+// 106001: Inbound TCP connection denied from A/p to B/q flags ... on
+// interface IF.  106015: Deny TCP (no connection) from A/p to B/q flags
+// ... on interface IF.  106006: Deny inbound UDP from A/p to B/q on
+// interface IF (immediately — no flags text).  All resolve via the
+// interface's in-direction binding.  ``lead`` is a token sequence matched
+// with \s+ separators (the regexes' flexibility); a token prefixed with
+// '\x01' must instead be separated from its predecessor by EXACTLY one
+// space (the 106015 pattern embeds a literal space inside
+// "\(no connection\)").
+bool parse_106001_like(const char* b, const char* be,
+                       const char* const* lead, int lead_n,
+                       bool need_flags, uint32_t proto, Parsed* out) {
+    size_t first_n = strlen(lead[0]);
+    const char* pos = b;
+    while (true) {
+        const char* hit = find_sub(pos, be, lead[0], first_n);
+        if (!hit) return false;
+        pos = hit + 1;
+        const char* p = hit;
+        const char* t0; const char* t1;
+        bool lead_ok = true;
+        for (int i = 0; i < lead_n; ++i) {
+            const char* want = lead[i];
+            if (i) {
+                if (want[0] == '\x01') {
+                    ++want;
+                    if (p >= be || *p != ' ') { lead_ok = false; break; }
+                    ++p;  // exactly one space; token() rejects a second
+                } else if (!skip_ws1(p, be)) {
+                    lead_ok = false;
+                    break;
+                }
+            }
+            if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, want)) {
+                lead_ok = false;
+                break;
+            }
+        }
+        if (!lead_ok) continue;
+        if (!skip_ws1(p, be)) continue;
+        uint32_t sip, spo;
+        if (!endpoint_bare(p, be, &sip, &spo)) continue;
+        if (!skip_ws1(p, be)) continue;
+        if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "to")) continue;
+        if (!skip_ws1(p, be)) continue;
+        uint32_t dip, dpo;
+        if (!endpoint_bare(p, be, &dip, &dpo)) continue;
+        const char* i0; const char* i1;
+        if (need_flags) {
+            if (!skip_ws1(p, be)) continue;
+            if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "flags")) continue;
+            if (!on_interface_scan(p, be, &i0, &i1)) continue;
+        } else {
+            // 106006: "on interface" must follow the endpoints directly
+            if (!skip_ws1(p, be)) continue;
+            if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "on")) continue;
+            if (!skip_ws1(p, be)) continue;
+            if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "interface")) continue;
+            if (!skip_ws1(p, be)) continue;
+            if (!token(p, be, &i0, &i1)) continue;
+        }
+        out->acl0 = nullptr; out->acl1 = nullptr;
+        out->if0 = i0; out->if1 = i1;
+        out->proto = proto;
+        out->src = sip; out->sport = spo; out->dst = dip; out->dport = dpo;
+        return true;
+    }
+}
+
+// Parse one line; emit its ACL evaluations into the column-major output.
+//
+// Returns the number of tuple rows written (0 = line skipped), or -1 when
+// the line's rows do NOT fit in [row, cap) — the caller must close the
+// batch without consuming the line.  A connection message whose ingress
+// interface has an in-ACL and whose egress interface has an out-ACL emits
+// TWO rows (two independent evaluations), mirroring LinePacker.
 //
 // Parity note (syslog.parse_line): _TAG_RE.search finds the FIRST
 // well-formed "%ASA-<d>-<dddddd>:" marker that has a host token before
 // it; the line's fate is then decided by that one tag — an unhandled
 // msgid or a failed body parse means the line is skipped, with no retry
 // against later markers.  Only malformed markers keep the scan going.
-bool handle_line(LocalCtx* pk, const char* ls, const char* le,
-                 uint32_t* out, int64_t cap, int64_t row) {
+int handle_line(LocalCtx* pk, const char* ls, const char* le,
+                uint32_t* out, int64_t cap, int64_t row) {
     const char* pos = ls;
     const char* msgid = nullptr;
     const char* body = nullptr;
     const char* h0 = nullptr; const char* h1 = nullptr;
     while (true) {
         const char* tag = find_sub(pos, le, "%ASA-", 5);
-        if (!tag) return false;
+        if (!tag) return 0;
         pos = tag + 1;
         const char* t = tag + 5;
         if (t >= le || !is_dig(*t)) continue;
@@ -435,30 +553,62 @@ bool handle_line(LocalCtx* pk, const char* ls, const char* le,
     else if (memcmp(msgid, "106023", 6) == 0) ok = parse_106023(body, le, &pr);
     else if (memcmp(msgid, "302013", 6) == 0 || memcmp(msgid, "302015", 6) == 0)
         ok = parse_302013(body, le, &pr);
-    else return false;  // unhandled message class
-    if (!ok) return false;
+    else if (memcmp(msgid, "106001", 6) == 0) {
+        static const char* const lead[] = {
+            "Inbound", "TCP", "connection", "denied", "from"};
+        ok = parse_106001_like(body, le, lead, 5, /*need_flags=*/true, 6, &pr);
+    } else if (memcmp(msgid, "106015", 6) == 0) {
+        static const char* const lead[] = {
+            // "\001" (octal): "\x01c..." would munch the 'c' as a hex digit
+            "Deny", "TCP", "(no", "\001connection)", "from"};
+        ok = parse_106001_like(body, le, lead, 5, /*need_flags=*/true, 6, &pr);
+    } else if (memcmp(msgid, "106006", 6) == 0) {
+        static const char* const lead[] = {"Deny", "inbound", "UDP", "from"};
+        ok = parse_106001_like(body, le, lead, 4, /*need_flags=*/false, 17, &pr);
+    } else return 0;  // unhandled message class
+    if (!ok) return 0;
+    // wire-width validation (syslog.py _field_ranges_ok): ports are
+    // 16-bit, protocol numbers 8-bit; a line claiming more is malformed
+    // and skipping beats silently truncating it into a false match
+    if (pr.sport > 0xFFFF || pr.dport > 0xFFFF || pr.proto > 0xFF) return 0;
 
-    // resolve: named ACL first, else ingress-interface binding
+    // resolve into up to two gids: named ACL, or in-binding of the
+    // ingress interface plus out-binding of the egress interface
     std::string& k = pk->keybuf;
-    k.assign(h0, h1 - h0);
+    uint32_t gids[2];
+    int n_gids = 0;
     if (pr.acl0) {
+        k.assign(h0, h1 - h0);
         k.push_back('\x01');
         k.append(pr.acl0, pr.acl1 - pr.acl0);
+        auto it = pk->resolve->find(k);
+        if (it != pk->resolve->end()) gids[n_gids++] = it->second;
     } else {
+        k.assign(h0, h1 - h0);
         k.push_back('\x02');
         k.append(pr.if0, pr.if1 - pr.if0);
+        auto it = pk->resolve->find(k);
+        if (it != pk->resolve->end()) gids[n_gids++] = it->second;
+        if (pr.eif0) {
+            k.assign(h0, h1 - h0);
+            k.push_back('\x03');
+            k.append(pr.eif0, pr.eif1 - pr.eif0);
+            it = pk->resolve->find(k);
+            if (it != pk->resolve->end()) gids[n_gids++] = it->second;
+        }
     }
-    auto it = pk->resolve->find(k);
-    if (it == pk->resolve->end()) return false;
-    if (row >= cap) return false;  // caller guards; belt-and-braces
-    out[0 * cap + row] = it->second;
-    out[1 * cap + row] = pr.proto;
-    out[2 * cap + row] = pr.src;
-    out[3 * cap + row] = pr.sport;
-    out[4 * cap + row] = pr.dst;
-    out[5 * cap + row] = pr.dport;
-    out[6 * cap + row] = 1;
-    return true;
+    if (n_gids == 0) return 0;
+    if (row + n_gids > cap) return -1;  // close the batch; line unconsumed
+    for (int g = 0; g < n_gids; ++g, ++row) {
+        out[0 * cap + row] = gids[g];
+        out[1 * cap + row] = pr.proto;
+        out[2 * cap + row] = pr.src;
+        out[3 * cap + row] = pr.sport;
+        out[4 * cap + row] = pr.dst;
+        out[5 * cap + row] = pr.dport;
+        out[6 * cap + row] = 1;
+    }
+    return n_gids;
 }
 
 }  // namespace
@@ -481,6 +631,15 @@ void asa_packer_add_binding(void* h, const char* fw, const char* iface, uint32_t
     Packer* pk = (Packer*)h;
     std::string k(fw);
     k.push_back('\x02');
+    k += iface;
+    pk->resolve[k] = gid;
+}
+
+// out-direction access-group: (firewall, egress interface) -> acl gid.
+void asa_packer_add_binding_out(void* h, const char* fw, const char* iface, uint32_t gid) {
+    Packer* pk = (Packer*)h;
+    std::string k(fw);
+    k.push_back('\x03');
     k += iface;
     pk->resolve[k] = gid;
 }
@@ -532,25 +691,27 @@ int64_t asa_pack_chunk_mt(void* h, const char* buf, int64_t len, int final_,
     if (n_threads == 1) {
         // direct streaming loop: no line index, no scratch — the
         // fastest path for one core and the reference semantics for the
-        // parity tests
-        LocalCtx cx{&pk->resolve, {}, 0, 0};
+        // parity tests.  Batches are line-atomic: when a line's rows
+        // (up to two — in + out evaluation) don't fit, it stays
+        // unconsumed and opens the next batch, exactly like the Python
+        // _TextSource.
+        LocalCtx cx{&pk->resolve, {}};
         const char* p = buf;
         int64_t lines = 0, valid = 0;
-        while (p < end && lines < max_lines && valid < cap) {
+        int64_t parsed = 0, skipped = 0;
+        while (p < end && lines < max_lines) {
             const char* nl = (const char*)memchr(p, '\n', end - p);
             const char* le = nl ? nl : end;
             if (!nl && !final_) break;  // incomplete tail line
-            if (handle_line(&cx, p, le, out, cap, valid)) {
-                ++valid;
-                ++cx.parsed;
-            } else {
-                ++cx.skipped;
-            }
+            int n = handle_line(&cx, p, le, out, cap, valid);
+            if (n < 0) break;  // rows don't fit: close batch, keep line
+            if (n == 0) ++skipped;
+            else { valid += n; parsed += n; }
             ++lines;
             p = nl ? nl + 1 : end;
         }
-        pk->parsed += cx.parsed;
-        pk->skipped += cx.skipped;
+        pk->parsed += parsed;
+        pk->skipped += skipped;
         zero_tail(out, cap, valid);
         *n_lines_out = lines;
         *n_valid_out = valid;
@@ -588,51 +749,67 @@ int64_t asa_pack_chunk_mt(void* h, const char* buf, int64_t len, int final_,
     if (W < 1) W = 1;
     if (W > (int)(L / 1024) + 1) W = (int)(L / 1024) + 1;  // tiny batches: few
 
-    // ---- workers: private slabs, thread-local contexts
-    std::vector<uint32_t> scratch((size_t)(TUPLE_COLS * L));
+    // ---- workers: private slabs (2 rows per line: a connection line can
+    // emit both an in- and an out-evaluation), thread-local contexts.
+    // rows_per_line records each line's emission count so the compaction
+    // can re-apply the line-atomic row cap exactly as the sequential loop
+    // (and the Python _TextSource) would.
+    std::vector<uint32_t> scratch((size_t)(TUPLE_COLS * 2 * L));
+    std::vector<uint8_t> rows_per_line((size_t)L);
     std::vector<int64_t> lo(W + 1);
     for (int w = 0; w <= W; ++w) lo[w] = L * w / W;
     std::vector<LocalCtx> ctx((size_t)W);
-    std::vector<int64_t> valid_w((size_t)W, 0);
     std::vector<std::thread> threads;
     threads.reserve((size_t)W);
     for (int w = 0; w < W; ++w) {
         ctx[w].resolve = &pk->resolve;
         threads.emplace_back([&, w]() {
             const int64_t i0 = lo[w], i1 = lo[w + 1];
-            const int64_t slab_cap = i1 - i0;
-            uint32_t* slab = scratch.data() + (size_t)(i0 * TUPLE_COLS);
+            const int64_t slab_cap = 2 * (i1 - i0);
+            uint32_t* slab = scratch.data() + (size_t)(2 * i0 * TUPLE_COLS);
             LocalCtx* cx = &ctx[w];
             int64_t v = 0;
             for (int64_t i = i0; i < i1; ++i) {
-                if (handle_line(cx, buf + off[i], line_end(i), slab, slab_cap, v)) {
-                    ++v;
-                    ++cx->parsed;
-                } else {
-                    ++cx->skipped;
-                }
+                int n = handle_line(cx, buf + off[i], line_end(i), slab, slab_cap, v);
+                // n < 0 impossible: slab_cap == 2 * range lines
+                rows_per_line[(size_t)i] = (uint8_t)(n > 0 ? n : 0);
+                if (n > 0) v += n;
             }
-            valid_w[w] = v;
         });
     }
     for (auto& t : threads) t.join();
 
-    // ---- compaction: concatenate slabs' valid rows, preserving order
+    // ---- line-atomic row cap: consume lines 0..K-1, K maximal with the
+    // cumulative rows fitting in cap (the first non-fitting valid line
+    // closes the batch, exactly like the sequential loop)
+    int64_t K = 0, total_rows = 0;
+    int64_t parsed = 0, skipped = 0;
+    for (; K < L; ++K) {
+        const int64_t r = rows_per_line[(size_t)K];
+        if (total_rows + r > cap) break;
+        total_rows += r;
+        if (r == 0) ++skipped; else parsed += r;
+    }
+
+    // ---- compaction: concatenate consumed lines' rows, preserving order
     int64_t valid = 0;
-    for (int w = 0; w < W; ++w) {
-        const int64_t i0 = lo[w], slab_cap = lo[w + 1] - i0;
-        const uint32_t* slab = scratch.data() + (size_t)(i0 * TUPLE_COLS);
+    for (int w = 0; w < W && lo[w] < K; ++w) {
+        const int64_t i0 = lo[w], i1 = lo[w + 1] < K ? lo[w + 1] : K;
+        const int64_t slab_cap = 2 * (lo[w + 1] - i0);
+        const uint32_t* slab = scratch.data() + (size_t)(2 * i0 * TUPLE_COLS);
+        int64_t take = 0;  // rows of this worker's consumed lines
+        for (int64_t i = i0; i < i1; ++i) take += rows_per_line[(size_t)i];
         for (int64_t c = 0; c < TUPLE_COLS; ++c)
             memcpy(out + c * cap + valid, slab + c * slab_cap,
-                   (size_t)valid_w[w] * sizeof(uint32_t));
-        valid += valid_w[w];
-        pk->parsed += ctx[w].parsed;
-        pk->skipped += ctx[w].skipped;
+                   (size_t)take * sizeof(uint32_t));
+        valid += take;
     }
+    pk->parsed += parsed;
+    pk->skipped += skipped;
     zero_tail(out, cap, valid);
-    *n_lines_out = L;
+    *n_lines_out = K;
     *n_valid_out = valid;
-    return consumed;
+    return K < L ? (int64_t)off[K] : consumed;
 }
 
 // Single-threaded ABI kept for compatibility.

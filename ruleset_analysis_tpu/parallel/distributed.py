@@ -72,3 +72,44 @@ def local_batch_slice(global_batch_size: int) -> tuple[int, int]:
     i = jax.process_index()
     per = global_batch_size // n
     return i * per, (i + 1) * per
+
+
+def to_global(mesh: Mesh, local_np: np.ndarray, spec) -> jax.Array:
+    """Assemble this process's local numpy block into a global jax.Array.
+
+    ``spec`` is the global PartitionSpec; replicated leaves (``P()``) must
+    hold identical data on every process (true for the analysis state and
+    rule tensor, which every process computes from the same ruleset).
+    """
+    from jax.sharding import NamedSharding
+
+    return jax.make_array_from_process_local_data(
+        NamedSharding(mesh, spec), np.ascontiguousarray(local_np)
+    )
+
+
+def all_processes_have_data(has_data: bool) -> bool:
+    """True while ANY process still has input (one tiny allgather).
+
+    The chunk loop is a collective program: every process must invoke the
+    jitted step the same number of times or the job deadlocks.  Processes
+    whose input split ran dry keep stepping all-invalid batches until
+    every split is exhausted — the register updates are weighted by the
+    valid mask, so padding rounds change nothing.
+    """
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(np.asarray([1 if has_data else 0]))
+    return bool(np.asarray(flags).sum() > 0)
+
+
+def sum_across_processes(values: dict[str, int]) -> dict[str, int]:
+    """Aggregate per-process counters (parsed/skipped/lines) for totals."""
+    from jax.experimental import multihost_utils
+
+    keys = sorted(values)
+    arr = np.asarray([int(values[k]) for k in keys], dtype=np.int64)
+    summed = np.asarray(multihost_utils.process_allgather(arr)).reshape(
+        jax.process_count(), len(keys)
+    ).sum(axis=0)
+    return {k: int(v) for k, v in zip(keys, summed)}

@@ -24,10 +24,17 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     erroring — the cache is an optimization, never a requirement.
     """
     path = cache_dir or os.environ.get("RA_XLA_CACHE_DIR") or _DEFAULT_DIR
-    # namespace by backend selection: axon/tpu and cpu-fallback runs must
-    # not share AOT entries (XLA:CPU loads cached code compiled with
-    # different machine-feature sets and warns of possible SIGILL)
     platforms = os.environ.get("JAX_PLATFORMS", "default") or "default"
+    # CPU-only runs (the dev/test fallback) skip the persistent cache by
+    # default: XLA:CPU re-loads its AOT result with pseudo machine
+    # features (+prefer-no-scatter, ...) and emits a scary
+    # possible-SIGILL error log on every cache hit.  RA_XLA_CACHE_DIR
+    # forces it on anyway.  TPU runs — where the ~15s step compile
+    # actually hurts — always cache.
+    if platforms == "cpu" and not os.environ.get("RA_XLA_CACHE_DIR"):
+        return None
+    # namespace by backend selection so axon/tpu and cpu runs never share
+    # entries compiled for a different executor
     path = os.path.join(path, platforms.replace(",", "+"))
     try:
         os.makedirs(path, exist_ok=True)
@@ -37,6 +44,10 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
         # cache even fast compiles: the step compiles in ~1s on CPU but
         # the suite builds dozens of fresh jit wrappers per run
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # JAX-executable entries only: XLA:CPU's AOT sub-caches re-load
+        # with machine-feature pseudo-flags (+prefer-no-scatter, ...) that
+        # trip a "could SIGILL" error log on every cache hit
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
         return path
     except Exception:
         return None

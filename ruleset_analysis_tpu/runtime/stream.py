@@ -42,7 +42,16 @@ def chunked(it: Iterable[str], size: int) -> Iterator[list[str]]:
 
 
 class _TextSource:
-    """Batch source over an iterable of decoded lines (pure-Python parse)."""
+    """Batch source over an iterable of decoded lines (pure-Python parse).
+
+    Batches are line-atomic: each holds a whole number of raw lines and at
+    most ``batch_size`` tuple rows.  A batch normally covers exactly
+    ``batch_size`` raw lines, but closes early when the next line's
+    evaluations would not fit — a connection line evaluated against both
+    an ``in`` and an ``out`` ACL emits two rows.  Counters update as lines
+    are assigned to batches, so checkpoint snapshots (taken at batch
+    boundaries) always agree with the batches actually emitted.
+    """
 
     def __init__(self, packed: PackedRuleset, lines: Iterable[str]):
         self.packer = LinePacker(packed)
@@ -52,6 +61,9 @@ class _TextSource:
         self.packer.parsed, self.packer.skipped = parsed, skipped
 
     def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
+        from ..hostside.syslog import parse_line
+        from ..hostside.pack import TUPLE_COLS
+
         it = iter(self._lines)
         skipped_ok = 0
         for _ in range(skip_lines):
@@ -65,11 +77,32 @@ class _TextSource:
                 f"snapshot consumed {skip_lines} lines but the input "
                 f"stream has only {skipped_ok}; wrong or truncated log input"
             )
-        for chunk in chunked(it, batch_size):
-            batch_np = np.ascontiguousarray(
-                self.packer.pack_lines(chunk, batch_size=batch_size).T
-            )
-            yield batch_np, len(chunk)
+        packer = self.packer
+        out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
+        fill = 0  # tuple rows used
+        raw = 0  # raw lines assigned to this batch
+        for line in it:
+            p = parse_line(line)
+            gids = [] if p is None else packer.resolve_gids(p)
+            if gids and fill + len(gids) > batch_size:
+                yield out, raw
+                out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
+                fill = 0
+                raw = 0
+            for gid in gids:
+                out[:, fill] = (gid, p.proto, p.src, p.sport, p.dst, p.dport, 1)
+                fill += 1
+            packer.parsed += len(gids)
+            if not gids:
+                packer.skipped += 1
+            raw += 1
+            if raw == batch_size:
+                yield out, raw
+                out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
+                fill = 0
+                raw = 0
+        if raw:
+            yield out, raw
 
 
 class _PackedCounters:
@@ -247,12 +280,7 @@ def run_stream_file(
     if native:
         source = _FileSource(packed, paths)
     else:
-        def _lines():
-            for path in paths:
-                with open(path, "r", encoding="utf-8", errors="replace") as f:
-                    yield from f
-
-        source = _TextSource(packed, _lines())
+        source = _TextSource(packed, _iter_files(paths))
     return _run_core(
         packed,
         source,
@@ -262,6 +290,140 @@ def run_stream_file(
         profile_dir=profile_dir,
         max_chunks=max_chunks,
     )
+
+
+def run_stream_file_distributed(
+    packed: PackedRuleset,
+    local_paths: str | list[str],
+    cfg: AnalysisConfig,
+    *,
+    native: bool | None = None,
+    topk: int = 10,
+    return_state: bool = False,
+):
+    """Multi-process analysis: each process feeds ITS OWN input split.
+
+    The reborn Hadoop job (SURVEY.md §3c): ``jax.distributed`` must already
+    be initialized (parallel.distributed.init_distributed); the mesh spans
+    every device of every process, each process parses only its own files
+    (the input-split analog), and the per-chunk global batch is assembled
+    with ``jax.make_array_from_process_local_data``.  The SAME shard_map
+    step then merges registers with psum/pmax — over ICI within a host,
+    DCN between hosts.  Every process returns the identical Report.
+
+    Checkpointing is not yet supported on this path (each process would
+    need its own offset in its own split); cfg must leave it disabled.
+    """
+    import jax
+
+    from ..hostside import fastparse
+    from ..parallel import distributed as dist
+    from ..parallel import mesh as mesh_lib
+    from ..parallel.step import make_parallel_step
+    from jax.sharding import PartitionSpec as P
+
+    if cfg.checkpoint_every_chunks or cfg.resume:
+        raise ValueError("checkpoint/resume is not supported with --distributed yet")
+    if cfg.layout != "flat":
+        raise ValueError("--distributed supports layout='flat' only for now")
+
+    if isinstance(local_paths, str):
+        local_paths = [local_paths]
+    if native is None:
+        native = fastparse.available()
+    source = _FileSource(packed, local_paths) if native else _TextSource(
+        packed, _iter_files(local_paths)
+    )
+
+    mesh = dist.make_global_mesh(cfg.mesh_axis)
+    n_procs = jax.process_count()
+    global_batch = mesh_lib.pad_batch_size(
+        max(cfg.batch_size, 2 if packed.bindings_out else 1) * n_procs,
+        mesh, cfg.mesh_axis,
+    )
+    local_batch = global_batch // n_procs
+
+    rules_host = pipeline.ship_ruleset_host(packed)
+    rules = pipeline.DeviceRuleset(
+        rules=dist.to_global(mesh, rules_host.rules, P()),
+        deny_key=dist.to_global(mesh, rules_host.deny_key, P()),
+        rules_fm=None,
+    )
+    state_host = pipeline.init_state_host(packed.n_keys, cfg)
+    state = pipeline.AnalysisState(
+        **{
+            k: dist.to_global(mesh, getattr(state_host, k), P())
+            for k in pipeline.AnalysisState._fields
+        }
+    )
+    step = make_parallel_step(mesh, cfg, packed.n_keys)
+    packer = source.packer
+    tracker = TopKTracker(cfg.sketch.topk_capacity)
+    pending: deque[pipeline.ChunkOut] = deque()
+
+    def drain(out: pipeline.ChunkOut) -> None:
+        tracker.offer_chunk(
+            np.asarray(out.cand_acl), np.asarray(out.cand_src), np.asarray(out.cand_est)
+        )
+
+    from ..hostside.pack import TUPLE_COLS
+    from .metrics import ThroughputMeter
+
+    meter = ThroughputMeter(cfg.report_every_chunks)
+    it = source.batches(0, local_batch)
+    empty = np.zeros((TUPLE_COLS, local_batch), dtype=np.uint32)
+    lines_consumed = 0
+    n_chunks = 0
+    while True:
+        nxt = next(it, None)
+        # collective agreement: everyone steps while anyone has data
+        if not dist.all_processes_have_data(nxt is not None):
+            break
+        batch_np, n_raw = nxt if nxt is not None else (empty, 0)
+        wire = pack_mod.compact_batch(batch_np)
+        gbatch = dist.to_global(mesh, wire, P(None, cfg.mesh_axis))
+        state, out = step(state, rules, gbatch, n_chunks)
+        pending.append(out)
+        if len(pending) > 2:
+            drain(pending.popleft())
+        n_chunks += 1
+        lines_consumed += n_raw
+        meter.tick(n_raw)
+
+    pipeline.sync_state(state)
+    elapsed = meter.elapsed()
+    while pending:
+        drain(pending.popleft())
+    agg = dist.sum_across_processes(
+        {
+            "lines_total": lines_consumed,
+            "lines_matched": packer.parsed,
+            "lines_skipped": packer.skipped,
+        }
+    )
+    totals = {
+        **agg,
+        "chunks": n_chunks,
+        "processes": n_procs,
+        "elapsed_sec": round(elapsed, 4),
+        "lines_per_sec": round(agg["lines_total"] / elapsed, 1) if elapsed > 0 else 0.0,
+    }
+    report = pipeline.finalize(state, packed, cfg, tracker, topk=topk, totals=totals)
+    if return_state:
+        import jax
+
+        regs = {
+            k: np.asarray(jax.device_get(getattr(state, k)))
+            for k in pipeline.AnalysisState._fields
+        }
+        return report, regs
+    return report
+
+
+def _iter_files(paths: list[str]):
+    for path in paths:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            yield from f
 
 
 def _run_core(
@@ -282,6 +444,11 @@ def _run_core(
     if mesh is None:
         mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
     batch_size = mesh_lib.pad_batch_size(cfg.batch_size, mesh, cfg.mesh_axis)
+    if packed.bindings_out and batch_size < 2:
+        raise ValueError(
+            "batch_size must be >= 2 when out-direction access-groups are "
+            "bound: one connection line can emit two ACL evaluations"
+        )
 
     stacked = cfg.layout == "stacked"
     lane = 0
@@ -376,7 +543,7 @@ def _run_core(
     # are fetched, their compute is long done, so the host never stalls on
     # the device — and memory stays O(1) chunks instead of O(n_chunks).
     pending: deque[pipeline.ChunkOut] = deque()
-    lines_at_start = packer.parsed + packer.skipped  # nonzero after resume
+    lines_at_start = lines_consumed  # nonzero after resume
     meter = ThroughputMeter(cfg.report_every_chunks)
     chunks_this_run = 0
     last_snap_chunks = n_chunks  # snapshot cadence is device chunks SINCE
@@ -429,10 +596,12 @@ def _run_core(
 
     # lines_total/matched/skipped/chunks are cumulative across resumes;
     # throughput is this run's lines over this run's wall time only.
-    lines_total = packer.parsed + packer.skipped
-    lines_this_run = lines_total - lines_at_start
+    # lines_matched counts ACL evaluations (a connection line bound to
+    # both an in and an out ACL contributes two); lines_skipped counts
+    # raw lines that produced no evaluation.
+    lines_this_run = lines_consumed - lines_at_start
     totals = {
-        "lines_total": lines_total,
+        "lines_total": lines_consumed,
         "lines_matched": packer.parsed,
         "lines_skipped": packer.skipped,
         "chunks": n_chunks,

@@ -1,0 +1,44 @@
+"""Worker process for tests/test_distributed.py.
+
+Joins a jax.distributed cluster (local CPU coordinator), runs the
+distributed stream driver over THIS process's input split, and dumps the
+final register files + report JSON for the parent test to compare.
+
+Usage: dist_worker.py PROC_ID N_PROCS PORT RULESET_PREFIX LOG_PATH OUT_PREFIX
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    proc_id, n_procs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+    ruleset_prefix, log_path, out_prefix = sys.argv[4], sys.argv[5], sys.argv[6]
+
+    from ruleset_analysis_tpu.parallel.distributed import init_distributed
+
+    init_distributed(f"127.0.0.1:{port}", n_procs, proc_id)
+
+    import numpy as np
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+    from ruleset_analysis_tpu.hostside import pack
+    from ruleset_analysis_tpu.runtime.stream import run_stream_file_distributed
+
+    packed = pack.load_packed(ruleset_prefix)
+    cfg = AnalysisConfig(
+        batch_size=64,
+        sketch=SketchConfig(cms_width=1 << 10, cms_depth=4, hll_p=6),
+    )
+    report, regs = run_stream_file_distributed(
+        packed, [log_path], cfg, return_state=True
+    )
+    np.savez(out_prefix + ".npz", **regs)
+    with open(out_prefix + ".json", "w", encoding="utf-8") as f:
+        f.write(report.to_json())
+    print(f"worker {proc_id}/{n_procs} done", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

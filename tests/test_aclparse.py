@@ -210,7 +210,7 @@ def test_access_group_binding():
         "access-list OUT extended permit ip any any\n"
         "access-group OUT in interface outside\n"
     )
-    assert rs.bindings["outside"] == ("OUT", "in")
+    assert rs.bindings[("outside", "in")] == "OUT"
 
 
 def test_hostname_detection(tmp_path):

@@ -1,0 +1,96 @@
+"""Register-memory guard + sketch-only CLI plumbing (VERDICT round 2 #6)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+from ruleset_analysis_tpu.models import pipeline
+
+
+def test_guard_fires_at_100k_keys_default_p():
+    # 100k keys x 256 registers x 4 B = ~100 MiB of HLL alone
+    cfg = AnalysisConfig(register_memory_budget_bytes=64 << 20)
+    with pytest.raises(ValueError, match="--hll-p"):
+        pipeline.init_state(100_000, cfg)
+
+
+def test_guard_suggestion_fits():
+    cfg = AnalysisConfig(register_memory_budget_bytes=64 << 20)
+    try:
+        pipeline.init_state(100_000, cfg)
+        raise AssertionError("guard did not fire")
+    except ValueError as e:
+        import re
+
+        p = int(re.search(r"--hll-p (\d+)", str(e)).group(1))
+    ok = cfg.replace(sketch=SketchConfig(hll_p=p))
+    sizes = pipeline.register_bytes(100_000, ok)
+    assert sum(sizes.values()) <= cfg.register_memory_budget_bytes
+    state = pipeline.init_state(100_000, ok)
+    assert state.hll.shape == (100_000, 1 << p)
+
+
+def test_guard_applies_to_host_init_too():
+    cfg = AnalysisConfig(register_memory_budget_bytes=1 << 20)
+    with pytest.raises(ValueError):
+        pipeline.init_state_host(100_000, cfg)
+
+
+def test_default_budget_accepts_normal_geometry():
+    state = pipeline.init_state(4096, AnalysisConfig())
+    assert state.counts_lo.shape == (4096,)
+
+
+def test_cli_no_exact_counts(tmp_path, capsys):
+    """--no-exact-counts runs sketch-only and still reports per-rule hits."""
+    from ruleset_analysis_tpu import cli
+    from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+
+    cfg_text = synth.synth_config(n_acls=2, rules_per_acl=6, seed=7)
+    cfg_path = tmp_path / "fw1.cfg"
+    cfg_path.write_text(cfg_text)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    pack.save_packed(packed, str(tmp_path / "packed"))
+    tuples = synth.synth_tuples(packed, 400, seed=7)
+    log_path = tmp_path / "fw1.log"
+    log_path.write_text("\n".join(synth.render_syslog(packed, tuples, seed=7)) + "\n")
+
+    rc = cli.main([
+        "run", "--ruleset", str(tmp_path / "packed"), "--logs", str(log_path),
+        "--backend", "tpu", "--no-exact-counts", "--json",
+        "--out", str(tmp_path / "rep.json"),
+    ])
+    assert rc == 0
+    import json
+
+    rep = json.loads((tmp_path / "rep.json").read_text())
+    assert sum(e["hits"] for e in rep["per_rule"]) > 0
+    # CMS error is one-sided: a rule with real hits can never show zero,
+    # so the unused list is a subset of the exact run's
+    rc = cli.main([
+        "run", "--ruleset", str(tmp_path / "packed"), "--logs", str(log_path),
+        "--backend", "tpu", "--json", "--out", str(tmp_path / "rep_exact.json"),
+    ])
+    assert rc == 0
+    exact = json.loads((tmp_path / "rep_exact.json").read_text())
+    assert set(map(tuple, rep["unused"])) <= set(map(tuple, exact["unused"]))
+
+
+def test_cli_oracle_rejects_no_exact_counts(tmp_path):
+    from ruleset_analysis_tpu import cli
+    from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+
+    cfg_text = synth.synth_config(n_acls=1, rules_per_acl=2, seed=1)
+    (tmp_path / "fw1.cfg").write_text(cfg_text)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    pack.save_packed(pack.pack_rulesets([rs]), str(tmp_path / "packed"))
+    (tmp_path / "x.log").write_text("\n")
+    rc = cli.main([
+        "run", "--ruleset", str(tmp_path / "packed"), "--logs", str(tmp_path / "x.log"),
+        "--backend", "oracle", "--acl-configs", str(tmp_path / "fw1.cfg"),
+        "--no-exact-counts",
+    ])
+    assert rc == 2

@@ -1,0 +1,305 @@
+"""out-direction access-groups + the 106001/106006/106015 deny classes.
+
+SURVEY.md §4.3 defines the mapper over the ASA access-list / connection
+message family with the ACL resolved from the configuration's
+``access-group`` bindings.  These tests pin:
+
+- (iface, direction)-keyed binding parsing (both in and out on one iface),
+- the three additional deny message classes in the Python parser,
+- dual evaluation of connection lines (ingress in-ACL + egress out-ACL)
+  in the oracle, the Python packer, and the native C++ packer,
+- end-to-end equality of the TPU backend against the oracle on an
+  egress-bound config with a mixed-message corpus.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+from ruleset_analysis_tpu.hostside import aclparse, fastparse, oracle, pack, synth
+from ruleset_analysis_tpu.hostside.syslog import parse_line
+from ruleset_analysis_tpu.runtime.stream import run_stream
+
+
+EGRESS_CFG = """
+hostname fwx
+access-list INBOUND extended permit tcp any host 10.0.0.5 eq 443
+access-list INBOUND extended deny ip any any
+access-list OUTBOUND extended permit tcp 10.0.0.0 255.0.0.0 any eq 443
+access-list OUTBOUND extended deny ip any any
+access-list MGMT extended permit udp any any eq 514
+access-group INBOUND in interface outside
+access-group OUTBOUND out interface dmz
+access-group MGMT in interface dmz
+"""
+
+# inbound TCP conn outside -> dmz: evaluated against INBOUND (in on
+# outside) AND OUTBOUND (out on dmz)
+CONN_DUAL = (
+    "Jul 29 01:02:03 fwx : %ASA-6-302013: Built inbound TCP connection 11 "
+    "for outside:10.1.2.3/1234 (10.1.2.3/1234) to dmz:10.0.0.5/443 (10.0.0.5/443)"
+)
+# inbound conn landing on an interface with no out-binding: single eval
+CONN_SINGLE = (
+    "Jul 29 01:02:03 fwx : %ASA-6-302013: Built inbound TCP connection 12 "
+    "for outside:10.1.2.3/1234 (10.1.2.3/1234) to inside:10.0.0.5/443 (10.0.0.5/443)"
+)
+DENY_106001 = (
+    "Jul 29 01:02:03 fwx : %ASA-2-106001: Inbound TCP connection denied from "
+    "10.9.9.9/5555 to 10.0.0.5/443 flags SYN on interface outside"
+)
+DENY_106006 = (
+    "Jul 29 01:02:03 fwx : %ASA-2-106006: Deny inbound UDP from "
+    "10.9.9.9/5555 to 10.0.0.7/514 on interface dmz"
+)
+DENY_106015 = (
+    "Jul 29 01:02:03 fwx : %ASA-6-106015: Deny TCP (no connection) from "
+    "10.9.9.9/5555 to 10.0.0.5/443 flags RST ACK on interface outside"
+)
+
+
+def _setup():
+    rs = aclparse.parse_asa_config(EGRESS_CFG, "fwx")
+    packed = pack.pack_rulesets([rs])
+    return rs, packed
+
+
+class TestBindings:
+    def test_both_directions_on_one_interface(self):
+        rs, packed = _setup()
+        assert rs.bindings[("outside", "in")] == "INBOUND"
+        assert rs.bindings[("dmz", "out")] == "OUTBOUND"
+        assert rs.bindings[("dmz", "in")] == "MGMT"
+        gid = packed.acl_gid[("fwx", "OUTBOUND")]
+        assert packed.bindings_out[("fwx", "dmz")] == gid
+        assert packed.bindings[("fwx", "dmz")] == packed.acl_gid[("fwx", "MGMT")]
+
+    def test_save_load_roundtrip_keeps_out_bindings(self, tmp_path):
+        _, packed = _setup()
+        prefix = str(tmp_path / "p")
+        pack.save_packed(packed, prefix)
+        loaded = pack.load_packed(prefix)
+        assert loaded.bindings_out == packed.bindings_out
+
+
+class TestParseNewClasses:
+    def test_106001(self):
+        p = parse_line(DENY_106001)
+        assert p is not None
+        assert (p.proto, p.sport, p.dport) == (6, 5555, 443)
+        assert p.ingress_if == "outside" and p.acl is None and p.egress_if is None
+        assert p.permitted is False
+
+    def test_106006(self):
+        p = parse_line(DENY_106006)
+        assert p is not None
+        assert (p.proto, p.sport, p.dport) == (17, 5555, 514)
+        assert p.ingress_if == "dmz"
+
+    def test_106015(self):
+        p = parse_line(DENY_106015)
+        assert p is not None
+        assert (p.proto, p.sport, p.dport) == (6, 5555, 443)
+        assert p.ingress_if == "outside"
+
+    def test_302013_carries_egress_interface(self):
+        p = parse_line(CONN_DUAL)
+        assert p is not None
+        assert p.ingress_if == "outside" and p.egress_if == "dmz"
+        out = parse_line(
+            "Jul 29 01:02:03 fwx : %ASA-6-302013: Built outbound TCP connection 9 "
+            "for dmz:8.8.8.8/443 (8.8.8.8/443) to inside:10.2.3.4/5999 (10.2.3.4/5999)"
+        )
+        assert out is not None
+        # outbound: initiated at the "to" side; packet exits the "for" side
+        assert out.ingress_if == "inside" and out.egress_if == "dmz"
+        assert out.src == aclparse.ip_to_u32("10.2.3.4")
+
+
+class TestDualEvaluation:
+    def test_oracle_counts_both_acls(self):
+        rs, _ = _setup()
+        res = oracle.Oracle([rs]).consume([CONN_DUAL])
+        assert res.hits[("fwx", "INBOUND", 1)] == 1
+        assert res.hits[("fwx", "OUTBOUND", 1)] == 1
+        assert res.lines_total == 1
+        assert res.lines_matched == 2  # evaluations
+        assert res.lines_skipped == 0
+
+    def test_oracle_single_when_no_out_binding(self):
+        rs, _ = _setup()
+        res = oracle.Oracle([rs]).consume([CONN_SINGLE])
+        assert res.lines_matched == 1
+        assert res.hits[("fwx", "INBOUND", 1)] == 1
+
+    def test_line_packer_emits_two_rows(self):
+        _, packed = _setup()
+        lp = pack.LinePacker(packed)
+        batch = lp.pack_lines([CONN_DUAL], batch_size=4)
+        assert int(batch[:, pack.T_VALID].sum()) == 2
+        gids = set(batch[batch[:, pack.T_VALID] == 1][:, pack.T_ACL].tolist())
+        assert gids == {
+            packed.acl_gid[("fwx", "INBOUND")],
+            packed.acl_gid[("fwx", "OUTBOUND")],
+        }
+        assert lp.parsed == 2 and lp.skipped == 0
+
+    def test_deny_classes_resolve_via_in_binding(self):
+        rs, _ = _setup()
+        res = oracle.Oracle([rs]).consume([DENY_106001, DENY_106006, DENY_106015])
+        # 106001/106015 hit INBOUND rule 1 (443 permit); 106006 hits MGMT 514
+        assert res.hits[("fwx", "INBOUND", 1)] == 2
+        assert res.hits[("fwx", "MGMT", 1)] == 1
+
+
+@pytest.mark.skipif(not fastparse.available(), reason="no native toolchain")
+class TestNativeParity:
+    CORPUS = [
+        CONN_DUAL, CONN_SINGLE, DENY_106001, DENY_106006, DENY_106015,
+        # near-misses the native parser must also skip
+        "Jul 29 x fwx : %ASA-2-106001: Inbound TCP connection denied from "
+        "10.9.9.9/5555 to 10.0.0.5/443 on interface outside",  # no flags
+        "Jul 29 x fwx : %ASA-2-106006: Deny inbound UDP from 10.9.9.9/5555 "
+        "to 10.0.0.7/514 due to DNS Query on interface dmz",  # not adjacent
+        "Jul 29 x fwx : %ASA-6-106015: Deny TCP (no  connection) from "
+        "10.9.9.9/5555 to 10.0.0.5/443 flags RST on interface outside",  # 2 spaces
+        "Jul 29 x fwx : %ASA-2-106001: Inbound TCP connection denied from "
+        "10.9.9.9/5555 to 10.0.0.5/443 flags SYN on interface",  # no iface
+    ]
+
+    def test_handwritten_corpus_bit_identical(self):
+        _, packed = _setup()
+        py = pack.LinePacker(packed)
+        ref = py.pack_lines(self.CORPUS, batch_size=32)
+        nat = fastparse.NativePacker(packed)
+        got = nat.pack_lines(self.CORPUS, batch_size=32)
+        np.testing.assert_array_equal(ref, got)
+        assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
+        assert py.parsed == 6 and py.skipped == 4  # dual conn counts twice
+
+    def test_synth_variety_corpus_bit_identical(self):
+        cfg_text = synth.synth_config(
+            n_acls=3, rules_per_acl=16, seed=11, egress_acls=True
+        )
+        rs = aclparse.parse_asa_config(cfg_text, "fw1")
+        packed = pack.pack_rulesets([rs])
+        tuples = synth.synth_tuples(packed, 4000, seed=12)
+        lines = synth.render_syslog(packed, tuples, seed=13, variety=0.5)
+        py = pack.LinePacker(packed)
+        ref = py.pack_lines(lines, batch_size=2 * len(lines))
+        nat = fastparse.NativePacker(packed)
+        got = nat.pack_lines(lines, batch_size=2 * len(lines))
+        np.testing.assert_array_equal(ref, got)
+        assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
+        assert py.parsed > len(lines) - py.skipped  # some dual evaluations
+
+    def test_multithread_with_dual_rows(self):
+        cfg_text = synth.synth_config(
+            n_acls=2, rules_per_acl=12, seed=21, egress_acls=True
+        )
+        rs = aclparse.parse_asa_config(cfg_text, "fw1")
+        packed = pack.pack_rulesets([rs])
+        tuples = synth.synth_tuples(packed, 3000, seed=22)
+        lines = synth.render_syslog(packed, tuples, seed=23, variety=0.6)
+        data = ("\n".join(lines) + "\n").encode()
+        nat1 = fastparse.NativePacker(packed)
+        out1, l1, u1 = nat1.pack_chunk(data, 2 * len(lines), final=True, n_threads=1)
+        nat4 = fastparse.NativePacker(packed)
+        out4, l4, u4 = nat4.pack_chunk(data, 2 * len(lines), final=True, n_threads=4)
+        np.testing.assert_array_equal(out1, out4)
+        assert (l1, u1) == (l4, u4)
+        assert (nat1.parsed, nat1.skipped) == (nat4.parsed, nat4.skipped)
+
+    def test_oversized_fields_skipped_identically(self):
+        """Ports > 65535 / protos > 255 exceed the wire field widths; both
+        parsers must SKIP such lines (truncating could forge a match)."""
+        _, packed = _setup()
+        lines = [
+            "Jul 29 x fwx : %ASA-6-106100: access-list INBOUND permitted tcp "
+            "outside/1.2.3.4(70000) -> dmz/10.0.0.5(443) hit-cnt 1",
+            "Jul 29 x fwx : %ASA-6-106100: access-list INBOUND permitted tcp "
+            "outside/1.2.3.4(1234) -> dmz/10.0.0.5(70443) hit-cnt 1",
+            "Jul 29 x fwx : %ASA-6-106100: access-list INBOUND permitted 300 "
+            "outside/1.2.3.4(1) -> dmz/10.0.0.5(2) hit-cnt 1",
+            # in-range control line
+            "Jul 29 x fwx : %ASA-6-106100: access-list INBOUND permitted tcp "
+            "outside/1.2.3.4(65535) -> dmz/10.0.0.5(443) hit-cnt 1",
+        ]
+        py = pack.LinePacker(packed)
+        ref = py.pack_lines(lines, batch_size=8)
+        nat = fastparse.NativePacker(packed)
+        got = nat.pack_lines(lines, batch_size=8)
+        np.testing.assert_array_equal(ref, got)
+        assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped) == (1, 3)
+
+    def test_pack_lines_default_capacity_fits_dual_rows(self):
+        """Default (no batch_size) capacity must hold two rows per line
+        when out-bindings exist (regression: raised ValueError)."""
+        _, packed = _setup()
+        py = pack.LinePacker(packed)
+        batch = py.pack_lines([CONN_DUAL])  # no batch_size
+        assert int(batch[:, pack.T_VALID].sum()) == 2
+        nat = fastparse.NativePacker(packed)
+        nbatch = nat.pack_lines([CONN_DUAL])
+        assert int(nbatch[:, pack.T_VALID].sum()) == 2
+
+    def test_row_cap_closes_batch_line_atomically(self):
+        """A dual-eval line whose rows don't fit stays for the next batch."""
+        _, packed = _setup()
+        lines = [CONN_SINGLE, CONN_DUAL]  # 1 row, then 2 rows
+        data = ("\n".join(lines) + "\n").encode()
+        nat = fastparse.NativePacker(packed)
+        out, n_lines, used = nat.pack_chunk(data, 2, final=True)
+        # second line's two rows don't fit after the first row: batch
+        # closes with 1 line / 1 row; the dual line is not consumed
+        assert n_lines == 1
+        assert int(out[6].sum()) == 1
+        assert used == len(CONN_SINGLE.encode()) + 1
+        out2, n2, _ = nat.pack_chunk(data[used:], 2, final=True)
+        assert n2 == 1 and int(out2[6].sum()) == 2
+
+
+class TestEndToEnd:
+    @pytest.fixture(scope="class")
+    def corpus(self):
+        cfg_text = synth.synth_config(
+            n_acls=3, rules_per_acl=10, seed=31, egress_acls=True
+        )
+        rs = aclparse.parse_asa_config(cfg_text, "fw1")
+        packed = pack.pack_rulesets([rs])
+        tuples = synth.synth_tuples(packed, 2500, seed=32)
+        lines = synth.render_syslog(packed, tuples, seed=33, variety=0.5)
+        res = oracle.Oracle([rs]).consume(list(lines))
+        return packed, rs, lines, res
+
+    def _run(self, packed, lines, batch_size=512):
+        cfg = AnalysisConfig(
+            backend="tpu",
+            batch_size=batch_size,
+            sketch=SketchConfig(cms_width=1 << 12, cms_depth=4, hll_p=8),
+        )
+        return run_stream(packed, iter(lines), cfg, topk=5)
+
+    def test_tpu_matches_oracle_on_egress_corpus(self, corpus):
+        packed, rs, lines, res = corpus
+        rep = self._run(packed, lines)
+        got = {
+            (e["firewall"], e["acl"], e["index"]): e["hits"]
+            for e in rep.per_rule
+            if e["hits"] > 0
+        }
+        assert got == dict(res.hits)
+        assert rep.totals["lines_matched"] == res.lines_matched
+        assert rep.totals["lines_total"] == res.lines_total
+        assert rep.unused == res.unused_rules([rs])
+
+    def test_batch_size_invariance_with_dual_rows(self, corpus):
+        packed, rs, lines, res = corpus
+        a = self._run(packed, lines, batch_size=512)
+        b = self._run(packed, lines, batch_size=97)
+        ha = {(e["firewall"], e["acl"], e["index"]): e["hits"] for e in a.per_rule}
+        hb = {(e["firewall"], e["acl"], e["index"]): e["hits"] for e in b.per_rule}
+        assert ha == hb
+        assert a.totals["lines_total"] == b.totals["lines_total"]

@@ -1,0 +1,78 @@
+"""Lenient parse mode: unsupported ACEs skip-and-count instead of aborting."""
+
+import pytest
+
+from ruleset_analysis_tpu.hostside import aclparse, oracle, pack
+
+MIXED_CFG = """
+hostname fw6
+access-list A extended permit tcp any host 10.0.0.5 eq 443
+access-list A extended permit tcp any6 any6 eq 443
+access-list A extended deny ip 2001:db8::0 ffff:ffff:: any
+access-list A extended deny ip any any
+access-list B extended permit udp object-group NOSUCHGROUP any
+access-group A in interface outside
+"""
+
+
+def test_strict_mode_still_raises():
+    with pytest.raises(aclparse.AclParseError):
+        aclparse.parse_asa_config(MIXED_CFG, "fw6")
+
+
+def test_lenient_skips_and_counts_exactly():
+    rs = aclparse.parse_asa_config(MIXED_CFG, "fw6", strict=False)
+    assert len(rs.skipped) == 3  # two v6 lines + the unknown group
+    assert [ln for ln, _, _ in rs.skipped] != []
+    reasons = " ".join(r for _, r, _ in rs.skipped)
+    assert "NOSUCHGROUP" in reasons
+    # the v4 entries survive with their DEVICE-side rule positions:
+    # line 1 -> index 1, the two skipped v6 lines consume 2 and 3,
+    # the final deny keeps index 4
+    a = rs.acls["A"]
+    assert [r.index for r in a] == [1, 4]
+    # ACL B exists (bindable, reportable) even though its only entry skipped
+    assert rs.acls["B"] == []
+
+
+def test_lenient_truncated_standard_acl_skips():
+    """Regression: a truncated STANDARD entry must skip in lenient mode,
+    not abort with IndexError."""
+    cfg = (
+        "access-list S standard permit host\n"
+        "access-list S standard permit 10.0.0.0\n"
+        "access-list S standard permit any\n"
+    )
+    with pytest.raises(aclparse.AclParseError):
+        aclparse.parse_asa_config(cfg, "fw7")
+    rs = aclparse.parse_asa_config(cfg, "fw7", strict=False)
+    assert len(rs.skipped) == 2
+    assert [r.index for r in rs.acls["S"]] == [3]
+
+
+def test_lenient_v4_analysis_completes():
+    rs = aclparse.parse_asa_config(MIXED_CFG, "fw6", strict=False)
+    packed = pack.pack_rulesets([rs])
+    line = (
+        "Jul 29 01:02:03 fw6 : %ASA-6-106100: access-list A permitted tcp "
+        "outside/1.2.3.4(999) -> inside/10.0.0.5(443) hit-cnt 1"
+    )
+    res = oracle.Oracle([rs]).consume([line])
+    assert res.hits[("fw6", "A", 1)] == 1
+    lp = pack.LinePacker(packed)
+    batch = lp.pack_lines([line])
+    assert lp.parsed == 1
+
+
+def test_cli_lenient_flag(tmp_path, capsys):
+    from ruleset_analysis_tpu import cli
+
+    p = tmp_path / "fw6.cfg"
+    p.write_text(MIXED_CFG)
+    rc = cli.main(["parse-acls", str(p), "--out", str(tmp_path / "packed")])
+    assert rc == 1  # strict default: abort
+    rc = cli.main(["parse-acls", str(p), "--lenient", "--out", str(tmp_path / "packed")])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "skipped=3" in err
+    assert "NOSUCHGROUP" in err
