@@ -239,6 +239,7 @@ def _bench_e2e(packed, cpu_scale: bool, mesh, device_lines_per_sec: float) -> di
 
             # --- stage 1: host parse only (no device traffic at all)
             parse = _bench_parse_only(packed, path, batch_size)
+            parse["feeder_scaling"] = _bench_feeder_scaling(packed, path, batch_size)
 
             # --- stage 2: host->device transfer only (pre-packed batches)
             h2d = _bench_h2d_only(packed, batch_size, mesh)
@@ -330,6 +331,44 @@ def _bench_parse_only(packed, path: str, batch_size: int) -> dict:
         "threads": threads,
         "elapsed_sec": round(dt, 3),
     }
+
+
+def _bench_feeder_scaling(packed, path: str, batch_size: int) -> dict | None:
+    """Parse rate of the multi-process feeder at 1/2/4 workers.
+
+    The input-split tier (SURVEY.md §2 L2): on a multi-core host the rate
+    should scale ~linearly with workers; on a single-core host (this dev
+    harness) it honestly reports flat numbers and the core count.
+    """
+    import os
+
+    try:
+        from ruleset_analysis_tpu.hostside.feeder import ParallelFeeder
+
+        cores = len(os.sched_getaffinity(0))
+        out = {"host_cores": cores}
+        for w in (1, 2, 4):
+            feeder = ParallelFeeder(packed, [path], n_workers=w)
+            it = feeder.batches(0, batch_size)
+            # steady state only: the first batch absorbs process spawn
+            # (the 'spawn' context re-imports numpy per worker) and the
+            # coordinator's scan start — on small inputs that startup
+            # would otherwise read as anti-scaling
+            first = next(it, None)
+            if first is None:
+                continue
+            t0 = time.perf_counter()
+            total = 0
+            for _batch, n in it:
+                total += n
+            dt = time.perf_counter() - t0
+            if total:
+                out[f"workers_{w}_lines_per_sec"] = round(total / dt, 1)
+                log(f"feeder w={w}: {total/dt:.0f} lines/s steady-state")
+        return out
+    except Exception as e:  # auxiliary measurement — never sink the bench
+        log(f"feeder scaling bench failed: {e!r}")
+        return {"error": repr(e)[:300]}
 
 
 def _bench_h2d_only(packed, batch_size: int, mesh) -> dict:

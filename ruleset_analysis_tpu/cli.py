@@ -140,6 +140,12 @@ def _cmd_run(args: argparse.Namespace) -> int:
         if args.native_parse and not file_input:
             print("--native-parse requires file inputs (not '-')", file=sys.stderr)
             return 2
+        if args.feed_workers > 1 and (not file_input or args.distributed):
+            print(
+                "--feed-workers requires file inputs and is not available "
+                "with --distributed", file=sys.stderr,
+            )
+            return 2
         if args.distributed:
             # multi-process job: this process joins the cluster and feeds
             # only ITS OWN --logs (the input-split analog); every process
@@ -172,6 +178,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 native=args.native_parse,  # None = auto
                 topk=args.topk,
                 profile_dir=args.profile_dir,
+                feed_workers=args.feed_workers,
             )
         else:
             rep = run_stream(packed, lines, cfg, topk=args.topk, profile_dir=args.profile_dir)
@@ -252,6 +259,9 @@ def make_parser() -> argparse.ArgumentParser:
                    help="print throughput to stderr every N chunks")
     p.add_argument("--native-parse", action=argparse.BooleanOptionalAction, default=None,
                    help="use the C++ host parser (default: auto when logs are files)")
+    p.add_argument("--feed-workers", type=int, default=0, metavar="N",
+                   help="parse with N worker processes over file shards "
+                        "(multi-core hosts; implies the native parser; 0/1 = off)")
     p.add_argument("--layout", choices=["flat", "stacked"], default="flat",
                    help="rule-match layout: flat scans all rules per line; stacked "
                         "buckets lines by ACL and vmaps over per-ACL rule slabs "

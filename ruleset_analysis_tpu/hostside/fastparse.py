@@ -51,14 +51,9 @@ def _as_buffer(data: bytes | bytearray | memoryview):
     return (ctypes.c_char * len(data)).from_buffer(data)
 
 
-def default_parse_threads() -> int:
-    """Parse workers for the native path: RA_PARSE_THREADS or CPU count.
-
-    On a one-core host this degenerates to the single-threaded parse; on a
-    real accelerator host (a v5e-8 host has dozens of cores) the batch
-    splits across workers (SURVEY.md §2 L2 — the input-split analog).
-    """
-    env = os.environ.get("RA_PARSE_THREADS")
+def host_workers(env_var: str, cap: int) -> int:
+    """Worker-count heuristic: ``env_var`` override, else usable cores."""
+    env = os.environ.get(env_var)
     if env:
         try:
             return max(1, int(env))
@@ -68,7 +63,17 @@ def default_parse_threads() -> int:
         n = len(os.sched_getaffinity(0))
     except (AttributeError, OSError):
         n = os.cpu_count() or 1
-    return max(1, min(n, 32))
+    return max(1, min(n, cap))
+
+
+def default_parse_threads() -> int:
+    """Parse threads for the native path: RA_PARSE_THREADS or CPU count.
+
+    On a one-core host this degenerates to the single-threaded parse; on a
+    real accelerator host (a v5e-8 host has dozens of cores) the batch
+    splits across workers (SURVEY.md §2 L2 — the input-split analog).
+    """
+    return host_workers("RA_PARSE_THREADS", 32)
 
 
 def _build() -> bool:
@@ -213,6 +218,7 @@ class NativePacker:
         max_lines: int | None = None,
         n_threads: int | None = None,
         length: int | None = None,
+        out: np.ndarray | None = None,
     ) -> tuple[np.ndarray, int, int]:
         """Parse up to ``max_lines`` (default batch_size) lines from data.
 
@@ -222,11 +228,22 @@ class NativePacker:
         ``n_threads`` (default :func:`default_parse_threads`) splits the
         parse across native workers; output is bit-identical for any
         thread count.  ``length`` limits the parse to ``data[:length]``
-        (zero-copy prefix of a reusable buffer).
+        (zero-copy prefix of a reusable buffer).  ``out`` supplies a
+        preallocated ``[TUPLE_COLS, batch_size]`` uint32 C-contiguous
+        destination (e.g. a shared-memory view) instead of a fresh array.
         """
         n = len(data) if length is None else length
         arg = _as_buffer(data)
-        out = np.empty((TUPLE_COLS, batch_size), dtype=np.uint32)
+        if out is None:
+            out = np.empty((TUPLE_COLS, batch_size), dtype=np.uint32)
+        else:
+            if out.shape != (TUPLE_COLS, batch_size) or out.dtype != np.uint32:
+                raise ValueError(
+                    f"out must be [TUPLE_COLS, {batch_size}] uint32, got "
+                    f"{out.shape} {out.dtype}"
+                )
+            if not out.flags.c_contiguous:
+                raise ValueError("out must be C-contiguous")
         n_lines = ctypes.c_int64(0)
         n_valid = ctypes.c_int64(0)
         used = self._lib.asa_pack_chunk_mt(

@@ -1,0 +1,282 @@
+"""Multi-process host feed: parallel parse workers over file shards.
+
+The reborn Hadoop input split (SURVEY.md §2 L2), one level above the
+native parser's in-process threads: N worker PROCESSES each run a
+:class:`fastparse.NativePacker` over byte ranges of the input files and
+pack straight into shared-memory slots; the coordinator hands the device
+driver batches in input order.  On a multi-core host this scales the
+parse stage — the e2e bottleneck once transfers are fast — nearly
+linearly with workers, without the GIL or per-batch pickling.
+
+Layout decisions:
+
+- The coordinator pre-chops files into batch descriptors of exactly
+  ``batch_size`` raw lines using the native newline scanner — byte
+  ranges only, no parsing.  Workers read their range straight from the
+  file (page cache makes this nearly free) so no input bytes cross a
+  queue; only tiny descriptors and completions do.
+- Output slots hold ``rows_cap = 2 x batch_size`` rows when any
+  out-direction binding exists (a connection line can emit two
+  evaluations), else ``batch_size``.  Since a descriptor never holds
+  more than ``batch_size`` lines, every line always fits and batches
+  stay aligned to the precomputed raw-line boundaries.
+- parsed/skipped counters ride each completion and fold into the
+  feeder's totals when its batch is YIELDED, so checkpoint snapshots
+  (taken at chunk boundaries) stay coherent with consumed input.
+
+Requires the native parser; the pure-Python path has no multi-process
+tier (it is not the deployment path).
+"""
+
+from __future__ import annotations
+
+import multiprocessing
+import pickle
+from multiprocessing import shared_memory
+
+import numpy as np
+
+from . import fastparse
+from .pack import PackedRuleset, TUPLE_COLS
+
+#: Coordinator read granularity while scanning for batch boundaries.
+SCAN_BLOCK = 8 << 20
+
+
+def default_feed_workers() -> int:
+    return fastparse.host_workers("RA_FEED_WORKERS", 16)
+
+
+def _scan_batches(paths: list[str], batch_size: int, skip_lines: int):
+    """Yield (path_idx, offset, nbytes, n_lines) descriptors.
+
+    Each descriptor covers exactly ``batch_size`` raw lines (the final
+    one per file may be short; descriptors never span files).  The first
+    ``skip_lines`` lines are consumed without emitting (resume).
+    """
+    lib = fastparse._load()
+    if lib is None:
+        from ..errors import NativeParserUnavailable
+
+        raise NativeParserUnavailable("feeder requires the native parser")
+    import ctypes
+
+    to_skip = skip_lines
+    for path_i, path in enumerate(paths):
+        with open(path, "rb") as f:
+            buf = b""
+            base = 0  # file offset of buf[0]
+            pos = 0  # consumed bytes within buf
+            eof = False
+            pend_lines = 0  # lines in the current (incomplete) descriptor
+            pend_start = 0  # absolute file offset where it starts
+
+            def refill():
+                nonlocal buf, base, pos, eof
+                block = f.read(SCAN_BLOCK)
+                if not block:
+                    eof = True
+                    return
+                buf = buf[pos:] + block
+                base += pos
+                pos = 0
+
+            while True:
+                avail = len(buf) - pos
+                if avail == 0:
+                    if eof:
+                        break
+                    refill()
+                    continue
+                want = to_skip if to_skip > 0 else batch_size - pend_lines
+                # zero-copy pointer into buf at pos (buf outlives the call)
+                arr = np.frombuffer(buf, dtype=np.uint8)
+                used = ctypes.c_int64(0)
+                got = int(
+                    lib.asa_count_lines(
+                        ctypes.c_void_p(arr.ctypes.data + pos), avail,
+                        1 if eof else 0, want, ctypes.byref(used),
+                    )
+                )
+                if got == 0:
+                    if eof:
+                        break
+                    refill()  # a line longer than the buffered bytes
+                    continue
+                if to_skip > 0:
+                    to_skip -= got
+                    pos += int(used.value)
+                    continue
+                if pend_lines == 0:
+                    pend_start = base + pos
+                pend_lines += got
+                pos += int(used.value)
+                if pend_lines == batch_size:
+                    yield (path_i, pend_start, base + pos - pend_start, pend_lines)
+                    pend_lines = 0
+            if pend_lines:
+                yield (path_i, pend_start, base + pos - pend_start, pend_lines)
+    if to_skip > 0:
+        from ..errors import ResumeInputMismatch
+
+        raise ResumeInputMismatch(
+            f"snapshot consumed {skip_lines} lines but the input ran short "
+            f"by {to_skip}"
+        )
+
+
+def _worker(packed_blob, paths, rows_cap, shm_name, task_q, done_q):
+    packed = pickle.loads(packed_blob)
+    packer = fastparse.NativePacker(packed)
+    shm = shared_memory.SharedMemory(name=shm_name)
+    slot_words = TUPLE_COLS * rows_cap
+    files = {}
+    try:
+        while True:
+            task = task_q.get()
+            if task is None:
+                return
+            idx, slot, path_i, offset, nbytes, n_lines = task
+            try:
+                f = files.get(path_i)
+                if f is None:
+                    f = files[path_i] = open(paths[path_i], "rb")
+                f.seek(offset)
+                data = f.read(nbytes)
+                out = np.ndarray(
+                    (TUPLE_COLS, rows_cap), dtype=np.uint32, buffer=shm.buf,
+                    offset=4 * slot * slot_words,
+                )
+                p0, s0 = packer.parsed, packer.skipped
+                _, lines, _used = packer.pack_chunk(
+                    data, rows_cap, final=True, max_lines=n_lines, n_threads=1,
+                    out=out,
+                )
+            except Exception as e:  # forward instead of dying silently
+                done_q.put(("error", idx, f"{type(e).__name__}: {e}"))
+                return
+            done_q.put(
+                (idx, slot, lines, packer.parsed - p0, packer.skipped - s0)
+            )
+    finally:
+        for f in files.values():
+            f.close()
+        shm.close()
+
+
+class _FeedCounters:
+    def __init__(self):
+        self.parsed = 0
+        self.skipped = 0
+
+
+class ParallelFeeder:
+    """Stream-source over files backed by N parse worker processes.
+
+    Drop-in for the stream driver's source protocol: ``.packer`` exposes
+    parsed/skipped counters and ``.batches(skip_lines, batch_size)``
+    yields ``([TUPLE_COLS, rows_cap] uint32, raw_line_count)`` in input
+    order.  ``rows_cap`` is fixed per run (2x batch_size with
+    out-bindings), so one compiled device program serves every chunk.
+    """
+
+    def __init__(self, packed: PackedRuleset, paths: list[str], n_workers: int | None = None):
+        if not fastparse.available():
+            from ..errors import NativeParserUnavailable
+
+            raise NativeParserUnavailable("feeder requires the native parser")
+        self.packed = packed
+        self.paths = list(paths)
+        self.n_workers = n_workers or default_feed_workers()
+        self.packer = _FeedCounters()
+        self._resume_counts = (0, 0)
+
+    def set_counts(self, parsed: int, skipped: int) -> None:
+        self._resume_counts = (parsed, skipped)
+
+    def batches(self, skip_lines: int, batch_size: int):
+        self.packer.parsed, self.packer.skipped = self._resume_counts
+        rows_cap = (2 if self.packed.bindings_out else 1) * batch_size
+        n_slots = 2 * self.n_workers + 2
+        slot_bytes = 4 * TUPLE_COLS * rows_cap
+        shm = shared_memory.SharedMemory(create=True, size=n_slots * slot_bytes)
+        # spawn, not fork: the driver process runs JAX's thread pools, and
+        # forking a multi-threaded process can deadlock the child.  The
+        # workers import only numpy + the native parser, so spawn is cheap.
+        ctx = multiprocessing.get_context("spawn")
+        task_q = ctx.Queue()
+        done_q = ctx.Queue()
+        blob = pickle.dumps(self.packed)
+        workers = [
+            ctx.Process(
+                target=_worker,
+                args=(blob, self.paths, rows_cap, shm.name, task_q, done_q),
+                daemon=True,
+            )
+            for _ in range(self.n_workers)
+        ]
+        for w in workers:
+            w.start()
+        try:
+            free_slots = list(range(n_slots))
+            ready: dict[int, tuple] = {}  # idx -> completion
+            next_submit = 0
+            next_yield = 0
+            desc_it = _scan_batches(self.paths, batch_size, skip_lines)
+            descs_done = False
+
+            def submit_until_full():
+                nonlocal next_submit, descs_done
+                while free_slots and not descs_done:
+                    d = next(desc_it, None)
+                    if d is None:
+                        descs_done = True
+                        break
+                    slot = free_slots.pop()
+                    task_q.put((next_submit, slot, *d))
+                    next_submit += 1
+
+            import queue as _queue
+
+            submit_until_full()
+            while next_yield < next_submit:
+                while next_yield not in ready:
+                    # timeout + liveness: a worker killed by the OS (OOM)
+                    # can't forward its error, and waiting forever on its
+                    # completion would hang the whole analysis silently
+                    try:
+                        msg = done_q.get(timeout=5.0)
+                    except _queue.Empty:
+                        dead = [w.pid for w in workers if not w.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"feeder worker(s) {dead} died without "
+                                "reporting (killed by the OS?)"
+                            )
+                        continue
+                    if msg[0] == "error":
+                        raise RuntimeError(
+                            f"feeder worker failed on batch {msg[1]}: {msg[2]}"
+                        )
+                    idx, slot, lines, dp, ds = msg
+                    ready[idx] = (slot, lines, dp, ds)
+                slot, lines, dp, ds = ready.pop(next_yield)
+                out = np.ndarray(
+                    (TUPLE_COLS, rows_cap), dtype=np.uint32, buffer=shm.buf,
+                    offset=4 * slot * TUPLE_COLS * rows_cap,
+                ).copy()  # the slot is reused; the driver may hold the batch
+                free_slots.append(slot)
+                next_yield += 1
+                self.packer.parsed += dp
+                self.packer.skipped += ds
+                submit_until_full()
+                yield out, lines
+        finally:
+            for _ in workers:
+                task_q.put(None)
+            for w in workers:
+                w.join(timeout=10)
+                if w.is_alive():
+                    w.terminate()
+            shm.close()
+            shm.unlink()

@@ -264,20 +264,31 @@ def run_stream_file(
     mesh=None,
     profile_dir: str | None = None,
     max_chunks: int | None = None,
+    feed_workers: int = 0,
 ):
     """Analyze syslog file(s), using the native C++ parser when available.
 
     ``native=None`` auto-selects: the C++ fast path if its library loads
     (building it on first use), else the pure-Python line path.  Results
     are identical either way; only host-side parse throughput differs.
+
+    ``feed_workers > 1`` parses with that many worker PROCESSES over file
+    shards (hostside.feeder) — the multi-core input-split tier.  Chunk
+    boundaries then follow raw-line counts only (a dual-evaluation line
+    never closes a batch early; the grouped batch is 2x wide instead), so
+    per-chunk candidates may differ from the sequential path, but every
+    register — and therefore the report — is identical.
     """
     from ..hostside import fastparse
 
     if isinstance(paths, str):
         paths = [paths]
-    if native is None:
-        native = fastparse.available()
-    if native:
+    use_native = native if native is not None else fastparse.available()
+    if feed_workers and feed_workers > 1:
+        from ..hostside.feeder import ParallelFeeder
+
+        source = ParallelFeeder(packed, paths, n_workers=feed_workers)
+    elif use_native:
         source = _FileSource(packed, paths)
     else:
         source = _TextSource(packed, _iter_files(paths))
