@@ -137,6 +137,7 @@ def run_bench(cpu_scale: bool) -> dict:
         raise BenchInvalid(
             f"3x timed window did not execute: counts moved {delta3}, expected {expect3}"
         )
+    profile = _capture_profile(step, state, rules, feeds)
     linearity = (dt3 / 3.0) / dt1  # ~1.0 when per-step time dominates
     if not dt3 > dt1:
         raise BenchInvalid(
@@ -197,6 +198,7 @@ def run_bench(cpu_scale: bool) -> dict:
         "rule_cells_per_sec_per_chip": round(cells_per_sec_chip, 1),
         "vpu_util_estimate": vpu_util,
         "hbm_util_estimate": hbm_util,
+        "profile": profile,
         # honest end-to-end decomposition (text -> parse -> transfer ->
         # device); the headline value above is the device-resident rate
         "e2e": e2e,
@@ -213,6 +215,39 @@ def run_bench(cpu_scale: bool) -> dict:
         "vs_baseline": round(per_chip / NORTH_STAR_PER_CHIP, 4),
         "detail": detail,
     }
+
+
+def _capture_profile(step, state, rules, feeds) -> dict | None:
+    """Trace a few steps with jax.profiler into profiles/ (best effort).
+
+    The trace answers "is the step match-bound or scatter-bound" on real
+    hardware; some PJRT plugins can't profile, so failure only reports
+    itself — it never sinks the bench.
+    """
+    import glob
+
+    import jax
+
+    out_dir = os.path.join(_REPO, "profiles", "bench")
+    try:
+        from ruleset_analysis_tpu.models import pipeline
+
+        os.makedirs(out_dir, exist_ok=True)
+        with jax.profiler.trace(out_dir):
+            for i in range(3):
+                state, _ = step(state, rules, feeds[i % len(feeds)])
+            pipeline.sync_state(state)
+        traces = glob.glob(
+            os.path.join(out_dir, "**", "*.xplane.pb"), recursive=True
+        )
+        return {
+            "dir": out_dir,
+            "captured": bool(traces),
+            "trace_files": [os.path.relpath(t, _REPO) for t in traces[:4]],
+        }
+    except Exception as e:
+        log(f"profiler capture failed: {e!r}")
+        return {"dir": out_dir, "captured": False, "error": repr(e)[:300]}
 
 
 def _bench_e2e(packed, cpu_scale: bool, mesh, device_lines_per_sec: float) -> dict | None:

@@ -25,27 +25,59 @@ from .hostside import aclparse, oracle, pack, synth
 from .runtime import report as report_mod
 
 
+def _report_ruleset(label: str, rs) -> None:
+    """One parsed ruleset's summary + lenient-mode skips, to stderr."""
+    skipped = f" skipped={len(rs.skipped)}" if rs.skipped else ""
+    print(
+        f"{label}: firewall={rs.firewall} acls={len(rs.acls)} "
+        f"rules={rs.rule_count()} expanded_aces={rs.ace_count()}{skipped}",
+        file=sys.stderr,
+    )
+    for lineno, reason, line in rs.skipped:
+        print(f"{label}:{lineno}: skipped: {reason}: {line}", file=sys.stderr)
+
+
+def _pack_and_save(rulesets, out_prefix: str, origin: str = "") -> int:
+    packed = pack.pack_rulesets(rulesets)
+    pack.save_packed(packed, out_prefix)
+    print(
+        f"packed {packed.rules.shape[0]} ACE rows, {packed.n_rules} rule keys, "
+        f"{packed.n_acls} ACLs{origin} -> {out_prefix}.npz/.json",
+        file=sys.stderr,
+    )
+    return 0
+
+
 def _cmd_parse_acls(args: argparse.Namespace) -> int:
     rulesets = []
     for path in args.configs:
         rs = aclparse.parse_config_file(path, strict=not args.lenient)
-        skipped = f" skipped={len(rs.skipped)}" if rs.skipped else ""
+        _report_ruleset(path, rs)
+        rulesets.append(rs)
+    return _pack_and_save(rulesets, args.out)
+
+
+def _cmd_fetch_acls(args: argparse.Namespace) -> int:
+    """getaccesslists.py analog: inventory -> fetch -> parse -> pack."""
+    from .hostside import acquire
+
+    inventory = acquire.load_inventory(args.inventory)
+    if not inventory:
         print(
-            f"{path}: firewall={rs.firewall} acls={len(rs.acls)} "
-            f"rules={rs.rule_count()} expanded_aces={rs.ace_count()}{skipped}",
+            "error: empty inventory (populate config.FIREWALLS or pass "
+            "--inventory FILE with 'name = source' lines)",
             file=sys.stderr,
         )
-        for lineno, reason, line in rs.skipped:
-            print(f"{path}:{lineno}: skipped: {reason}: {line}", file=sys.stderr)
+        return 2
+    rulesets = []
+    for name, source, rs in acquire.iter_rulesets(
+        inventory, strict=not args.lenient
+    ):
+        _report_ruleset(f"{name} <- {source}", rs)
         rulesets.append(rs)
-    packed = pack.pack_rulesets(rulesets)
-    pack.save_packed(packed, args.out)
-    print(
-        f"packed {packed.rules.shape[0]} ACE rows, {packed.n_rules} rule keys, "
-        f"{packed.n_acls} ACLs -> {args.out}.npz/.json",
-        file=sys.stderr,
+    return _pack_and_save(
+        rulesets, args.out, origin=f" from {len(rulesets)} firewalls"
     )
-    return 0
 
 
 def _iter_log_lines(paths: list[str]):
@@ -95,6 +127,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--checkpoint-dir": args.checkpoint_dir,
             "--layout=stacked": args.layout != "flat",
             "--no-exact-counts": not args.exact_counts,
+            "--feed-workers": args.feed_workers > 1,
         }
         bad = [k for k, v in tpu_only.items() if v]
         if bad:
@@ -140,10 +173,12 @@ def _cmd_run(args: argparse.Namespace) -> int:
         if args.native_parse and not file_input:
             print("--native-parse requires file inputs (not '-')", file=sys.stderr)
             return 2
-        if args.feed_workers > 1 and (not file_input or args.distributed):
+        if args.feed_workers > 1 and (
+            not file_input or args.distributed or args.native_parse is False
+        ):
             print(
-                "--feed-workers requires file inputs and is not available "
-                "with --distributed", file=sys.stderr,
+                "--feed-workers requires file inputs and the native parser, "
+                "and is not available with --distributed", file=sys.stderr,
             )
             return 2
         if args.distributed:
@@ -229,6 +264,20 @@ def make_parser() -> argparse.ArgumentParser:
                         "IPv6, exotic object members — instead of aborting; "
                         "skipped entries keep their rule positions")
     p.set_defaults(fn=_cmd_parse_acls)
+
+    p = sub.add_parser(
+        "fetch-acls",
+        help="acquire + parse configs from a firewall inventory "
+             "(config.FIREWALLS or --inventory)",
+    )
+    p.add_argument("--inventory", default=None, metavar="FILE",
+                   help="'name = source' lines; source is a config file path "
+                        "or cmd:<shell command> whose stdout is the config "
+                        "(default: config.FIREWALLS)")
+    p.add_argument("--out", required=True, help="output path prefix")
+    p.add_argument("--lenient", action="store_true",
+                   help="skip-and-count unsupported entries (see parse-acls)")
+    p.set_defaults(fn=_cmd_fetch_acls)
 
     p = sub.add_parser("run", help="run the analysis over syslog")
     p.add_argument("--ruleset", required=True, help="packed ruleset path prefix")

@@ -1,0 +1,86 @@
+"""Ruleset acquisition from a firewall inventory (SURVEY.md §4.1).
+
+The reference's ``getaccesslists.py`` loops ``for firewall in
+config.FIREWALLS``, obtains each firewall's configuration text, and
+parses it.  This module is that loop: an inventory maps firewall name ->
+source, where a source is either a path to a saved configuration file or
+``cmd:<shell command>`` whose stdout is the configuration (the "fetch
+from device" arm — e.g. ``cmd:ssh fw1 show running-config``).
+
+The default inventory is ``config.FIREWALLS``; ``load_inventory`` also
+reads a simple ``name = source`` text file so jobs can ship their own.
+"""
+
+from __future__ import annotations
+
+import subprocess
+
+from .. import config as config_mod
+from .aclparse import AclParseError, Ruleset, parse_asa_config
+
+
+def obtain_config(source: str, timeout: float = 60.0) -> str:
+    """Configuration text for one inventory source (file or cmd:...).
+
+    Both arms decode permissively (device banners love stray bytes) and
+    every failure mode — nonzero exit, hang past ``timeout`` — surfaces
+    as :class:`AclParseError` so the CLI reports it cleanly.
+    """
+    if source.startswith("cmd:"):
+        cmd = source[4:].strip()
+        if not cmd:
+            raise AclParseError(f"empty command in inventory source {source!r}")
+        try:
+            r = subprocess.run(
+                cmd, shell=True, capture_output=True, timeout=timeout
+            )
+        except subprocess.TimeoutExpired:
+            raise AclParseError(
+                f"inventory command timed out after {timeout:.0f}s: {cmd!r}"
+            ) from None
+        if r.returncode != 0:
+            err = r.stderr.decode("utf-8", errors="replace").strip()[:200]
+            raise AclParseError(
+                f"inventory command failed rc={r.returncode}: {cmd!r} ({err})"
+            )
+        return r.stdout.decode("utf-8", errors="replace")
+    with open(source, "r", encoding="utf-8", errors="replace") as f:
+        return f.read()
+
+
+def load_inventory(path: str | None = None) -> dict[str, str]:
+    """Inventory mapping firewall name -> source.
+
+    ``path=None`` returns ``config.FIREWALLS`` (the reference's module
+    constant).  A file holds one ``name = source`` pair per line;
+    ``#`` comments and blank lines are ignored.
+    """
+    if path is None:
+        return dict(config_mod.FIREWALLS)
+    out: dict[str, str] = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise AclParseError(
+                    f"{path}:{lineno}: expected 'name = source', got {line!r}"
+                )
+            name, source = line.split("=", 1)
+            out[name.strip()] = source.strip()
+    return out
+
+
+def iter_rulesets(inventory: dict[str, str], strict: bool = True):
+    """Yield (name, source, Ruleset) per inventory entry, in order."""
+    for name, source in inventory.items():
+        text = obtain_config(source)
+        yield name, source, parse_asa_config(text, name, strict=strict)
+
+
+def acquire_rulesets(
+    inventory: dict[str, str], strict: bool = True
+) -> list[Ruleset]:
+    """Obtain + parse every inventory entry, in inventory order."""
+    return [rs for _, _, rs in iter_rulesets(inventory, strict=strict)]

@@ -285,6 +285,10 @@ def run_stream_file(
         paths = [paths]
     use_native = native if native is not None else fastparse.available()
     if feed_workers and feed_workers > 1:
+        if native is False:
+            raise ValueError(
+                "feed_workers requires the native parser; drop native=False"
+            )
         from ..hostside.feeder import ParallelFeeder
 
         source = ParallelFeeder(packed, paths, n_workers=feed_workers)

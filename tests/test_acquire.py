@@ -1,0 +1,88 @@
+"""Inventory-driven ruleset acquisition (SURVEY.md §4.1 getaccesslists loop)."""
+
+import pytest
+
+from ruleset_analysis_tpu.hostside import acquire, aclparse, synth
+
+
+CFG = synth.synth_config(n_acls=2, rules_per_acl=4, seed=71)
+
+
+def test_obtain_from_file(tmp_path):
+    p = tmp_path / "fw.cfg"
+    p.write_text(CFG)
+    assert acquire.obtain_config(str(p)) == CFG
+
+
+def test_obtain_from_command(tmp_path):
+    p = tmp_path / "fw.cfg"
+    p.write_text(CFG)
+    text = acquire.obtain_config(f"cmd:cat {p}")
+    assert text == CFG
+
+
+def test_obtain_command_failure_raises():
+    with pytest.raises(aclparse.AclParseError, match="rc="):
+        acquire.obtain_config("cmd:false")
+
+
+def test_load_inventory_file(tmp_path):
+    inv = tmp_path / "inv.txt"
+    inv.write_text(
+        "# firewalls\n"
+        "edge1 = /etc/cfg/edge1.cfg\n"
+        "edge2 = cmd:ssh edge2 show run\n"
+        "\n"
+    )
+    got = acquire.load_inventory(str(inv))
+    assert got == {
+        "edge1": "/etc/cfg/edge1.cfg",
+        "edge2": "cmd:ssh edge2 show run",
+    }
+
+
+def test_load_inventory_default_is_config_firewalls(monkeypatch):
+    from ruleset_analysis_tpu import config as config_mod
+
+    monkeypatch.setattr(config_mod, "FIREWALLS", {"fwA": "/tmp/a.cfg"})
+    assert acquire.load_inventory(None) == {"fwA": "/tmp/a.cfg"}
+
+
+def test_malformed_inventory_line(tmp_path):
+    inv = tmp_path / "inv.txt"
+    inv.write_text("edge1 /etc/cfg/edge1.cfg\n")
+    with pytest.raises(aclparse.AclParseError, match="name = source"):
+        acquire.load_inventory(str(inv))
+
+
+def test_acquire_rulesets_and_cli(tmp_path, capsys):
+    from ruleset_analysis_tpu import cli
+
+    c1 = tmp_path / "fw1.cfg"
+    c1.write_text(synth.synth_config(n_acls=2, rules_per_acl=4, seed=72, hostname="fw1"))
+    c2 = tmp_path / "fw2.cfg"
+    c2.write_text(synth.synth_config(n_acls=1, rules_per_acl=3, seed=73, hostname="fw2"))
+    inv = tmp_path / "inv.txt"
+    inv.write_text(f"fw1 = {c1}\nfw2 = cmd:cat {c2}\n")
+
+    rulesets = acquire.acquire_rulesets(acquire.load_inventory(str(inv)))
+    assert [rs.firewall for rs in rulesets] == ["fw1", "fw2"]
+    assert rulesets[0].rule_count() == 8 and rulesets[1].rule_count() == 3
+
+    rc = cli.main(["fetch-acls", "--inventory", str(inv), "--out", str(tmp_path / "packed")])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "fw1 <-" in err and "fw2 <-" in err
+    from ruleset_analysis_tpu.hostside import pack
+
+    packed = pack.load_packed(str(tmp_path / "packed"))
+    assert packed.n_rules == 11
+    assert {fw for fw, _ in packed.acl_gid} == {"fw1", "fw2"}
+
+
+def test_cli_empty_inventory(capsys):
+    from ruleset_analysis_tpu import cli
+
+    rc = cli.main(["fetch-acls", "--out", "/tmp/nope"])
+    assert rc == 2
+    assert "empty inventory" in capsys.readouterr().err
