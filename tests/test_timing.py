@@ -1,0 +1,61 @@
+"""runtime.timing: counts-closed benchmark windows catch fake execution."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+from ruleset_analysis_tpu.models import pipeline
+from ruleset_analysis_tpu.runtime.timing import timed_validated_steps
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg_text = synth.synth_config(n_acls=2, rules_per_acl=8, seed=81)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    cfg = AnalysisConfig(
+        batch_size=256, sketch=SketchConfig(cms_width=1 << 10, cms_depth=2, hll_p=5)
+    )
+    b = np.ascontiguousarray(synth.synth_tuples(packed, 256, seed=81).T)
+    feeds = [pack.compact_batch(b)]
+    valid = [int(b[pack.T_VALID].sum())]
+    import functools
+    import jax
+
+    step = jax.jit(
+        functools.partial(
+            pipeline.analysis_step,
+            n_keys=packed.n_keys,
+            topk_k=cfg.sketch.topk_chunk_candidates,
+        )
+    )
+    return packed, cfg, step, feeds, valid
+
+
+def test_real_execution_validates(setup):
+    packed, cfg, step, feeds, valid = setup
+    state = pipeline.init_state(packed.n_keys, cfg)
+    state, dt, delta, expect = timed_validated_steps(
+        step, state, pipeline.ship_ruleset(packed), feeds, valid, 4
+    )
+    assert delta == expect == 4 * valid[0]
+    assert dt > 0
+
+
+def test_fake_step_is_caught(setup):
+    """A step that never runs (returns its inputs) must show delta=0."""
+    packed, cfg, _, feeds, valid = setup
+
+    def fake_step(state, rules, batch):
+        return state, None
+
+    state = pipeline.init_state(packed.n_keys, cfg)
+    state, _dt, delta, expect = timed_validated_steps(
+        fake_step, state, pipeline.ship_ruleset(packed), feeds, valid, 4
+    )
+    assert delta == 0
+    assert expect == 4 * valid[0]
+    assert delta != expect  # the caller's integrity check would fire
