@@ -103,6 +103,14 @@ def all_processes_have_data(has_data: bool) -> bool:
     return bool(np.asarray(flags).sum() > 0)
 
 
+def value_across_processes(value: int) -> np.ndarray:
+    """Every process's value, as a [process_count] array (tiny allgather)."""
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray([int(value)], dtype=np.int64)
+    return np.asarray(multihost_utils.process_allgather(arr)).reshape(-1)
+
+
 def sum_across_processes(values: dict[str, int]) -> dict[str, int]:
     """Aggregate per-process counters (parsed/skipped/lines) for totals."""
     from jax.experimental import multihost_utils

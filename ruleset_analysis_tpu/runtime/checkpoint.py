@@ -195,6 +195,44 @@ def load(ckpt_dir: str) -> Snapshot | None:
     )
 
 
+def snapshot_of(
+    state,
+    *,
+    lines_consumed: int,
+    n_chunks: int,
+    parsed: int,
+    skipped: int,
+    tracker: TopKTracker,
+    fingerprint: str,
+) -> Snapshot:
+    """Host-side Snapshot of a device AnalysisState (fetches registers)."""
+    import jax
+
+    from ..models.pipeline import AnalysisState
+
+    return Snapshot(
+        arrays={
+            k: np.asarray(jax.device_get(getattr(state, k)))
+            for k in AnalysisState._fields
+        },
+        lines_consumed=lines_consumed,
+        n_chunks=n_chunks,
+        parsed=parsed,
+        skipped=skipped,
+        tracker_tables=tracker.tables(),
+        fingerprint=fingerprint,
+    )
+
+
+def state_of(snap: Snapshot, put_leaf):
+    """Device AnalysisState from a Snapshot; ``put_leaf`` places each
+    register (device_put for single-process, a global-array constructor
+    for multi-process)."""
+    from ..models.pipeline import AnalysisState
+
+    return AnalysisState(**{k: put_leaf(v) for k, v in snap.arrays.items()})
+
+
 def restore_tracker(snap: Snapshot, capacity: int) -> TopKTracker:
     t = TopKTracker(capacity)
     for acl, table in snap.tracker_tables.items():

@@ -14,6 +14,7 @@ never synchronises the host with the in-flight chunk.
 
 from __future__ import annotations
 
+import os
 from collections import deque
 from collections.abc import Iterable, Iterator
 
@@ -315,6 +316,7 @@ def run_stream_file_distributed(
     native: bool | None = None,
     topk: int = 10,
     return_state: bool = False,
+    max_chunks: int | None = None,
 ):
     """Multi-process analysis: each process feeds ITS OWN input split.
 
@@ -326,8 +328,12 @@ def run_stream_file_distributed(
     step then merges registers with psum/pmax — over ICI within a host,
     DCN between hosts.  Every process returns the identical Report.
 
-    Checkpointing is not yet supported on this path (each process would
-    need its own offset in its own split); cfg must leave it disabled.
+    Checkpointing: every process snapshots under its own
+    ``checkpoint_dir/proc-<i>-of-<n>`` subdirectory — registers are
+    replicated (identical everywhere) but each process must remember its
+    OWN offset into its OWN split.  The chunk loop is collective, so all
+    processes snapshot at the same chunk count; resume verifies that in
+    lockstep and refuses a changed process count.
     """
     import jax
 
@@ -337,8 +343,6 @@ def run_stream_file_distributed(
     from ..parallel.step import make_parallel_step
     from jax.sharding import PartitionSpec as P
 
-    if cfg.checkpoint_every_chunks or cfg.resume:
-        raise ValueError("checkpoint/resume is not supported with --distributed yet")
     if cfg.layout != "flat":
         raise ValueError("--distributed supports layout='flat' only for now")
 
@@ -364,31 +368,110 @@ def run_stream_file_distributed(
         deny_key=dist.to_global(mesh, rules_host.deny_key, P()),
         rules_fm=None,
     )
-    state_host = pipeline.init_state_host(packed.n_keys, cfg)
-    state = pipeline.AnalysisState(
-        **{
-            k: dist.to_global(mesh, getattr(state_host, k), P())
-            for k in pipeline.AnalysisState._fields
-        }
-    )
     step = make_parallel_step(mesh, cfg, packed.n_keys)
     packer = source.packer
-    tracker = TopKTracker(cfg.sketch.topk_capacity)
     pending: deque[pipeline.ChunkOut] = deque()
+
+    from . import checkpoint as ckpt
+
+    pid, nproc = jax.process_index(), jax.process_count()
+    # per-process snapshot dir: registers are identical everywhere, but
+    # the offset is into THIS process's own input split
+    my_ckpt_dir = os.path.join(cfg.checkpoint_dir, f"proc-{pid}-of-{nproc}")
+    fp = (
+        ckpt.fingerprint(packed, cfg, mesh.shape[cfg.mesh_axis], 0)
+        + f"-dist{pid}of{nproc}"
+    )
+    lines_consumed = 0
+    n_chunks = 0
+    snap = None
+    if cfg.resume:
+        # Every process must reach every allgather: evaluate ALL local
+        # conditions first, gather once, and raise the SAME verdict
+        # everywhere — a lone early raise would leave the other processes
+        # blocked in the next collective instead of surfacing the error.
+        layout_err = _dist_ckpt_layout_error(cfg.checkpoint_dir, nproc)
+        snap = ckpt.load(my_ckpt_dir) if layout_err is None else None
+        local_state = 0  # 0 = no snapshot
+        if layout_err is not None:
+            local_state = 3  # foreign process layout
+        elif snap is not None:
+            local_state = 1 if snap.fingerprint == fp else 2
+        states = dist.value_across_processes(local_state)
+        chunks_all = dist.value_across_processes(
+            snap.n_chunks if snap is not None else -1
+        )
+        if (states == 3).any():
+            raise ckpt.CheckpointMismatch(
+                layout_err
+                or f"another process found a foreign process layout in "
+                f"{cfg.checkpoint_dir!r}"
+            )
+        if (states == 2).any():
+            raise ckpt.CheckpointMismatch(
+                f"snapshot under {cfg.checkpoint_dir!r} was taken with a "
+                "different ruleset, geometry, or process layout; refusing "
+                "to merge"
+            )
+        n_have = int((states == 1).sum())
+        if 0 < n_have < nproc:
+            raise ckpt.CheckpointMismatch(
+                f"only {n_have}/{nproc} processes found a snapshot in "
+                f"{cfg.checkpoint_dir!r}; all or none must resume"
+            )
+        if n_have and not (chunks_all == chunks_all[0]).all():
+            raise ckpt.CheckpointMismatch(
+                "processes hold snapshots from different chunk counts "
+                f"({chunks_all.tolist()}); the checkpoint is inconsistent"
+            )
+    if snap is not None:
+        state = ckpt.state_of(snap, lambda v: dist.to_global(mesh, v, P()))
+        tracker = ckpt.restore_tracker(snap, cfg.sketch.topk_capacity)
+        source.set_counts(snap.parsed, snap.skipped)
+        lines_consumed = snap.lines_consumed
+        n_chunks = snap.n_chunks
+    else:
+        state_host = pipeline.init_state_host(packed.n_keys, cfg)
+        state = pipeline.AnalysisState(
+            **{
+                k: dist.to_global(mesh, getattr(state_host, k), P())
+                for k in pipeline.AnalysisState._fields
+            }
+        )
+        tracker = TopKTracker(cfg.sketch.topk_capacity)
+    lines_at_start = lines_consumed  # throughput covers this run only
 
     def drain(out: pipeline.ChunkOut) -> None:
         tracker.offer_chunk(
             np.asarray(out.cand_acl), np.asarray(out.cand_src), np.asarray(out.cand_est)
         )
 
+    def save_snapshot() -> None:
+        while pending:
+            drain(pending.popleft())
+        pipeline.sync_state(state)
+        ckpt.save(
+            my_ckpt_dir,
+            ckpt.snapshot_of(
+                state,
+                lines_consumed=lines_consumed,
+                n_chunks=n_chunks,
+                parsed=packer.parsed,
+                skipped=packer.skipped,
+                tracker=tracker,
+                fingerprint=fp,
+            ),
+        )
+
     from ..hostside.pack import TUPLE_COLS
     from .metrics import ThroughputMeter
 
     meter = ThroughputMeter(cfg.report_every_chunks)
-    it = source.batches(0, local_batch)
+    it = source.batches(lines_consumed, local_batch)
     empty = np.zeros((TUPLE_COLS, local_batch), dtype=np.uint32)
-    lines_consumed = 0
-    n_chunks = 0
+    last_snap_chunks = n_chunks
+    chunks_this_run = 0
+    aborted = False
     while True:
         nxt = next(it, None)
         # collective agreement: everyone steps while anyone has data
@@ -403,9 +486,23 @@ def run_stream_file_distributed(
             drain(pending.popleft())
         n_chunks += 1
         lines_consumed += n_raw
+        chunks_this_run += 1
         meter.tick(n_raw)
+        # the loop is collective, so every process reaches the cadence at
+        # the same n_chunks and snapshots the same register state
+        if (
+            cfg.checkpoint_every_chunks
+            and n_chunks - last_snap_chunks >= cfg.checkpoint_every_chunks
+        ):
+            save_snapshot()
+            last_snap_chunks = n_chunks
+        if max_chunks is not None and chunks_this_run >= max_chunks:
+            aborted = True  # crash simulation: skip the final snapshot
+            break
 
     pipeline.sync_state(state)
+    if cfg.checkpoint_every_chunks and not aborted:
+        save_snapshot()
     elapsed = meter.elapsed()
     while pending:
         drain(pending.popleft())
@@ -414,14 +511,18 @@ def run_stream_file_distributed(
             "lines_total": lines_consumed,
             "lines_matched": packer.parsed,
             "lines_skipped": packer.skipped,
+            # throughput covers THIS run's lines only (totals above are
+            # cumulative across resumes)
+            "lines_this_run": lines_consumed - lines_at_start,
         }
     )
+    lines_this_run = agg.pop("lines_this_run")
     totals = {
         **agg,
         "chunks": n_chunks,
         "processes": n_procs,
         "elapsed_sec": round(elapsed, 4),
-        "lines_per_sec": round(agg["lines_total"] / elapsed, 1) if elapsed > 0 else 0.0,
+        "lines_per_sec": round(lines_this_run / elapsed, 1) if elapsed > 0 else 0.0,
     }
     report = pipeline.finalize(state, packed, cfg, tracker, topk=topk, totals=totals)
     if return_state:
@@ -439,6 +540,41 @@ def _iter_files(paths: list[str]):
     for path in paths:
         with open(path, "r", encoding="utf-8", errors="replace") as f:
             yield from f
+
+
+def _dist_ckpt_layout_error(ckpt_dir: str, nproc: int) -> str | None:
+    """Error message if resuming this layout would silently restart.
+
+    Snapshot subdirs are named ``proc-<i>-of-<n>``.  Foreign-``n`` dirs
+    are only fatal when NO matching-``n`` dirs exist: then a resume would
+    find nothing and silently start from scratch even though an (older,
+    differently-laid-out) checkpoint is clearly present.  When a complete
+    current-layout set coexists with stale dirs, the stale ones are
+    ignored.
+    """
+    import re
+
+    try:
+        entries = os.listdir(ckpt_dir)
+    except OSError:
+        return None
+    foreign = set()
+    have_matching = False
+    for e in entries:
+        m = re.fullmatch(r"proc-\d+-of-(\d+)", e)
+        if not m:
+            continue
+        if int(m.group(1)) == nproc:
+            have_matching = True
+        else:
+            foreign.add(int(m.group(1)))
+    if foreign and not have_matching:
+        return (
+            f"{ckpt_dir!r} holds snapshots from a "
+            f"{sorted(foreign)[0]}-process run; this job has {nproc} "
+            "processes"
+        )
+    return None
 
 
 def _run_core(
@@ -493,8 +629,8 @@ def _run_core(
                 "ruleset, sketch geometry, batch size, or device count; "
                 "refusing to merge"
             )
-        state = pipeline.AnalysisState(
-            **{k: jax.device_put(v, mesh_lib.replicated(mesh)) for k, v in snap.arrays.items()}
+        state = ckpt.state_of(
+            snap, lambda v: jax.device_put(v, mesh_lib.replicated(mesh))
         )
         tracker = ckpt.restore_tracker(snap, cfg.sketch.topk_capacity)
         source.set_counts(snap.parsed, snap.skipped)
@@ -524,16 +660,13 @@ def _run_core(
         pipeline.sync_state(state)
         ckpt.save(
             cfg.checkpoint_dir,
-            ckpt.Snapshot(
-                arrays={
-                    k: np.asarray(jax.device_get(getattr(state, k)))
-                    for k in pipeline.AnalysisState._fields
-                },
+            ckpt.snapshot_of(
+                state,
                 lines_consumed=lines_consumed,
                 n_chunks=n_chunks,
                 parsed=packer.parsed,
                 skipped=packer.skipped,
-                tracker_tables=tracker.tables(),
+                tracker=tracker,
                 fingerprint=fp,
             ),
         )

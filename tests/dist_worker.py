@@ -5,6 +5,10 @@ distributed stream driver over THIS process's input split, and dumps the
 final register files + report JSON for the parent test to compare.
 
 Usage: dist_worker.py PROC_ID N_PROCS PORT RULESET_PREFIX LOG_PATH OUT_PREFIX
+           [CKPT_DIR MODE]
+
+MODE (requires CKPT_DIR): "crash" checkpoints every 2 chunks and aborts
+after 3; "resume" resumes from the checkpoint and runs to completion.
 """
 
 import json
@@ -14,6 +18,8 @@ import sys
 def main() -> int:
     proc_id, n_procs, port = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
     ruleset_prefix, log_path, out_prefix = sys.argv[4], sys.argv[5], sys.argv[6]
+    ckpt_dir = sys.argv[7] if len(sys.argv) > 7 else None
+    mode = sys.argv[8] if len(sys.argv) > 8 else None
 
     from ruleset_analysis_tpu.parallel.distributed import init_distributed
 
@@ -29,9 +35,16 @@ def main() -> int:
     cfg = AnalysisConfig(
         batch_size=64,
         sketch=SketchConfig(cms_width=1 << 10, cms_depth=4, hll_p=6),
+        **(
+            {"checkpoint_every_chunks": 2, "checkpoint_dir": ckpt_dir}
+            if ckpt_dir
+            else {}
+        ),
+        resume=(mode == "resume"),
     )
+    max_chunks = 3 if mode == "crash" else None
     report, regs = run_stream_file_distributed(
-        packed, [log_path], cfg, return_state=True
+        packed, [log_path], cfg, return_state=True, max_chunks=max_chunks
     )
     np.savez(out_prefix + ".npz", **regs)
     with open(out_prefix + ".json", "w", encoding="utf-8") as f:

@@ -39,13 +39,14 @@ def _worker_env(n_local_devices: int) -> dict:
     return env
 
 
-def _run_workers(n_procs, port, ruleset_prefix, logs, out_prefixes, n_local_devices):
+def _run_workers(n_procs, port, ruleset_prefix, logs, out_prefixes,
+                 n_local_devices, extra=()):
     procs = []
     for pid in range(n_procs):
         procs.append(
             subprocess.Popen(
                 [sys.executable, _WORKER, str(pid), str(n_procs), str(port),
-                 ruleset_prefix, logs[pid], out_prefixes[pid]],
+                 ruleset_prefix, logs[pid], out_prefixes[pid], *extra],
                 env=_worker_env(n_local_devices),
                 cwd=_REPO,
                 stdout=subprocess.PIPE,
@@ -114,3 +115,48 @@ def test_two_process_registers_bit_identical_to_single(corpus):
     assert rep0["totals"]["lines_total"] == rep_ref["totals"]["lines_total"]
     assert rep0["totals"]["lines_matched"] == rep_ref["totals"]["lines_matched"]
     assert rep0["totals"]["processes"] == 2
+
+
+def test_two_process_checkpoint_crash_resume(corpus):
+    """Crash after 3 chunks (snapshot every 2), resume, finish: registers
+    must be bit-identical to an uninterrupted 2-process run."""
+    td, prefix, full, half0, half1 = corpus
+    ck = str(td / "ck")
+
+    # uninterrupted reference (2 processes, no checkpointing)
+    _run_workers(2, _free_port(), prefix, [half0, half1],
+                 [str(td / "u0"), str(td / "u1")], 4)
+
+    # crash mid-run, then resume from the per-process snapshots
+    _run_workers(2, _free_port(), prefix, [half0, half1],
+                 [str(td / "c0"), str(td / "c1")], 4, extra=(ck, "crash"))
+    assert os.path.isdir(os.path.join(ck, "proc-0-of-2"))
+    assert os.path.isdir(os.path.join(ck, "proc-1-of-2"))
+    _run_workers(2, _free_port(), prefix, [half0, half1],
+                 [str(td / "r0"), str(td / "r1")], 4, extra=(ck, "resume"))
+
+    ref = np.load(str(td / "u0.npz"))
+    res = np.load(str(td / "r0.npz"))
+    for k in ref.files:
+        np.testing.assert_array_equal(ref[k], res[k], err_msg=f"register {k}")
+    rep_u = json.loads((td / "u0.json").read_text())
+    rep_r = json.loads((td / "r0.json").read_text())
+    assert rep_r["unused"] == rep_u["unused"]
+    assert rep_r["totals"]["lines_total"] == rep_u["totals"]["lines_total"]
+    assert rep_r["totals"]["lines_matched"] == rep_u["totals"]["lines_matched"]
+
+
+def test_stale_foreign_layout_dirs_do_not_block_resume(corpus, tmp_path):
+    """proc-*-of-M leftovers must not block a valid proc-*-of-N resume."""
+    from ruleset_analysis_tpu.runtime.stream import _dist_ckpt_layout_error
+
+    ck = tmp_path / "ck"
+    (ck / "proc-0-of-2").mkdir(parents=True)
+    (ck / "proc-1-of-2").mkdir()
+    # only foreign dirs -> resuming with 4 processes must refuse
+    assert _dist_ckpt_layout_error(str(ck), 4) is not None
+    # matching dirs present -> stale foreign dirs are ignored
+    (ck / "proc-0-of-4").mkdir()
+    assert _dist_ckpt_layout_error(str(ck), 4) is None
+    # matching layout, no foreign -> fine
+    assert _dist_ckpt_layout_error(str(ck), 2) is None
