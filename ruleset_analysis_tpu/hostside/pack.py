@@ -89,6 +89,11 @@ class PackedRuleset:
     #: connection messages are evaluated against the egress interface's
     #: out ACL in addition to the ingress in ACL.
     bindings_out: dict[tuple[str, str], int] = dataclasses.field(default_factory=dict)
+    #: Lenient-parse skips carried from the Rulesets: (firewall, lineno,
+    #: reason) per unsupported config entry — surfaced in the analysis
+    #: report so a packed ruleset can't silently hide that its source
+    #: config wasn't fully parsed.
+    parse_skips: list[tuple[str, int, str]] = dataclasses.field(default_factory=list)
 
     @property
     def n_keys(self) -> int:
@@ -160,6 +165,12 @@ def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> P
             KeyMeta(firewall=fw, acl=acl, index=0, text="<implicit deny>", implicit_deny=True)
         )
 
+    parse_skips = [
+        (rs.firewall, lineno, reason)
+        for rs in rulesets
+        for lineno, reason, _line in rs.skipped
+    ]
+
     r = len(rows)
     pad_to = max(pad_rules_to or 0, r, 1)
     mat = np.full((pad_to, RULE_COLS), 0, dtype=np.uint32)
@@ -175,6 +186,7 @@ def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> P
         deny_key=deny_key,
         bindings=bindings,
         bindings_out=bindings_out,
+        parse_skips=parse_skips,
     )
 
 
@@ -457,6 +469,7 @@ def save_packed(packed: PackedRuleset, path_prefix: str) -> None:
         "bindings_out": [
             [fw, iface, gid] for (fw, iface), gid in packed.bindings_out.items()
         ],
+        "parse_skips": [[fw, lineno, reason] for fw, lineno, reason in packed.parse_skips],
     }
     with open(path_prefix + ".json", "w", encoding="utf-8") as f:
         json.dump(meta, f)
@@ -477,4 +490,8 @@ def load_packed(path_prefix: str) -> PackedRuleset:
         bindings_out={
             (fw, iface): gid for fw, iface, gid in meta.get("bindings_out", [])
         },
+        parse_skips=[
+            (fw, int(lineno), reason)
+            for fw, lineno, reason in meta.get("parse_skips", [])
+        ],
     )

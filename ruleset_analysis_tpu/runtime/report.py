@@ -46,6 +46,11 @@ class Report:
             f"# lines={t.get('lines_total', 0)} matched={t.get('lines_matched', 0)} "
             f"skipped={t.get('lines_skipped', 0)} backend={t.get('backend', '?')}"
         )
+        if t.get("config_entries_skipped"):
+            out.append(
+                f"# WARNING: {t['config_entries_skipped']} config entries were "
+                "skipped at parse time (lenient mode); their rules are not analyzed"
+            )
         # group by ACL: key order is all configured rules first, then every
         # ACL's implicit deny, so naive sequential headers would repeat
         by_acl: dict[tuple[str, str], list[dict]] = {}
@@ -102,4 +107,8 @@ def build_report(
     t["backend"] = backend
     t["n_rules"] = packed.n_rules
     t["n_unused"] = len(unused)
+    if packed.parse_skips:
+        # lenient-mode parse skips: the report must say the source config
+        # wasn't fully parsed (those rules were never analyzable)
+        t["config_entries_skipped"] = len(packed.parse_skips)
     return Report(per_rule=per_rule, unused=unused, totals=t, talkers=talk)

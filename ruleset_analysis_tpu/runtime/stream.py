@@ -501,9 +501,9 @@ def run_stream_file_distributed(
             break
 
     pipeline.sync_state(state)
+    elapsed = meter.elapsed()  # before the final snapshot write (as _run_core)
     if cfg.checkpoint_every_chunks and not aborted:
         save_snapshot()
-    elapsed = meter.elapsed()
     while pending:
         drain(pending.popleft())
     agg = dist.sum_across_processes(

@@ -76,3 +76,26 @@ def test_cli_lenient_flag(tmp_path, capsys):
     err = capsys.readouterr().err
     assert "skipped=3" in err
     assert "NOSUCHGROUP" in err
+
+
+def test_parse_skips_surface_in_report(tmp_path):
+    """A leniently-packed ruleset carries its skip count into the report."""
+    from ruleset_analysis_tpu.runtime.report import build_report
+
+    rs = aclparse.parse_asa_config(MIXED_CFG, "fw6", strict=False)
+    packed = pack.pack_rulesets([rs])
+    assert len(packed.parse_skips) == 3
+    prefix = str(tmp_path / "p")
+    pack.save_packed(packed, prefix)
+    loaded = pack.load_packed(prefix)
+    assert loaded.parse_skips == packed.parse_skips
+
+    rep = build_report(loaded, {}, backend="tpu")
+    assert rep.totals["config_entries_skipped"] == 3
+    assert "WARNING" in rep.to_text()
+
+    strict_rs = aclparse.parse_asa_config(
+        "access-list A extended permit ip any any\n", "fw8"
+    )
+    clean = build_report(pack.pack_rulesets([strict_rs]), {}, backend="tpu")
+    assert "config_entries_skipped" not in clean.totals
