@@ -39,21 +39,19 @@ def _worker_env(n_local_devices: int) -> dict:
     return env
 
 
-def _run_workers(n_procs, port, ruleset_prefix, logs, out_prefixes,
-                 n_local_devices, extra=()):
-    procs = []
-    for pid in range(n_procs):
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, _WORKER, str(pid), str(n_procs), str(port),
-                 ruleset_prefix, logs[pid], out_prefixes[pid], *extra],
-                env=_worker_env(n_local_devices),
-                cwd=_REPO,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-            )
+def _spawn_and_check(argvs, n_local_devices):
+    """Run one process per argv; kill all on timeout; assert every rc==0."""
+    procs = [
+        subprocess.Popen(
+            argv,
+            env=_worker_env(n_local_devices),
+            cwd=_REPO,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
         )
+        for argv in argvs
+    ]
     outs = []
     for p in procs:
         try:
@@ -63,8 +61,21 @@ def _run_workers(n_procs, port, ruleset_prefix, logs, out_prefixes,
                 q.kill()
             raise
         outs.append((p.returncode, out, err))
-    for rc, out, err in outs:
+    for rc, _out, err in outs:
         assert rc == 0, f"worker failed rc={rc}\nstderr:\n{err[-3000:]}"
+    return outs
+
+
+def _run_workers(n_procs, port, ruleset_prefix, logs, out_prefixes,
+                 n_local_devices, extra=()):
+    _spawn_and_check(
+        [
+            [sys.executable, _WORKER, str(pid), str(n_procs), str(port),
+             ruleset_prefix, logs[pid], out_prefixes[pid], *extra]
+            for pid in range(n_procs)
+        ],
+        n_local_devices,
+    )
 
 
 @pytest.fixture(scope="module")
@@ -160,3 +171,25 @@ def test_stale_foreign_layout_dirs_do_not_block_resume(corpus, tmp_path):
     assert _dist_ckpt_layout_error(str(ck), 4) is None
     # matching layout, no foreign -> fine
     assert _dist_ckpt_layout_error(str(ck), 2) is None
+
+
+def test_cli_distributed_two_processes(corpus):
+    """The run --distributed CLI path end-to-end across two processes."""
+    td, prefix, full, half0, half1 = corpus
+    port = _free_port()
+    out0 = td / "cli_rep.json"
+    _spawn_and_check(
+        [
+            [sys.executable, "-m", "ruleset_analysis_tpu.cli", "run",
+             "--ruleset", prefix, "--logs", log, "--backend", "tpu",
+             "--distributed", "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(pid),
+             "--batch-size", "64", "--json", "--out", str(out0)]
+            for pid, log in ((0, half0), (1, half1))
+        ],
+        4,
+    )
+    # only rank 0 writes the report (rank 1 returns before output)
+    rep = json.loads(out0.read_text(encoding="utf-8"))
+    assert rep["totals"]["processes"] == 2
+    assert rep["totals"]["lines_total"] == 1200
