@@ -217,6 +217,7 @@ class ParallelFeeder:
         ]
         for w in workers:
             w.start()
+        self._workers = workers  # exposed for fault-injection tests
         try:
             free_slots = list(range(n_slots))
             ready: dict[int, tuple] = {}  # idx -> completion

@@ -113,3 +113,19 @@ def test_feeder_resume_checkpoint(corpus, tmp_path):
     hf = {(e["firewall"], e["acl"], e["index"]): e["hits"] for e in full.per_rule}
     assert hr == hf
     assert rep.totals["lines_total"] == 3000
+
+
+def test_killed_worker_detected_not_hung(corpus):
+    """An OS-killed worker (OOM analog) must surface as RuntimeError via
+    the liveness timeout, not hang the coordinator on done_q forever."""
+    import os
+    import signal
+
+    packed, rs, paths, res = corpus
+    feeder = ParallelFeeder(packed, paths, n_workers=1)
+    gen = feeder.batches(0, 64)  # 3000 lines -> ~47 batches, plenty left
+    next(gen)
+    os.kill(feeder._workers[0].pid, signal.SIGKILL)
+    with pytest.raises(RuntimeError, match="died without reporting"):
+        for _ in gen:
+            pass
