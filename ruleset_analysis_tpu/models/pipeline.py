@@ -336,6 +336,14 @@ def analysis_step_stacked(
     )
 
 
+def state_to_host(state: AnalysisState) -> dict[str, np.ndarray]:
+    """Fetch every register file to host numpy (a hard sync point)."""
+    return {
+        k: np.asarray(jax.device_get(getattr(state, k)))
+        for k in AnalysisState._fields
+    }
+
+
 def counts_total(state: AnalysisState) -> int:
     """Total hits across all keys, fetched to host — and therefore a hard
     synchronization point.

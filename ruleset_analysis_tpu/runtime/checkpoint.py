@@ -206,15 +206,10 @@ def snapshot_of(
     fingerprint: str,
 ) -> Snapshot:
     """Host-side Snapshot of a device AnalysisState (fetches registers)."""
-    import jax
-
-    from ..models.pipeline import AnalysisState
+    from ..models.pipeline import state_to_host
 
     return Snapshot(
-        arrays={
-            k: np.asarray(jax.device_get(getattr(state, k)))
-            for k in AnalysisState._fields
-        },
+        arrays=state_to_host(state),
         lines_consumed=lines_consumed,
         n_chunks=n_chunks,
         parsed=parsed,

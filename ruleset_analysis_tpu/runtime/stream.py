@@ -23,7 +23,8 @@ import numpy as np
 
 from ..config import AnalysisConfig
 from ..hostside import pack as pack_mod
-from ..hostside.pack import LinePacker, PackedRuleset
+from ..hostside.pack import T_VALID, TUPLE_COLS, LinePacker, PackedRuleset
+from ..hostside.syslog import parse_line
 from ..models import pipeline
 from ..ops.topk import TopKTracker
 
@@ -62,9 +63,6 @@ class _TextSource:
         self.packer.parsed, self.packer.skipped = parsed, skipped
 
     def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
-        from ..hostside.syslog import parse_line
-        from ..hostside.pack import TUPLE_COLS
-
         it = iter(self._lines)
         skipped_ok = 0
         for _ in range(skip_lines):
@@ -132,8 +130,6 @@ class _PackedSource:
         self.packer.parsed, self.packer.skipped = parsed, skipped
 
     def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
-        from ..hostside.pack import T_VALID, TUPLE_COLS
-
         buf = np.empty((TUPLE_COLS, batch_size), dtype=np.uint32)
         fill = 0
         to_skip = skip_lines
@@ -150,7 +146,7 @@ class _PackedSource:
                 fill += m
                 pos += m
                 if fill == batch_size:
-                    yield self._emit(buf, fill, batch_size, T_VALID)
+                    yield self._emit(buf, fill, batch_size)
                     fill = 0
         if to_skip:
             from ..errors import ResumeInputMismatch
@@ -160,9 +156,9 @@ class _PackedSource:
                 f"ran short by {to_skip}"
             )
         if fill:
-            yield self._emit(buf, fill, batch_size, T_VALID)
+            yield self._emit(buf, fill, batch_size)
 
-    def _emit(self, buf, fill, batch_size, t_valid):
+    def _emit(self, buf, fill, batch_size):
         # always a fresh array: the reusable fill buffer must not be
         # mutated under an in-flight async device_put of a prior chunk
         if fill == batch_size:
@@ -170,7 +166,7 @@ class _PackedSource:
         else:
             out = np.zeros_like(buf)
             out[:, :fill] = buf[:, :fill]
-        valid = int(out[t_valid].sum())
+        valid = int(out[T_VALID].sum())
         self.packer.parsed += valid
         self.packer.skipped += fill - valid
         return out, fill
@@ -335,8 +331,6 @@ def run_stream_file_distributed(
     processes snapshot at the same chunk count; resume verifies that in
     lockstep and refuses a changed process count.
     """
-    import jax
-
     from ..hostside import fastparse
     from ..parallel import distributed as dist
     from ..parallel import mesh as mesh_lib
@@ -355,12 +349,12 @@ def run_stream_file_distributed(
     )
 
     mesh = dist.make_global_mesh(cfg.mesh_axis)
-    n_procs = jax.process_count()
+    pid, nproc = jax.process_index(), jax.process_count()
     global_batch = mesh_lib.pad_batch_size(
-        max(cfg.batch_size, 2 if packed.bindings_out else 1) * n_procs,
+        max(cfg.batch_size, 2 if packed.bindings_out else 1) * nproc,
         mesh, cfg.mesh_axis,
     )
-    local_batch = global_batch // n_procs
+    local_batch = global_batch // nproc
 
     rules_host = pipeline.ship_ruleset_host(packed)
     rules = pipeline.DeviceRuleset(
@@ -374,7 +368,6 @@ def run_stream_file_distributed(
 
     from . import checkpoint as ckpt
 
-    pid, nproc = jax.process_index(), jax.process_count()
     # per-process snapshot dir: registers are identical everywhere, but
     # the offset is into THIS process's own input split
     my_ckpt_dir = os.path.join(cfg.checkpoint_dir, f"proc-{pid}-of-{nproc}")
@@ -463,7 +456,6 @@ def run_stream_file_distributed(
             ),
         )
 
-    from ..hostside.pack import TUPLE_COLS
     from .metrics import ThroughputMeter
 
     meter = ThroughputMeter(cfg.report_every_chunks)
@@ -520,19 +512,13 @@ def run_stream_file_distributed(
     totals = {
         **agg,
         "chunks": n_chunks,
-        "processes": n_procs,
+        "processes": nproc,
         "elapsed_sec": round(elapsed, 4),
         "lines_per_sec": round(lines_this_run / elapsed, 1) if elapsed > 0 else 0.0,
     }
     report = pipeline.finalize(state, packed, cfg, tracker, topk=topk, totals=totals)
     if return_state:
-        import jax
-
-        regs = {
-            k: np.asarray(jax.device_get(getattr(state, k)))
-            for k in pipeline.AnalysisState._fields
-        }
-        return report, regs
+        return report, pipeline.state_to_host(state)
     return report
 
 
