@@ -1,0 +1,22 @@
+#!/bin/bash
+# One-shot TPU evidence capture: headline bench + perf suite configs.
+# Run with NO env overrides (the default env selects the axon TPU).
+# Produces:
+#   BENCH_r03_local.json        headline (self-validating, e2e decomposition)
+#   BENCH_SUITE_r03_tpu.json    exact/pallas/multifw/e2e + accuracy configs
+set -u
+cd "$(dirname "$0")"
+echo "=== headline bench ===" >&2
+timeout 2400 python bench.py > BENCH_r03_local.json 2> /tmp/bench_r03.log
+echo "headline rc=$?" >&2
+tail -3 /tmp/bench_r03.log >&2
+echo "=== suite (perf configs on TPU) ===" >&2
+timeout 3600 python bench_suite.py exact pallas multifw recall e2e \
+    > /tmp/suite_tpu.jsonl 2> /tmp/suite_tpu.log
+echo "suite rc=$?" >&2
+{
+  echo '{"note": "TPU run (axon tunnel). cms/hll/topk accuracy lines carried from the committed interim artifact (platform-independent).", "platform": "tpu"}'
+  cat /tmp/suite_tpu.jsonl
+  grep -E '"config2_|"config3_|"config5_' BENCH_SUITE_r03_interim_cpu.json
+} > BENCH_SUITE_r03_tpu.json
+echo "wrote BENCH_r03_local.json and BENCH_SUITE_r03_tpu.json" >&2
