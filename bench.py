@@ -407,39 +407,48 @@ def _bench_feeder_scaling(packed, path: str, batch_size: int) -> dict | None:
 
 
 def _bench_h2d_only(packed, batch_size: int, mesh) -> dict:
-    """Host->device batch transfer rate, synced by a cross-shard readback."""
+    """Host->device batch transfer rate, synced by a cross-shard readback.
+
+    Measures BOTH layouts — the 16 B/line wire format the stream ships
+    and the 28 B/line wide layout it replaced — so the JSON quantifies
+    what the bit-packing buys on this link.
+    """
     import jax
     import numpy as np
 
     from ruleset_analysis_tpu.hostside import pack, synth
     from ruleset_analysis_tpu.parallel import mesh as mesh_lib
 
-    batch = pack.compact_batch(
-        np.ascontiguousarray(synth.synth_tuples(packed, batch_size, seed=3).T)
-    )
-    nbytes = batch.nbytes
+    wide = np.ascontiguousarray(synth.synth_tuples(packed, batch_size, seed=3).T)
+    wire = pack.compact_batch(wide)
     # full reduction, NOT a slice: the batch shards over the mesh's data
     # axis, and a one-shard readback would only prove device 0's transfer
     # finished — the sum's result depends on every shard's bytes
     allsum = jax.jit(lambda x: x.sum(dtype=jax.numpy.uint32))
-    # warmup (allocator, tunnel)
-    d = mesh_lib.shard_batch(mesh, batch)
-    np.asarray(allsum(d))
-    reps = 3
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        d = mesh_lib.shard_batch(mesh, batch)
-        np.asarray(allsum(d))  # 4-byte fetch bounding every shard's transfer
-    dt = time.perf_counter() - t0
-    rate = reps * batch_size / dt
-    log(f"h2d-only: {reps} x {nbytes/1e6:.1f} MB in {dt:.2f}s = "
-        f"{reps*nbytes/dt/1e6:.1f} MB/s = {rate:.0f} lines/s")
+
+    def measure(batch) -> tuple[float, float]:
+        d = mesh_lib.shard_batch(mesh, batch)  # warmup (allocator, tunnel)
+        np.asarray(allsum(d))
+        reps = 3
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            d = mesh_lib.shard_batch(mesh, batch)
+            np.asarray(allsum(d))  # 4-byte fetch bounding every transfer
+        dt = time.perf_counter() - t0
+        return reps * batch_size / dt, reps * batch.nbytes / dt / 1e6
+
+    rate, mbps = measure(wire)
+    wide_rate, wide_mbps = measure(wide)
+    log(f"h2d-only: wire {mbps:.1f} MB/s = {rate:.0f} lines/s; "
+        f"wide {wide_mbps:.1f} MB/s = {wide_rate:.0f} lines/s "
+        f"(wire speedup {rate/max(wide_rate,1):.2f}x)")
     return {
         "lines_per_sec": round(rate, 1),
-        "mb_per_sec": round(reps * nbytes / dt / 1e6, 2),
-        "batch_mb": round(nbytes / 1e6, 1),
-        "bytes_per_line": round(nbytes / batch_size, 1),
-        "elapsed_sec": round(dt, 3),
+        "mb_per_sec": round(mbps, 2),
+        "batch_mb": round(wire.nbytes / 1e6, 1),
+        "bytes_per_line": round(wire.nbytes / batch_size, 1),
+        "wide_lines_per_sec": round(wide_rate, 1),
+        "wire_speedup_vs_wide": round(rate / max(wide_rate, 1.0), 3),
     }
 
 
