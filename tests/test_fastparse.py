@@ -55,6 +55,19 @@ EDGE_LINES = [
     # hostname with attached colon, no space before the tag
     'fw9:%ASA-4-106023: Deny udp src inside:192.168.2.2/5000 '
     'dst outside:1.1.1.1/6000 by access-group "IN"',
+    # identity-firewall user info after both endpoints (idfw)
+    "Jul 30 01:02:03 fw9 : %ASA-6-106100: access-list OUT permitted tcp "
+    "outside/1.2.3.4(1234)(DOMAIN\\user1) -> inside/10.0.0.5(443)(DOMAIN\\svc) "
+    "hit-cnt 1 first hit [0x0, 0x0]",
+    # 300-second-interval hit-count phrasing
+    "Jul 30 01:02:03 fw9 : %ASA-6-106100: access-list OUT permitted tcp "
+    "outside/1.2.3.4(5555) -> inside/10.0.0.5(443) hit-cnt 44 300-second interval [0x0, 0x0]",
+    # syslog priority + ISO timestamp prefix
+    "<166>2026-07-30T01:02:03Z fw9 : %ASA-6-106100: access-list OUT denied tcp "
+    "outside/9.8.7.6(1) -> inside/10.0.0.5(80) hit-cnt 1",
+    # interface names with dashes + flow-hash tail
+    'Jul 30 01:02:03 fw9 : %ASA-4-106023: Deny tcp src outside:5.6.7.8/55 '
+    'dst dmz-web:10.0.0.5/443 by access-group "OUT" [0x8a2b, 0x0]',
     # malformed bodies
     "Jul 29 fw9 : %ASA-6-106100: access-list OUT permitted tcp garbage",
     'Jul 29 fw9 : %ASA-4-106023: Deny tcp src outside:5.6.7.8 dst missing-group',
