@@ -1,0 +1,61 @@
+"""Direct unit tests for the report layer (SURVEY.md L5)."""
+
+import json
+
+from ruleset_analysis_tpu.hostside import aclparse, pack
+from ruleset_analysis_tpu.runtime.report import build_report
+
+CFG = """
+hostname fwr
+access-list A extended permit tcp any host 10.0.0.5 eq 443
+access-list A extended deny ip any any
+access-list B extended permit udp any any eq 53
+access-group A in interface outside
+"""
+
+
+def _packed():
+    rs = aclparse.parse_asa_config(CFG, "fwr")
+    return pack.pack_rulesets([rs])
+
+
+def test_per_rule_order_and_unused():
+    packed = _packed()
+    rep = build_report(
+        packed,
+        {("fwr", "A", 1): 10, ("fwr", "A", 0): 3},
+        backend="tpu",
+    )
+    # config order: A/1, A/2, B/1, then implicit denies
+    keys = [(e["firewall"], e["acl"], e["index"]) for e in rep.per_rule]
+    assert keys[:3] == [("fwr", "A", 1), ("fwr", "A", 2), ("fwr", "B", 1)]
+    assert ("fwr", "A", 0) in keys and ("fwr", "B", 0) in keys
+    # unused = configured rules with zero hits (implicit denies excluded)
+    assert rep.unused == [("fwr", "A", 2), ("fwr", "B", 1)]
+    assert rep.totals["n_unused"] == 2
+    assert rep.totals["n_rules"] == 3
+
+
+def test_text_report_tags_and_talkers():
+    packed = _packed()
+    rep = build_report(
+        packed,
+        {("fwr", "A", 1): 7},
+        backend="tpu",
+        unique_sources={("fwr", "A", 1): 4},
+        talkers={("fwr", "A"): [(0x0A000001, 5), (0x0A000002, 2)]},
+    )
+    text = rep.to_text()
+    assert "rule 1" in text and "implicit-deny" in text
+    assert "uniq_src~4" in text
+    assert rep.talkers == {"fwr A": [["10.0.0.1", 5], ["10.0.0.2", 2]]}
+
+
+def test_json_roundtrip_shape():
+    packed = _packed()
+    rep = build_report(packed, {}, backend="oracle", totals={"lines_total": 9})
+    d = json.loads(rep.to_json())
+    assert set(d) == {"totals", "per_rule", "unused", "talkers"}
+    assert d["totals"]["backend"] == "oracle"
+    assert d["totals"]["lines_total"] == 9
+    assert all(len(k) == 3 for k in d["unused"])
