@@ -283,7 +283,9 @@ def run_stream_file(
     use_native = native if native is not None else fastparse.available()
     if feed_workers and feed_workers > 1:
         if native is False:
-            raise ValueError(
+            from ..errors import AnalysisError
+
+            raise AnalysisError(
                 "feed_workers requires the native parser; drop native=False"
             )
         from ..hostside.feeder import ParallelFeeder
@@ -334,11 +336,20 @@ def run_stream_file_distributed(
     from ..hostside import fastparse
     from ..parallel import distributed as dist
     from ..parallel import mesh as mesh_lib
-    from ..parallel.step import make_parallel_step
+    from ..parallel.step import make_parallel_step, make_parallel_step_stacked
     from jax.sharding import PartitionSpec as P
 
-    if cfg.layout != "flat":
-        raise ValueError("--distributed supports layout='flat' only for now")
+    from ..errors import AnalysisError
+
+    stacked = cfg.layout == "stacked"
+    if stacked and (cfg.checkpoint_every_chunks or cfg.resume):
+        # a snapshot would have to flush each process's group buffer, and
+        # flush emissions are data-dependent per process — the collective
+        # chunk loop can't stay in lockstep through that yet
+        raise AnalysisError(
+            "checkpoint/resume is not supported with --distributed "
+            "--layout=stacked; use the flat layout for checkpointed jobs"
+        )
 
     if isinstance(local_paths, str):
         local_paths = [local_paths]
@@ -356,13 +367,31 @@ def run_stream_file_distributed(
     )
     local_batch = global_batch // nproc
 
-    rules_host = pipeline.ship_ruleset_host(packed)
-    rules = pipeline.DeviceRuleset(
-        rules=dist.to_global(mesh, rules_host.rules, P()),
-        deny_key=dist.to_global(mesh, rules_host.deny_key, P()),
-        rules_fm=None,
-    )
-    step = make_parallel_step(mesh, cfg, packed.n_keys)
+    if stacked:
+        from ..hostside.pack import GroupBuffer, stack_rules
+
+        # per-GLOBAL-batch lane, sharded over every device; each process
+        # contributes its local lane slice from its own group buffer
+        lane = cfg.stacked_lane or max(1, cfg.batch_size // max(1, packed.n_acls))
+        lane = mesh_lib.pad_batch_size(lane * nproc, mesh, cfg.mesh_axis)
+        local_lane = lane // nproc
+        rules = pipeline.DeviceRulesetStacked(
+            rules3d=dist.to_global(mesh, stack_rules(packed), P()),
+            deny_key=dist.to_global(
+                mesh, packed.deny_key.astype(np.uint32), P()
+            ),
+        )
+        step = make_parallel_step_stacked(mesh, cfg, packed.n_keys)
+        gbuf = GroupBuffer(max(packed.n_acls, 1), local_lane)
+    else:
+        rules_host = pipeline.ship_ruleset_host(packed)
+        rules = pipeline.DeviceRuleset(
+            rules=dist.to_global(mesh, rules_host.rules, P()),
+            deny_key=dist.to_global(mesh, rules_host.deny_key, P()),
+            rules_fm=None,
+        )
+        step = make_parallel_step(mesh, cfg, packed.n_keys)
+        gbuf = None
     packer = source.packer
     pending: deque[pipeline.ChunkOut] = deque()
 
@@ -460,26 +489,74 @@ def run_stream_file_distributed(
 
     meter = ThroughputMeter(cfg.report_every_chunks)
     it = source.batches(lines_consumed, local_batch)
-    empty = np.zeros((TUPLE_COLS, local_batch), dtype=np.uint32)
+    empty = (
+        None if stacked else np.zeros((TUPLE_COLS, local_batch), dtype=np.uint32)
+    )
     last_snap_chunks = n_chunks
     chunks_this_run = 0
     aborted = False
-    while True:
-        nxt = next(it, None)
-        # collective agreement: everyone steps while anyone has data
-        if not dist.all_processes_have_data(nxt is not None):
-            break
-        batch_np, n_raw = nxt if nxt is not None else (empty, 0)
-        wire = pack_mod.compact_batch(batch_np)
-        gbatch = dist.to_global(mesh, wire, P(None, cfg.mesh_axis))
+    # Stacked: grouped batches emit from the group buffer at a
+    # data-dependent cadence, so a ready-queue decouples source pulls from
+    # the collective loop — each round steps at most ONE grouped batch per
+    # process, and processes whose queue ran dry pad with an all-invalid
+    # batch until every queue is empty.
+    ready: deque[np.ndarray] = deque()
+    src_done = False
+
+    def refill_ready() -> None:
+        nonlocal src_done, lines_consumed
+        while not ready and not src_done:
+            nxt = next(it, None)
+            if nxt is None:
+                src_done = True
+                ready.extend(gbuf.flush())
+                return
+            batch_np, n_raw = nxt
+            lines_consumed += n_raw
+            meter.tick(n_raw)
+            ready.extend(gbuf.add(np.ascontiguousarray(batch_np.T)))
+
+    def step_grouped_round(has: bool) -> None:
+        nonlocal state, n_chunks
+        grouped = (
+            ready.popleft()
+            if has
+            else np.zeros(
+                (max(packed.n_acls, 1), TUPLE_COLS, local_lane), dtype=np.uint32
+            )
+        )
+        wire = pack_mod.compact_grouped(grouped)
+        gbatch = dist.to_global(mesh, wire, P(None, None, cfg.mesh_axis))
         state, out = step(state, rules, gbatch, n_chunks)
         pending.append(out)
         if len(pending) > 2:
             drain(pending.popleft())
         n_chunks += 1
-        lines_consumed += n_raw
+
+    while True:
+        if stacked:
+            refill_ready()
+            has = bool(ready)
+        else:
+            nxt = next(it, None)
+            has = nxt is not None
+        # collective agreement: everyone steps while anyone has data
+        if not dist.all_processes_have_data(has):
+            break
+        if stacked:
+            step_grouped_round(has)
+        else:
+            batch_np, n_raw = nxt if has else (empty, 0)
+            lines_consumed += n_raw
+            meter.tick(n_raw)
+            wire = pack_mod.compact_batch(batch_np)
+            gbatch = dist.to_global(mesh, wire, P(None, cfg.mesh_axis))
+            state, out = step(state, rules, gbatch, n_chunks)
+            pending.append(out)
+            if len(pending) > 2:
+                drain(pending.popleft())
+            n_chunks += 1
         chunks_this_run += 1
-        meter.tick(n_raw)
         # the loop is collective, so every process reaches the cadence at
         # the same n_chunks and snapshots the same register state
         if (
@@ -491,6 +568,21 @@ def run_stream_file_distributed(
         if max_chunks is not None and chunks_this_run >= max_chunks:
             aborted = True  # crash simulation: skip the final snapshot
             break
+
+    if stacked and aborted:
+        # drain buffered lines after a max_chunks abort: they are already
+        # counted in lines_consumed / the packer counters, and a report
+        # claiming lines the registers never saw would be a lie (the same
+        # invariant _run_core's post-abort gbuf flush preserves).  The
+        # drain stays collective: everyone keeps stepping until every
+        # process's queue is dry.
+        src_done = True
+        ready.extend(gbuf.flush())
+        while True:
+            has = bool(ready)
+            if not dist.all_processes_have_data(has):
+                break
+            step_grouped_round(has)
 
     pipeline.sync_state(state)
     elapsed = meter.elapsed()  # before the final snapshot write (as _run_core)
@@ -582,7 +674,9 @@ def _run_core(
         mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
     batch_size = mesh_lib.pad_batch_size(cfg.batch_size, mesh, cfg.mesh_axis)
     if packed.bindings_out and batch_size < 2:
-        raise ValueError(
+        from ..errors import AnalysisError
+
+        raise AnalysisError(
             "batch_size must be >= 2 when out-direction access-groups are "
             "bound: one connection line can emit two ACL evaluations"
         )

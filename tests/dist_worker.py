@@ -8,7 +8,8 @@ Usage: dist_worker.py PROC_ID N_PROCS PORT RULESET_PREFIX LOG_PATH OUT_PREFIX
            [CKPT_DIR MODE]
 
 MODE (requires CKPT_DIR): "crash" checkpoints every 2 chunks and aborts
-after 3; "resume" resumes from the checkpoint and runs to completion.
+after 3; "resume" resumes from the checkpoint and runs to completion;
+"stacked" (CKPT_DIR ignored, pass "-") runs the stacked layout.
 """
 
 import json
@@ -37,12 +38,13 @@ def main() -> int:
         sketch=SketchConfig(cms_width=1 << 10, cms_depth=4, hll_p=6),
         **(
             {"checkpoint_every_chunks": 2, "checkpoint_dir": ckpt_dir}
-            if ckpt_dir
+            if ckpt_dir and ckpt_dir != "-"
             else {}
         ),
         resume=(mode == "resume"),
+        layout="stacked" if mode in ("stacked", "stacked-abort") else "flat",
     )
-    max_chunks = 3 if mode == "crash" else None
+    max_chunks = {"crash": 3, "stacked-abort": 2}.get(mode)
     report, regs = run_stream_file_distributed(
         packed, [log_path], cfg, return_state=True, max_chunks=max_chunks
     )

@@ -193,3 +193,51 @@ def test_cli_distributed_two_processes(corpus):
     rep = json.loads(out0.read_text(encoding="utf-8"))
     assert rep["totals"]["processes"] == 2
     assert rep["totals"]["lines_total"] == 1200
+
+
+def test_two_process_stacked_layout(corpus):
+    """Stacked (per-ACL slab) layout across two processes: the mergeable
+    registers that don't depend on chunk boundaries (counts, cms, hll)
+    must be bit-identical to the flat 2-process run's."""
+    td, prefix, full, half0, half1 = corpus
+
+    _run_workers(2, _free_port(), prefix, [half0, half1],
+                 [str(td / "st0"), str(td / "st1")], 4, extra=("-", "stacked"))
+
+    # flat reference already produced by the first test (module fixture
+    # ordering isn't guaranteed, so recompute if missing)
+    if not (td / "out0.npz").exists():
+        _run_workers(2, _free_port(), prefix, [half0, half1],
+                     [str(td / "out0"), str(td / "out1")], 4)
+
+    flat = np.load(str(td / "out0.npz"))
+    st0 = np.load(str(td / "st0.npz"))
+    st1 = np.load(str(td / "st1.npz"))
+    for k in ("counts_lo", "counts_hi", "cms", "hll", "talk_cms"):
+        np.testing.assert_array_equal(flat[k], st0[k], err_msg=f"register {k}")
+        np.testing.assert_array_equal(st0[k], st1[k], err_msg=f"register {k} ranks")
+    rep_flat = json.loads((td / "out0.json").read_text())
+    rep_st = json.loads((td / "st0.json").read_text())
+    hits = lambda r: {(e["firewall"], e["acl"], e["index"]): e["hits"] for e in r["per_rule"]}  # noqa: E731
+    assert hits(rep_st) == hits(rep_flat)
+    assert rep_st["unused"] == rep_flat["unused"]
+    assert rep_st["totals"]["lines_total"] == rep_flat["totals"]["lines_total"]
+    assert rep_st["totals"]["lines_matched"] == rep_flat["totals"]["lines_matched"]
+
+
+def test_stacked_abort_drains_buffered_lines(corpus):
+    """max_chunks abort in stacked mode: lines already counted into the
+    totals must still reach the registers (collective post-abort drain)."""
+    td, prefix, full, half0, half1 = corpus
+    _run_workers(2, _free_port(), prefix, [half0, half1],
+                 [str(td / "sa0"), str(td / "sa1")], 4,
+                 extra=("-", "stacked-abort"))
+    regs = np.load(str(td / "sa0.npz"))
+    rep = json.loads((td / "sa0.json").read_text())
+    total_counts = int(
+        regs["counts_lo"].astype(np.uint64).sum()
+        + (regs["counts_hi"].astype(np.uint64).sum() << np.uint64(32))
+    )
+    # every counted evaluation landed in the registers — no limbo lines
+    assert total_counts == rep["totals"]["lines_matched"]
+    assert 0 < rep["totals"]["lines_total"] < 1200  # genuinely aborted early
