@@ -116,6 +116,14 @@ def _cmd_run(args: argparse.Namespace) -> int:
     lines = _iter_log_lines(args.logs)
 
     if args.backend == "oracle":
+        from .hostside.wire import is_wire_file
+
+        if any(p != "-" and is_wire_file(p) for p in args.logs):
+            print(
+                "--backend=oracle reads text syslog; .rawire files only "
+                "apply to --backend=tpu", file=sys.stderr,
+            )
+            return 2
         # These only plumb into the device stream driver; accepting them
         # silently would let a user believe an oracle run is checkpointed.
         tpu_only = {
@@ -126,6 +134,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--native-parse": args.native_parse,
             "--checkpoint-dir": args.checkpoint_dir,
             "--layout=stacked": args.layout != "flat",
+            "--packed-input": args.packed_input,
             "--no-exact-counts": not args.exact_counts,
             "--feed-workers": args.feed_workers > 1,
         }
@@ -164,12 +173,37 @@ def _cmd_run(args: argparse.Namespace) -> int:
     elif args.backend == "tpu":
         try:
             from .runtime.compcache import enable_persistent_cache
-            from .runtime.stream import run_stream, run_stream_file  # deferred: imports JAX
+            from .runtime.stream import (  # deferred: imports JAX
+                run_stream,
+                run_stream_file,
+                run_stream_wire,
+            )
         except ImportError as e:
             print(f"error: tpu backend unavailable ({e})", file=sys.stderr)
             return 1
         enable_persistent_cache()  # skip the ~15s recompile on repeat runs
         file_input = all(p != "-" for p in args.logs)
+        from .hostside.wire import is_wire_file
+
+        # '-' (stdin) is never a wire file but still poisons a mix: binary
+        # wire data must not fall through to the text-parse path
+        n_wire = sum(1 for p in args.logs if p != "-" and is_wire_file(p))
+        if args.packed_input and n_wire < len(args.logs):
+            print(
+                "--packed-input: not every --logs file is a .rawire wire "
+                "file (run `ruleset-analyze convert` first)", file=sys.stderr,
+            )
+            return 2
+        if 0 < n_wire < len(args.logs):
+            print("cannot mix .rawire and text inputs in one --logs list", file=sys.stderr)
+            return 2
+        wire_input = n_wire == len(args.logs) and n_wire > 0
+        if wire_input and (args.native_parse or args.feed_workers > 1):
+            print(
+                "--native-parse/--feed-workers do not apply to packed "
+                ".rawire inputs (there is no text parse)", file=sys.stderr,
+            )
+            return 2
         if args.native_parse and not file_input:
             print("--native-parse requires file inputs (not '-')", file=sys.stderr)
             return 2
@@ -203,6 +237,14 @@ def _cmd_run(args: argparse.Namespace) -> int:
             )
             if jax.process_index() != 0:
                 return 0
+        elif wire_input:
+            rep = run_stream_wire(
+                packed,
+                args.logs,
+                cfg,
+                topk=args.topk,
+                profile_dir=args.profile_dir,
+            )
         elif file_input:
             # forced --native-parse with no C++ toolchain raises
             # NativeParserUnavailable, handled as AnalysisError in main()
@@ -227,6 +269,36 @@ def _cmd_run(args: argparse.Namespace) -> int:
             f.write(payload + "\n")
     else:
         print(payload)
+    return 0
+
+
+def _cmd_convert(args: argparse.Namespace) -> int:
+    """Text syslog -> pre-tokenized .rawire wire file (SURVEY.md §8.2).
+
+    Parses once (native C++ parser when available) and writes the 16 B/line
+    bit-packed evaluation rows; `run` then feeds the device straight from
+    the mmap'd file, skipping the host parse that bottlenecks e2e.
+    """
+    from .hostside import wire
+
+    if args.block_rows < 1:
+        print("error: --block-rows must be >= 1", file=sys.stderr)
+        return 2
+    packed = pack.load_packed(args.ruleset)
+    stats = wire.convert_logs(
+        packed,
+        args.logs,
+        args.out,
+        native=args.native_parse,
+        block_rows=args.block_rows,
+    )
+    mb = stats["bytes"] / 1e6
+    print(
+        f"wrote {args.out}: {stats['rows']} evaluation rows from "
+        f"{stats['raw_lines']} lines ({stats['skipped']} skipped), "
+        f"{mb:.1f} MB, parser={stats['parser']}",
+        file=sys.stderr,
+    )
     return 0
 
 
@@ -308,6 +380,9 @@ def make_parser() -> argparse.ArgumentParser:
                    help="print throughput to stderr every N chunks")
     p.add_argument("--native-parse", action=argparse.BooleanOptionalAction, default=None,
                    help="use the C++ host parser (default: auto when logs are files)")
+    p.add_argument("--packed-input", action="store_true",
+                   help="require --logs to be .rawire wire files (see "
+                        "`convert`; wire inputs are also auto-detected)")
     p.add_argument("--feed-workers", type=int, default=0, metavar="N",
                    help="parse with N worker processes over file shards "
                         "(multi-core hosts; implies the native parser; 0/1 = off)")
@@ -331,6 +406,21 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_run)
+
+    p = sub.add_parser(
+        "convert",
+        help="pre-tokenize text syslog into a .rawire wire file "
+             "(16 B/line; `run` feeds it to the device with no host parse)",
+    )
+    p.add_argument("--ruleset", required=True, help="packed ruleset path prefix")
+    p.add_argument("--logs", nargs="+", required=True, help="text syslog file(s)")
+    p.add_argument("--out", required=True, help="output .rawire path")
+    p.add_argument("--native-parse", action=argparse.BooleanOptionalAction, default=None,
+                   help="use the C++ parser for the one-time conversion (default: auto)")
+    p.add_argument("--block-rows", type=int, default=1 << 16, metavar="N",
+                   help="rows per payload block; match the run --batch-size "
+                        "for the zero-copy mmap read path (default 65536)")
+    p.set_defaults(fn=_cmd_convert)
 
     p = sub.add_parser("synth", help="generate synthetic config + syslog")
     p.add_argument("--out-dir", required=True)

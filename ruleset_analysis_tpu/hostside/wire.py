@@ -1,0 +1,392 @@
+"""On-disk wire format: pre-tokenized syslog, 16 bytes/line, mmap-readable.
+
+SURVEY.md §8.2 names host regex parse as the end-to-end bottleneck and
+prescribes a "pre-tokenized binary input format for the benchmark path".
+This module makes that format a production tier, not a bench-only hack:
+
+- ``ruleset-analyze convert`` parses text syslog ONCE (native C++ parser
+  when available) and writes a ``.rawire`` file holding each ACL
+  evaluation as the same 4-word bit-packed row that crosses the
+  host->device link (``pack.compact_batch``: src | dst | sport<<16|dport |
+  proto<<24|valid<<23|acl).  Re-running an analysis then skips the parse
+  entirely — the mmap-backed reader feeds the device step at memory
+  bandwidth, which is what lets a small host keep a TPU busy.
+
+- The file is bound to the ruleset it was packed against: ACL gids are
+  ruleset-relative, so the header carries a ruleset fingerprint and the
+  reader refuses a mismatched ruleset instead of silently attributing
+  hits to the wrong ACLs.
+
+Layout (all little-endian):
+
+  header, 64 bytes:
+    0   magic     8s   b"RAWIREv1"
+    8   block_rows u32  rows per payload block
+    12  reserved  u32
+    16  n_rows    u64  total evaluation rows in the payload
+    24  raw_lines u64  raw text lines the converter consumed
+    32  n_evals   u64  evaluations emitted (== n_rows)
+    40  n_skipped u64  raw lines that produced no evaluation
+    48  fp        16s  ruleset fingerprint (sha256 prefix)
+  payload: ceil(n_rows / block_rows) blocks; block b holds
+    r = min(block_rows, n_rows - b*block_rows) rows stored column-major
+    as a C-contiguous [WIRE_COLS, r] uint32 plane — so a whole block is a
+    zero-copy mmap slice ready for jax.device_put.
+
+Only evaluation rows are stored (a skipped line would be 16 zero bytes of
+padding the device masks out anyway); the header keeps the raw-line
+accounting so reports state true input totals.  Rows appear in exactly
+the order the text path would evaluate them, so registers and per-rule
+counts from a ``.rawire`` run are bit-identical to the text run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import mmap
+import os
+import struct
+from collections.abc import Iterator
+
+import numpy as np
+
+from ..errors import AnalysisError
+from .pack import (
+    T_VALID,
+    TUPLE_COLS,
+    W_META,
+    WIRE_COLS,
+    PackedRuleset,
+    compact_batch,
+)
+
+MAGIC = b"RAWIREv1"
+#: Placeholder magic while a convert is in flight; only a successful
+#: ``WireWriter.close()`` upgrades it to MAGIC, so a crashed or aborted
+#: convert leaves a file every reader refuses ("not a wire file") instead
+#: of a silently short one.
+MAGIC_PARTIAL = b"RAWIRE??"
+HEADER_BYTES = 64
+_HEADER_FMT = "<8sII4Q16s"
+#: Default rows per payload block.  Matches the default run batch size so
+#: the aligned read path hands mmap views straight to device_put.
+DEFAULT_BLOCK_ROWS = 1 << 16
+
+ROW_BYTES = WIRE_COLS * 4  # 16 B/line
+
+
+def ruleset_fingerprint(packed: PackedRuleset) -> bytes:
+    """16-byte identity of the gid universe a wire file is valid for.
+
+    Covers everything that maps a log line to (acl gid, key): the expanded
+    rule matrix, deny keys, ACL gid assignment, and interface bindings.
+    """
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(packed.rules).tobytes())
+    h.update(np.ascontiguousarray(packed.deny_key).tobytes())
+    for (fw, acl), gid in sorted(packed.acl_gid.items()):
+        h.update(f"a:{fw}/{acl}={gid};".encode())
+    for (fw, iface), gid in sorted(packed.bindings.items()):
+        h.update(f"i:{fw}/{iface}={gid};".encode())
+    for (fw, iface), gid in sorted(packed.bindings_out.items()):
+        h.update(f"o:{fw}/{iface}={gid};".encode())
+    return h.digest()[:16]
+
+
+class WireFormatError(AnalysisError):
+    """Bad magic, truncated payload, or ruleset mismatch."""
+
+
+class WireWriter:
+    """Stream evaluation rows into a ``.rawire`` file.
+
+    Feed dense wire-format column batches (``[WIRE_COLS, k]`` uint32, all
+    rows valid); blocks are written as they fill and the header is
+    back-patched on close.  Until :meth:`close` succeeds the header
+    carries ``MAGIC_PARTIAL``, so a convert that crashes, is interrupted,
+    or calls :meth:`abort` leaves a file every reader refuses outright —
+    never one that validates with only part of the rows.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        fp: bytes,
+        block_rows: int = DEFAULT_BLOCK_ROWS,
+    ):
+        if block_rows <= 0:
+            raise ValueError("block_rows must be positive")
+        self._f = open(path, "wb")
+        self._fp = fp
+        self.block_rows = block_rows
+        self.n_rows = 0
+        self.raw_lines = 0
+        self.n_skipped = 0
+        self._buf = np.empty((WIRE_COLS, block_rows), dtype=np.uint32)
+        self._fill = 0
+        # placeholder header; rewritten with the final magic + counts on close
+        self._f.write(self._header(final=False))
+
+    def _header(self, final: bool = True) -> bytes:
+        return struct.pack(
+            _HEADER_FMT,
+            MAGIC if final else MAGIC_PARTIAL,
+            self.block_rows,
+            0,
+            self.n_rows,
+            self.raw_lines,
+            self.n_rows,  # n_evals == stored rows
+            self.n_skipped,
+            self._fp,
+        )
+
+    def add(self, wire: np.ndarray, raw_lines: int, skipped: int) -> None:
+        """Append ``wire[:, :k]`` rows covering ``raw_lines`` text lines."""
+        if wire.dtype != np.uint32 or wire.shape[0] != WIRE_COLS:
+            raise ValueError(f"expected [WIRE_COLS, k] uint32, got {wire.shape} {wire.dtype}")
+        self.raw_lines += raw_lines
+        self.n_skipped += skipped
+        pos = 0
+        k = wire.shape[1]
+        while pos < k:
+            m = min(self.block_rows - self._fill, k - pos)
+            self._buf[:, self._fill : self._fill + m] = wire[:, pos : pos + m]
+            self._fill += m
+            pos += m
+            self.n_rows += m
+            if self._fill == self.block_rows:
+                self._f.write(self._buf.tobytes())
+                self._fill = 0
+
+    def close(self) -> None:
+        if self._f.closed:
+            return
+        if self._fill:
+            self._f.write(np.ascontiguousarray(self._buf[:, : self._fill]).tobytes())
+            self._fill = 0
+        self._f.flush()
+        self._f.seek(0)
+        self._f.write(self._header(final=True))
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+
+    def abort(self) -> None:
+        """Stop without finalizing: the partial-magic header stays, so the
+        file is refused by every reader rather than read short."""
+        if not self._f.closed:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            self.abort()
+        else:
+            self.close()
+
+
+def is_wire_file(path: str) -> bool:
+    """True if ``path`` is a wire file — complete OR partial (cheap sniff).
+
+    Partial files (crashed converts) must count here: routing decides
+    between the text parser and :class:`WireReader`, and a partial file
+    fed to the text parser would silently skip every binary "line" and
+    report a clean empty analysis.  Routing it to WireReader instead
+    surfaces the loud "incomplete wire file" refusal.
+    """
+    try:
+        with open(path, "rb") as f:
+            head = f.read(len(MAGIC))
+            return head == MAGIC or head == MAGIC_PARTIAL
+    except OSError:
+        return False
+
+
+class _WireFile:
+    """One mmap'd wire file, header-validated."""
+
+    def __init__(self, path: str, fp: bytes | None):
+        self.path = path
+        f = open(path, "rb")
+        try:
+            head = f.read(HEADER_BYTES)
+            if len(head) >= len(MAGIC_PARTIAL) and head.startswith(MAGIC_PARTIAL):
+                raise WireFormatError(
+                    f"{path!r} is an incomplete wire file (the convert that "
+                    "wrote it crashed or was aborted); re-run the convert"
+                )
+            if len(head) < HEADER_BYTES or not head.startswith(MAGIC):
+                raise WireFormatError(f"{path!r} is not a wire file (bad magic/header)")
+            (_, self.block_rows, _r, self.n_rows, self.raw_lines,
+             self.n_evals, self.n_skipped, self.fp) = struct.unpack(_HEADER_FMT, head)
+            if self.block_rows < 1:
+                raise WireFormatError(
+                    f"{path!r} has a corrupt header (block_rows == 0)"
+                )
+            if fp is not None and self.fp != fp:
+                raise WireFormatError(
+                    f"{path!r} was converted against a different ruleset "
+                    "(fingerprint mismatch); re-run `ruleset-analyze convert` "
+                    "with the current packed ruleset"
+                )
+            need = HEADER_BYTES + self.n_rows * ROW_BYTES
+            size = os.fstat(f.fileno()).st_size
+            if size < need:
+                raise WireFormatError(
+                    f"{path!r} is truncated: header claims {self.n_rows} rows "
+                    f"({need} bytes) but the file has {size}"
+                )
+            if self.n_rows:
+                self._mm = mmap.mmap(f.fileno(), need, access=mmap.ACCESS_READ)
+            else:
+                self._mm = None
+        finally:
+            f.close()  # mmap keeps its own reference
+
+    def close(self) -> None:
+        if self._mm is not None:
+            self._mm.close()
+            self._mm = None
+
+    def block(self, b: int) -> np.ndarray:
+        """Read-only [WIRE_COLS, r] view of payload block ``b``."""
+        start = b * self.block_rows
+        r = min(self.block_rows, self.n_rows - start)
+        off = HEADER_BYTES + start * ROW_BYTES
+        arr = np.frombuffer(self._mm, dtype=np.uint32, count=WIRE_COLS * r, offset=off)
+        return arr.reshape(WIRE_COLS, r)
+
+    @property
+    def n_blocks(self) -> int:
+        return (self.n_rows + self.block_rows - 1) // self.block_rows if self.n_rows else 0
+
+
+class WireReader:
+    """mmap-backed batch source over one or more wire files.
+
+    ``iter_batches`` re-chunks rows to exactly ``batch_size`` columns.
+    When a request lines up with a stored block (the common case: default
+    block_rows == default batch size and no mid-block resume offset), the
+    yielded array is a zero-copy read-only mmap view — ``device_put``
+    consumes it directly with no host-side copy or transpose.
+    """
+
+    def __init__(self, paths: list[str], packed: PackedRuleset | None = None):
+        fp = ruleset_fingerprint(packed) if packed is not None else None
+        self._files = [_WireFile(p, fp) for p in paths]
+        self.n_rows = sum(f.n_rows for f in self._files)
+        self.raw_lines = sum(f.raw_lines for f in self._files)
+        self.n_evals = sum(f.n_evals for f in self._files)
+        self.n_skipped = sum(f.n_skipped for f in self._files)
+
+    def close(self) -> None:
+        for f in self._files:
+            f.close()
+
+    def iter_batches(
+        self, skip_rows: int, batch_size: int
+    ) -> Iterator[tuple[np.ndarray, int]]:
+        """Yield ``([WIRE_COLS, batch_size] uint32, rows_in_batch)``.
+
+        The final partial batch is zero-padded to ``batch_size`` columns
+        (zero meta == valid bit clear, so padding is masked on device).
+        Raises ResumeInputMismatch if the files hold fewer than
+        ``skip_rows`` rows.
+        """
+        if skip_rows > self.n_rows:
+            from ..errors import ResumeInputMismatch
+
+            raise ResumeInputMismatch(
+                f"snapshot consumed {skip_rows} rows but the wire input has "
+                f"only {self.n_rows}; wrong or truncated input"
+            )
+        pend: np.ndarray | None = None  # partially filled output batch
+        fill = 0
+        to_skip = skip_rows
+        for wf in self._files:
+            if to_skip >= wf.n_rows:
+                to_skip -= wf.n_rows
+                continue
+            b0 = to_skip // wf.block_rows if wf.block_rows else 0
+            to_skip -= b0 * wf.block_rows  # rows in the blocks jumped over
+            for b in range(b0, wf.n_blocks):
+                blk = wf.block(b)
+                if to_skip:
+                    drop = min(to_skip, blk.shape[1])
+                    blk = blk[:, drop:]
+                    to_skip -= drop
+                    if not blk.shape[1]:
+                        continue
+                pos = 0
+                n = blk.shape[1]
+                # zero-copy fast path: a full block, nothing pending
+                if fill == 0 and n == batch_size:
+                    yield blk, n
+                    continue
+                while pos < n:
+                    if pend is None:
+                        pend = np.zeros((WIRE_COLS, batch_size), dtype=np.uint32)
+                    m = min(batch_size - fill, n - pos)
+                    pend[:, fill : fill + m] = blk[:, pos : pos + m]
+                    fill += m
+                    pos += m
+                    if fill == batch_size:
+                        yield pend, fill
+                        pend = None
+                        fill = 0
+        if fill:
+            yield pend, fill
+
+
+def convert_logs(
+    packed: PackedRuleset,
+    log_paths: list[str],
+    out_path: str,
+    *,
+    native: bool | None = None,
+    batch_size: int = DEFAULT_BLOCK_ROWS,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+) -> dict:
+    """Parse text syslog once and write a ``.rawire`` file; return stats.
+
+    Uses the same batch sources as the run path (native C++ parser when
+    available, pure-Python fallback), so the row sequence written is
+    exactly the sequence a text run would feed the device.
+    """
+    from . import fastparse
+
+    use_native = native if native is not None else fastparse.available()
+    if use_native:
+        packer = fastparse.NativePacker(packed)
+        batches = fastparse.batches_from_files(log_paths, packer, batch_size)
+    else:
+        from ..runtime.stream import _iter_files, _TextSource
+
+        src = _TextSource(packed, _iter_files(log_paths))
+        packer = src.packer
+        batches = src.batches(0, batch_size)
+
+    last_skipped = 0
+    with WireWriter(out_path, ruleset_fingerprint(packed), block_rows) as w:
+        for batch, n_raw in batches:
+            skipped = packer.skipped
+            n_valid = int(batch[T_VALID].sum())
+            # evaluation rows are packed densely from column 0
+            w.add(compact_batch(batch[:, :n_valid]), n_raw, skipped - last_skipped)
+            last_skipped = skipped
+    return {
+        "rows": w.n_rows,
+        "raw_lines": w.raw_lines,
+        "evals": w.n_rows,
+        "skipped": w.n_skipped,
+        "bytes": os.path.getsize(out_path),
+        "parser": "native" if use_native else "python",
+    }
+
+
+def sanity_check_valid_bits(wire: np.ndarray) -> tuple[int, int]:
+    """(valid, invalid) row counts of a wire batch (meta bit 23)."""
+    v = int(np.count_nonzero(wire[W_META] & np.uint32(1 << 23)))
+    return v, wire.shape[1] - v

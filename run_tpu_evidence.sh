@@ -2,16 +2,16 @@
 # One-shot TPU evidence capture: headline bench + perf suite configs.
 # Run with NO env overrides (the default env selects the axon TPU).
 # Produces:
-#   BENCH_r03_local.json        headline (self-validating, e2e decomposition)
-#   BENCH_SUITE_r03_tpu.json    exact/pallas/multifw/e2e + accuracy configs
+#   BENCH_r04_local.json        headline (self-validating, e2e decomposition)
+#   BENCH_SUITE_r04_tpu.json    exact/pallas/multifw/e2e + accuracy configs
 set -u
 cd "$(dirname "$0")"
 echo "=== headline bench ===" >&2
 # no outer timeout: bench.py self-bounds (probe 3x60s + 1800s TPU child +
 # 900s CPU fallback) and always emits exactly one JSON line
-python bench.py > BENCH_r03_local.json 2> /tmp/bench_r03.log
+python bench.py > BENCH_r04_local.json 2> /tmp/bench_r04.log
 echo "headline rc=$?" >&2
-tail -3 /tmp/bench_r03.log >&2
+tail -3 /tmp/bench_r04.log >&2
 echo "=== suite (perf configs on TPU) ===" >&2
 timeout 5400 python bench_suite.py exact pallas multifw recall e2e \
     > /tmp/suite_tpu.jsonl 2> /tmp/suite_tpu.log
@@ -22,8 +22,8 @@ n_lines=$(grep -c '^{' /tmp/suite_tpu.jsonl || true)
   echo "{\"note\": \"TPU run (axon tunnel). cms/hll/topk accuracy lines carried from the committed interim artifact (platform-independent).\", \"platform\": \"tpu\", \"suite_rc\": $suite_rc, \"suite_configs_completed\": $n_lines, \"complete\": $([ "$suite_rc" -eq 0 ] && echo true || echo false)}"
   cat /tmp/suite_tpu.jsonl
   grep -E '"config2_|"config3_|"config5_' BENCH_SUITE_r03_interim_cpu.json
-} > BENCH_SUITE_r03_tpu.json
+} > BENCH_SUITE_r04_tpu.json
 if [ "$suite_rc" -ne 0 ]; then
   echo "WARNING: suite incomplete (rc=$suite_rc, $n_lines configs) — artifact is marked partial" >&2
 fi
-echo "wrote BENCH_r03_local.json and BENCH_SUITE_r03_tpu.json" >&2
+echo "wrote BENCH_r04_local.json and BENCH_SUITE_r04_tpu.json" >&2

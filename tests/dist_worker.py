@@ -9,7 +9,9 @@ Usage: dist_worker.py PROC_ID N_PROCS PORT RULESET_PREFIX LOG_PATH OUT_PREFIX
 
 MODE (requires CKPT_DIR): "crash" checkpoints every 2 chunks and aborts
 after 3; "resume" resumes from the checkpoint and runs to completion;
-"stacked" (CKPT_DIR ignored, pass "-") runs the stacked layout.
+"stacked" (CKPT_DIR ignored, pass "-") runs the stacked layout;
+"stacked-crash"/"stacked-resume" are the checkpointed stacked variants
+(collective flush-barrier snapshots).
 """
 
 import json
@@ -41,10 +43,10 @@ def main() -> int:
             if ckpt_dir and ckpt_dir != "-"
             else {}
         ),
-        resume=(mode == "resume"),
-        layout="stacked" if mode in ("stacked", "stacked-abort") else "flat",
+        resume=mode in ("resume", "stacked-resume"),
+        layout="stacked" if mode and mode.startswith("stacked") else "flat",
     )
-    max_chunks = {"crash": 3, "stacked-abort": 2}.get(mode)
+    max_chunks = {"crash": 3, "stacked-abort": 2, "stacked-crash": 3}.get(mode)
     report, regs = run_stream_file_distributed(
         packed, [log_path], cfg, return_state=True, max_chunks=max_chunks
     )
