@@ -384,57 +384,88 @@ def bench_pallas() -> dict:
 
 
 def bench_recall() -> dict:
-    """Sketch-only mode at scale: >=1e7 packed lines, exact_counts=False.
+    """Sketch-only recall certification at 1e8 lines (VERDICT r3 #7).
 
     The BASELINE.md accuracy north star ("exact counts replaced by CMS,
-    >=99% unused-ACL recall vs the exact run") demonstrated beyond toy
-    scale: the same 10.5M-line packed stream runs once with exact counts
-    (the ground truth) and once sketch-only, both through the production
-    stream driver, at a geometry the register-memory guard accepts.
+    >=99% unused-ACL recall vs the exact run") demonstrated at the scale
+    where CMS load factors actually stress: ~1e8 packed lines (1e6 on the
+    CPU fallback so the config still completes anywhere) over a 1k-key
+    ruleset, swept across CMS widths.  One exact run is the ground truth;
+    each geometry then runs sketch-only through the production stream
+    driver, giving the committed recall CURVE plus the recommended
+    geometry per ruleset size.
     """
+    import jax
+
     from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
     from ruleset_analysis_tpu.hostside.oracle import unused_rule_recall
     from ruleset_analysis_tpu.models.pipeline import register_bytes
     from ruleset_analysis_tpu.runtime.stream import run_stream_packed
 
-    packed = _setup(n_acls=4, rules_per_acl=64)
-    n_chunks_, chunk = 10, 1 << 20  # 10.5M lines
+    on_tpu = jax.devices()[0].platform == "tpu"
+    packed = _setup(n_acls=8, rules_per_acl=128)  # 1024 rule keys + denies
+    chunk = 1 << 20
+    n_chunks_ = 96 if on_tpu else 1  # 100.7M lines on TPU; 1M CPU fallback
     feeds = [np.ascontiguousarray(_tuples(packed, chunk, seed=100 + i).T)
              for i in range(2)]
+    total = n_chunks_ * chunk
+    log(f"recall: {packed.n_keys} keys, {total} lines, tpu={on_tpu}")
 
     def arrays():
         for i in range(n_chunks_):
             yield feeds[i % len(feeds)]
 
-    cfg = AnalysisConfig(
-        batch_size=chunk,
-        sketch=SketchConfig(cms_width=1 << 14, cms_depth=4, hll_p=8),
-    )
+    def cfg_for(width: int, depth: int, exact: bool) -> AnalysisConfig:
+        return AnalysisConfig(
+            batch_size=chunk,
+            sketch=SketchConfig(cms_width=width, cms_depth=depth, hll_p=8),
+            exact_counts=exact,
+        )
+
     t0 = time.perf_counter()
-    rep_exact = run_stream_packed(packed, arrays(), cfg)
+    rep_exact = run_stream_packed(packed, arrays(), cfg_for(1 << 14, 4, True))
     t_exact = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    rep_sketch = run_stream_packed(packed, arrays(), cfg.replace(exact_counts=False))
-    t_sketch = time.perf_counter() - t0
-    recall = unused_rule_recall(rep_exact.unused, rep_sketch.unused)
-    # no false "unused" claims either: a rule the exact run saw hit must
-    # never be reported unused by the sketch (CMS error is one-sided)
-    false_unused = [k for k in rep_sketch.unused if k not in set(rep_exact.unused)]
-    return {
-        "metric": "recall_sketch_only_unused_vs_exact_10M_lines",
-        "value": round(recall, 4),
-        "unit": "recall",
-        "vs_baseline": round(recall / 0.99, 4),
-        "detail": {
-            "lines": n_chunks_ * chunk,
-            "exact_unused": len(rep_exact.unused),
-            "sketch_unused": len(rep_sketch.unused),
+    exact_unused = rep_exact.unused
+
+    sweep = []
+    for width, depth in [(1 << 12, 4), (1 << 14, 4), (1 << 16, 4)]:
+        cfg = cfg_for(width, depth, False)
+        t0 = time.perf_counter()
+        rep = run_stream_packed(packed, arrays(), cfg)
+        dt = time.perf_counter() - t0
+        recall = unused_rule_recall(exact_unused, rep.unused)
+        # CMS error is one-sided: a rule with real hits can never estimate
+        # zero, so false "unused" claims must be structurally absent
+        false_unused = [k for k in rep.unused if k not in set(exact_unused)]
+        rb = sum(register_bytes(packed.n_keys, cfg).values())
+        sweep.append({
+            "width": width, "depth": depth,
+            "recall_unused": round(recall, 4),
             "false_unused": len(false_unused),
-            "register_bytes": register_bytes(packed.n_keys, cfg),
+            "register_bytes": rb,
+            "lines_per_sec": round(total / dt, 1),
+        })
+        log(f"recall w={width} d={depth}: {recall:.4f} "
+            f"({total / dt:.0f} lines/s)")
+    meets = [s for s in sweep if s["recall_unused"] >= 0.99]
+    recommended = min(meets, key=lambda s: s["register_bytes"]) if meets else None
+    headline = next(s for s in sweep if s["width"] == 1 << 14)
+    return {
+        "metric": f"recall_sketch_only_unused_vs_exact_{total // 1_000_000}M_lines",
+        "value": headline["recall_unused"],
+        "unit": "recall",
+        "vs_baseline": round(headline["recall_unused"] / 0.99, 4),
+        "detail": {
+            "lines": total,
+            "n_keys": packed.n_keys,
+            "exact_unused": len(exact_unused),
             "exact_run_sec": round(t_exact, 1),
-            "sketch_run_sec": round(t_sketch, 1),
-            "exact_lines_per_sec": round(n_chunks_ * chunk / t_exact, 1),
-            "sketch_lines_per_sec": round(n_chunks_ * chunk / t_sketch, 1),
+            "exact_lines_per_sec": round(total / t_exact, 1),
+            "sweep": sweep,
+            # smallest geometry meeting the >=99% north star for this
+            # ruleset size — the documented recommendation
+            "recommended_geometry": recommended,
+            "platform": "tpu" if on_tpu else "cpu",
         },
     }
 

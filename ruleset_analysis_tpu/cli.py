@@ -284,6 +284,16 @@ def _cmd_convert(args: argparse.Namespace) -> int:
     if args.block_rows < 1:
         print("error: --block-rows must be >= 1", file=sys.stderr)
         return 2
+    already = [p for p in args.logs if wire.is_wire_file(p)]
+    if already:
+        # a shell glob catching *.rawire must not "convert" binary data
+        # through the text parser into a valid-but-empty wire file
+        print(
+            f"error: {already[0]!r} is already a wire file; convert takes "
+            "text syslog inputs",
+            file=sys.stderr,
+        )
+        return 2
     packed = pack.load_packed(args.ruleset)
     stats = wire.convert_logs(
         packed,

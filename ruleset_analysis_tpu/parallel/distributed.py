@@ -26,17 +26,29 @@ def init_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    heartbeat_timeout_seconds: int | None = None,
 ) -> None:
     """Join (or bootstrap) the multi-host cluster.
 
     With no arguments, relies on the environment (TPU pod metadata / the
     launcher's JAX_COORDINATOR_* variables), which is how TPU pods
     normally initialize.
+
+    ``heartbeat_timeout_seconds`` bounds dead-peer detection: when a
+    process dies mid-job, the coordinator declares it missing after this
+    long and every surviving process's pending collective aborts with an
+    error instead of hanging — the rebuilt analog of YARN failing a job
+    whose task died (SURVEY.md §6 failure detection).  None keeps JAX's
+    default (100s).
     """
+    kw = {}
+    if heartbeat_timeout_seconds is not None:
+        kw["heartbeat_timeout_seconds"] = heartbeat_timeout_seconds
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        **kw,
     )
 
 

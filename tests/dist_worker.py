@@ -11,7 +11,9 @@ MODE (requires CKPT_DIR): "crash" checkpoints every 2 chunks and aborts
 after 3; "resume" resumes from the checkpoint and runs to completion;
 "stacked" (CKPT_DIR ignored, pass "-") runs the stacked layout;
 "stacked-crash"/"stacked-resume" are the checkpointed stacked variants
-(collective flush-barrier snapshots).
+(collective flush-barrier snapshots); "die" joins the cluster then exits
+abruptly (dead-peer failure surface — the survivors must error out in
+bounded time, not hang).
 """
 
 import json
@@ -26,7 +28,22 @@ def main() -> int:
 
     from ruleset_analysis_tpu.parallel.distributed import init_distributed
 
-    init_distributed(f"127.0.0.1:{port}", n_procs, proc_id)
+    init_distributed(
+        f"127.0.0.1:{port}",
+        n_procs,
+        proc_id,
+        # kill-test runs bound dead-peer detection so the survivor's
+        # failure is provably bounded-time, not a hang
+        heartbeat_timeout_seconds=10 if mode in ("die", "survivor") else None,
+    )
+
+    if mode == "die":
+        import os
+
+        # abrupt death AFTER joining the cluster (no atexit, no shutdown
+        # handshake) — the most hostile failure the coordinator can see
+        print(f"worker {proc_id} dying abruptly", file=sys.stderr, flush=True)
+        os._exit(3)
 
     import numpy as np
 

@@ -323,3 +323,19 @@ def test_cli_convert_block_rows_validation(corpus, tmp_path, capsys):
                "--out", str(tmp_path / "x.rawire"), "--block-rows", "0"])
     assert rc == 2
     assert "block-rows" in capsys.readouterr().err
+
+
+def test_cli_convert_refuses_wire_input(corpus, tmp_path, capsys):
+    """convert over an existing .rawire must be refused, not laundered
+    through the text parser into a valid empty file (code-review finding)."""
+    from ruleset_analysis_tpu.cli import main
+
+    packed, _rs, logs, _lines = corpus
+    prefix = str(tmp_path / "rs")
+    pack.save_packed(packed, prefix)
+    out = str(tmp_path / "a.rawire")
+    assert main(["convert", "--ruleset", prefix, "--logs", *logs, "--out", out]) == 0
+    rc = main(["convert", "--ruleset", prefix, "--logs", out,
+               "--out", str(tmp_path / "b.rawire")])
+    assert rc == 2
+    assert "already a wire file" in capsys.readouterr().err

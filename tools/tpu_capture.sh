@@ -1,0 +1,82 @@
+#!/bin/bash
+# Resilient TPU-evidence capture (VERDICT r4 #1: make capture automatic).
+#
+# The axon tunnel comes and goes, and a process killed mid-TPU-operation
+# can wedge it for everyone (see .claude/skills/verify gotchas).  So this
+# loop never trusts a single long run:
+#   1. probe the backend in a BOUNDED subprocess;
+#   2. when it answers, run each outstanding suite config in its own
+#      bounded subprocess, banking each result as it lands;
+#   3. reassemble BENCH_SUITE_r04_tpu.json from everything banked so far
+#      after every config — a later wedge can't lose earlier evidence;
+#   4. sleep and repeat until every config is banked.
+#
+# Run detached:  setsid nohup tools/tpu_capture.sh > /tmp/tpu_capture.log 2>&1 &
+# State lives in $BANK; artifacts land at the repo root (committed by the
+# build session or, failing that, by the driver's end-of-round commit).
+set -u
+cd "$(dirname "$0")/.."
+BANK=${BANK:-/tmp/tpu_bank_r04}
+CONFIGS=(exact pallas multifw recall e2e)
+PER_CONFIG_TIMEOUT=${PER_CONFIG_TIMEOUT:-2700}
+PROBE_TIMEOUT=${PROBE_TIMEOUT:-90}
+SLEEP_BETWEEN=${SLEEP_BETWEEN:-300}
+mkdir -p "$BANK"
+
+probe() {
+    timeout "$PROBE_TIMEOUT" python - << 'EOF' > /dev/null 2>&1
+import jax
+assert jax.devices()[0].platform == "tpu"
+EOF
+}
+
+assemble() {
+    local n_done=0 total=${#CONFIGS[@]}
+    for c in "${CONFIGS[@]}"; do
+        [ -s "$BANK/$c.jsonl" ] && n_done=$((n_done + 1))
+    done
+    local complete=false
+    [ "$n_done" -eq "$total" ] && complete=true
+    {
+        echo "{\"note\": \"TPU run (axon tunnel), captured per-config by tools/tpu_capture.sh. cms/hll/topk accuracy lines carried from the committed interim artifact (platform-independent).\", \"platform\": \"tpu\", \"suite_configs_completed\": $n_done, \"suite_configs_total\": $total, \"complete\": $complete}"
+        for c in "${CONFIGS[@]}"; do
+            [ -s "$BANK/$c.jsonl" ] && cat "$BANK/$c.jsonl"
+        done
+        grep -E '"config2_|"config3_|"config5_' BENCH_SUITE_r03_interim_cpu.json
+    } > BENCH_SUITE_r04_tpu.json
+    echo "assembled BENCH_SUITE_r04_tpu.json ($n_done/$total configs)" >&2
+}
+
+while true; do
+    outstanding=()
+    for c in "${CONFIGS[@]}"; do
+        [ -s "$BANK/$c.jsonl" ] || outstanding+=("$c")
+    done
+    if [ ${#outstanding[@]} -eq 0 ]; then
+        echo "$(date -u +%T) all configs banked; done" >&2
+        assemble
+        exit 0
+    fi
+    if probe; then
+        echo "$(date -u +%T) probe ok; outstanding: ${outstanding[*]}" >&2
+        for c in "${outstanding[@]}"; do
+            echo "$(date -u +%T) running config $c" >&2
+            if timeout "$PER_CONFIG_TIMEOUT" python bench_suite.py "$c" \
+                    > "$BANK/$c.tmp" 2> "$BANK/$c.log"; then
+                if grep -q '^{' "$BANK/$c.tmp"; then
+                    grep '^{' "$BANK/$c.tmp" > "$BANK/$c.jsonl"
+                    echo "$(date -u +%T) banked $c" >&2
+                    assemble
+                else
+                    echo "$(date -u +%T) $c produced no JSON line" >&2
+                fi
+            else
+                echo "$(date -u +%T) $c failed/timed out (rc=$?); tunnel may be wedged" >&2
+                break  # re-probe before burning time on the rest
+            fi
+        done
+    else
+        echo "$(date -u +%T) probe failed (tunnel down); sleeping ${SLEEP_BETWEEN}s" >&2
+    fi
+    sleep "$SLEEP_BETWEEN"
+done
