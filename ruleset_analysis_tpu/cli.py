@@ -355,7 +355,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--inventory", default=None, metavar="FILE",
                    help="'name = source' lines; source is a config file path "
                         "or cmd:<shell command> whose stdout is the config "
-                        "(default: config.FIREWALLS)")
+                        "(default: config.FIREWALLS). cmd: sources run "
+                        "through the shell — the inventory file must be "
+                        "trusted like a shell script")
     p.add_argument("--out", required=True, help="output path prefix")
     p.add_argument("--lenient", action="store_true",
                    help="skip-and-count unsupported entries (see parse-acls)")

@@ -22,6 +22,13 @@ from .aclparse import AclParseError, Ruleset, parse_asa_config
 def obtain_config(source: str, timeout: float = 60.0) -> str:
     """Configuration text for one inventory source (file or cmd:...).
 
+    TRUST BOUNDARY: ``cmd:`` sources run through the shell verbatim
+    (pipelines and ssh option strings are the point of the feature, as in
+    the reference's fetch-from-device design), so an inventory file is
+    executable configuration — treat it like a shell script.  Only point
+    ``--inventory`` at operator-controlled files; never at files writable
+    by untrusted users.
+
     Both arms decode permissively (device banners love stray bytes) and
     every failure mode — nonzero exit, hang past ``timeout`` — surfaces
     as :class:`AclParseError` so the CLI reports it cleanly.

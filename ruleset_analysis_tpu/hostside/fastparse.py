@@ -414,10 +414,12 @@ def batches_from_files(
                 fill()
         # ---- stream batches
         # Buffer until batch_size COMPLETE lines are held (not merely
-        # read_block bytes): every mid-stream batch must hold exactly
-        # batch_size raw lines so chunk boundaries — and therefore
-        # per-chunk top-K candidates and resume offsets — land exactly
-        # where the pure-Python text path puts them.
+        # read_block bytes), then close each batch line-atomically: at
+        # most batch_size raw lines AND at most batch_size tuple rows —
+        # with out-direction bindings a dual-evaluation line can close a
+        # batch early, exactly as _TextSource does, so chunk boundaries —
+        # and therefore per-chunk top-K candidates and resume offsets —
+        # land exactly where the pure-Python text path puts them.
         while True:
             while not eof and nl < batch_size:
                 fill()

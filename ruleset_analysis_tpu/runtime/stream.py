@@ -352,8 +352,11 @@ def run_stream_file(
     shards (hostside.feeder) — the multi-core input-split tier.  Chunk
     boundaries then follow raw-line counts only (a dual-evaluation line
     never closes a batch early; the grouped batch is 2x wide instead), so
-    per-chunk candidates may differ from the sequential path, but every
-    register — and therefore the report — is identical.
+    per-chunk candidates may differ from the sequential path.  Registers,
+    per-rule counts, and the unused set are identical either way
+    (order-invariant mergeable state); the top-K talker section is the
+    one approximation whose candidate pool is chunk-boundary-sensitive,
+    so borderline talkers can differ between feeder and sequential runs.
     """
     from ..hostside import fastparse
 
