@@ -301,6 +301,7 @@ def _cmd_convert(args: argparse.Namespace) -> int:
         args.out,
         native=args.native_parse,
         block_rows=args.block_rows,
+        feed_workers=args.feed_workers,
     )
     mb = stats["bytes"] / 1e6
     print(
@@ -432,6 +433,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--block-rows", type=int, default=1 << 16, metavar="N",
                    help="rows per payload block; match the run --batch-size "
                         "for the zero-copy mmap read path (default 65536)")
+    p.add_argument("--feed-workers", type=int, default=0, metavar="N",
+                   help="parse with N worker processes (multi-core one-time "
+                        "conversion; output is byte-identical; 0/1 = off)")
     p.set_defaults(fn=_cmd_convert)
 
     p = sub.add_parser("synth", help="generate synthetic config + syslog")
@@ -455,6 +459,11 @@ def main(argv: list[str] | None = None) -> int:
     except errors.AnalysisError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    except ValueError as e:
+        # bad argument combinations surfaced by library-level validation
+        # (e.g. convert feed_workers with native=False)
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

@@ -348,33 +348,52 @@ def convert_logs(
     native: bool | None = None,
     batch_size: int = DEFAULT_BLOCK_ROWS,
     block_rows: int = DEFAULT_BLOCK_ROWS,
+    feed_workers: int = 0,
 ) -> dict:
     """Parse text syslog once and write a ``.rawire`` file; return stats.
 
     Uses the same batch sources as the run path (native C++ parser when
-    available, pure-Python fallback), so the row sequence written is
-    exactly the sequence a text run would feed the device.
+    available, pure-Python fallback, or the multi-process feeder with
+    ``feed_workers > 1``), so the row sequence written is exactly the
+    sequence a text run would feed the device — the output file is
+    byte-identical across all three parse tiers (chunk boundaries differ
+    between tiers, but the file stores only the row stream).
     """
     from . import fastparse
 
-    use_native = native if native is not None else fastparse.available()
-    if use_native:
-        packer = fastparse.NativePacker(packed)
-        batches = fastparse.batches_from_files(log_paths, packer, batch_size)
-    else:
-        from ..runtime.stream import _iter_files, _TextSource
+    if feed_workers and feed_workers > 1:
+        if native is False:
+            raise ValueError(
+                "feed_workers requires the native parser; drop native=False"
+            )
+        from .feeder import ParallelFeeder
 
-        src = _TextSource(packed, _iter_files(log_paths))
+        src = ParallelFeeder(packed, log_paths, n_workers=feed_workers)
         packer = src.packer
         batches = src.batches(0, batch_size)
+        parser_name = f"native-feeder-x{feed_workers}"
+    else:
+        use_native = native if native is not None else fastparse.available()
+        if use_native:
+            packer = fastparse.NativePacker(packed)
+            batches = fastparse.batches_from_files(log_paths, packer, batch_size)
+        else:
+            from ..runtime.stream import _iter_files, _TextSource
+
+            src = _TextSource(packed, _iter_files(log_paths))
+            packer = src.packer
+            batches = src.batches(0, batch_size)
+        parser_name = "native" if use_native else "python"
 
     last_skipped = 0
     with WireWriter(out_path, ruleset_fingerprint(packed), block_rows) as w:
         for batch, n_raw in batches:
             skipped = packer.skipped
-            n_valid = int(batch[T_VALID].sum())
-            # evaluation rows are packed densely from column 0
-            w.add(compact_batch(batch[:, :n_valid]), n_raw, skipped - last_skipped)
+            # keep only evaluation rows, wherever the source put them
+            # (every current source packs them densely from column 0, but
+            # the mask keeps this correct for any conforming source)
+            valid = batch[:, batch[T_VALID] == 1]
+            w.add(compact_batch(valid), n_raw, skipped - last_skipped)
             last_skipped = skipped
     return {
         "rows": w.n_rows,
@@ -382,7 +401,7 @@ def convert_logs(
         "evals": w.n_rows,
         "skipped": w.n_skipped,
         "bytes": os.path.getsize(out_path),
-        "parser": "native" if use_native else "python",
+        "parser": parser_name,
     }
 
 

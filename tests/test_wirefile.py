@@ -339,3 +339,27 @@ def test_cli_convert_refuses_wire_input(corpus, tmp_path, capsys):
                "--out", str(tmp_path / "b.rawire")])
     assert rc == 2
     assert "already a wire file" in capsys.readouterr().err
+
+
+def test_convert_feed_workers_byte_identical(corpus, tmp_path):
+    """Multi-process conversion writes the byte-identical file: chunk
+    boundaries differ between parse tiers but the row stream does not."""
+    from ruleset_analysis_tpu.hostside import fastparse
+
+    if not fastparse.available():
+        pytest.skip("native parser not buildable here")
+    packed, _rs, logs, _lines = corpus
+    seq = str(tmp_path / "seq.rawire")
+    par = str(tmp_path / "par.rawire")
+    s1 = wire.convert_logs(packed, logs, seq, block_rows=128)
+    s2 = wire.convert_logs(packed, logs, par, block_rows=128, feed_workers=2)
+    assert s2["parser"] == "native-feeder-x2"
+    assert s1["raw_lines"] == s2["raw_lines"]
+    assert open(seq, "rb").read() == open(par, "rb").read()
+
+
+def test_convert_feed_workers_native_false_refused(corpus, tmp_path):
+    packed, _rs, logs, _lines = corpus
+    with pytest.raises(ValueError, match="native"):
+        wire.convert_logs(packed, logs, str(tmp_path / "x.rawire"),
+                          native=False, feed_workers=2)
