@@ -383,6 +383,193 @@ def bench_pallas() -> dict:
     }
 
 
+def bench_stage() -> dict:
+    """Device-step stage attribution: where do the milliseconds go?
+
+    The round-4 headline runs at ~4% of the u32 VPU roofline, so the step
+    is NOT bounded by the predicate math — this config times each piece of
+    the fused step in isolation (match kernel, exact-counts scatter, a
+    one-hot-matmul counts alternative, HLL scatter-max, talker update,
+    full step) to show which register update to attack next.  Timing
+    discipline matches runtime/timing.py: the warmup dispatch is closed
+    by a host fetch before the clock starts, every iteration's carry
+    depends on the previous one (no pipelined elision of the chain), the
+    window closes with a host fetch of the carry, and every stage
+    validates the fetched value against an independently computed
+    expectation — a window whose work did not run fails loudly instead of
+    reporting a plausible number (the round-2 9x-roofline lesson).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+    from ruleset_analysis_tpu.hostside import pack as pack_mod
+    from ruleset_analysis_tpu.models import pipeline
+    from ruleset_analysis_tpu.ops import cms as cms_ops
+    from ruleset_analysis_tpu.ops import counts as count_ops
+    from ruleset_analysis_tpu.ops import hll as hll_ops
+    from ruleset_analysis_tpu.ops import topk as topk_ops
+    from ruleset_analysis_tpu.ops.match import match_keys
+    from ruleset_analysis_tpu.runtime.timing import timed_validated_steps
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    b = 1 << 20 if on_tpu else 1 << 16
+    iters = 20 if on_tpu else 5
+    packed = _setup(n_acls=4, rules_per_acl=64)
+    n_keys = packed.n_keys
+    tup = _tuples(packed, b, seed=3)
+    wire = jnp.asarray(pack_mod.compact_batch(np.ascontiguousarray(tup.T)))
+    rules = pipeline.ship_ruleset(packed)
+    cols, valid = pipeline.batch_cols(wire)
+    keys0 = jax.block_until_ready(
+        match_keys(cols, rules.rules, rules.deny_key)
+    )
+    src, acl = cols["src"], cols["acl"]
+    n_valid = int(jax.device_get(valid.astype(jnp.uint32).sum()))
+    # exact host-side expectations (device sums are u32 and wrap mod 2^32)
+    keys_sum = int(np.asarray(jax.device_get(keys0), dtype=np.uint64).sum() % (1 << 32))
+
+    u32 = jnp.uint32
+    M = 1 << 32
+
+    def timed(name, init_carry, one_iter, validate):
+        """Chained-carry window: warmup closed by a fetch, then timed."""
+        f = jax.jit(one_iter)
+        jax.device_get(jax.tree_util.tree_leaves(f(init_carry))[0])
+        carry = init_carry
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            carry = f(carry)
+        final = jax.device_get(carry)  # closes the window
+        dt = time.perf_counter() - t0
+        validate(final)
+        ms = dt / iters * 1e3
+        log(f"stage {name}: {ms:.2f} ms/iter")
+        return round(ms, 3)
+
+    def expect_scalar(expected, what):
+        def check(final):
+            got = int(np.asarray(final).reshape(())) 
+            if got != expected:
+                raise AssertionError(
+                    f"stage window invalid: {what} carry {got} != {expected}"
+                )
+        return check
+
+    results = {}
+
+    # match kernel only: each iteration folds the (recomputed) key sum
+    # into the carry, so iteration i+1 cannot issue before i finished
+    results["match_ms"] = timed(
+        "match",
+        u32(0),
+        lambda c: c + match_keys(cols, rules.rules, rules.deny_key).sum(dtype=u32),
+        expect_scalar(iters * keys_sum % M, "match key-sum"),
+    )
+
+    # exact-counts scatter-add ([B] -> [n_keys]); per-iter sum == n_valid
+    results["counts_scatter_ms"] = timed(
+        "counts-scatter",
+        u32(0),
+        lambda c: c + count_ops.segment_counts(keys0, valid, n_keys).sum(dtype=u32),
+        expect_scalar(iters * n_valid % M, "counts total"),
+    )
+
+    # one-hot matmul alternative: [B] f32 @ [B, n_keys] one-hot -> [K];
+    # exact for per-chunk counts (every product 0/1, sums < 2^24)
+    iota = jnp.arange(n_keys, dtype=u32)
+
+    def counts_matmul(keys):
+        onehot = (keys[:, None] == iota[None, :]).astype(jnp.float32)
+        return jnp.dot(valid.astype(jnp.float32), onehot).astype(u32)
+
+    results["counts_matmul_ms"] = timed(
+        "counts-matmul",
+        u32(0),
+        lambda c: c + counts_matmul(keys0).sum(dtype=u32),
+        expect_scalar(iters * n_valid % M, "matmul counts total"),
+    )
+
+    # parity: the matmul path must produce the exact scatter counts
+    c_sc = jax.device_get(count_ops.segment_counts(keys0, valid, n_keys))
+    c_mm = jax.device_get(counts_matmul(keys0))
+    if not np.array_equal(c_sc, c_mm):
+        raise AssertionError("one-hot matmul counts != scatter counts")
+
+    # HLL scatter-max ([B] -> [n_keys, m]).  Max-updates are idempotent,
+    # so iterations past the first change nothing; the carry chain still
+    # forces each scatter to execute, and the fixed point is the check.
+    hll0 = hll_ops.hll_init(n_keys, 8)
+    hll1 = jax.device_get(hll_ops.hll_update(hll0, keys0, src, valid))
+
+    def check_hll(final):
+        if not np.array_equal(final, hll1):
+            raise AssertionError("stage window invalid: hll != 1-step fixed point")
+
+    results["hll_ms"] = timed(
+        "hll", hll0, lambda h: hll_ops.hll_update(h, keys0, src, valid), check_hll
+    )
+
+    # talker candidate update: additive, so final sum == iters x 1-step sum
+    sk = SketchConfig()
+    tcms = cms_ops.cms_init(sk.cms_width, sk.talk_cms_depth)
+    d1 = int(np.asarray(jax.device_get(
+        topk_ops.talker_chunk_update(tcms, acl, src, valid, 10, salt=0)[0]
+    ), dtype=np.uint64).sum())
+
+    def step_talk(t):
+        new, _ca, _cs, _ce = topk_ops.talker_chunk_update(t, acl, src, valid, 10, salt=0)
+        return new
+
+    def check_talk(final):
+        got = int(np.asarray(final, dtype=np.uint64).sum())
+        if got != iters * d1:
+            raise AssertionError(
+                f"stage window invalid: talker sum {got} != {iters * d1}"
+            )
+
+    results["talker_ms"] = timed("talker", tcms, step_talk, check_talk)
+
+    # full fused step, via the SHARED counts-validated helper
+    import functools
+
+    full_step = jax.jit(
+        functools.partial(pipeline.analysis_step, n_keys=n_keys, topk_k=10, salt=0),
+        donate_argnums=(0,),  # same discipline as bench_exact: no state copy
+    )
+    state = pipeline.init_state(n_keys, AnalysisConfig(sketch=sk))
+    state, _ = full_step(state, rules, wire)  # warmup
+    pipeline.counts_total(state)  # close warmup with the counts fetch
+    state, dt, delta, expect = timed_validated_steps(
+        full_step, state, rules, [wire], [n_valid], iters
+    )
+    if delta != expect:
+        raise AssertionError(f"full-step window invalid: {delta} != {expect}")
+    results["full_step_ms"] = round(dt / iters * 1e3, 3)
+    log(f"stage full: {results['full_step_ms']:.2f} ms/iter")
+
+    results["unattributed_ms"] = round(
+        results["full_step_ms"] - (
+            results["match_ms"] + results["counts_scatter_ms"]
+            + results["hll_ms"] + results["talker_ms"]
+        ), 3,
+    )
+    results["batch"] = b
+    results["iters"] = iters
+    results["n_keys"] = n_keys
+    results["platform"] = "tpu" if on_tpu else "cpu"
+    results["counts_matmul_speedup"] = round(
+        results["counts_scatter_ms"] / max(results["counts_matmul_ms"], 1e-9), 2
+    )
+    return {
+        "metric": "stage_full_step_ms",
+        "value": results["full_step_ms"],
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "detail": results,
+    }
+
+
 def bench_recall() -> dict:
     """Sketch-only recall certification at 1e8 lines (VERDICT r3 #7).
 
@@ -518,6 +705,7 @@ def bench_e2e() -> dict:
 
 
 BENCHES = {
+    "stage": bench_stage,
     "exact": bench_exact,
     "cms": bench_cms,
     "hll": bench_hll,

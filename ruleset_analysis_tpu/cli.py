@@ -295,14 +295,20 @@ def _cmd_convert(args: argparse.Namespace) -> int:
         )
         return 2
     packed = pack.load_packed(args.ruleset)
-    stats = wire.convert_logs(
-        packed,
-        args.logs,
-        args.out,
-        native=args.native_parse,
-        block_rows=args.block_rows,
-        feed_workers=args.feed_workers,
-    )
+    try:
+        stats = wire.convert_logs(
+            packed,
+            args.logs,
+            args.out,
+            native=args.native_parse,
+            block_rows=args.block_rows,
+            feed_workers=args.feed_workers,
+        )
+    except ValueError as e:
+        # argument-combination validation from the library (keeps real
+        # bugs elsewhere as tracebacks — only the convert call is guarded)
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     mb = stats["bytes"] / 1e6
     print(
         f"wrote {args.out}: {stats['rows']} evaluation rows from "
@@ -459,11 +465,6 @@ def main(argv: list[str] | None = None) -> int:
     except errors.AnalysisError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
-    except ValueError as e:
-        # bad argument combinations surfaced by library-level validation
-        # (e.g. convert feed_workers with native=False)
-        print(f"error: {e}", file=sys.stderr)
-        return 2
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

@@ -17,7 +17,7 @@
 set -u
 cd "$(dirname "$0")/.."
 BANK=${BANK:-/tmp/tpu_bank_r04}
-CONFIGS=(exact pallas multifw recall e2e)
+CONFIGS=(exact pallas multifw recall e2e stage)
 PER_CONFIG_TIMEOUT=${PER_CONFIG_TIMEOUT:-2700}
 PROBE_TIMEOUT=${PROBE_TIMEOUT:-90}
 SLEEP_BETWEEN=${SLEEP_BETWEEN:-300}
