@@ -510,25 +510,43 @@ def bench_stage() -> dict:
         "hll", hll0, lambda h: hll_ops.hll_update(h, keys0, src, valid), check_hll
     )
 
-    # talker candidate update: additive, so final sum == iters x 1-step sum
+    # talker update INCLUDING candidate extraction: the candidates must be
+    # live outputs of the chain or XLA dead-code-eliminates the per-chunk
+    # top-k selection that the real step pays for
     sk = SketchConfig()
     tcms = cms_ops.cms_init(sk.cms_width, sk.talk_cms_depth)
     d1 = int(np.asarray(jax.device_get(
         topk_ops.talker_chunk_update(tcms, acl, src, valid, 10, salt=0)[0]
     ), dtype=np.uint64).sum())
 
-    def step_talk(t):
-        new, _ca, _cs, _ce = topk_ops.talker_chunk_update(t, acl, src, valid, 10, salt=0)
-        return new
+    def step_talk(carry):
+        t, acc = carry
+        new, _ca, _cs, ce = topk_ops.talker_chunk_update(t, acl, src, valid, 10, salt=0)
+        return new, acc + ce.sum(dtype=u32)
+
+    # candidate estimates evolve with the accumulating cms, so the acc
+    # expectation comes from an untimed replay of the same chain; the cms
+    # sum (additive: iters x one-step delta) is the independent anchor
+    pre = jax.jit(step_talk)
+    c = (tcms, u32(0))
+    for _ in range(iters):
+        c = pre(c)
+    expected_acc = int(np.asarray(jax.device_get(c[1])).reshape(()))
 
     def check_talk(final):
-        got = int(np.asarray(final, dtype=np.uint64).sum())
+        t_final, acc = final
+        got = int(np.asarray(t_final, dtype=np.uint64).sum())
         if got != iters * d1:
             raise AssertionError(
                 f"stage window invalid: talker sum {got} != {iters * d1}"
             )
+        got_acc = int(np.asarray(acc).reshape(()))
+        if got_acc != expected_acc:
+            raise AssertionError(
+                f"stage window invalid: candidate sum {got_acc} != {expected_acc}"
+            )
 
-    results["talker_ms"] = timed("talker", tcms, step_talk, check_talk)
+    results["talker_ms"] = timed("talker", (tcms, u32(0)), step_talk, check_talk)
 
     # full fused step, via the SHARED counts-validated helper
     import functools

@@ -295,20 +295,14 @@ def _cmd_convert(args: argparse.Namespace) -> int:
         )
         return 2
     packed = pack.load_packed(args.ruleset)
-    try:
-        stats = wire.convert_logs(
-            packed,
-            args.logs,
-            args.out,
-            native=args.native_parse,
-            block_rows=args.block_rows,
-            feed_workers=args.feed_workers,
-        )
-    except ValueError as e:
-        # argument-combination validation from the library (keeps real
-        # bugs elsewhere as tracebacks — only the convert call is guarded)
-        print(f"error: {e}", file=sys.stderr)
-        return 2
+    stats = wire.convert_logs(
+        packed,
+        args.logs,
+        args.out,
+        native=args.native_parse,
+        block_rows=args.block_rows,
+        feed_workers=args.feed_workers,
+    )
     mb = stats["bytes"] / 1e6
     print(
         f"wrote {args.out}: {stats['rows']} evaluation rows from "
@@ -465,6 +459,19 @@ def main(argv: list[str] | None = None) -> int:
     except errors.AnalysisError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    except ValueError as e:
+        # User-reachable library validation (corrupt packed-ruleset files,
+        # bad distributed divisibility, malformed wire arrays) surfaces as
+        # ValueError; a CLI should report it cleanly, not traceback.  The
+        # trade-off (a genuine bug raising ValueError also loses its
+        # traceback) is accepted for the operator-facing tool; run with
+        # RA_DEBUG=1 to re-raise.
+        import os
+
+        if os.environ.get("RA_DEBUG"):
+            raise
+        print(f"error: {e}", file=sys.stderr)
+        return 2
     except FileNotFoundError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

@@ -115,3 +115,46 @@ def test_run_stream_uses_mesh_and_matches_single(setup):
     }
     assert got == dict(res.hits)
     assert rep.unused == res.unused_rules([rs])
+
+
+def test_step_specialization_cache_correct_across_rulesets():
+    """The ruleset-specialized step cache must dispatch by VALUE: two
+    different rulesets through one step object give each its own correct
+    counts, and an equal-valued re-shipped ruleset reuses the executable."""
+    import numpy as np
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+    from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+    from ruleset_analysis_tpu.models import pipeline
+    from ruleset_analysis_tpu.parallel import mesh as mesh_lib
+    from ruleset_analysis_tpu.parallel.step import make_parallel_step
+
+    cfg = AnalysisConfig(batch_size=64, sketch=SketchConfig(cms_width=1 << 10, cms_depth=2, hll_p=4))
+    mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
+
+    def setup(seed):
+        rs = aclparse.parse_asa_config(
+            synth.synth_config(n_acls=2, rules_per_acl=6, seed=seed), "fw1"
+        )
+        packed = pack.pack_rulesets([rs])
+        tup = synth.synth_tuples(packed, 64, seed=seed)
+        wire = pack.compact_batch(np.ascontiguousarray(tup.T))
+        return packed, wire
+
+    pa, wa = setup(1)
+    pb, wb = setup(2)
+    assert pa.n_keys == pb.n_keys  # same key space, different rule values
+    step = make_parallel_step(mesh, cfg, pa.n_keys)
+
+    def run(packed, wire):
+        rules = pipeline.ship_ruleset(packed)
+        st = pipeline.init_state(packed.n_keys, cfg)
+        st, _ = step(st, rules, mesh_lib.shard_batch(mesh, wire, cfg.mesh_axis))
+        return np.asarray(st.counts_lo).copy()
+
+    ca1 = run(pa, wa)
+    cb = run(pb, wb)
+    ca2 = run(pa, wa)  # re-shipped equal-valued ruleset (new object)
+    np.testing.assert_array_equal(ca1, ca2)
+    assert not np.array_equal(ca1, cb)  # different rules really dispatched
+    assert ca1.sum() > 0 and cb.sum() > 0
