@@ -367,7 +367,15 @@ def _resolve_network_object(groups: _Groups, name: str) -> list[tuple[int, int]]
         elif toks[0] == "subnet":
             out.append(subnet_range(toks[1], toks[2]))
         elif toks[0] == "range":
-            out.append((ip_to_u32(toks[1]), ip_to_u32(toks[2])))
+            lo, hi = ip_to_u32(toks[1]), ip_to_u32(toks[2])
+            if lo > hi:
+                # real ASA rejects inverted ranges; the device kernel's
+                # wraparound range check also requires lo <= hi
+                raise AclParseError(
+                    f"inverted address range {toks[1]}-{toks[2]} in network "
+                    f"object {name!r}"
+                )
+            out.append((lo, hi))
         elif toks[0] in ("nat", "fqdn"):
             continue  # not matchable statically
         else:
@@ -389,7 +397,12 @@ def _port_spec_from_tokens(toks: list[str], pos: int) -> tuple[list[tuple[int, i
         v = _port_value(toks[pos + 1])
         return [(v, v)], pos + 2
     if op == "range":
-        return [(_port_value(toks[pos + 1]), _port_value(toks[pos + 2]))], pos + 3
+        lo, hi = _port_value(toks[pos + 1]), _port_value(toks[pos + 2])
+        if lo > hi:
+            # real ASA rejects inverted port ranges; the device kernel's
+            # wraparound range check also requires lo <= hi
+            raise AclParseError(f"inverted port range {lo}-{hi}")
+        return [(lo, hi)], pos + 3
     if op == "gt":
         v = _port_value(toks[pos + 1])
         return ([(v + 1, PORT_MAX)] if v < PORT_MAX else []), pos + 2

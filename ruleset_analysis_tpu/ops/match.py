@@ -51,24 +51,23 @@ def _block_min_row(cols: dict, rules: jnp.ndarray, base: jnp.ndarray) -> jnp.nda
     def col(i):
         return r[:, i][None, :]
 
+    def in_range(lo_col, hi_col, x):
+        # unsigned wraparound range check: with lo <= hi (pack.py
+        # guarantees it), x in [lo, hi]  <=>  x - lo <= hi - lo.  One
+        # subtract + one compare instead of two compares + an AND — and
+        # with the rule tensor compiled in as a constant (parallel/step
+        # specialization), hi - lo folds away entirely.
+        lo = col(lo_col)
+        return (x - lo) <= (col(hi_col) - lo)
+
     acl = cols["acl"][:, None]
-    proto = cols["proto"][:, None]
-    src = cols["src"][:, None]
-    sport = cols["sport"][:, None]
-    dst = cols["dst"][:, None]
-    dport = cols["dport"][:, None]
     ok = (
         (col(R_ACL) == acl)
-        & (col(R_PLO) <= proto)
-        & (proto <= col(R_PHI))
-        & (col(R_SLO) <= src)
-        & (src <= col(R_SHI))
-        & (col(R_SPLO) <= sport)
-        & (sport <= col(R_SPHI))
-        & (col(R_DLO) <= dst)
-        & (dst <= col(R_DHI))
-        & (col(R_DPLO) <= dport)
-        & (dport <= col(R_DPHI))
+        & in_range(R_PLO, R_PHI, cols["proto"][:, None])
+        & in_range(R_SLO, R_SHI, cols["src"][:, None])
+        & in_range(R_SPLO, R_SPHI, cols["sport"][:, None])
+        & in_range(R_DLO, R_DHI, cols["dst"][:, None])
+        & in_range(R_DPLO, R_DPHI, cols["dport"][:, None])
     )
     rb = rules.shape[0]
     idx = base + lax.broadcasted_iota(_U32, (1, rb), 1)

@@ -244,3 +244,33 @@ def test_icmp_type_group_cycle_and_bad_name():
             " icmp-object bogus-name\n"
             "access-list C extended permit icmp any any object-group IT\n"
         )
+
+
+def test_inverted_ranges_rejected():
+    """Real ASA rejects inverted ranges; so must the parser — the device
+    kernel's wraparound range check relies on lo <= hi, and silently
+    packing an inverted range would make it match almost everything."""
+    from ruleset_analysis_tpu.hostside.aclparse import AclParseError, parse_asa_config
+
+    bad_port = """hostname fw1
+access-list A extended permit tcp any any range 100 50
+access-group A in interface outside
+"""
+    with pytest.raises(AclParseError, match="inverted port range"):
+        parse_asa_config(bad_port, "fw1")
+
+    bad_addr = """hostname fw1
+object network SRV
+ range 10.0.0.9 10.0.0.1
+access-list A extended permit tcp object SRV any
+access-group A in interface outside
+"""
+    with pytest.raises(AclParseError, match="inverted address range"):
+        parse_asa_config(bad_addr, "fw1")
+
+    ok = """hostname fw1
+access-list A extended permit tcp any any range 50 100
+access-group A in interface outside
+"""
+    rs = parse_asa_config(ok, "fw1")
+    assert rs.rule_count() == 1

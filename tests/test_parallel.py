@@ -121,14 +121,6 @@ def test_step_specialization_cache_correct_across_rulesets():
     """The ruleset-specialized step cache must dispatch by VALUE: two
     different rulesets through one step object give each its own correct
     counts, and an equal-valued re-shipped ruleset reuses the executable."""
-    import numpy as np
-
-    from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
-    from ruleset_analysis_tpu.hostside import aclparse, pack, synth
-    from ruleset_analysis_tpu.models import pipeline
-    from ruleset_analysis_tpu.parallel import mesh as mesh_lib
-    from ruleset_analysis_tpu.parallel.step import make_parallel_step
-
     cfg = AnalysisConfig(batch_size=64, sketch=SketchConfig(cms_width=1 << 10, cms_depth=2, hll_p=4))
     mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
 
