@@ -273,9 +273,24 @@ class WireReader:
     consumes it directly with no host-side copy or transpose.
     """
 
-    def __init__(self, paths: list[str], packed: PackedRuleset | None = None):
-        fp = ruleset_fingerprint(packed) if packed is not None else None
+    def __init__(
+        self,
+        paths: list[str],
+        packed: PackedRuleset | None = None,
+        fingerprint: bytes | None = None,
+    ):
+        """``packed`` validates each file's ruleset fingerprint; callers
+        inspecting many files can hash once themselves and pass
+        ``fingerprint`` instead."""
+        fp = fingerprint
+        if fp is None and packed is not None:
+            fp = ruleset_fingerprint(packed)
         self._files = [_WireFile(p, fp) for p in paths]
+        blocks = {f.block_rows for f in self._files}
+        #: Common payload block size, or 0 when the files disagree (the
+        #: reader handles mixed blocks fine; only the aggregate is
+        #: meaningless then).
+        self.block_rows = blocks.pop() if len(blocks) == 1 else 0
         self.n_rows = sum(f.n_rows for f in self._files)
         self.raw_lines = sum(f.raw_lines for f in self._files)
         self.n_evals = sum(f.n_evals for f in self._files)

@@ -363,3 +363,36 @@ def test_convert_feed_workers_native_false_refused(corpus, tmp_path):
     with pytest.raises(ValueError, match="native"):
         wire.convert_logs(packed, logs, str(tmp_path / "x.rawire"),
                           native=False, feed_workers=2)
+
+
+def test_cli_wire_info(corpus, tmp_path, capsys):
+    from ruleset_analysis_tpu.cli import main
+
+    packed, _rs, logs, lines = corpus
+    prefix = str(tmp_path / "rs")
+    pack.save_packed(packed, prefix)
+    out = str(tmp_path / "a.rawire")
+    assert main(["convert", "--ruleset", prefix, "--logs", *logs, "--out", out]) == 0
+    capsys.readouterr()
+    rc = main(["wire-info", out, "--ruleset", prefix, "--json"])
+    assert rc == 0
+    import json
+
+    info = json.loads(capsys.readouterr().out)[0]
+    assert info["ok"] and info["raw_lines"] == len(lines)
+
+    # wrong ruleset -> invalid, rc 1
+    other_cfg = synth.synth_config(n_acls=2, rules_per_acl=4, seed=3)
+    other = pack.pack_rulesets([aclparse.parse_asa_config(other_cfg, "fw1")])
+    pack.save_packed(other, str(tmp_path / "rs2"))
+    rc = main(["wire-info", out, "--ruleset", str(tmp_path / "rs2")])
+    assert rc == 1
+    assert "INVALID" in capsys.readouterr().out
+
+
+def test_cli_wire_info_missing_file_reported(tmp_path, capsys):
+    from ruleset_analysis_tpu.cli import main
+
+    rc = main(["wire-info", str(tmp_path / "nope.rawire")])
+    assert rc == 1
+    assert "INVALID" in capsys.readouterr().out
