@@ -99,3 +99,19 @@ def test_parse_skips_surface_in_report(tmp_path):
     )
     clean = build_report(pack.pack_rulesets([strict_rs]), {}, backend="tpu")
     assert "config_entries_skipped" not in clean.totals
+
+
+def test_lenient_skips_inverted_range():
+    """Inverted ranges abort strict parses but skip-and-count leniently,
+    keeping rule positions for the entries that remain."""
+    from ruleset_analysis_tpu.hostside.aclparse import parse_asa_config
+
+    cfg = """hostname fw1
+access-list A extended permit tcp any any range 100 50
+access-list A extended permit udp any any eq 53
+access-group A in interface outside
+"""
+    rs = parse_asa_config(cfg, "fw1", strict=False)
+    assert rs.rule_count() == 1
+    assert len(rs.skipped) == 1
+    assert "inverted port range" in rs.skipped[0][1]
