@@ -1,0 +1,63 @@
+"""Differential sweep: randomized configs, device path vs oracle.
+
+The golden tests pin a few fixed corpora; this sweep broadens coverage by
+generating MANY (config, syslog) pairs across the synth generator's
+feature space — varied ACL counts, rule densities, egress bindings, seed
+variety — and asserting the full TPU stream path reproduces the exact
+oracle's per-rule hits and unused set on every one.  A kernel or parser
+regression that happens to dodge the fixed goldens gets caught here.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+from ruleset_analysis_tpu.hostside import aclparse, oracle, pack, synth
+from ruleset_analysis_tpu.runtime.stream import run_stream
+
+
+CASES = [
+    # (seed, n_acls, rules_per_acl, egress, lines, batch)
+    (101, 1, 4, False, 400, 64),
+    (102, 2, 16, False, 800, 128),
+    (103, 3, 8, True, 800, 96),
+    (104, 5, 24, False, 1200, 256),
+    (105, 2, 12, True, 1000, 100),  # odd batch, egress dual-eval
+    (106, 4, 6, False, 600, 601),  # batch larger than the corpus
+    (107, 1, 48, True, 900, 128),
+    (108, 6, 10, False, 1000, 250),
+]
+
+
+@pytest.mark.parametrize("seed,n_acls,rules,egress,lines,batch", CASES)
+def test_device_matches_oracle(seed, n_acls, rules, egress, lines, batch):
+    cfg_text = synth.synth_config(
+        n_acls=n_acls, rules_per_acl=rules, seed=seed, egress_acls=egress
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    tuples = synth.synth_tuples(packed, lines, seed=seed)
+    log_lines = synth.render_syslog(packed, tuples, seed=seed, variety=0.3)
+    res = oracle.Oracle([rs]).consume(list(log_lines))
+
+    rep = run_stream(
+        packed,
+        iter(log_lines),
+        AnalysisConfig(
+            batch_size=batch,
+            sketch=SketchConfig(cms_width=1 << 11, cms_depth=4, hll_p=6),
+        ),
+        topk=5,
+    )
+    got = {
+        (e["firewall"], e["acl"], e["index"]): e["hits"]
+        for e in rep.per_rule
+        if e["hits"] > 0
+    }
+    exp = dict(res.hits)
+    assert got == exp, f"seed {seed}: device hits != oracle"
+    assert rep.unused == res.unused_rules([rs]), f"seed {seed}: unused set"
+    assert rep.totals["lines_matched"] == res.lines_matched
+    assert rep.totals["lines_skipped"] == res.lines_skipped
