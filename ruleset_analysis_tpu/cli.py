@@ -362,6 +362,88 @@ def _cmd_wire_info(args: argparse.Namespace) -> int:
     return rc
 
 
+def _cmd_diff_reports(args: argparse.Namespace) -> int:
+    """Compare two JSON run reports: the operator's delete-decision view.
+
+    The reference's end goal is "which rules can we safely delete"; one
+    run can't answer that (a rule may simply be quiet this week).  This
+    diff shows stability across runs: rules unused in BOTH reports are
+    the deletion candidates, newly-unused / newly-used rules are the
+    churn to investigate.
+    """
+    import json as json_mod
+
+    if args.top < 0:
+        print("error: --top must be >= 0", file=sys.stderr)
+        return 2
+
+    def load(path):
+        with open(path, "r", encoding="utf-8") as f:
+            rep = json_mod.load(f)
+        hits = {
+            tuple((e["firewall"], e["acl"], e["index"])): e["hits"]
+            for e in rep.get("per_rule", [])
+        }
+        unused = {tuple(k) for k in rep.get("unused", [])}
+        return hits, unused
+
+    try:
+        hits_a, unused_a = load(args.old)
+        hits_b, unused_b = load(args.new)
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        print(f"error: unreadable report: {e}", file=sys.stderr)
+        return 2
+
+    key_str = lambda k: f"{k[0]} {k[1]} {k[2]}"  # noqa: E731
+    # Compare only rules PRESENT in both reports: a rule deleted between
+    # runs must not masquerade as "newly used", nor a rule added between
+    # runs as "newly unused" — ruleset churn is reported separately.
+    common = set(hits_a) & set(hits_b)
+    rules_removed = sorted(set(hits_a) - common)
+    rules_added = sorted(set(hits_b) - common)
+    stable_unused = sorted(unused_a & unused_b & common)
+    newly_unused = sorted((unused_b - unused_a) & common)
+    newly_used = sorted((unused_a - unused_b) & common)
+    movers = sorted(
+        ((abs(hits_b[k] - hits_a[k]), k) for k in common),
+        reverse=True,
+    )[: args.top]
+    out = {
+        "stable_unused": [key_str(k) for k in stable_unused],
+        "newly_unused": [key_str(k) for k in newly_unused],
+        "newly_used": [key_str(k) for k in newly_used],
+        "rules_added": [key_str(k) for k in rules_added],
+        "rules_removed": [key_str(k) for k in rules_removed],
+        "top_hit_movers": [
+            {"rule": key_str(k), "old": hits_a[k], "new": hits_b[k]}
+            for d, k in movers
+            if d > 0
+        ],
+    }
+    if args.json:
+        print(json_mod.dumps(out, indent=2))
+        return 0
+    print(f"# stable unused (deletion candidates): {len(stable_unused)}")
+    for k in stable_unused:
+        print(f"  {key_str(k)}")
+    print(f"# newly unused (quiet this run): {len(newly_unused)}")
+    for k in newly_unused:
+        print(f"  {key_str(k)}")
+    print(f"# newly used (were unused before): {len(newly_used)}")
+    for k in newly_used:
+        print(f"  {key_str(k)}")
+    if rules_added or rules_removed:
+        print(
+            f"# ruleset churn: {len(rules_added)} added, "
+            f"{len(rules_removed)} removed between reports"
+        )
+    if out["top_hit_movers"]:
+        print("# top hit movers:")
+        for m in out["top_hit_movers"]:
+            print(f"  {m['rule']}: {m['old']} -> {m['new']}")
+    return 0
+
+
 def _cmd_synth(args: argparse.Namespace) -> int:
     import os
 
@@ -497,6 +579,17 @@ def make_parser() -> argparse.ArgumentParser:
                    help="packed ruleset prefix to validate the fingerprint against")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=_cmd_wire_info)
+
+    p = sub.add_parser(
+        "diff-reports",
+        help="compare two `run --json` reports: stable-unused deletion "
+             "candidates, newly used/unused rules, top hit movers",
+    )
+    p.add_argument("old", help="earlier report (run --json output)")
+    p.add_argument("new", help="later report")
+    p.add_argument("--top", type=int, default=10, help="hit movers to show")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_diff_reports)
 
     p = sub.add_parser("synth", help="generate synthetic config + syslog")
     p.add_argument("--out-dir", required=True)

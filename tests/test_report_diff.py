@@ -1,0 +1,87 @@
+"""diff-reports: cross-run stability view (the delete-decision tool)."""
+
+import json
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.cli import main
+from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+
+
+@pytest.fixture(scope="module")
+def two_reports(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("diff")
+    cfg_text = synth.synth_config(n_acls=2, rules_per_acl=8, seed=55)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    prefix = str(tmp / "rs")
+    pack.save_packed(packed, prefix)
+    paths = []
+    for i, (n, seed) in enumerate([(500, 55), (700, 56)]):
+        tuples = synth.synth_tuples(packed, n, seed=seed)
+        lines = synth.render_syslog(packed, tuples, seed=seed)
+        log = tmp / f"l{i}.log"
+        log.write_text("\n".join(lines) + "\n")
+        out = str(tmp / f"rep{i}.json")
+        rc = main(["run", "--ruleset", prefix, "--logs", str(log),
+                   "--batch-size", "128", "--json", "--out", out])
+        assert rc == 0
+        paths.append(out)
+    return paths
+
+
+def test_diff_reports_text_and_json(two_reports, capsys):
+    old, new = two_reports
+    assert main(["diff-reports", old, new]) == 0
+    text = capsys.readouterr().out
+    assert "stable unused" in text
+
+    assert main(["diff-reports", old, new, "--json"]) == 0
+    d = json.loads(capsys.readouterr().out)
+    a = json.load(open(old))
+    b = json.load(open(new))
+    ua = {tuple(k) for k in a["unused"]}
+    ub = {tuple(k) for k in b["unused"]}
+    assert len(d["stable_unused"]) == len(ua & ub)
+    assert len(d["newly_used"]) == len(ua - ub)
+    assert len(d["newly_unused"]) == len(ub - ua)
+
+
+def test_diff_reports_bad_input(tmp_path, capsys):
+    bad = tmp_path / "x.json"
+    bad.write_text("not json")
+    rc = main(["diff-reports", str(bad), str(bad)])
+    assert rc == 2
+    assert "unreadable report" in capsys.readouterr().err
+
+
+def test_diff_reports_ruleset_churn_not_mislabeled(tmp_path, capsys):
+    """A rule present in only one report is ruleset churn, never
+    newly-used/newly-unused (code-review finding)."""
+    a = {"per_rule": [
+            {"firewall": "fw1", "acl": "A", "index": 1, "hits": 0},
+            {"firewall": "fw1", "acl": "A", "index": 2, "hits": 5}],
+         "unused": [["fw1", "A", 1]]}
+    b = {"per_rule": [
+            {"firewall": "fw1", "acl": "A", "index": 2, "hits": 9},
+            {"firewall": "fw1", "acl": "A", "index": 3, "hits": 0}],
+         "unused": [["fw1", "A", 3]]}
+    pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+    pa.write_text(json.dumps(a))
+    pb.write_text(json.dumps(b))
+    assert main(["diff-reports", str(pa), str(pb), "--json"]) == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["newly_used"] == [] and d["newly_unused"] == []
+    assert d["rules_removed"] == ["fw1 A 1"]
+    assert d["rules_added"] == ["fw1 A 3"]
+    assert d["top_hit_movers"] == [{"rule": "fw1 A 2", "old": 5, "new": 9}]
+
+
+def test_diff_reports_negative_top_rejected(tmp_path, capsys):
+    p = tmp_path / "r.json"
+    p.write_text(json.dumps({"per_rule": [], "unused": []}))
+    assert main(["diff-reports", str(p), str(p), "--top", "-1"]) == 2
+    assert "--top" in capsys.readouterr().err
