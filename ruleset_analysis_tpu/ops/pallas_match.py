@@ -78,18 +78,20 @@ def _kernel(acl, proto, src, sport, dst, dport, rules, out, *, n_tiles: int):
         def row(c):
             return rules[c, sl][None, :]  # [1, RULE_TILE]
 
+        def in_range(lo_c, hi_c, x):
+            # unsigned wraparound range check (see ops.match._block_min_row):
+            # one subtract + one compare per range instead of two compares
+            # + an AND; pack/aclparse guarantee lo <= hi
+            lo = row(lo_c)
+            return (x - lo) <= (row(hi_c) - lo)
+
         ok = (
             (row(R_ACL) == a)
-            & (row(R_PLO) <= p)
-            & (p <= row(R_PHI))
-            & (row(R_SLO) <= s)
-            & (s <= row(R_SHI))
-            & (row(R_SPLO) <= sp)
-            & (sp <= row(R_SPHI))
-            & (row(R_DLO) <= d)
-            & (d <= row(R_DHI))
-            & (row(R_DPLO) <= dp)
-            & (dp <= row(R_DPHI))
+            & in_range(R_PLO, R_PHI, p)
+            & in_range(R_SLO, R_SHI, s)
+            & in_range(R_SPLO, R_SPHI, sp)
+            & in_range(R_DLO, R_DHI, d)
+            & in_range(R_DPLO, R_DPHI, dp)
         )
         idx = (
             lax.broadcasted_iota(_U32, (1, RULE_TILE), 1)
