@@ -396,3 +396,20 @@ def test_cli_wire_info_missing_file_reported(tmp_path, capsys):
     rc = main(["wire-info", str(tmp_path / "nope.rawire")])
     assert rc == 1
     assert "INVALID" in capsys.readouterr().out
+
+
+def test_wire_stacked_checkpoint_crash_resume(corpus, wire_path, tmp_path):
+    """All three round-4 features compose: wire input, stacked layout,
+    checkpointed crash/resume — counts bit-identical to uninterrupted."""
+    packed = corpus[0]
+    base = make_cfg(layout="stacked", stacked_lane=64)
+    ref = run_stream_wire(packed, wire_path, base, topk=5)
+    ck = dict(layout="stacked", stacked_lane=64,
+              checkpoint_every_chunks=2, checkpoint_dir=str(tmp_path / "ck"))
+    run_stream_wire(packed, wire_path, make_cfg(**ck), topk=5, max_chunks=3)
+    snap = ckpt.load(str(tmp_path / "ck"))
+    assert snap is not None and snap.fingerprint.endswith("-wire")
+    rep = run_stream_wire(packed, wire_path, make_cfg(**ck, resume=True), topk=5)
+    assert hits_of(rep) == hits_of(ref)
+    assert rep.unused == ref.unused
+    assert rep.totals["lines_matched"] == ref.totals["lines_matched"]
