@@ -98,6 +98,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 cms_width=args.cms_width,
                 cms_depth=args.cms_depth,
                 hll_p=args.hll_p,
+                topk_sample_shift=args.topk_sample_shift,
             ),
             exact_counts=args.exact_counts,
             register_memory_budget_bytes=args.register_budget_mb << 20,
@@ -514,6 +515,11 @@ def make_parser() -> argparse.ArgumentParser:
                    help="ceiling on device register memory (counts+CMS+HLL); "
                         "oversized geometries fail fast with a suggested --hll-p")
     p.add_argument("--topk", type=int, default=10)
+    p.add_argument("--topk-sample-shift", type=int, default=0, metavar="S",
+                   help="select per-chunk talker candidates from every "
+                        "2^S-th line (the talker sketch still covers every "
+                        "line; trims the scatter-bound share of the device "
+                        "step; 0 = full batch)")
     p.add_argument("--checkpoint-every", type=int, default=0, metavar="CHUNKS",
                    help="snapshot (offset, registers) every N chunks")
     p.add_argument("--checkpoint-dir", default=None,

@@ -64,6 +64,15 @@ class SketchConfig:
     #: across chunks), so a shallow sketch suffices — and its scatter cost
     #: scales with depth x batch, a large share of the whole device step.
     talk_cms_depth: int = 2
+    #: Candidate-SELECTION subsampling: pick per-chunk talker candidates
+    #: from every 2**shift-th line instead of the whole batch.  The talker
+    #: CMS still absorbs EVERY line (estimates are exact-as-before); only
+    #: the two candidate-table scatters shrink — the TPU trace shows the
+    #: step is scatter-bound, and heavy hitters by definition recur, so a
+    #: stride sample still surfaces them (a chunk where one is missed
+    #: feeds it next chunk).  0 = select from the full batch (bit-exact
+    #: pre-round-4 candidates).
+    topk_sample_shift: int = 0
 
     def __post_init__(self) -> None:
         if self.cms_width < 2 or self.cms_width & (self.cms_width - 1):
@@ -78,6 +87,10 @@ class SketchConfig:
             raise ValueError(f"hll_p must be in 1..16, got {self.hll_p}")
         if self.topk_capacity < 1 or self.topk_chunk_candidates < 1:
             raise ValueError("topk_capacity and topk_chunk_candidates must be >= 1")
+        if not 0 <= self.topk_sample_shift <= 8:
+            raise ValueError(
+                f"topk_sample_shift must be in 0..8, got {self.topk_sample_shift}"
+            )
 
     @property
     def hll_m(self) -> int:

@@ -232,6 +232,7 @@ def _update_registers(
     topk_k: int,
     exact_counts: bool,
     salt: jax.Array | int = 0,
+    topk_sample_shift: int = 0,
 ) -> tuple[AnalysisState, ChunkOut]:
     """Shared register tail: the reducer's whole job, for any match layout."""
     # One bincount into the (small) key space feeds BOTH the exact counts
@@ -248,7 +249,8 @@ def _update_registers(
     cms = cms_ops.cms_update(state.cms, jnp.arange(n_keys, dtype=_U32), delta)
     hll = hll_ops.hll_update(state.hll, keys, src, valid)
     talk_cms, ca, cs, ce = topk_ops.talker_chunk_update(
-        state.talk_cms, acl, src, valid, topk_k, salt=salt
+        state.talk_cms, acl, src, valid, topk_k, salt=salt,
+        sample_shift=topk_sample_shift,
     )
     return (
         AnalysisState(counts_lo=lo, counts_hi=hi, cms=cms, hll=hll, talk_cms=talk_cms),
@@ -267,6 +269,7 @@ def analysis_step(
     rule_block: int = RULE_BLOCK,
     salt: jax.Array | int = 0,
     match_impl: str = "xla",
+    topk_sample_shift: int = 0,
 ) -> tuple[AnalysisState, ChunkOut]:
     """One fused device step over a batch of packed log lines.
 
@@ -285,6 +288,7 @@ def analysis_step(
     return _update_registers(
         state, keys, valid, cols["src"], cols["acl"],
         n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts, salt=salt,
+        topk_sample_shift=topk_sample_shift,
     )
 
 
@@ -314,6 +318,7 @@ def analysis_step_stacked(
     exact_counts: bool = True,
     rule_block: int = RULE_BLOCK,
     salt: jax.Array | int = 0,
+    topk_sample_shift: int = 0,
 ) -> tuple[AnalysisState, ChunkOut]:
     """Grouped-batch variant of analysis_step (vmap over rule slabs).
 
@@ -333,6 +338,7 @@ def analysis_step_stacked(
         topk_k=topk_k,
         exact_counts=exact_counts,
         salt=salt,
+        topk_sample_shift=topk_sample_shift,
     )
 
 

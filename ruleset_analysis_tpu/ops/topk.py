@@ -42,6 +42,7 @@ def talker_chunk_update(
     valid: jnp.ndarray,
     k: int,
     salt: jnp.ndarray | int = 0,
+    sample_shift: int = 0,
 ):
     """Absorb one chunk; return (new_cms, cand_acl, cand_src, cand_est).
 
@@ -50,16 +51,35 @@ def talker_chunk_update(
     ``salt`` re-randomizes the candidate table's slot assignment; stream
     drivers pass the chunk counter so collisions cannot persist across
     chunks while staying deterministic for checkpoint resume.
+
+    ``sample_shift > 0`` selects candidates from every 2**shift-th line
+    only.  The CMS update — and therefore every reported estimate — still
+    covers the full batch; the sample only shrinks the candidate-table
+    scatters (the scatter-bound share of the TPU step).  Deterministic:
+    the stride is fixed, so resume replays identically.
     """
     pair = hash_pair(acl, src)
     new_cms = cms_update(talk_cms, pair, valid)
-    cand = select_candidates(new_cms, acl, src, valid, min(k, acl.shape[0]), salt=salt)
+    cand = select_candidates(
+        new_cms, acl, src, valid, min(k, acl.shape[0]), salt=salt,
+        sample_shift=sample_shift,
+    )
     return (new_cms, *cand)
 
 
 def select_candidates(talk_cms, acl, src, valid, k, slots: int = CAND_SLOTS,
-                      salt: jnp.ndarray | int = 0):
+                      salt: jnp.ndarray | int = 0, sample_shift: int = 0):
     """Top-k distinct (acl, src) candidates of this chunk.
+
+    ``sample_shift > 0`` selects from 1/2**shift of the lines: the batch
+    reshapes to [b', stride] rows and ONE column — rotated by ``salt`` so
+    the phase differs every chunk — feeds the candidate table.  The
+    rotation matters for grouped (stacked) layouts, where lines are
+    group-major and a FIXED stride phase could alias entire ACL groups
+    out of the sample forever; with rotation every line position is
+    sampled within ``stride`` chunks, restoring the heavy-hitters-recur
+    argument.  Estimates are untouched (they come from ``talk_cms``,
+    which absorbed every line).
 
     A naive "dedup then top_k over the batch" costs a full argsort of the
     batch (the old implementation dominated the whole analysis step).
@@ -80,6 +100,16 @@ def select_candidates(talk_cms, acl, src, valid, k, slots: int = CAND_SLOTS,
     with the same ``salt``, which is why streaming callers pass a
     per-chunk salt: the suppressed pair surfaces under the next salt.
     """
+    if sample_shift:
+        stride = 1 << sample_shift
+        bs = (acl.shape[0] // stride) * stride
+        phase = jnp.asarray(salt, dtype=_U32) % _U32(stride)
+
+        def col(x):
+            return jnp.take(x[:bs].reshape(-1, stride), phase, axis=1)
+
+        acl, src, valid = col(acl), col(src), col(valid)
+        k = min(k, acl.shape[0])
     b = acl.shape[0]
     pair = hash_pair(acl, src)
     slot = fmix32(pair ^ jnp.asarray(salt, dtype=_U32)) & _U32(slots - 1)

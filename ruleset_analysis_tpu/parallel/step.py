@@ -52,6 +52,7 @@ def _merge_tail(
     n_keys: int,
     topk_k: int,
     exact_counts: bool,
+    topk_sample_shift: int = 0,
 ) -> tuple[AnalysisState, ChunkOut]:
     # The register-update tail shared by the flat and stacked shard steps:
     # mirrors pipeline._update_registers with the collective merges
@@ -81,9 +82,11 @@ def _merge_tail(
     talk_cms = state.talk_cms + lax.psum(delta_talk, axis)
     # candidate selection against the *merged* global talker sketch, then
     # gather every device's candidates so the host sees them all, replicated
+    # (sample_shift: salt-rotated sampled selection — the sketch covered
+    # every line above; see ops.topk.select_candidates)
     ca, cs, ce = topk_ops.select_candidates(
         talk_cms, acl, src, valid, min(topk_k, valid.shape[0]),
-        salt=salt,
+        salt=salt, sample_shift=topk_sample_shift,
     )
     cand_acl = lax.all_gather(ca, axis, tiled=True)
     cand_src = lax.all_gather(cs, axis, tiled=True)
@@ -107,6 +110,7 @@ def _local_shard_step(
     exact_counts: bool,
     rule_block: int,
     match_impl: str = "xla",
+    topk_sample_shift: int = 0,
 ) -> tuple[AnalysisState, ChunkOut]:
     cols, valid = batch_cols(batch)
     if match_impl == "pallas" and ruleset.rules_fm is not None:
@@ -120,6 +124,7 @@ def _local_shard_step(
     return _merge_tail(
         state, keys, valid, cols["src"], cols["acl"], salt,
         axis=axis, n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts,
+        topk_sample_shift=topk_sample_shift,
     )
 
 
@@ -134,6 +139,7 @@ def _local_shard_step_stacked(
     topk_k: int,
     exact_counts: bool,
     rule_block: int,
+    topk_sample_shift: int = 0,
 ) -> tuple[AnalysisState, ChunkOut]:
     # Grouped twin of _local_shard_step: each line scans only its own
     # ACL's slab (vmapped match over the group axis); the mergeable
@@ -151,6 +157,7 @@ def _local_shard_step_stacked(
         n_keys=n_keys,
         topk_k=topk_k,
         exact_counts=exact_counts,
+        topk_sample_shift=topk_sample_shift,
     )
 
 
@@ -267,6 +274,7 @@ def make_parallel_step(
         exact_counts=cfg.exact_counts,
         rule_block=rule_block,
         match_impl=cfg.match_impl,
+        topk_sample_shift=cfg.sketch.topk_sample_shift,
     )
     return _make_step(mesh, local, P(None, axis))
 
@@ -293,5 +301,6 @@ def make_parallel_step_stacked(
         topk_k=cfg.sketch.topk_chunk_candidates,
         exact_counts=cfg.exact_counts,
         rule_block=rule_block,
+        topk_sample_shift=cfg.sketch.topk_sample_shift,
     )
     return _make_step(mesh, local, P(None, None, axis))

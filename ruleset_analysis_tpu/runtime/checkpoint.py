@@ -67,7 +67,7 @@ def fingerprint(
     h.update(
         f"{s.cms_width},{s.cms_depth},{s.talk_cms_depth},{s.hll_p},{cfg.exact_counts},"
         f"{padded},{n_shards},{s.topk_chunk_candidates},{s.topk_capacity},"
-        f"{cfg.layout},{lane}".encode()
+        f"{cfg.layout},{lane},{s.topk_sample_shift}".encode()
     )
     return h.hexdigest()[:16]
 

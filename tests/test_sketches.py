@@ -265,3 +265,60 @@ def test_hll_estimate_capped_at_value_space():
     regs = np.full((1, 1 << 14), 33, dtype=np.uint32)
     est = float(hll_ops.hll_estimate_np(regs)[0])
     assert est == 2.0**32
+
+
+def test_talker_sampled_selection_still_finds_heavy_hitters():
+    """sample_shift selects candidates from a stride sample; the sketch
+    still covers every line, so estimates are unchanged and persistent
+    heavy hitters still surface (they recur within any stride)."""
+    rng = np.random.default_rng(7)
+    b = 1 << 14
+    # one dominant talker at 30% of lines, background uniform
+    src = rng.integers(0, 1 << 32, size=b, dtype=np.uint32)
+    hot = np.uint32(0x0A0A0A0A)
+    src[rng.random(b) < 0.3] = hot
+    acl = np.zeros(b, dtype=np.uint32)
+    valid = np.ones(b, dtype=np.uint32)
+    sk = cms_ops.cms_init(1 << 12, 2)
+
+    full = topk_ops.talker_chunk_update(
+        sk, jnp.asarray(acl), jnp.asarray(src), jnp.asarray(valid), 8, salt=1
+    )
+    samp = topk_ops.talker_chunk_update(
+        sk, jnp.asarray(acl), jnp.asarray(src), jnp.asarray(valid), 8, salt=1,
+        sample_shift=3,
+    )
+    # identical sketch state (every line absorbed either way)
+    np.testing.assert_array_equal(np.asarray(full[0]), np.asarray(samp[0]))
+    cand_full = set(np.asarray(full[2])[np.asarray(full[3]) > 0].tolist())
+    cand_samp = set(np.asarray(samp[2])[np.asarray(samp[3]) > 0].tolist())
+    assert int(hot) in cand_full and int(hot) in cand_samp
+    # and the hot talker's estimate comes from the full sketch both ways
+    est_full = dict(zip(np.asarray(full[2]).tolist(), np.asarray(full[3]).tolist()))
+    est_samp = dict(zip(np.asarray(samp[2]).tolist(), np.asarray(samp[3]).tolist()))
+    assert est_full[int(hot)] == est_samp[int(hot)]
+
+
+def test_talker_sampled_phase_rotates_with_salt():
+    """The sampled column rotates by salt, so grouped (group-major) lines
+    cannot alias entire groups out of the sample across chunks."""
+    b = 1 << 10
+    stride = 8
+    # put a unique talker at flat positions with index % 8 == 5 only
+    src = np.arange(b, dtype=np.uint32) + 1
+    acl = np.zeros(b, dtype=np.uint32)
+    valid = np.zeros(b, dtype=np.uint32)
+    valid[5::stride] = 1  # only phase-5 lines are valid
+    sk = cms_ops.cms_init(1 << 12, 2)
+
+    def cands(salt):
+        _, ca, cs, ce = topk_ops.talker_chunk_update(
+            sk, jnp.asarray(acl), jnp.asarray(src), jnp.asarray(valid), 8,
+            salt=salt, sample_shift=3,
+        )
+        return set(np.asarray(cs)[np.asarray(ce) > 0].tolist())
+
+    # across stride consecutive salts, at least one chunk samples phase 5
+    seen = [len(cands(s)) > 0 for s in range(stride)]
+    assert any(seen), "rotation never reached the valid phase"
+    assert not all(seen), "with only phase-5 valid, other phases must be empty"
