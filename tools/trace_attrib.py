@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Attribute device-step time from a committed jax.profiler Chrome trace.
+
+Usage:
+    python tools/trace_attrib.py [trace.json.gz ...]
+
+Defaults to every ``vm.trace.json.gz`` under ``profiles/``.  Prints total
+duration by event name per process track (TPU device vs host), which is
+how the DESIGN.md §6b claim was derived: the fused analysis step splits
+across ~7 comparable device fusions — the batch-sized register scatters —
+so the TPU step is scatter-bound, not match-bound.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import sys
+
+
+def attribute(path: str, top: int = 20) -> None:
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    ev = data.get("traceEvents", [])
+    names = {
+        e["pid"]: e["args"].get("name", "")
+        for e in ev
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    tot: dict = collections.defaultdict(float)
+    cnt: collections.Counter = collections.Counter()
+    for e in ev:
+        if e.get("ph") == "X" and "dur" in e:
+            key = (names.get(e["pid"], str(e["pid"])), e["name"][:90])
+            tot[key] += e["dur"]
+            cnt[key] += 1
+    print(f"== {path} ({len(ev)} events) ==")
+    for (proc, name), d in sorted(tot.items(), key=lambda kv: -kv[1])[:top]:
+        print(f"{d / 1e3:10.1f} ms  x{cnt[(proc, name)]:>5}  [{proc}] {name}")
+    print()
+
+
+def main(argv: list[str]) -> int:
+    paths = argv or sorted(glob.glob("profiles/**/*.trace.json.gz", recursive=True))
+    if not paths:
+        print("no traces found under profiles/", file=sys.stderr)
+        return 1
+    for p in paths:
+        attribute(p)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
