@@ -13,7 +13,7 @@ python bench.py > BENCH_r04_local.json 2> /tmp/bench_r04.log
 echo "headline rc=$?" >&2
 tail -3 /tmp/bench_r04.log >&2
 echo "=== suite (perf configs on TPU) ===" >&2
-timeout 5400 python bench_suite.py exact pallas multifw recall e2e \
+timeout 5400 python bench_suite.py exact pallas multifw recall e2e stage \
     > /tmp/suite_tpu.jsonl 2> /tmp/suite_tpu.log
 suite_rc=$?
 echo "suite rc=$suite_rc" >&2

@@ -413,3 +413,29 @@ def test_wire_stacked_checkpoint_crash_resume(corpus, wire_path, tmp_path):
     assert hits_of(rep) == hits_of(ref)
     assert rep.unused == ref.unused
     assert rep.totals["lines_matched"] == ref.totals["lines_matched"]
+
+
+def test_cli_topk_sample_shift_plumbing(corpus, tmp_path, capsys):
+    """--topk-sample-shift reaches the device step; exact counts and the
+    unused set are sample-invariant (only candidate SELECTION samples)."""
+    import json
+
+    from ruleset_analysis_tpu.cli import main
+
+    packed, _rs, logs, _lines = corpus
+    prefix = str(tmp_path / "rs")
+    pack.save_packed(packed, prefix)
+
+    def run(shift):
+        out = str(tmp_path / f"rep{shift}.json")
+        rc = main(["run", "--ruleset", prefix, "--logs", *logs,
+                   "--batch-size", "256", "--topk-sample-shift", str(shift),
+                   "--json", "--out", out])
+        assert rc == 0
+        return json.load(open(out))
+
+    a, b = run(0), run(3)
+    ha = {(e["firewall"], e["acl"], e["index"]): e["hits"] for e in a["per_rule"]}
+    hb = {(e["firewall"], e["acl"], e["index"]): e["hits"] for e in b["per_rule"]}
+    assert ha == hb
+    assert a["unused"] == b["unused"]
