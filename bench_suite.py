@@ -490,11 +490,28 @@ def bench_stage() -> dict:
         expect_scalar(iters * n_valid % M, "matmul counts total"),
     )
 
-    # parity: the matmul path must produce the exact scatter counts
+    # compare-and-reduce alternative: counts[k] = sum_b (keys==k)*valid —
+    # XLA fuses the compare into the reduction (reductions accept fused
+    # producers, dots do not), so nothing [B, K]-shaped materializes
+    def counts_reduce(keys):
+        eq = keys[None, :] == iota[:, None]
+        return jnp.sum(jnp.where(eq, valid, 0).astype(u32), axis=1)
+
+    results["counts_reduce_ms"] = timed(
+        "counts-reduce",
+        u32(0),
+        lambda c: c + counts_reduce(keys0).sum(dtype=u32),
+        expect_scalar(iters * n_valid % M, "reduce counts total"),
+    )
+
+    # parity: every counts formulation must produce the exact scatter counts
     c_sc = jax.device_get(count_ops.segment_counts(keys0, valid, n_keys))
     c_mm = jax.device_get(counts_matmul(keys0))
+    c_rd = jax.device_get(counts_reduce(keys0))
     if not np.array_equal(c_sc, c_mm):
         raise AssertionError("one-hot matmul counts != scatter counts")
+    if not np.array_equal(c_sc, c_rd):
+        raise AssertionError("compare-reduce counts != scatter counts")
 
     # HLL scatter-max ([B] -> [n_keys, m]).  Max-updates are idempotent,
     # so iterations past the first change nothing; the carry chain still
