@@ -624,10 +624,16 @@ def bench_recall() -> dict:
     from ruleset_analysis_tpu.models.pipeline import register_bytes
     from ruleset_analysis_tpu.runtime.stream import run_stream_packed
 
+    import os
+
     on_tpu = jax.devices()[0].platform == "tpu"
     packed = _setup(n_acls=8, rules_per_acl=128)  # 1024 rule keys + denies
     chunk = 1 << 20
-    n_chunks_ = 96 if on_tpu else 1  # 100.7M lines on TPU; 1M CPU fallback
+    # RA_RECALL_CHUNKS overrides the scale (e.g. a deliberate 1e8-line CPU
+    # certification run: accuracy is platform-independent, only slower)
+    n_chunks_ = int(
+        os.environ.get("RA_RECALL_CHUNKS", "0")
+    ) or (96 if on_tpu else 1)
     feeds = [np.ascontiguousarray(_tuples(packed, chunk, seed=100 + i).T)
              for i in range(2)]
     total = n_chunks_ * chunk
