@@ -42,11 +42,11 @@ assemble() {
     local complete=false
     [ "$n_done" -eq "$total" ] && complete=true
     {
-        echo "{\"note\": \"TPU run (axon tunnel), captured per-config by tools/tpu_capture.sh. cms/hll/topk accuracy lines carried from the committed interim artifact (platform-independent).\", \"platform\": \"tpu\", \"suite_configs_completed\": $n_done, \"suite_configs_total\": $total, \"complete\": $complete}"
+        echo "{\"note\": \"TPU run (axon tunnel), captured per-config by tools/tpu_capture.sh. cms/hll/topk accuracy lines carried from the round-4 fresh accuracy artifact (platform-independent).\", \"platform\": \"tpu\", \"suite_configs_completed\": $n_done, \"suite_configs_total\": $total, \"complete\": $complete}"
         for c in "${CONFIGS[@]}"; do
             [ -s "$BANK/$c.jsonl" ] && cat "$BANK/$c.jsonl"
         done
-        grep -E '"config2_|"config3_|"config5_' BENCH_SUITE_r03_interim_cpu.json
+        grep -E '"config2_|"config3_|"config5_' BENCH_SUITE_r04_accuracy_cpu.json
     } > BENCH_SUITE_r04_tpu.json
     echo "assembled BENCH_SUITE_r04_tpu.json ($n_done/$total configs)" >&2
 }
