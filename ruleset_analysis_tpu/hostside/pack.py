@@ -34,6 +34,7 @@ import json
 
 import numpy as np
 
+from ..errors import AnalysisError
 from .aclparse import Ruleset
 from .syslog import ParsedLine, parse_line
 
@@ -475,10 +476,46 @@ def save_packed(packed: PackedRuleset, path_prefix: str) -> None:
         json.dump(meta, f)
 
 
+#: (lo, hi, name) column pairs that every rule row must keep ordered.  The
+#: device predicate is the branch-free wraparound check (x - lo) <= (hi - lo)
+#: on uint32, which assumes lo <= hi: an inverted pair would silently match
+#: almost every value instead of matching nothing (ADVICE r4, medium).
+_RANGE_COLS = (
+    (R_PLO, R_PHI, "proto"),
+    (R_SLO, R_SHI, "src"),
+    (R_SPLO, R_SPHI, "sport"),
+    (R_DLO, R_DHI, "dst"),
+    (R_DPLO, R_DPHI, "dport"),
+)
+
+
+def validate_rule_ranges(rules: np.ndarray) -> None:
+    """Reject rule rows with inverted lo/hi ranges.
+
+    The parser refuses inverted ranges at parse time (aclparse), but a
+    packed artifact saved by an older build may still carry one; under the
+    wraparound predicate it would inflate that rule's hit count and remove
+    it from the unused/deletion-candidate set with no error.  Fail loudly
+    instead, naming the first offending row.
+    """
+    for lo, hi, name in _RANGE_COLS:
+        bad = np.nonzero(rules[:, lo] > rules[:, hi])[0]
+        if bad.size:
+            row = int(bad[0])
+            raise AnalysisError(
+                f"packed ruleset row {row} has inverted {name} range "
+                f"[{int(rules[row, lo])}, {int(rules[row, hi])}]"
+                f" ({bad.size} offending row(s) total); the artifact was "
+                "likely written by a pre-wraparound-check build — re-pack "
+                "it with parse-acls/convert"
+            )
+
+
 def load_packed(path_prefix: str) -> PackedRuleset:
     z = np.load(path_prefix + ".npz")
     with open(path_prefix + ".json", "r", encoding="utf-8") as f:
         meta = json.load(f)
+    validate_rule_ranges(z["rules"])
     return PackedRuleset(
         rules=z["rules"],
         n_rules=int(z["n_rules"]),

@@ -247,7 +247,16 @@ class _WireFile:
 
     def close(self) -> None:
         if self._mm is not None:
-            self._mm.close()
+            try:
+                self._mm.close()
+            except BufferError:
+                # A zero-copy block() view is still alive somewhere (e.g.
+                # a chunk-loop frame kept reachable by an in-flight
+                # exception traceback).  mmap refuses to close under live
+                # exports; dropping our reference lets GC finalize the
+                # mapping once the last view dies — and close() must not
+                # replace the caller's real exception with a BufferError.
+                pass
             self._mm = None
 
     def block(self, b: int) -> np.ndarray:

@@ -100,7 +100,11 @@ def select_candidates(talk_cms, acl, src, valid, k, slots: int = CAND_SLOTS,
     with the same ``salt``, which is why streaming callers pass a
     per-chunk salt: the suppressed pair surfaces under the next salt.
     """
-    if sample_shift:
+    # A per-shard batch smaller than the stride would leave bs == 0 and
+    # feed ZERO candidates every chunk — an empty talker report with no
+    # warning (ADVICE r4).  Degrade to exact full-batch selection instead;
+    # shapes are static so this resolves at trace time.
+    if sample_shift and acl.shape[0] >= (1 << sample_shift):
         stride = 1 << sample_shift
         bs = (acl.shape[0] // stride) * stride
         phase = jnp.asarray(salt, dtype=_U32) % _U32(stride)

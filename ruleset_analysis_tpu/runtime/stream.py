@@ -226,6 +226,10 @@ class _WireFileSource:
             self.packer.skipped += inv - (wire.shape[1] - n)
             yield wire, n
 
+    def close(self) -> None:
+        """Release the reader's mmaps/fds (called from _run_core's finally)."""
+        self.reader.close()
+
     def totals_patch(self, complete: bool) -> dict:
         """True raw-line accounting once the whole input was consumed.
 
@@ -441,289 +445,297 @@ def run_stream_file_distributed(
         source = _FileSource(packed, local_paths) if native else _TextSource(
             packed, _iter_files(local_paths)
         )
-    wire_src = getattr(source, "yields_wire", False)
+    try:
+        wire_src = getattr(source, "yields_wire", False)
 
-    mesh = dist.make_global_mesh(cfg.mesh_axis)
-    pid, nproc = jax.process_index(), jax.process_count()
-    global_batch = mesh_lib.pad_batch_size(
-        max(cfg.batch_size, 2 if packed.bindings_out else 1) * nproc,
-        mesh, cfg.mesh_axis,
-    )
-    local_batch = global_batch // nproc
-
-    if stacked:
-        from ..hostside.pack import GroupBuffer, stack_rules
-
-        # per-GLOBAL-batch lane, sharded over every device; each process
-        # contributes its local lane slice from its own group buffer
-        lane = cfg.stacked_lane or max(1, cfg.batch_size // max(1, packed.n_acls))
-        lane = mesh_lib.pad_batch_size(lane * nproc, mesh, cfg.mesh_axis)
-        local_lane = lane // nproc
-        rules = pipeline.DeviceRulesetStacked(
-            rules3d=dist.to_global(mesh, stack_rules(packed), P()),
-            deny_key=dist.to_global(
-                mesh, packed.deny_key.astype(np.uint32), P()
-            ),
+        mesh = dist.make_global_mesh(cfg.mesh_axis)
+        pid, nproc = jax.process_index(), jax.process_count()
+        global_batch = mesh_lib.pad_batch_size(
+            max(cfg.batch_size, 2 if packed.bindings_out else 1) * nproc,
+            mesh, cfg.mesh_axis,
         )
-        step = make_parallel_step_stacked(mesh, cfg, packed.n_keys)
-        gbuf = GroupBuffer(max(packed.n_acls, 1), local_lane)
-    else:
-        rules_host = pipeline.ship_ruleset_host(packed)
-        rules = pipeline.DeviceRuleset(
-            rules=dist.to_global(mesh, rules_host.rules, P()),
-            deny_key=dist.to_global(mesh, rules_host.deny_key, P()),
-            rules_fm=None,
-        )
-        step = make_parallel_step(mesh, cfg, packed.n_keys)
-        gbuf = None
-    packer = source.packer
-    pending: deque[pipeline.ChunkOut] = deque()
+        local_batch = global_batch // nproc
 
-    from . import checkpoint as ckpt
-
-    # per-process snapshot dir: registers are identical everywhere, but
-    # the offset is into THIS process's own input split
-    my_ckpt_dir = os.path.join(cfg.checkpoint_dir, f"proc-{pid}-of-{nproc}")
-    fp = (
-        ckpt.fingerprint(
-            packed, cfg, mesh.shape[cfg.mesh_axis], local_lane if stacked else 0
-        )
-        + f"-dist{pid}of{nproc}"
-        + ("-wire" if wire_src else "")
-    )
-    lines_consumed = 0
-    n_chunks = 0
-    snap = None
-    if cfg.resume:
-        # Every process must reach every allgather: evaluate ALL local
-        # conditions first, gather once, and raise the SAME verdict
-        # everywhere — a lone early raise would leave the other processes
-        # blocked in the next collective instead of surfacing the error.
-        layout_err = _dist_ckpt_layout_error(cfg.checkpoint_dir, nproc)
-        snap = ckpt.load(my_ckpt_dir) if layout_err is None else None
-        local_state = 0  # 0 = no snapshot
-        if layout_err is not None:
-            local_state = 3  # foreign process layout
-        elif snap is not None:
-            local_state = 1 if snap.fingerprint == fp else 2
-        states = dist.value_across_processes(local_state)
-        chunks_all = dist.value_across_processes(
-            snap.n_chunks if snap is not None else -1
-        )
-        if (states == 3).any():
-            raise ckpt.CheckpointMismatch(
-                layout_err
-                or f"another process found a foreign process layout in "
-                f"{cfg.checkpoint_dir!r}"
-            )
-        if (states == 2).any():
-            raise ckpt.CheckpointMismatch(
-                f"snapshot under {cfg.checkpoint_dir!r} was taken with a "
-                "different ruleset, geometry, or process layout; refusing "
-                "to merge"
-            )
-        n_have = int((states == 1).sum())
-        if 0 < n_have < nproc:
-            raise ckpt.CheckpointMismatch(
-                f"only {n_have}/{nproc} processes found a snapshot in "
-                f"{cfg.checkpoint_dir!r}; all or none must resume"
-            )
-        if n_have and not (chunks_all == chunks_all[0]).all():
-            raise ckpt.CheckpointMismatch(
-                "processes hold snapshots from different chunk counts "
-                f"({chunks_all.tolist()}); the checkpoint is inconsistent"
-            )
-    if snap is not None:
-        state = ckpt.state_of(snap, lambda v: dist.to_global(mesh, v, P()))
-        tracker = ckpt.restore_tracker(snap, cfg.sketch.topk_capacity)
-        source.set_counts(snap.parsed, snap.skipped)
-        lines_consumed = snap.lines_consumed
-        n_chunks = snap.n_chunks
-    else:
-        state_host = pipeline.init_state_host(packed.n_keys, cfg)
-        state = pipeline.AnalysisState(
-            **{
-                k: dist.to_global(mesh, getattr(state_host, k), P())
-                for k in pipeline.AnalysisState._fields
-            }
-        )
-        tracker = TopKTracker(cfg.sketch.topk_capacity)
-    lines_at_start = lines_consumed  # throughput covers this run only
-
-    def drain(out: pipeline.ChunkOut) -> None:
-        tracker.offer_chunk(
-            np.asarray(out.cand_acl), np.asarray(out.cand_src), np.asarray(out.cand_est)
-        )
-
-    def collective_flush() -> None:
-        # Snapshot barrier for the stacked layout (VERDICT r3 #4): flush
-        # emissions are data-dependent per process, so every process
-        # drains its group buffer through the SAME lockstep ready-queue
-        # protocol the end-of-stream path uses — processes whose queue ran
-        # dry keep stepping padded batches until everyone is empty, so all
-        # processes reach the snapshot at the same chunk count with no
-        # lines in limbo.
-        ready.extend(gbuf.flush())
-        while True:
-            has = bool(ready)
-            if not dist.all_processes_have_data(has):
-                break
-            step_grouped_round(has)
-
-    def save_snapshot() -> None:
         if stacked:
-            collective_flush()
-        while pending:
-            drain(pending.popleft())
-        pipeline.sync_state(state)
-        ckpt.save(
-            my_ckpt_dir,
-            ckpt.snapshot_of(
-                state,
-                lines_consumed=lines_consumed,
-                n_chunks=n_chunks,
-                parsed=packer.parsed,
-                skipped=packer.skipped,
-                tracker=tracker,
-                fingerprint=fp,
-            ),
-        )
+            from ..hostside.pack import GroupBuffer, stack_rules
 
-    from .metrics import ThroughputMeter
-
-    meter = ThroughputMeter(cfg.report_every_chunks)
-    it = source.batches(lines_consumed, local_batch)
-    empty_cols = pack_mod.WIRE_COLS if wire_src else TUPLE_COLS
-    empty = (
-        None if stacked else np.zeros((empty_cols, local_batch), dtype=np.uint32)
-    )
-    last_snap_chunks = n_chunks
-    chunks_this_run = 0
-    aborted = False
-    # Stacked: grouped batches emit from the group buffer at a
-    # data-dependent cadence, so a ready-queue decouples source pulls from
-    # the collective loop — each round steps at most ONE grouped batch per
-    # process, and processes whose queue ran dry pad with an all-invalid
-    # batch until every queue is empty.
-    ready: deque[np.ndarray] = deque()
-    src_done = False
-
-    def refill_ready() -> None:
-        nonlocal src_done, lines_consumed
-        while not ready and not src_done:
-            nxt = next(it, None)
-            if nxt is None:
-                src_done = True
-                ready.extend(gbuf.flush())
-                return
-            batch_np, n_raw = nxt
-            lines_consumed += n_raw
-            meter.tick(n_raw)
-            cols = pack_mod.expand_batch(batch_np) if wire_src else batch_np
-            ready.extend(gbuf.add(np.ascontiguousarray(cols.T)))
-
-    def step_grouped_round(has: bool) -> None:
-        nonlocal state, n_chunks
-        grouped = (
-            ready.popleft()
-            if has
-            else np.zeros(
-                (max(packed.n_acls, 1), TUPLE_COLS, local_lane), dtype=np.uint32
+            # per-GLOBAL-batch lane, sharded over every device; each process
+            # contributes its local lane slice from its own group buffer
+            lane = cfg.stacked_lane or max(1, cfg.batch_size // max(1, packed.n_acls))
+            lane = mesh_lib.pad_batch_size(lane * nproc, mesh, cfg.mesh_axis)
+            local_lane = lane // nproc
+            rules = pipeline.DeviceRulesetStacked(
+                rules3d=dist.to_global(mesh, stack_rules(packed), P()),
+                deny_key=dist.to_global(
+                    mesh, packed.deny_key.astype(np.uint32), P()
+                ),
             )
-        )
-        wire = pack_mod.compact_grouped(grouped)
-        gbatch = dist.to_global(mesh, wire, P(None, None, cfg.mesh_axis))
-        state, out = step(state, rules, gbatch, n_chunks)
-        pending.append(out)
-        if len(pending) > 2:
-            drain(pending.popleft())
-        n_chunks += 1
-
-    while True:
-        if stacked:
-            refill_ready()
-            has = bool(ready)
+            step = make_parallel_step_stacked(mesh, cfg, packed.n_keys)
+            gbuf = GroupBuffer(max(packed.n_acls, 1), local_lane)
         else:
-            nxt = next(it, None)
-            has = nxt is not None
-        # collective agreement: everyone steps while anyone has data
-        if not dist.all_processes_have_data(has):
-            break
-        if stacked:
-            step_grouped_round(has)
+            rules_host = pipeline.ship_ruleset_host(packed)
+            rules = pipeline.DeviceRuleset(
+                rules=dist.to_global(mesh, rules_host.rules, P()),
+                deny_key=dist.to_global(mesh, rules_host.deny_key, P()),
+                rules_fm=None,
+            )
+            step = make_parallel_step(mesh, cfg, packed.n_keys)
+            gbuf = None
+        packer = source.packer
+        pending: deque[pipeline.ChunkOut] = deque()
+
+        from . import checkpoint as ckpt
+
+        # per-process snapshot dir: registers are identical everywhere, but
+        # the offset is into THIS process's own input split
+        my_ckpt_dir = os.path.join(cfg.checkpoint_dir, f"proc-{pid}-of-{nproc}")
+        fp = (
+            ckpt.fingerprint(
+                packed, cfg, mesh.shape[cfg.mesh_axis], local_lane if stacked else 0
+            )
+            + f"-dist{pid}of{nproc}"
+            + ("-wire" if wire_src else "")
+        )
+        lines_consumed = 0
+        n_chunks = 0
+        snap = None
+        if cfg.resume:
+            # Every process must reach every allgather: evaluate ALL local
+            # conditions first, gather once, and raise the SAME verdict
+            # everywhere — a lone early raise would leave the other processes
+            # blocked in the next collective instead of surfacing the error.
+            layout_err = _dist_ckpt_layout_error(cfg.checkpoint_dir, nproc)
+            snap = ckpt.load(my_ckpt_dir) if layout_err is None else None
+            local_state = 0  # 0 = no snapshot
+            if layout_err is not None:
+                local_state = 3  # foreign process layout
+            elif snap is not None:
+                local_state = 1 if snap.fingerprint == fp else 2
+            states = dist.value_across_processes(local_state)
+            chunks_all = dist.value_across_processes(
+                snap.n_chunks if snap is not None else -1
+            )
+            if (states == 3).any():
+                raise ckpt.CheckpointMismatch(
+                    layout_err
+                    or f"another process found a foreign process layout in "
+                    f"{cfg.checkpoint_dir!r}"
+                )
+            if (states == 2).any():
+                raise ckpt.CheckpointMismatch(
+                    f"snapshot under {cfg.checkpoint_dir!r} was taken with a "
+                    "different ruleset, geometry, or process layout; refusing "
+                    "to merge"
+                )
+            n_have = int((states == 1).sum())
+            if 0 < n_have < nproc:
+                raise ckpt.CheckpointMismatch(
+                    f"only {n_have}/{nproc} processes found a snapshot in "
+                    f"{cfg.checkpoint_dir!r}; all or none must resume"
+                )
+            if n_have and not (chunks_all == chunks_all[0]).all():
+                raise ckpt.CheckpointMismatch(
+                    "processes hold snapshots from different chunk counts "
+                    f"({chunks_all.tolist()}); the checkpoint is inconsistent"
+                )
+        if snap is not None:
+            state = ckpt.state_of(snap, lambda v: dist.to_global(mesh, v, P()))
+            tracker = ckpt.restore_tracker(snap, cfg.sketch.topk_capacity)
+            source.set_counts(snap.parsed, snap.skipped)
+            lines_consumed = snap.lines_consumed
+            n_chunks = snap.n_chunks
         else:
-            batch_np, n_raw = nxt if has else (empty, 0)
-            lines_consumed += n_raw
-            meter.tick(n_raw)
-            wire = batch_np if wire_src else pack_mod.compact_batch(batch_np)
-            gbatch = dist.to_global(mesh, wire, P(None, cfg.mesh_axis))
+            state_host = pipeline.init_state_host(packed.n_keys, cfg)
+            state = pipeline.AnalysisState(
+                **{
+                    k: dist.to_global(mesh, getattr(state_host, k), P())
+                    for k in pipeline.AnalysisState._fields
+                }
+            )
+            tracker = TopKTracker(cfg.sketch.topk_capacity)
+        lines_at_start = lines_consumed  # throughput covers this run only
+
+        def drain(out: pipeline.ChunkOut) -> None:
+            tracker.offer_chunk(
+                np.asarray(out.cand_acl), np.asarray(out.cand_src), np.asarray(out.cand_est)
+            )
+
+        def collective_flush() -> None:
+            # Snapshot barrier for the stacked layout (VERDICT r3 #4): flush
+            # emissions are data-dependent per process, so every process
+            # drains its group buffer through the SAME lockstep ready-queue
+            # protocol the end-of-stream path uses — processes whose queue ran
+            # dry keep stepping padded batches until everyone is empty, so all
+            # processes reach the snapshot at the same chunk count with no
+            # lines in limbo.
+            ready.extend(gbuf.flush())
+            while True:
+                has = bool(ready)
+                if not dist.all_processes_have_data(has):
+                    break
+                step_grouped_round(has)
+
+        def save_snapshot() -> None:
+            if stacked:
+                collective_flush()
+            while pending:
+                drain(pending.popleft())
+            pipeline.sync_state(state)
+            ckpt.save(
+                my_ckpt_dir,
+                ckpt.snapshot_of(
+                    state,
+                    lines_consumed=lines_consumed,
+                    n_chunks=n_chunks,
+                    parsed=packer.parsed,
+                    skipped=packer.skipped,
+                    tracker=tracker,
+                    fingerprint=fp,
+                ),
+            )
+
+        from .metrics import ThroughputMeter
+
+        meter = ThroughputMeter(cfg.report_every_chunks)
+        it = source.batches(lines_consumed, local_batch)
+        empty_cols = pack_mod.WIRE_COLS if wire_src else TUPLE_COLS
+        empty = (
+            None if stacked else np.zeros((empty_cols, local_batch), dtype=np.uint32)
+        )
+        last_snap_chunks = n_chunks
+        chunks_this_run = 0
+        aborted = False
+        # Stacked: grouped batches emit from the group buffer at a
+        # data-dependent cadence, so a ready-queue decouples source pulls from
+        # the collective loop — each round steps at most ONE grouped batch per
+        # process, and processes whose queue ran dry pad with an all-invalid
+        # batch until every queue is empty.
+        ready: deque[np.ndarray] = deque()
+        src_done = False
+
+        def refill_ready() -> None:
+            nonlocal src_done, lines_consumed
+            while not ready and not src_done:
+                nxt = next(it, None)
+                if nxt is None:
+                    src_done = True
+                    ready.extend(gbuf.flush())
+                    return
+                batch_np, n_raw = nxt
+                lines_consumed += n_raw
+                meter.tick(n_raw)
+                cols = pack_mod.expand_batch(batch_np) if wire_src else batch_np
+                ready.extend(gbuf.add(np.ascontiguousarray(cols.T)))
+
+        def step_grouped_round(has: bool) -> None:
+            nonlocal state, n_chunks
+            grouped = (
+                ready.popleft()
+                if has
+                else np.zeros(
+                    (max(packed.n_acls, 1), TUPLE_COLS, local_lane), dtype=np.uint32
+                )
+            )
+            wire = pack_mod.compact_grouped(grouped)
+            gbatch = dist.to_global(mesh, wire, P(None, None, cfg.mesh_axis))
             state, out = step(state, rules, gbatch, n_chunks)
             pending.append(out)
             if len(pending) > 2:
                 drain(pending.popleft())
             n_chunks += 1
-        chunks_this_run += 1
-        # the loop is collective, so every process reaches the cadence at
-        # the same n_chunks and snapshots the same register state
-        if (
-            cfg.checkpoint_every_chunks
-            and n_chunks - last_snap_chunks >= cfg.checkpoint_every_chunks
-        ):
-            save_snapshot()
-            last_snap_chunks = n_chunks
-        if max_chunks is not None and chunks_this_run >= max_chunks:
-            aborted = True  # crash simulation: skip the final snapshot
-            break
 
-    if stacked and aborted:
-        # drain buffered lines after a max_chunks abort: they are already
-        # counted in lines_consumed / the packer counters, and a report
-        # claiming lines the registers never saw would be a lie (the same
-        # invariant _run_core's post-abort gbuf flush preserves).  The
-        # drain stays collective: everyone keeps stepping until every
-        # process's queue is dry.
-        src_done = True
-        ready.extend(gbuf.flush())
         while True:
-            has = bool(ready)
+            if stacked:
+                refill_ready()
+                has = bool(ready)
+            else:
+                nxt = next(it, None)
+                has = nxt is not None
+            # collective agreement: everyone steps while anyone has data
             if not dist.all_processes_have_data(has):
                 break
-            step_grouped_round(has)
+            if stacked:
+                step_grouped_round(has)
+            else:
+                batch_np, n_raw = nxt if has else (empty, 0)
+                lines_consumed += n_raw
+                meter.tick(n_raw)
+                wire = batch_np if wire_src else pack_mod.compact_batch(batch_np)
+                gbatch = dist.to_global(mesh, wire, P(None, cfg.mesh_axis))
+                state, out = step(state, rules, gbatch, n_chunks)
+                pending.append(out)
+                if len(pending) > 2:
+                    drain(pending.popleft())
+                n_chunks += 1
+            chunks_this_run += 1
+            # the loop is collective, so every process reaches the cadence at
+            # the same n_chunks and snapshots the same register state
+            if (
+                cfg.checkpoint_every_chunks
+                and n_chunks - last_snap_chunks >= cfg.checkpoint_every_chunks
+            ):
+                save_snapshot()
+                last_snap_chunks = n_chunks
+            if max_chunks is not None and chunks_this_run >= max_chunks:
+                aborted = True  # crash simulation: skip the final snapshot
+                break
 
-    pipeline.sync_state(state)
-    elapsed = meter.elapsed()  # before the final snapshot write (as _run_core)
-    if cfg.checkpoint_every_chunks and not aborted:
-        save_snapshot()
-    while pending:
-        drain(pending.popleft())
-    local_total, local_skipped = lines_consumed, packer.skipped
-    if wire_src and not aborted:
-        # restore the converter's raw-line accounting for this process's
-        # fully-consumed wire split (rows != raw text lines)
-        p = source.totals_patch(True)
-        local_total, local_skipped = p["lines_total"], p["lines_skipped"]
-    agg = dist.sum_across_processes(
-        {
-            "lines_total": local_total,
-            "lines_matched": packer.parsed,
-            "lines_skipped": local_skipped,
-            # throughput covers THIS run's lines only (totals above are
-            # cumulative across resumes)
-            "lines_this_run": lines_consumed - lines_at_start,
+        if stacked and aborted:
+            # drain buffered lines after a max_chunks abort: they are already
+            # counted in lines_consumed / the packer counters, and a report
+            # claiming lines the registers never saw would be a lie (the same
+            # invariant _run_core's post-abort gbuf flush preserves).  The
+            # drain stays collective: everyone keeps stepping until every
+            # process's queue is dry.
+            src_done = True
+            ready.extend(gbuf.flush())
+            while True:
+                has = bool(ready)
+                if not dist.all_processes_have_data(has):
+                    break
+                step_grouped_round(has)
+
+        pipeline.sync_state(state)
+        elapsed = meter.elapsed()  # before the final snapshot write (as _run_core)
+        if cfg.checkpoint_every_chunks and not aborted:
+            save_snapshot()
+        while pending:
+            drain(pending.popleft())
+        local_total, local_skipped = lines_consumed, packer.skipped
+        if wire_src and not aborted:
+            # restore the converter's raw-line accounting for this process's
+            # fully-consumed wire split (rows != raw text lines)
+            p = source.totals_patch(True)
+            local_total, local_skipped = p["lines_total"], p["lines_skipped"]
+        agg = dist.sum_across_processes(
+            {
+                "lines_total": local_total,
+                "lines_matched": packer.parsed,
+                "lines_skipped": local_skipped,
+                # throughput covers THIS run's lines only (totals above are
+                # cumulative across resumes)
+                "lines_this_run": lines_consumed - lines_at_start,
+            }
+        )
+        lines_this_run = agg.pop("lines_this_run")
+        totals = {
+            **agg,
+            "chunks": n_chunks,
+            "processes": nproc,
+            "elapsed_sec": round(elapsed, 4),
+            "lines_per_sec": round(lines_this_run / elapsed, 1) if elapsed > 0 else 0.0,
         }
-    )
-    lines_this_run = agg.pop("lines_this_run")
-    totals = {
-        **agg,
-        "chunks": n_chunks,
-        "processes": nproc,
-        "elapsed_sec": round(elapsed, 4),
-        "lines_per_sec": round(lines_this_run / elapsed, 1) if elapsed > 0 else 0.0,
-    }
-    report = pipeline.finalize(state, packed, cfg, tracker, topk=topk, totals=totals)
-    if return_state:
-        return report, pipeline.state_to_host(state)
-    return report
+        report = pipeline.finalize(state, packed, cfg, tracker, topk=topk, totals=totals)
+        if return_state:
+            return report, pipeline.state_to_host(state)
+        return report
+    finally:
+        # release the wire mmaps deterministically (ADVICE r4): a
+        # long-lived driver iterating many wire inputs must not wait
+        # for GC to drop file mappings
+        close = getattr(source, "close", None)
+        if close is not None:
+            close()
 
 
 def _iter_files(paths: list[str]):
@@ -768,6 +780,38 @@ def _dist_ckpt_layout_error(ckpt_dir: str, nproc: int) -> str | None:
 
 
 def _run_core(
+    packed: PackedRuleset,
+    source,
+    cfg: AnalysisConfig,
+    *,
+    topk: int,
+    mesh,
+    profile_dir: str | None,
+    max_chunks: int | None,
+):
+    """Run the chunk loop, deterministically closing the source after.
+
+    Sources holding OS resources (the wire reader's mmaps) expose
+    ``close()``; releasing them here instead of at GC time keeps repeated
+    wire runs in one process from accumulating file mappings (ADVICE r4).
+    """
+    try:
+        return _run_core_impl(
+            packed,
+            source,
+            cfg,
+            topk=topk,
+            mesh=mesh,
+            profile_dir=profile_dir,
+            max_chunks=max_chunks,
+        )
+    finally:
+        close = getattr(source, "close", None)
+        if close is not None:
+            close()
+
+
+def _run_core_impl(
     packed: PackedRuleset,
     source,
     cfg: AnalysisConfig,

@@ -119,3 +119,31 @@ def test_pack_overflow_is_clean_error():
     )
     with pytest.raises(ValueError, match="batch_size"):
         lp.pack_lines([line] * 8, batch_size=4)
+
+
+def test_load_packed_rejects_inverted_range(tmp_path):
+    """A stale artifact with an inverted lo/hi range must fail loudly.
+
+    Under the wraparound predicate (x - lo) <= (hi - lo) an inverted
+    range matches nearly everything, silently inflating that rule's hits
+    and hiding it from the unused set (ADVICE r4, medium) — so load
+    refuses the matrix instead of shipping it.
+    """
+    import pytest
+
+    from ruleset_analysis_tpu.errors import AnalysisError
+
+    packed, _ = packed_fixture()
+    prefix = str(tmp_path / "stale")
+    # simulate a pre-wraparound-check build: dport range [443, 80]
+    packed.rules[0, pack.R_DPLO] = 443
+    packed.rules[0, pack.R_DPHI] = 80
+    pack.save_packed(packed, prefix)
+    with pytest.raises(AnalysisError, match=r"row 0.*inverted dport|inverted dport.*row 0"):
+        pack.load_packed(prefix)
+
+
+def test_validate_rule_ranges_accepts_padding_and_full_ranges():
+    rs = aclparse.parse_asa_config(CFG, "fw1")
+    packed = pack.pack_rulesets([rs], pad_rules_to=32)
+    pack.validate_rule_ranges(packed.rules)  # must not raise

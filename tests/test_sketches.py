@@ -322,3 +322,20 @@ def test_talker_sampled_phase_rotates_with_salt():
     seen = [len(cands(s)) > 0 for s in range(stride)]
     assert any(seen), "rotation never reached the valid phase"
     assert not all(seen), "with only phase-5 valid, other phases must be empty"
+
+
+def test_talker_sampled_selection_small_batch_degrades_to_exact():
+    """A per-shard batch smaller than 2**shift must fall back to exact
+    full-batch selection — not silently select zero candidates every
+    chunk (ADVICE r4)."""
+    b = 4  # < 2**3
+    src = np.asarray([7, 7, 7, 9], dtype=np.uint32)
+    acl = np.zeros(b, dtype=np.uint32)
+    valid = np.ones(b, dtype=np.uint32)
+    sk = cms_ops.cms_init(1 << 10, 2)
+    _, ca, cs, ce = topk_ops.talker_chunk_update(
+        sk, jnp.asarray(acl), jnp.asarray(src), jnp.asarray(valid), 4,
+        salt=1, sample_shift=3,
+    )
+    winners = set(np.asarray(cs)[np.asarray(ce) > 0].tolist())
+    assert 7 in winners

@@ -439,3 +439,56 @@ def test_cli_topk_sample_shift_plumbing(corpus, tmp_path, capsys):
     hb = {(e["firewall"], e["acl"], e["index"]): e["hits"] for e in b["per_rule"]}
     assert ha == hb
     assert a["unused"] == b["unused"]
+
+
+def test_wire_run_closes_reader_deterministically(corpus, wire_path, monkeypatch):
+    """run_stream_wire must release the reader's mmaps in a finally —
+    long-lived drivers over many wire inputs cannot wait for GC
+    (ADVICE r4)."""
+    closed = []
+    orig = wire.WireReader.close
+
+    def spy(self):
+        closed.append(True)
+        return orig(self)
+
+    monkeypatch.setattr(wire.WireReader, "close", spy)
+    run_stream_wire(corpus[0], wire_path, make_cfg(), topk=5)
+    assert closed, "WireReader.close was never called by the run driver"
+
+
+def test_wire_close_tolerates_live_zero_copy_views(corpus, tmp_path):
+    """block_rows == batch_size serves zero-copy mmap views; the finally-
+    block close() must not raise BufferError while a suspended generator
+    (max_chunks abort) or loop frame still holds the last view."""
+    packed, _rs, logs, _lines = corpus
+    out = tmp_path / "aligned.rawire"
+    wire.convert_logs(packed, logs, str(out), block_rows=512)
+    rep = run_stream_wire(
+        packed, str(out), make_cfg(batch_size=512), topk=5, max_chunks=1
+    )
+    assert rep.totals["chunks"] == 1  # aborted cleanly, no BufferError
+
+
+def test_wire_midrun_error_not_replaced_by_buffererror(corpus, tmp_path, monkeypatch):
+    """An exception raised mid-run (device failure analog) must propagate
+    as itself: the close() in the finally runs while the traceback keeps
+    the chunk-loop frame (and its mmap view) alive, and a BufferError
+    there would mask the real error from callers catching specific types."""
+    from ruleset_analysis_tpu.parallel import mesh as mesh_lib
+
+    packed, _rs, logs, _lines = corpus
+    out = tmp_path / "aligned2.rawire"
+    wire.convert_logs(packed, logs, str(out), block_rows=512)
+    calls = []
+    orig = mesh_lib.shard_batch
+
+    def boom(*a, **kw):
+        calls.append(True)
+        if len(calls) == 2:
+            raise RuntimeError("injected device failure")
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(mesh_lib, "shard_batch", boom)
+    with pytest.raises(RuntimeError, match="injected device failure"):
+        run_stream_wire(packed, str(out), make_cfg(batch_size=512), topk=5)
