@@ -597,6 +597,61 @@ def _run_child(env: dict | None, cpu_scale: bool, timeout: float) -> dict | None
     return None
 
 
+def _last_tpu_evidence() -> dict | None:
+    """Newest banked real-TPU headline, for embedding in fallback output.
+
+    The driver's artifact has been evidence-free whenever the axon tunnel
+    was down at bench time (VERDICT r4 missing #4) even though a committed
+    TPU capture existed in the repo.  Embed that capture — value, capture
+    commit, validation checks — so BENCH_r{N}.json always carries the TPU
+    evidence trail regardless of tunnel state.
+    """
+    import glob as glob_mod
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = sorted(
+        glob_mod.glob(os.path.join(here, "BENCH_r*_local.json")), reverse=True
+    )
+    for path in candidates:
+        name = os.path.basename(path)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                d = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if d.get("detail", {}).get("platform") != "tpu" or not d.get("value"):
+            continue
+        commit = None
+        try:
+            out = subprocess.run(
+                ["git", "log", "-1", "--format=%H %cI", "--", name],
+                cwd=here, capture_output=True, text=True, timeout=10,
+            )
+            if out.returncode == 0 and out.stdout.strip():
+                sha, _, when = out.stdout.strip().partition(" ")
+                commit = {"sha": sha, "committed_at": when}
+        except (OSError, subprocess.SubprocessError):
+            pass
+        return {
+            "source": name,
+            "metric": d.get("metric"),
+            "value": d.get("value"),
+            "unit": d.get("unit"),
+            "vs_baseline": d.get("vs_baseline"),
+            "checks": d.get("detail", {}).get("checks"),
+            "commit": commit,
+        }
+    return None
+
+
+def _with_last_tpu(obj: dict) -> dict:
+    """Attach the newest banked TPU headline to a non-TPU bench result."""
+    last = _last_tpu_evidence()
+    if last is not None:
+        obj["last_tpu"] = last
+    return obj
+
+
 def main(argv: list[str]) -> int:
     if "--run" in argv:
         # child mode: assume the backend this env selects is healthy; let
@@ -633,17 +688,19 @@ def main(argv: list[str]) -> int:
         result["backend"] = "cpu-fallback"
         result.setdefault("detail", {})["tpu_unavailable"] = failure[:500]
         result["vs_baseline"] = 0.0  # a CPU number is not the per-chip claim
-        emit(result)
+        emit(_with_last_tpu(result))
         return 0
 
     emit(
-        {
-            "metric": "asa_syslog_lines_per_sec_per_chip",
-            "value": 0.0,
-            "unit": "lines/sec/chip",
-            "vs_baseline": 0.0,
-            "error": f"all backends failed; last: {failure[:500]}",
-        }
+        _with_last_tpu(
+            {
+                "metric": "asa_syslog_lines_per_sec_per_chip",
+                "value": 0.0,
+                "unit": "lines/sec/chip",
+                "vs_baseline": 0.0,
+                "error": f"all backends failed; last: {failure[:500]}",
+            }
+        )
     )
     return 0
 
