@@ -145,6 +145,12 @@ class AclParseError(ValueError):
 
 
 def ip_to_u32(s: str) -> int:
+    # Name IPv6 explicitly in the skip reason: the packed model is
+    # v4-only (DESIGN.md "IPv6 position"), and lenient-mode accounting
+    # should say WHY a line was skipped, not just that the text looked
+    # wrong.  ASA spells v6 ACEs with colon literals or the any6 keyword.
+    if ":" in s or s == "any6":
+        raise AclParseError(f"IPv6 address (v4-only packed model): {s!r}")
     parts = s.split(".")
     if len(parts) != 4:
         raise AclParseError(f"bad IPv4 address: {s!r}")

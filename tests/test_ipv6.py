@@ -1,0 +1,65 @@
+"""IPv6 position (DESIGN.md; SURVEY.md §8.0 tags v6 "later").
+
+The packed model is v4-only: IPv6 ACEs are counted-skipped in lenient
+mode (preserving later rules' device-side indices), rejected loudly in
+strict mode, and IPv6 syslog lines are parse-skipped — NEVER mis-parsed
+into uint32 columns."""
+
+import pytest
+
+from ruleset_analysis_tpu.hostside import aclparse, pack, syslog
+
+CFG_MIXED = """\
+hostname fw1
+access-list A extended permit tcp any host 10.0.0.5 eq 443
+access-list A extended permit tcp any6 host 2001:db8::5 eq 443
+access-list A extended permit ip host 2001:db8::7 any
+access-list A extended deny ip any any
+access-group A in interface outside
+"""
+
+
+def test_lenient_counts_ipv6_aces_and_preserves_indices():
+    rs = aclparse.parse_asa_config(CFG_MIXED, "fw1", strict=False)
+    # both v6 ACEs are recorded with an explicit IPv6 reason
+    assert len(rs.skipped) == 2
+    for _lineno, reason, _line in rs.skipped:
+        assert "IPv6" in reason
+    # surviving rules keep their config positions: 1 and 4
+    assert [r.index for r in rs.acls["A"]] == [1, 4]
+    # and the pack carries the skip accounting forward
+    packed = pack.pack_rulesets([rs])
+    assert len(packed.parse_skips) == 2
+    assert all("IPv6" in reason for _fw, _lineno, reason in packed.parse_skips)
+
+
+def test_strict_rejects_ipv6_loudly():
+    with pytest.raises(aclparse.AclParseError, match="IPv6"):
+        aclparse.parse_asa_config(CFG_MIXED, "fw1", strict=True)
+
+
+def test_ipv6_syslog_line_is_skipped_not_misparsed():
+    line = (
+        "Jul 29 07:48:01 fw1 : %ASA-6-106100: access-list A permitted tcp "
+        "inside/2001:db8::9(1000) -> outside/10.0.0.5(443) hit-cnt 1 "
+        "first hit [0x0, 0x0]"
+    )
+    assert syslog.parse_line(line) is None
+
+
+def test_ipv6_syslog_lines_land_in_lines_skipped():
+    rs = aclparse.parse_asa_config(CFG_MIXED, "fw1", strict=False)
+    packed = pack.pack_rulesets([rs])
+    lp = pack.LinePacker(packed)
+    v4 = (
+        "Jul 29 07:48:01 fw1 : %ASA-6-106100: access-list A permitted tcp "
+        "inside/1.2.3.4(1000) -> outside/10.0.0.5(443) hit-cnt 1 first hit [0x0, 0x0]"
+    )
+    v6 = (
+        "Jul 29 07:48:02 fw1 : %ASA-6-106100: access-list A permitted tcp "
+        "inside/2001:db8::9(1000) -> outside/10.0.0.5(443) hit-cnt 1 first hit [0x0, 0x0]"
+    )
+    batch = lp.pack_lines([v4, v6, v4], batch_size=4)
+    assert lp.parsed == 2 and lp.skipped == 1
+    # the skipped line contributed no valid evaluation row
+    assert int(batch[:, pack.T_VALID].sum()) == 2
