@@ -324,15 +324,27 @@ def bench_topk() -> dict:
 
 
 def bench_pallas() -> dict:
-    """Match-kernel shootout: XLA-fused vs pallas, small and large rulesets."""
+    """Match-kernel shootout: XLA-fused vs pallas vs pallas_fused.
+
+    pallas_fused also does the exact-counts work (match + in-VMEM count
+    histograms, ops/pallas_fused.py), so its fair comparison is against
+    XLA match + segment_counts — the fused column measures match+counts
+    for all three, deciding whether the batch-sized counts scatter
+    (fusion.5 in the committed trace) is worth a kernel.
+    """
     import jax
     import jax.numpy as jnp
 
     from ruleset_analysis_tpu.hostside import pack
-    from ruleset_analysis_tpu.ops import pallas_match
-    from ruleset_analysis_tpu.ops.match import first_match_rows
+    from ruleset_analysis_tpu.ops import pallas_fused, pallas_match
+    from ruleset_analysis_tpu.ops.counts import segment_counts
+    from ruleset_analysis_tpu.ops.match import first_match_rows, match_keys
 
-    b = 1 << 20
+    on_tpu = jax.devices()[0].platform == "tpu"
+    # CPU runs execute pallas via the interpreter (parity smoke only);
+    # full-size timing there would burn the whole config timeout
+    b = 1 << 20 if on_tpu else 1 << 17
+    n_timing_iters = 10 if on_tpu else 2
     results = {}
     from ruleset_analysis_tpu.models import pipeline
 
@@ -355,7 +367,7 @@ def bench_pallas() -> dict:
             out = fn(*args)
             np.asarray(out[:1])
             t0 = time.perf_counter()
-            n = 10
+            n = n_timing_iters
             for _ in range(n):
                 out = fn(*args)
             np.asarray(out[:1])
@@ -367,13 +379,47 @@ def bench_pallas() -> dict:
         want = np.asarray(xla_fn(cols))
         assert (got == want).all(), f"pallas/xla mismatch ({tag})"
         dt_x, dt_p = run(xla_fn, cols), run(pl_fn, cols)
+
+        # match+counts leg: XLA match_keys + segment_counts vs the fused
+        # kernel (keys AND per-key count delta in one pallas_call)
+        deny = shipped.deny_key
+        valid = jnp.ones(b, dtype=jnp.uint32)
+        n_keys = packed.n_keys
+
+        def xla_mc(c):
+            keys = match_keys(c, rules, deny)
+            return segment_counts(keys, valid, n_keys)
+
+        def fused_mc(c):
+            _keys, delta = pallas_fused.match_keys_and_counts_pallas(
+                c, valid, rules, fm, deny, n_keys
+            )
+            return delta
+
+        xla_mc_fn, fused_mc_fn = jax.jit(xla_mc), jax.jit(fused_mc)
+        d_want = np.asarray(xla_mc_fn(cols))
+        d_got = np.asarray(fused_mc_fn(cols))
+        assert (d_got == d_want).all(), f"fused counts mismatch ({tag})"
+        dt_xc, dt_f = run(xla_mc_fn, cols), run(fused_mc_fn, cols)
+
         results[tag] = {
             "rows": int(rules.shape[0]),
             "xla_mlines_per_sec": round(b / dt_x / 1e6, 1),
             "pallas_mlines_per_sec": round(b / dt_p / 1e6, 1),
             "pallas_speedup": round(dt_x / dt_p, 3),
+            "xla_match_counts_mlines_per_sec": round(b / dt_xc / 1e6, 1),
+            "fused_match_counts_mlines_per_sec": round(b / dt_f / 1e6, 1),
+            "fused_speedup": round(dt_xc / dt_f, 3),
         }
-        log(f"pallas[{tag}]: xla {b/dt_x/1e6:.1f}M vs pallas {b/dt_p/1e6:.1f}M lines/s")
+        log(
+            f"pallas[{tag}]: xla {b/dt_x/1e6:.1f}M vs pallas {b/dt_p/1e6:.1f}M"
+            f" | match+counts: xla {b/dt_xc/1e6:.1f}M vs fused {b/dt_f/1e6:.1f}M lines/s"
+        )
+    results["platform"] = "tpu" if on_tpu else "cpu"
+    results["batch"] = b
+    results["timing_iters"] = n_timing_iters
+    # a CPU run exercises parity only (pallas via the interpreter at 1/8
+    # batch) — its timings must never be read as TPU numbers
     return {
         "metric": "pallas_match_speedup_vs_xla_large_ruleset",
         "value": results["large"]["pallas_speedup"],
@@ -475,13 +521,11 @@ def bench_stage() -> dict:
         expect_scalar(iters * n_valid % M, "counts total"),
     )
 
-    # one-hot matmul alternative: [B] f32 @ [B, n_keys] one-hot -> [K];
-    # exact for per-chunk counts (every product 0/1, sums < 2^24)
-    iota = jnp.arange(n_keys, dtype=u32)
-
+    # one-hot matmul alternative: the SHIPPED formulation
+    # (ops/counts.segment_counts_matmul — what counts_impl="matmul"
+    # actually runs), so a measured default flip prices production code
     def counts_matmul(keys):
-        onehot = (keys[:, None] == iota[None, :]).astype(jnp.float32)
-        return jnp.dot(valid.astype(jnp.float32), onehot).astype(u32)
+        return count_ops.segment_counts_matmul(keys, valid, n_keys)
 
     results["counts_matmul_ms"] = timed(
         "counts-matmul",
@@ -490,12 +534,10 @@ def bench_stage() -> dict:
         expect_scalar(iters * n_valid % M, "matmul counts total"),
     )
 
-    # compare-and-reduce alternative: counts[k] = sum_b (keys==k)*valid —
-    # XLA fuses the compare into the reduction (reductions accept fused
-    # producers, dots do not), so nothing [B, K]-shaped materializes
+    # compare-and-reduce alternative: the SHIPPED formulation
+    # (ops/counts.segment_counts_reduce, counts_impl="reduce")
     def counts_reduce(keys):
-        eq = keys[None, :] == iota[:, None]
-        return jnp.sum(jnp.where(eq, valid, 0).astype(u32), axis=1)
+        return count_ops.segment_counts_reduce(keys, valid, n_keys)
 
     results["counts_reduce_ms"] = timed(
         "counts-reduce",
@@ -627,7 +669,13 @@ def bench_recall() -> dict:
     import os
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    packed = _setup(n_acls=8, rules_per_acl=128)  # 1024 rule keys + denies
+    # RA_RECALL_KEYS ~ fleet size of the key universe (VERDICT r4 #6:
+    # certify at 10k+ keys, not just the 1k of the r4 run); RA_RECALL_LAYOUT
+    # runs the sweep through the stacked (per-ACL slab) path instead of flat.
+    want_keys = int(os.environ.get("RA_RECALL_KEYS", "1024"))
+    n_acls_ = 16 if want_keys >= 4096 else 8
+    packed = _setup(n_acls=n_acls_, rules_per_acl=max(want_keys // n_acls_, 8))
+    layout = os.environ.get("RA_RECALL_LAYOUT", "flat")
     chunk = 1 << 20
     # RA_RECALL_CHUNKS overrides the scale (e.g. a deliberate 1e8-line CPU
     # certification run: accuracy is platform-independent, only slower)
@@ -648,6 +696,7 @@ def bench_recall() -> dict:
             batch_size=chunk,
             sketch=SketchConfig(cms_width=width, cms_depth=depth, hll_p=8),
             exact_counts=exact,
+            layout=layout,
         )
 
     t0 = time.perf_counter()
@@ -656,7 +705,12 @@ def bench_recall() -> dict:
     exact_unused = rep_exact.unused
 
     sweep = []
-    for width, depth in [(1 << 12, 4), (1 << 14, 4), (1 << 16, 4)]:
+    # depth is part of the sweep (VERDICT r4 #6): depth 2 halves the
+    # register traffic, depth 6 tests whether extra rows buy recall at
+    # fleet key counts where width collisions concentrate
+    for width, depth in [
+        (1 << 12, 4), (1 << 14, 2), (1 << 14, 4), (1 << 14, 6), (1 << 16, 4),
+    ]:
         cfg = cfg_for(width, depth, False)
         t0 = time.perf_counter()
         rep = run_stream_packed(packed, arrays(), cfg)
@@ -677,7 +731,12 @@ def bench_recall() -> dict:
             f"({total / dt:.0f} lines/s)")
     meets = [s for s in sweep if s["recall_unused"] >= 0.99]
     recommended = min(meets, key=lambda s: s["register_bytes"]) if meets else None
-    headline = next(s for s in sweep if s["width"] == 1 << 14)
+    # headline geometry pinned to (2^14, depth 4) — the same row every
+    # round, so cross-round artifact comparisons track ONE config even as
+    # the sweep grows more depths
+    headline = next(
+        s for s in sweep if s["width"] == 1 << 14 and s["depth"] == 4
+    )
     return {
         "metric": f"recall_sketch_only_unused_vs_exact_{total // 1_000_000}M_lines",
         "value": headline["recall_unused"],
@@ -693,6 +752,7 @@ def bench_recall() -> dict:
             # smallest geometry meeting the >=99% north star for this
             # ruleset size — the documented recommendation
             "recommended_geometry": recommended,
+            "layout": layout,
             "platform": "tpu" if on_tpu else "cpu",
         },
     }

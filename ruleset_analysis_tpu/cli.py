@@ -106,6 +106,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             resume=args.resume,
             report_every_chunks=args.report_every,
             match_impl=args.match_impl,
+            counts_impl=args.counts_impl,
             layout=args.layout,
             stacked_lane=args.stacked_lane,
             **({"checkpoint_dir": args.checkpoint_dir} if args.checkpoint_dir else {}),
@@ -542,8 +543,13 @@ def make_parser() -> argparse.ArgumentParser:
                         "(faster for many firewalls/ACLs)")
     p.add_argument("--stacked-lane", type=int, default=0, metavar="N",
                    help="per-ACL lane width for --layout=stacked (0 = auto)")
-    p.add_argument("--match-impl", choices=["xla", "pallas"], default="xla",
+    p.add_argument("--match-impl", choices=["xla", "pallas", "pallas_fused"],
+                   default="xla",
                    help="first-match kernel (bench_suite.py pallas compares them)")
+    p.add_argument("--counts-impl", choices=["scatter", "matmul", "reduce"],
+                   default="scatter",
+                   help="exact-counts formulation (bench_suite.py stage "
+                        "prices them; all bit-identical)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here (TensorBoard profile)")
     p.add_argument("--distributed", action="store_true",

@@ -117,10 +117,20 @@ class AnalysisConfig:
     resume: bool = False  # resume from checkpoint_dir if a snapshot exists
     report_every_chunks: int = 0  # 0 = no periodic throughput lines on stderr
     seed: int = 0
-    #: First-match kernel implementation: "xla" (fused predicate, default)
-    #: or "pallas" (explicit-layout TPU kernel, ops/pallas_match.py).
-    #: ``bench_suite.py pallas`` compares them on the deployment hardware.
+    #: First-match kernel implementation: "xla" (fused predicate, default),
+    #: "pallas" (explicit-layout TPU kernel, ops/pallas_match.py), or
+    #: "pallas_fused" (match + in-VMEM count histograms in one kernel,
+    #: ops/pallas_fused.py — replaces the batch-sized exact-counts scatter
+    #: with a row-sized one).  ``bench_suite.py pallas`` compares all
+    #: three on the deployment hardware.
     match_impl: str = "xla"
+    #: Exact-counts formulation: "scatter" (segment-sum scatter-add,
+    #: default), "matmul" (one-hot matmul on the MXU), or "reduce"
+    #: (compare-and-reduce on the VPU).  All bit-identical
+    #: (ops/counts.py); ``bench_suite.py stage`` prices them on the
+    #: deployment hardware — the TPU trace shows the scatter at 9.2 ms of
+    #: a 60 ms step, so flipping this is a measured-default candidate.
+    counts_impl: str = "scatter"
     #: Batch layout: "flat" scans every line against the whole rule
     #: tensor; "stacked" buckets lines by ACL host-side (pack.GroupBuffer)
     #: and vmaps the match over per-ACL rule slabs — O(max slab rows)
@@ -136,18 +146,35 @@ class AnalysisConfig:
             raise ValueError(f"batch_size must be >= 1, got {self.batch_size}")
         if self.checkpoint_every_chunks < 0:
             raise ValueError("checkpoint_every_chunks must be >= 0")
-        if self.match_impl not in ("xla", "pallas"):
-            raise ValueError(f"match_impl must be 'xla' or 'pallas', got {self.match_impl!r}")
+        if self.match_impl not in ("xla", "pallas", "pallas_fused"):
+            raise ValueError(
+                "match_impl must be 'xla', 'pallas', or 'pallas_fused', "
+                f"got {self.match_impl!r}"
+            )
+        if self.counts_impl not in ("scatter", "matmul", "reduce"):
+            raise ValueError(
+                "counts_impl must be 'scatter', 'matmul', or 'reduce', "
+                f"got {self.counts_impl!r}"
+            )
+        if self.match_impl == "pallas_fused" and self.counts_impl != "scatter":
+            # the fused kernel produces the counts delta itself (in-VMEM
+            # histograms), so a non-default counts_impl would silently
+            # never run — reject the combination instead of mis-measuring
+            raise ValueError(
+                "match_impl='pallas_fused' computes counts in-kernel; "
+                f"counts_impl={self.counts_impl!r} would be ignored — "
+                "leave it 'scatter' (the default)"
+            )
         if self.layout not in ("flat", "stacked"):
             raise ValueError(f"layout must be 'flat' or 'stacked', got {self.layout!r}")
         if self.stacked_lane < 0:
             raise ValueError("stacked_lane must be >= 0")
         if self.register_memory_budget_bytes < 1:
             raise ValueError("register_memory_budget_bytes must be >= 1")
-        if self.layout == "stacked" and self.match_impl == "pallas":
+        if self.layout == "stacked" and self.match_impl != "xla":
             raise ValueError(
-                "match_impl='pallas' supports layout='flat' only; the stacked "
-                "path always uses the XLA vmapped kernel"
+                f"match_impl={self.match_impl!r} supports layout='flat' only; "
+                "the stacked path always uses the XLA vmapped kernel"
             )
 
     def replace(self, **kw) -> "AnalysisConfig":

@@ -126,7 +126,7 @@ def ship_ruleset(
 ) -> DeviceRuleset:
     rules = jnp.asarray(pad_rules(packed.rules, rule_block))
     rules_fm = None
-    if match_impl == "pallas":
+    if match_impl in ("pallas", "pallas_fused"):
         from ..ops import pallas_match
 
         rules_fm = pallas_match.prep_rules(rules)
@@ -233,6 +233,8 @@ def _update_registers(
     exact_counts: bool,
     salt: jax.Array | int = 0,
     topk_sample_shift: int = 0,
+    counts_delta: jax.Array | None = None,
+    counts_impl: str = "scatter",
 ) -> tuple[AnalysisState, ChunkOut]:
     """Shared register tail: the reducer's whole job, for any match layout."""
     # One bincount into the (small) key space feeds BOTH the exact counts
@@ -240,8 +242,14 @@ def _update_registers(
     # updating from [n_keys] aggregated deltas instead of [B] raw lines is
     # bit-identical and turns the batch-sized CMS scatter into a
     # key-space-sized one (~free; the batch-sized scatter dominated the
-    # whole step at 1M-line chunks).
-    delta = count_ops.segment_counts(keys, valid, n_keys)
+    # whole step at 1M-line chunks).  counts_delta: the fused pallas
+    # kernel already built the bincount in VMEM (mirrors parallel/step.py
+    # _merge_tail — keep the two tails in lockstep).
+    if counts_delta is None:
+        counts_delta = count_ops.SEGMENT_COUNTS_IMPLS[counts_impl](
+            keys, valid, n_keys
+        )
+    delta = counts_delta
     if exact_counts:
         lo, hi = count_ops.add64(state.counts_lo, state.counts_hi, delta)
     else:
@@ -270,6 +278,7 @@ def analysis_step(
     salt: jax.Array | int = 0,
     match_impl: str = "xla",
     topk_sample_shift: int = 0,
+    counts_impl: str = "scatter",
 ) -> tuple[AnalysisState, ChunkOut]:
     """One fused device step over a batch of packed log lines.
 
@@ -277,7 +286,15 @@ def analysis_step(
     ``[WIRE_COLS, B]`` layout (see :func:`batch_cols`).
     """
     cols, valid = batch_cols(batch)
-    if match_impl == "pallas" and ruleset.rules_fm is not None:
+    counts_delta = None
+    if match_impl == "pallas_fused" and ruleset.rules_fm is not None:
+        from ..ops import pallas_fused
+
+        keys, counts_delta = pallas_fused.match_keys_and_counts_pallas(
+            cols, valid, ruleset.rules, ruleset.rules_fm, ruleset.deny_key,
+            n_keys,
+        )
+    elif match_impl == "pallas" and ruleset.rules_fm is not None:
         from ..ops import pallas_match
 
         keys = pallas_match.match_keys_pallas(
@@ -288,7 +305,8 @@ def analysis_step(
     return _update_registers(
         state, keys, valid, cols["src"], cols["acl"],
         n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts, salt=salt,
-        topk_sample_shift=topk_sample_shift,
+        topk_sample_shift=topk_sample_shift, counts_delta=counts_delta,
+        counts_impl=counts_impl,
     )
 
 

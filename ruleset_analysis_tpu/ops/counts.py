@@ -23,6 +23,50 @@ def segment_counts(keys: jnp.ndarray, weights: jnp.ndarray, n_keys: int) -> jnp.
     )
 
 
+def segment_counts_matmul(
+    keys: jnp.ndarray, weights: jnp.ndarray, n_keys: int
+) -> jnp.ndarray:
+    """One-hot matmul formulation of :func:`segment_counts`.
+
+    ``[B] f32 @ [B, n_keys] one-hot -> [n_keys]`` rides the MXU instead
+    of issuing a batch-sized scatter — the committed TPU trace shows the
+    scatter (fusion.5) at 9.2 ms/step while the MXU sits idle
+    (DESIGN.md §8).  Exact because every product is 0/1 and per-key
+    per-chunk sums are < 2^24 (f32 integer range): guarded at trace time,
+    falling back to the scatter for pathological batch sizes.  Keys out
+    of range contribute to no column (the one-hot row is all zero) —
+    same semantics as the scatter's ``mode="drop"``.
+    """
+    if keys.shape[0] >= 1 << 24:
+        return segment_counts(keys, weights, n_keys)
+    iota = jnp.arange(n_keys, dtype=_U32)
+    onehot = (keys[:, None] == iota[None, :]).astype(jnp.float32)
+    return jnp.dot(weights.astype(jnp.float32), onehot).astype(_U32)
+
+
+def segment_counts_reduce(
+    keys: jnp.ndarray, weights: jnp.ndarray, n_keys: int
+) -> jnp.ndarray:
+    """Compare-and-reduce formulation: ``counts[k] = sum_b (keys==k)*w``.
+
+    XLA fuses the compare into the reduction (reductions accept fused
+    producers, dots do not), so nothing [B, K]-shaped materializes; all
+    VPU, no scatter, no MXU.  ``bench_suite.py stage`` measures all three
+    formulations; ``AnalysisConfig.counts_impl`` selects per deployment.
+    """
+    iota = jnp.arange(n_keys, dtype=_U32)
+    eq = keys[None, :] == iota[:, None]
+    return jnp.sum(jnp.where(eq, weights.astype(_U32), 0), axis=1)
+
+
+#: counts_impl name -> formulation (all bit-identical; see the stage bench)
+SEGMENT_COUNTS_IMPLS = {
+    "scatter": segment_counts,
+    "matmul": segment_counts_matmul,
+    "reduce": segment_counts_reduce,
+}
+
+
 def add64(lo: jnp.ndarray, hi: jnp.ndarray, delta: jnp.ndarray):
     """(lo, hi) uint32 pair += delta (uint32), exact 64-bit accumulation."""
     new_lo = lo + delta

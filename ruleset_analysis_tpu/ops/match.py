@@ -158,9 +158,25 @@ def match_keys(
     consumer weights by ``cols["valid"]`` so they contribute nothing.
     """
     row = first_match_rows(cols, rules, rule_block)
+    return rows_to_keys(row, rules, deny_key, cols["acl"])
+
+
+def rows_to_keys(
+    row: jnp.ndarray,
+    rules: jnp.ndarray,
+    deny_key: jnp.ndarray,
+    acl: jnp.ndarray,
+) -> jnp.ndarray:
+    """Global first-match row -> count key (shared by every match impl).
+
+    NO_MATCH rows land on the line's ACL's implicit-deny key, with
+    out-of-range ACL ids clamped to the last ACL — the single definition
+    of that fold, so the xla/pallas/pallas_fused epilogues cannot drift.
+    """
     matched = row != NO_MATCH
     safe_row = jnp.where(matched, row, _U32(0))
     rule_key = rules[:, R_KEY].astype(_U32)[safe_row]
-    acl = jnp.minimum(cols["acl"], _U32(deny_key.shape[0] - 1))
-    deny = deny_key.astype(_U32)[acl]
+    deny = deny_key.astype(_U32)[
+        jnp.minimum(acl, _U32(deny_key.shape[0] - 1))
+    ]
     return jnp.where(matched, rule_key, deny)

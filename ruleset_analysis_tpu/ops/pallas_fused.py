@@ -1,0 +1,232 @@
+"""Fused Pallas kernel: first-match scan + in-VMEM count histograms.
+
+The committed TPU trace (DESIGN.md §8) shows the analysis step is
+SCATTER-BOUND: the exact-counts segment-sum (fusion.5, 9.2 ms) is a
+batch-sized scatter into a ~260-key register, while the match itself is
+only 22% of the step.  This kernel attacks that scatter by never doing
+it: while the match block is resident in VMEM it also builds
+
+- ``hist_rows`` ``[1, Rp]`` — how many (valid) lines first-matched each
+  rule ROW, and
+- ``hist_deny`` ``[1, Ap]`` — how many (valid) lines of each ACL matched
+  nothing (implicit deny),
+
+both via lane-tile compare-reduce (``best == iota`` summed over the
+sublane axis): O(B * Rp/128) VPU ops instead of a serialized batch-sized
+scatter.  The remaining scatter is ROW-sized (Rp ~ 512) not BATCH-sized
+(64k): :func:`counts_from_hists` folds the histograms into per-KEY count
+deltas with two tiny scatters (rows share keys via R_KEY — multiple ACEs
+per rule — and unmatched lines land on their ACL's deny key).
+
+Accumulation across the batch grid uses the standard Pallas revisiting
+pattern: the histogram output block maps every grid step to block 0, is
+zero-initialized at ``program_id == 0``, and accumulates in VMEM.
+
+Parity: ``tests/test_pallas_fused.py`` pins the counts delta and the
+report bit-identical to the XLA path (interpret mode on CPU, compiled on
+TPU).  Select with ``AnalysisConfig(match_impl="pallas_fused")`` /
+``--match-impl pallas_fused``; the default stays "xla" until the TPU A/B
+(``bench_suite.py pallas``) decides otherwise (VERDICT r4 #5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from ..hostside.pack import R_KEY, RULE_COLS
+from .match import NO_MATCH, rows_to_keys
+from .pallas_match import (  # noqa: F401
+    BLOCK_LINES,
+    RULE_TILE,
+    _ceil_to,
+    prep_rules,
+    tile_first_match,
+)
+
+_U32 = jnp.uint32
+_NO_MATCH = 0xFFFFFFFF
+
+
+def _kernel(
+    acl, proto, src, sport, dst, dport, valid, rules,
+    out_row, hist_rows, hist_deny,
+    *, n_tiles: int, n_acl_tiles: int, n_acls: int,
+):
+    """One batch block: first-match rows + histogram accumulation.
+
+    Refs: seven [BLOCK_LINES, 1] u32 line fields (incl. valid); rules
+    [RULE_COLS, Rp] u32 field-major.  out_row [BLOCK_LINES, 1];
+    hist_rows [1, Rp] and hist_deny [1, Ap] revisit block 0 every grid
+    step and accumulate in VMEM.
+    """
+    a = acl[:]
+    v = valid[:]
+    best = tile_first_match(
+        (a, proto[:], src[:], sport[:], dst[:], dport[:]), rules, n_tiles
+    )
+    out_row[:] = best
+
+    # Histogram pass: compare-reduce per lane tile.  Invalid lines are
+    # excluded here (the XLA path weights them 0 in segment_counts).
+    bv = jnp.where(v > 0, best, _U32(_NO_MATCH - 1))  # valid-masked copy
+    # _NO_MATCH-1 can never equal a row index (< Rp << 2^32-2) nor the
+    # NO_MATCH sentinel, so invalid lines fall out of BOTH histograms.
+
+    def hrow(t, acc):
+        idx = (
+            lax.broadcasted_iota(_U32, (1, RULE_TILE), 1)
+            + (t * RULE_TILE).astype(_U32)
+        )
+        eq = (bv == idx).astype(_U32)  # [BLOCK, RULE_TILE]
+        part = jnp.sum(eq, axis=0, keepdims=True)  # [1, RULE_TILE]
+        return lax.dynamic_update_slice(acc, part, (0, t * RULE_TILE))
+
+    rows_acc = lax.fori_loop(
+        0, n_tiles, hrow, jnp.zeros_like(hist_rows[:])
+    )
+
+    # Clamp out-of-range ACL ids exactly as the keys epilogue does
+    # (jnp.minimum(acl, n_acls-1)): a valid line with a corrupt acl gid
+    # must land on the LAST ACL's deny key in BOTH the keys and the
+    # counts, or delta would diverge from segment_counts(keys, valid).
+    a_cl = jnp.minimum(a, _U32(n_acls - 1))
+    unmatched = jnp.where(bv == _U32(_NO_MATCH), a_cl, _U32(_NO_MATCH - 1))
+
+    def hdeny(t, acc):
+        idx = (
+            lax.broadcasted_iota(_U32, (1, RULE_TILE), 1)
+            + (t * RULE_TILE).astype(_U32)
+        )
+        eq = (unmatched == idx).astype(_U32)
+        part = jnp.sum(eq, axis=0, keepdims=True)
+        return lax.dynamic_update_slice(acc, part, (0, t * RULE_TILE))
+
+    deny_acc = lax.fori_loop(
+        0, n_acl_tiles, hdeny, jnp.zeros_like(hist_deny[:])
+    )
+
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        hist_rows[:] = jnp.zeros_like(hist_rows[:])
+        hist_deny[:] = jnp.zeros_like(hist_deny[:])
+
+    hist_rows[:] += rows_acc
+    hist_deny[:] += deny_acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_acls", "block_lines", "interpret")
+)
+def match_rows_and_hists_pallas(
+    cols: dict,
+    valid: jnp.ndarray,  # [B] u32
+    rules_fm: jnp.ndarray,  # [RULE_COLS, Rp] from prep_rules
+    n_acls: int | None = None,
+    block_lines: int = BLOCK_LINES,
+    interpret: bool | None = None,
+):
+    """Fused first-match + histograms over the whole batch.
+
+    Returns ``(row [B] u32, hist_rows [Rp] u32, hist_deny [Ap] u32)``
+    where ``Ap = ceil(n_acls/128)*128``.  ``interpret=None`` auto-selects
+    like :func:`pallas_match.first_match_rows_pallas`.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() == "cpu"
+    b = cols["acl"].shape[0]
+    rp = rules_fm.shape[1]
+    assert rp % RULE_TILE == 0
+    ap = _ceil_to(max(n_acls or 1, 1), RULE_TILE)
+    block_lines = min(block_lines, _ceil_to(b, 8))
+    bp = _ceil_to(b, block_lines)
+
+    def field(v):
+        if bp != b:
+            # padding lines carry valid=0 via the valid field below, so
+            # they fall out of both histograms; their out rows are sliced
+            v = jnp.concatenate([v, jnp.zeros(bp - b, dtype=_U32)])
+        return v.reshape(bp, 1)
+
+    line_spec = pl.BlockSpec((block_lines, 1), lambda i: (i, 0))
+    hist_rows_spec = pl.BlockSpec((1, rp), lambda i: (0, 0))
+    hist_deny_spec = pl.BlockSpec((1, ap), lambda i: (0, 0))
+    row, hist_rows, hist_deny = pl.pallas_call(
+        functools.partial(
+            _kernel, n_tiles=rp // RULE_TILE, n_acl_tiles=ap // RULE_TILE,
+            n_acls=max(n_acls or 1, 1),
+        ),
+        grid=(bp // block_lines,),
+        in_specs=[line_spec] * 7
+        + [pl.BlockSpec((RULE_COLS, rp), lambda i: (0, 0))],
+        out_specs=(line_spec, hist_rows_spec, hist_deny_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((bp, 1), _U32),
+            jax.ShapeDtypeStruct((1, rp), _U32),
+            jax.ShapeDtypeStruct((1, ap), _U32),
+        ),
+        interpret=interpret,
+    )(
+        field(cols["acl"]),
+        field(cols["proto"]),
+        field(cols["src"]),
+        field(cols["sport"]),
+        field(cols["dst"]),
+        field(cols["dport"]),
+        field(valid.astype(_U32)),
+        rules_fm,
+    )
+    return row.reshape(bp)[:b], hist_rows.reshape(rp), hist_deny.reshape(ap)
+
+
+def counts_from_hists(
+    hist_rows: jnp.ndarray,  # [Rp] u32
+    hist_deny: jnp.ndarray,  # [Ap] u32
+    rules: jnp.ndarray,  # [R, RULE_COLS] row-major
+    deny_key: jnp.ndarray,  # [n_acls] u32
+    n_keys: int,
+) -> jnp.ndarray:
+    """Fold row/deny histograms into per-KEY count deltas.
+
+    Two ROW-sized scatters (R ~ 512, n_acls ~ tens) replace the
+    batch-sized segment-sum scatter — this is the whole point of the
+    fusion.  Bit-identical to ``segment_counts(match_keys(...), valid)``:
+    rows -> keys via R_KEY (several ACE rows share one rule key), deny
+    counts land on each ACL's deny key.  Padding rows never match (their
+    hist entries are 0), so their R_KEY=0 contributions add zero.
+    """
+    r = rules.shape[0]
+    delta = jnp.zeros(n_keys, dtype=_U32)
+    delta = delta.at[rules[:, R_KEY].astype(_U32)].add(
+        hist_rows[:r], mode="drop"
+    )
+    a = deny_key.shape[0]
+    delta = delta.at[deny_key.astype(_U32)].add(hist_deny[:a], mode="drop")
+    return delta
+
+
+def match_keys_and_counts_pallas(
+    cols: dict,
+    valid: jnp.ndarray,
+    rules: jnp.ndarray,
+    rules_fm: jnp.ndarray,
+    deny_key: jnp.ndarray,
+    n_keys: int,
+    block_lines: int = BLOCK_LINES,
+    interpret: bool | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Count-key per line + per-key count delta, fused (step integration).
+
+    The keys feed the downstream HLL/talker updates exactly as
+    ``match_keys`` would; the counts delta replaces ``segment_counts``.
+    """
+    row, hist_rows, hist_deny = match_rows_and_hists_pallas(
+        cols, valid, rules_fm, deny_key.shape[0], block_lines, interpret
+    )
+    keys = rows_to_keys(row, rules, deny_key, cols["acl"])
+    delta = counts_from_hists(hist_rows, hist_deny, rules, deny_key, n_keys)
+    return keys, delta

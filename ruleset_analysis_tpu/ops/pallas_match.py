@@ -59,18 +59,16 @@ BLOCK_LINES = 4096
 RULE_TILE = 128
 
 
-def _kernel(acl, proto, src, sport, dst, dport, rules, out, *, n_tiles: int):
-    """One batch block vs every rule tile; running-min carry over tiles.
+def tile_first_match(fields: tuple, rules, n_tiles: int):
+    """Shared kernel body: min matching global row per line, over rule tiles.
 
-    Refs: six [BLOCK_LINES, 1] u32 line fields; rules [RULE_COLS, R]
-    u32 (field-major, lane-padded); out [BLOCK_LINES, 1] u32.
+    ``fields`` = (acl, proto, src, sport, dst, dport) as [BLOCK_LINES, 1]
+    u32 VALUES; ``rules`` is the [RULE_COLS, Rp] field-major ref.  The one
+    definition of the tile predicate — ops/pallas_fused.py reuses it, so
+    a predicate change (e.g. a new tuple field) lands in every pallas
+    kernel at once.  Returns the [BLOCK_LINES, 1] running-min rows.
     """
-    a = acl[:]
-    p = proto[:]
-    s = src[:]
-    sp = sport[:]
-    d = dst[:]
-    dp = dport[:]
+    a, p, s, sp, d, dp = fields
 
     def body(t, best):
         sl = pl.ds(t * RULE_TILE, RULE_TILE)
@@ -81,7 +79,8 @@ def _kernel(acl, proto, src, sport, dst, dport, rules, out, *, n_tiles: int):
         def in_range(lo_c, hi_c, x):
             # unsigned wraparound range check (see ops.match._block_min_row):
             # one subtract + one compare per range instead of two compares
-            # + an AND; pack/aclparse guarantee lo <= hi
+            # + an AND; pack/aclparse + load_packed validation guarantee
+            # lo <= hi
             lo = row(lo_c)
             return (x - lo) <= (row(hi_c) - lo)
 
@@ -101,7 +100,19 @@ def _kernel(acl, proto, src, sport, dst, dport, rules, out, *, n_tiles: int):
         return jnp.minimum(best, jnp.min(cand, axis=1, keepdims=True))
 
     init = jnp.full((a.shape[0], 1), _NO_MATCH, dtype=_U32)
-    out[:] = lax.fori_loop(0, n_tiles, body, init)
+    return lax.fori_loop(0, n_tiles, body, init)
+
+
+def _kernel(acl, proto, src, sport, dst, dport, rules, out, *, n_tiles: int):
+    """One batch block vs every rule tile; running-min carry over tiles.
+
+    Refs: six [BLOCK_LINES, 1] u32 line fields; rules [RULE_COLS, R]
+    u32 (field-major, lane-padded); out [BLOCK_LINES, 1] u32.
+    """
+    out[:] = tile_first_match(
+        (acl[:], proto[:], src[:], sport[:], dst[:], dport[:]),
+        rules, n_tiles,
+    )
 
 
 def prep_rules(rules: jnp.ndarray) -> jnp.ndarray:
@@ -184,12 +195,7 @@ def match_keys_pallas(
     interpret: bool | None = None,
 ) -> jnp.ndarray:
     """Count-key per line via the pallas kernel (ops.match.match_keys twin)."""
-    from ..hostside.pack import R_KEY
+    from .match import rows_to_keys
 
     row = first_match_rows_pallas(cols, rules_fm, block_lines, interpret)
-    matched = row != NO_MATCH
-    safe_row = jnp.where(matched, row, _U32(0))
-    rule_key = rules[:, R_KEY].astype(_U32)[safe_row]
-    acl = jnp.minimum(cols["acl"], _U32(deny_key.shape[0] - 1))
-    deny = deny_key.astype(_U32)[acl]
-    return jnp.where(matched, rule_key, deny)
+    return rows_to_keys(row, rules, deny_key, cols["acl"])

@@ -53,6 +53,8 @@ def _merge_tail(
     topk_k: int,
     exact_counts: bool,
     topk_sample_shift: int = 0,
+    counts_delta: jax.Array | None = None,
+    counts_impl: str = "scatter",
 ) -> tuple[AnalysisState, ChunkOut]:
     # The register-update tail shared by the flat and stacked shard steps:
     # mirrors pipeline._update_registers with the collective merges
@@ -62,8 +64,15 @@ def _merge_tail(
 
     # one globally-merged bincount feeds exact counts AND the per-rule CMS
     # (linear in per-key increments — see pipeline._update_registers);
-    # the batch-sized CMS scatter this replaces dominated the shard step
-    delta = lax.psum(count_ops.segment_counts(keys, valid, n_keys), axis)
+    # the batch-sized CMS scatter this replaces dominated the shard step.
+    # counts_delta: the fused pallas kernel already built the local
+    # bincount in VMEM (ops/pallas_fused.py) — skip the batch-sized
+    # scatter and merge its row-sized result instead.
+    if counts_delta is None:
+        counts_delta = count_ops.SEGMENT_COUNTS_IMPLS[counts_impl](
+            keys, valid, n_keys
+        )
+    delta = lax.psum(counts_delta, axis)
     if exact_counts:
         lo, hi = count_ops.add64(state.counts_lo, state.counts_hi, delta)
     else:
@@ -111,9 +120,18 @@ def _local_shard_step(
     rule_block: int,
     match_impl: str = "xla",
     topk_sample_shift: int = 0,
+    counts_impl: str = "scatter",
 ) -> tuple[AnalysisState, ChunkOut]:
     cols, valid = batch_cols(batch)
-    if match_impl == "pallas" and ruleset.rules_fm is not None:
+    counts_delta = None
+    if match_impl == "pallas_fused" and ruleset.rules_fm is not None:
+        from ..ops import pallas_fused
+
+        keys, counts_delta = pallas_fused.match_keys_and_counts_pallas(
+            cols, valid, ruleset.rules, ruleset.rules_fm, ruleset.deny_key,
+            n_keys,
+        )
+    elif match_impl == "pallas" and ruleset.rules_fm is not None:
         from ..ops import pallas_match
 
         keys = pallas_match.match_keys_pallas(
@@ -124,7 +142,8 @@ def _local_shard_step(
     return _merge_tail(
         state, keys, valid, cols["src"], cols["acl"], salt,
         axis=axis, n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts,
-        topk_sample_shift=topk_sample_shift,
+        topk_sample_shift=topk_sample_shift, counts_delta=counts_delta,
+        counts_impl=counts_impl,
     )
 
 
@@ -140,6 +159,7 @@ def _local_shard_step_stacked(
     exact_counts: bool,
     rule_block: int,
     topk_sample_shift: int = 0,
+    counts_impl: str = "scatter",
 ) -> tuple[AnalysisState, ChunkOut]:
     # Grouped twin of _local_shard_step: each line scans only its own
     # ACL's slab (vmapped match over the group axis); the mergeable
@@ -158,6 +178,7 @@ def _local_shard_step_stacked(
         topk_k=topk_k,
         exact_counts=exact_counts,
         topk_sample_shift=topk_sample_shift,
+        counts_impl=counts_impl,
     )
 
 
@@ -275,6 +296,7 @@ def make_parallel_step(
         rule_block=rule_block,
         match_impl=cfg.match_impl,
         topk_sample_shift=cfg.sketch.topk_sample_shift,
+        counts_impl=cfg.counts_impl,
     )
     return _make_step(mesh, local, P(None, axis))
 
@@ -302,5 +324,6 @@ def make_parallel_step_stacked(
         exact_counts=cfg.exact_counts,
         rule_block=rule_block,
         topk_sample_shift=cfg.sketch.topk_sample_shift,
+        counts_impl=cfg.counts_impl,
     )
     return _make_step(mesh, local, P(None, None, axis))

@@ -109,7 +109,11 @@ while true; do
                 run_headline && assemble || break
                 continue
             fi
-            if timeout "$PER_CONFIG_TIMEOUT" python bench_suite.py "$c" \
+            # recall runs at fleet shape on TPU (VERDICT r4 #6): 10k keys,
+            # stacked layout, default 96-chunk (1e8-line) scale
+            cfg_env=()
+            [ "$c" = recall ] && cfg_env=(RA_RECALL_KEYS=10240 RA_RECALL_LAYOUT=stacked)
+            if timeout "$PER_CONFIG_TIMEOUT" env "${cfg_env[@]}" python bench_suite.py "$c" \
                     > "$BANK/$c.tmp" 2> "$BANK/$c.log"; then
                 if grep -q '^{' "$BANK/$c.tmp"; then
                     grep '^{' "$BANK/$c.tmp" > "$BANK/$c.jsonl"
