@@ -55,12 +55,12 @@ assemble() {
     local platform='"pending_tpu_window"'
     { [ "$n_done" -gt 0 ] || [ "$headline_done" = true ]; } && platform='"tpu"'
     {
-        echo "{\"note\": \"Round-5 TPU capture (axon tunnel), banked per-config by tools/tpu_capture.sh. cms/hll/topk accuracy lines carried from BENCH_SUITE_r04_accuracy_cpu.json (platform-independent).\", \"platform\": $platform, \"suite_configs_completed\": $n_done, \"suite_configs_total\": $SUITE_TOTAL, \"headline_recaptured\": $headline_done, \"complete\": $complete}"
+        echo "{\"note\": \"Round-5 TPU capture (axon tunnel), banked per-config by tools/tpu_capture.sh. cms/hll/topk accuracy lines carried from the FRESH round-5 accuracy artifact BENCH_SUITE_r05_accuracy_cpu.json (platform-independent).\", \"platform\": $platform, \"suite_configs_completed\": $n_done, \"suite_configs_total\": $SUITE_TOTAL, \"headline_recaptured\": $headline_done, \"complete\": $complete}"
         for c in "${ITEMS[@]}"; do
             [ "$c" = headline ] && continue
             [ -s "$BANK/$c.jsonl" ] && cat "$BANK/$c.jsonl"
         done
-        grep -E '"config2_|"config3_|"config5_' BENCH_SUITE_r04_accuracy_cpu.json
+        grep -E '"config2_|"config3_|"config5_' BENCH_SUITE_r05_accuracy_cpu.json
     } > BENCH_SUITE_r05_tpu.json
     echo "assembled BENCH_SUITE_r05_tpu.json ($n_done/$SUITE_TOTAL configs, headline=$headline_done)" >&2
 }
