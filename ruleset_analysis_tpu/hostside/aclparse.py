@@ -156,6 +156,14 @@ def ip_to_u32(s: str) -> int:
         raise AclParseError(f"bad IPv4 address: {s!r}")
     v = 0
     for p in parts:
+        # plain ASCII digits only: int() also accepts "+1", "1_0", and
+        # Unicode digits, which the native parser (asaparse.cpp
+        # parse_ipv4_run — documented ip_to_u32 semantics) rejects; the
+        # two paths must agree on every input.  Non-numeric octets (fuzz:
+        # "1..2.3") must raise the clean parse error, not a raw
+        # ValueError that escapes the lenient-mode skip handler.
+        if not (p.isascii() and p.isdigit()):
+            raise AclParseError(f"bad IPv4 address: {s!r}")
         b = int(p)
         if not 0 <= b <= 255:
             raise AclParseError(f"bad IPv4 address: {s!r}")

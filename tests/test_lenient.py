@@ -2,7 +2,7 @@
 
 import pytest
 
-from ruleset_analysis_tpu.hostside import aclparse, oracle, pack
+from ruleset_analysis_tpu.hostside import aclparse, oracle, pack, synth
 
 MIXED_CFG = """
 hostname fw6
@@ -115,3 +115,45 @@ access-group A in interface outside
     assert rs.rule_count() == 1
     assert len(rs.skipped) == 1
     assert "inverted port range" in rs.skipped[0][1]
+
+
+def test_parser_fuzz_never_crashes():
+    """Randomized mutations of valid configs: lenient mode must SKIP, not
+    crash, and strict mode must raise only AclParseError — never a raw
+    ValueError/IndexError from token handling (r5 fuzz found ip_to_u32
+    leaking int('') ValueErrors through both modes)."""
+    import random
+
+    base = synth.synth_config(n_acls=3, rules_per_acl=12, seed=5)
+    lines = base.splitlines()
+    garbage = ["%$#@", "999999", "eq", "range", "object-group", "host",
+               "::", "256.1.2.3", "-1", "\x00", "any6"]
+    for trial in range(400):
+        rng = random.Random(trial)
+        muts = lines[:]
+        for _ in range(rng.randint(1, 4)):
+            i = rng.randrange(len(muts))
+            toks = muts[i].split()
+            if not toks:
+                continue
+            op = rng.randrange(5)
+            if op == 0 and len(toks) > 1:
+                toks = toks[: rng.randrange(1, len(toks))]
+            elif op == 1:
+                j, k = rng.randrange(len(toks)), rng.randrange(len(toks))
+                toks[j], toks[k] = toks[k], toks[j]
+            elif op == 2:
+                toks.insert(rng.randrange(len(toks) + 1), rng.choice(garbage))
+            elif op == 3:
+                j = rng.randrange(len(toks))
+                toks[j] = toks[j][: max(0, len(toks[j]) - 2)] or "x"
+            else:
+                toks = toks + toks
+            muts[i] = " ".join(toks)
+        text = "\n".join(muts)
+        rs = aclparse.parse_asa_config(text, "fw", strict=False)  # no raise
+        assert rs.firewall == "fw"
+        try:
+            aclparse.parse_asa_config(text, "fw", strict=True)
+        except aclparse.AclParseError:
+            pass  # the only acceptable strict-mode failure
