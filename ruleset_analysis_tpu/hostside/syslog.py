@@ -73,12 +73,17 @@ def _proto_num(tok: str) -> int:
 
 # hostname is the last whitespace token before the %ASA tag (syslog relay
 # prefixes vary; this is robust to "<pri>MMM dd hh:mm:ss host : %ASA-...").
-_TAG_RE = re.compile(r"(?:^|\s)(\S+?)\s*:?\s*%ASA-\d-(\d{6}):\s*(.*)$")
+# re.ASCII everywhere: Python's \d otherwise matches Unicode digits,
+# which int() accepts but the native parser (asaparse.cpp is_dig,
+# ASCII-only) rejects — the two parsers must agree on every input
+# (mirrors the ip_to_u32 isascii() guard).
+_TAG_RE = re.compile(r"(?:^|\s)(\S+?)\s*:?\s*%ASA-\d-(\d{6}):\s*(.*)$", re.ASCII)
 
 _M106100_RE = re.compile(
     r"access-list\s+(\S+)\s+(permitted|denied|est-allowed)\s+(\S+)\s+"
     r"(\S+?)/([\d.]+)\((\d+)\)(?:\([^)]*\))?\s*->\s*"
     r"(\S+?)/([\d.]+)\((\d+)\)"
+    , re.ASCII
 )
 
 _M106023_RE = re.compile(
@@ -86,27 +91,32 @@ _M106023_RE = re.compile(
     r"dst\s+(\S+?):([\d.]+)(?:/(\d+))?"
     r"(?:\s+\(type\s+(\d+),\s*code\s+(\d+)\))?"
     r'.*?by\s+access-group\s+"([^"]+)"'
+    , re.ASCII
 )
 
 _M302013_RE = re.compile(
     r"Built\s+(inbound|outbound)\s+(TCP|UDP)\s+connection\s+\S+\s+for\s+"
     r"(\S+?):([\d.]+)/(\d+)\s*(?:\([^)]*\))?\s*to\s+"
     r"(\S+?):([\d.]+)/(\d+)"
+    , re.ASCII
 )
 
 _M106001_RE = re.compile(
     r"Inbound\s+TCP\s+connection\s+denied\s+from\s+([\d.]+)/(\d+)\s+to\s+"
     r"([\d.]+)/(\d+)\s+flags\s+.*?\bon\s+interface\s+(\S+)"
+    , re.ASCII
 )
 
 _M106006_RE = re.compile(
     r"Deny\s+inbound\s+UDP\s+from\s+([\d.]+)/(\d+)\s+to\s+"
     r"([\d.]+)/(\d+)\s+on\s+interface\s+(\S+)"
+    , re.ASCII
 )
 
 _M106015_RE = re.compile(
     r"Deny\s+TCP\s+\(no connection\)\s+from\s+([\d.]+)/(\d+)\s+to\s+"
     r"([\d.]+)/(\d+)\s+flags\s+.*?\bon\s+interface\s+(\S+)"
+    , re.ASCII
 )
 
 
@@ -126,7 +136,14 @@ def _field_ranges_ok(p: ParsedLine) -> ParsedLine | None:
 
 def parse_line(line: str) -> ParsedLine | None:
     """Parse one raw syslog line; None if it is not a handled ASA message."""
-    p = _parse_line_raw(line)
+    try:
+        p = _parse_line_raw(line)
+    except ValueError:
+        # An ASA-shaped line with a malformed field (e.g. a corrupt
+        # address like "1.2.3.4.5.6" — r5 fuzz) is not a handled message:
+        # skip it like any other unparseable line instead of letting
+        # ip_to_u32's AclParseError crash the whole chunk loop.
+        return None
     return None if p is None else _field_ranges_ok(p)
 
 

@@ -308,3 +308,36 @@ class TestDriver:
         resumed = run_stream_file(packed, str(path), cfg2, native=True)
         assert resumed.per_rule == full.per_rule
         assert resumed.totals["lines_total"] == full.totals["lines_total"]
+
+
+class TestFuzzParity:
+    def test_mutated_corpus_bit_identical_and_crash_free(self):
+        """Randomized corruption of valid syslog lines: both parsers must
+        SKIP malformed lines (r5 fuzz: a corrupt address like
+        '1.2.3.4.5.6' leaked an AclParseError out of the Python
+        parse_line) and stay bit-identical to each other on the
+        survivors."""
+        import random
+
+        packed, lines = _synth_case(n=300, seed=3)
+        garbage = ["", "\x00\x01\x02", "%ASA-6-106100", "a" * 5000, "\u0661\u0660"]
+        mutated = []
+        for trial in range(1024):
+            rng = random.Random(trial)
+            line = rng.choice(lines)
+            op = rng.randrange(4)
+            if op == 0:
+                line = line[: rng.randrange(len(line))]
+            elif op == 1:
+                i = rng.randrange(len(line))
+                line = line[:i] + rng.choice("()/:->% \x00日\u0661") + line[i + 1:]
+            elif op == 2:
+                line = line + rng.choice(garbage)
+            else:
+                i, j = sorted(rng.randrange(len(line)) for _ in range(2))
+                line = line[:j] + line[i:]
+            mutated.append(line.replace("\n", " ").replace("\r", " "))
+        py, ref, nat, got = _both(packed, mutated, 2048)
+        np.testing.assert_array_equal(ref, got)
+        assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
+        assert py.skipped > 0  # the corpus really contains corrupt lines

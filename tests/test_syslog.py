@@ -98,3 +98,15 @@ def test_non_asa_lines_skipped():
     assert S.parse_line("Jul 29 07:48:01 host sshd[123]: Accepted publickey") is None
     assert S.parse_line("") is None
     assert S.parse_line("Jul 29 fw1 %ASA-6-305011: Built dynamic TCP translation") is None
+
+
+def test_malformed_address_line_skipped_not_raised():
+    """An ASA-shaped line whose address field is corrupt (regex matches,
+    ip_to_u32 would refuse) must return None — not leak AclParseError
+    into the chunk loop (r5 fuzz regression)."""
+    line = (
+        "Jul 29 07:48:01 fw1 : %ASA-6-106100: access-list A permitted tcp "
+        "inside/198.51.72.9.0.21.47(1000) -> outside/10.0.0.5(443) "
+        "hit-cnt 1 first hit [0x0, 0x0]"
+    )
+    assert S.parse_line(line) is None
