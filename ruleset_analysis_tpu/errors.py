@@ -9,6 +9,16 @@ class CheckpointMismatch(AnalysisError):
     """Snapshot belongs to a different ruleset or sketch geometry."""
 
 
+class CheckpointCorrupt(AnalysisError):
+    """The pointed-to snapshot exists but cannot be decoded.
+
+    Raised LOUDLY instead of silently starting the analysis from scratch:
+    a truncated/bit-flipped snapshot usually means storage trouble, and a
+    fresh-start would discard the operator's resume intent without a
+    trace.  Recovery: delete the snapshot directory (or fix the storage)
+    and rerun."""
+
+
 class ResumeInputMismatch(AnalysisError):
     """Input stream is shorter than the snapshot's consumed-line offset."""
 

@@ -25,15 +25,18 @@ import hashlib
 import json
 import os
 import tempfile
+import zipfile
 
 import numpy as np
 
 from ..config import AnalysisConfig
-from ..errors import CheckpointMismatch  # re-export: raised on foreign snapshots
+# re-exports: raised on foreign / undecodable snapshots
+from ..errors import CheckpointCorrupt, CheckpointMismatch
 from ..hostside.pack import PackedRuleset
 from ..ops.topk import TopKTracker
 
 __all__ = [
+    "CheckpointCorrupt",
     "CheckpointMismatch",
     "Snapshot",
     "fingerprint",
@@ -158,8 +161,19 @@ def _read_pointer(ckpt_dir: str) -> str | None:
     try:
         with open(os.path.join(ckpt_dir, POINTER_FILE), "r", encoding="utf-8") as f:
             return f.read().strip()
-    except OSError:
-        return None
+    except FileNotFoundError:
+        return None  # no checkpoint was ever committed here
+    except NotADirectoryError:
+        return None  # ckpt_dir path component is a file: nothing saved here
+    except UnicodeDecodeError as e:
+        # a pointer file holding non-UTF-8 bytes is storage corruption,
+        # not a missing checkpoint — refuse loudly (a None here would
+        # silently restart the analysis from scratch)
+        raise CheckpointCorrupt(
+            f"checkpoint pointer {os.path.join(ckpt_dir, POINTER_FILE)!r} "
+            f"is corrupt ({e}); delete the checkpoint dir (or repair "
+            "storage) to proceed"
+        ) from e
 
 
 def _rmtree(path: str) -> None:
@@ -170,29 +184,53 @@ def _rmtree(path: str) -> None:
 
 def load(ckpt_dir: str) -> Snapshot | None:
     name = _read_pointer(ckpt_dir)
-    if not name:
-        return None
+    if name is None:
+        return None  # no pointer file at all: genuinely no checkpoint
     snap_dir = os.path.join(ckpt_dir, name)
     state_path = os.path.join(snap_dir, STATE_FILE)
     manifest_path = os.path.join(snap_dir, MANIFEST_FILE)
-    if not (os.path.exists(state_path) and os.path.exists(manifest_path)):
-        return None
-    with open(manifest_path, "r", encoding="utf-8") as f:
-        m = json.load(f)
-    with np.load(state_path) as z:
-        arrays = {k: z[k] for k in z.files}
-    return Snapshot(
-        arrays=arrays,
-        lines_consumed=int(m["lines_consumed"]),
-        n_chunks=int(m["n_chunks"]),
-        parsed=int(m["parsed"]),
-        skipped=int(m["skipped"]),
-        tracker_tables={
-            int(acl): {int(k): int(v) for k, v in items}
-            for acl, items in m["tracker"]
-        },
-        fingerprint=m["fingerprint"],
-    )
+    if not name or not (
+        os.path.exists(state_path) and os.path.exists(manifest_path)
+    ):
+        # save() makes the snapshot dir durable BEFORE the pointer moves,
+        # so a committed pointer that is empty or names a missing/partial
+        # snapshot is storage corruption — refuse loudly rather than
+        # silently starting the analysis from scratch (the most common
+        # single-byte pointer flip stays valid UTF-8 and lands here)
+        raise CheckpointCorrupt(
+            f"checkpoint pointer in {ckpt_dir!r} names "
+            f"{name!r} but no complete snapshot exists there; delete the "
+            "checkpoint dir (or repair storage) to proceed"
+        )
+    try:
+        with open(manifest_path, "r", encoding="utf-8") as f:
+            m = json.load(f)
+        with np.load(state_path) as z:
+            arrays = {k: z[k] for k in z.files}
+        return Snapshot(
+            arrays=arrays,
+            lines_consumed=int(m["lines_consumed"]),
+            n_chunks=int(m["n_chunks"]),
+            parsed=int(m["parsed"]),
+            skipped=int(m["skipped"]),
+            tracker_tables={
+                int(acl): {int(k): int(v) for k, v in items}
+                for acl, items in m["tracker"]
+            },
+            fingerprint=m["fingerprint"],
+        )
+    except (
+        ValueError,  # json.JSONDecodeError, np.load format errors
+        KeyError,  # manifest/npz missing fields
+        TypeError,  # reshaped manifest values
+        OSError,  # short reads
+        UnicodeDecodeError,
+        zipfile.BadZipFile,  # npz container corrupt (plain Exception!)
+    ) as e:
+        raise CheckpointCorrupt(
+            f"snapshot {snap_dir!r} is corrupt ({type(e).__name__}: "
+            f"{str(e)[:200]}); delete it (or repair storage) to proceed"
+        ) from e
 
 
 def snapshot_of(
