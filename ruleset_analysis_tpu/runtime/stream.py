@@ -505,16 +505,34 @@ def run_stream_file_distributed(
             # everywhere — a lone early raise would leave the other processes
             # blocked in the next collective instead of surfacing the error.
             layout_err = _dist_ckpt_layout_error(cfg.checkpoint_dir, nproc)
-            snap = ckpt.load(my_ckpt_dir) if layout_err is None else None
+            corrupt_err = None
+            if layout_err is None:
+                try:
+                    snap = ckpt.load(my_ckpt_dir)
+                except (ckpt.CheckpointCorrupt, OSError) as e:
+                    # a LOCAL raise here would strand the other processes
+                    # in the allgather below — classify and gather instead.
+                    # OSError too: an unreadable pointer (PermissionError,
+                    # IsADirectoryError) is as stranding as a corrupt one.
+                    corrupt_err = e
             local_state = 0  # 0 = no snapshot
             if layout_err is not None:
                 local_state = 3  # foreign process layout
+            elif corrupt_err is not None:
+                local_state = 4  # undecodable snapshot on this process
             elif snap is not None:
                 local_state = 1 if snap.fingerprint == fp else 2
             states = dist.value_across_processes(local_state)
             chunks_all = dist.value_across_processes(
                 snap.n_chunks if snap is not None else -1
             )
+            if (states == 4).any():
+                raise ckpt.CheckpointCorrupt(
+                    str(corrupt_err)
+                    if corrupt_err is not None
+                    else f"another process found an undecodable snapshot in "
+                    f"{cfg.checkpoint_dir!r}"
+                )
             if (states == 3).any():
                 raise ckpt.CheckpointMismatch(
                     layout_err

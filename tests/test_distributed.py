@@ -413,3 +413,44 @@ def test_stacked_abort_drains_buffered_lines(corpus):
     # every counted evaluation landed in the registers — no limbo lines
     assert total_counts == rep["totals"]["lines_matched"]
     assert 0 < rep["totals"]["lines_total"] < 1200  # genuinely aborted early
+
+
+def test_corrupt_one_process_snapshot_fails_all_loudly(corpus):
+    """Resume where ONE process's snapshot is corrupt: every process must
+    exit with the typed CheckpointCorrupt verdict in bounded time — a
+    lone local raise would strand the peers in the resume allgather
+    (stream.py evaluates all local conditions first, gathers once, then
+    raises the same verdict everywhere)."""
+    td, prefix, full, half0, half1 = corpus
+    ck = str(td / "ck-corrupt")
+
+    _run_workers(2, _free_port(), prefix, [half0, half1],
+                 [str(td / "x0"), str(td / "x1")], 4, extra=(ck, "crash"))
+    # corrupt proc-1's snapshot payload (keep the pointer intact)
+    pdir = os.path.join(ck, "proc-1-of-2")
+    latest = open(os.path.join(pdir, "LATEST")).read().strip()
+    state = os.path.join(pdir, latest, "state.npz")
+    with open(state, "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef" * 8)
+
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), "2", str(port),
+             prefix, [half0, half1][pid], str(td / f"z{pid}"), ck, "resume"],
+            env=_worker_env(4), cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for pid in range(2)
+    ]
+    errs = []
+    for p in procs:
+        try:
+            _out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError("resume with a corrupt snapshot HUNG")
+        errs.append((p.returncode, err))
+    assert all(rc != 0 for rc, _ in errs), f"some worker succeeded: {errs}"
+    assert any("CheckpointCorrupt" in err or "corrupt" in err for _, err in errs)
