@@ -282,7 +282,13 @@ def bench_multifw() -> dict:
 
 
 def bench_topk() -> dict:
-    """Config #5: streaming top-K talkers precision vs exact."""
+    """Config #5: streaming top-K talkers precision vs exact.
+
+    Also sweeps the scatter-bound FLIP variants (talk_cms_depth=1 halves
+    fusion.7; sample_shift=3 kills 7/8 of fusions 8+9 — DESIGN.md §8):
+    their ACCURACY halves are platform-independent, so the flip decision
+    only needs the TPU timing half from bench.py's step_variants A/B.
+    """
     import jax.numpy as jnp
 
     from ruleset_analysis_tpu.ops import cms as cms_ops
@@ -294,32 +300,51 @@ def bench_topk() -> dict:
     acls = rng.integers(0, 4, size=n_chunks * chunk).astype(np.uint32)
     # zipf sources: the heavy hitters we must recover
     src = (rng.zipf(1.2, size=n_chunks * chunk) % 50000).astype(np.uint32)
-    talk = cms_ops.cms_init(1 << 14, 4)
-    tracker = topk_ops.TopKTracker(capacity=4096)
     valid = np.ones(chunk, dtype=np.uint32)
-    for c in range(n_chunks):
-        sl = slice(c * chunk, (c + 1) * chunk)
-        talk, ca, cs, ce = topk_ops.talker_chunk_update(
-            talk, jnp.asarray(acls[sl]), jnp.asarray(src[sl]), jnp.asarray(valid), 64
-        )
-        tracker.offer_chunk(np.asarray(ca), np.asarray(cs), np.asarray(ce))
-    # exact top-K per acl
     import collections
 
-    precisions = []
+    exact_tops = {}
     for a in range(4):
         cnt = collections.Counter(src[acls == a].tolist())
-        exact_top = {s for s, _ in cnt.most_common(k)}
-        got_top = {s for s, _ in tracker.top(a, k)}
-        precisions.append(len(exact_top & got_top) / k)
-        log(f"topk acl={a} precision@{k}={precisions[-1]:.2f}")
+        exact_tops[a] = {s for s, _ in cnt.most_common(k)}
+
+    def precision(depth: int, shift: int) -> list[float]:
+        talk = cms_ops.cms_init(1 << 14, depth)
+        tracker = topk_ops.TopKTracker(capacity=4096)
+        for c in range(n_chunks):
+            sl = slice(c * chunk, (c + 1) * chunk)
+            talk, ca, cs, ce = topk_ops.talker_chunk_update(
+                talk, jnp.asarray(acls[sl]), jnp.asarray(src[sl]),
+                jnp.asarray(valid), 64, salt=c, sample_shift=shift,
+            )
+            tracker.offer_chunk(np.asarray(ca), np.asarray(cs), np.asarray(ce))
+        return [
+            len(exact_tops[a] & {s for s, _ in tracker.top(a, k)}) / k
+            for a in range(4)
+        ]
+
+    variants = {}
+    for depth, shift in [(4, 0), (2, 0), (1, 0), (2, 3), (1, 3)]:
+        ps = precision(depth, shift)
+        variants[f"d{depth}_shift{shift}"] = {
+            "talk_cms_depth": depth,
+            "sample_shift": shift,
+            "precision_at_10": round(float(np.mean(ps)), 4),
+            "per_acl": [round(p, 3) for p in ps],
+        }
+        log(f"topk d={depth} shift={shift}: precision@{k}="
+            f"{variants[f'd{depth}_shift{shift}']['precision_at_10']:.2f}")
+
+    headline = variants["d4_shift0"]["precision_at_10"]
     return {
         "metric": "config5_topk_precision_at_10",
-        "value": round(float(np.mean(precisions)), 4),
+        "value": headline,
         "unit": "precision",
-        "vs_baseline": round(float(np.mean(precisions)) / 0.9, 4),
+        "vs_baseline": round(headline / 0.9, 4),
         "detail": {"chunks": n_chunks, "chunk": chunk,
-                   "per_acl": [round(p, 3) for p in precisions]},
+                   "per_acl": variants["d4_shift0"]["per_acl"],
+                   # accuracy half of every pending scatter-lever flip
+                   "flip_variants": variants},
     }
 
 
