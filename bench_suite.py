@@ -324,7 +324,7 @@ def bench_topk() -> dict:
         ]
 
     variants = {}
-    for depth, shift in [(4, 0), (2, 0), (1, 0), (2, 3), (1, 3)]:
+    for depth, shift in [(4, 0), (4, 3), (2, 0), (1, 0), (2, 3), (1, 3)]:
         ps = precision(depth, shift)
         variants[f"d{depth}_shift{shift}"] = {
             "talk_cms_depth": depth,
