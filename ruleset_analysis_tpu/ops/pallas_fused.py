@@ -82,7 +82,10 @@ def _kernel(
             lax.broadcasted_iota(_U32, (1, RULE_TILE), 1)
             + (t * RULE_TILE).astype(_U32)
         )
-        eq = (bv == idx).astype(_U32)  # [BLOCK, RULE_TILE]
+        # int32 sum: Mosaic TPU has no unsigned-reduction lowering (same
+        # constraint as tile_first_match's running min); block counts are
+        # <= BLOCK_LINES so int32 cannot overflow.
+        eq = (bv == idx).astype(jnp.int32)  # [BLOCK, RULE_TILE]
         part = jnp.sum(eq, axis=0, keepdims=True)  # [1, RULE_TILE]
         return lax.dynamic_update_slice(acc, part, (0, t * RULE_TILE))
 
@@ -102,7 +105,7 @@ def _kernel(
             lax.broadcasted_iota(_U32, (1, RULE_TILE), 1)
             + (t * RULE_TILE).astype(_U32)
         )
-        eq = (unmatched == idx).astype(_U32)
+        eq = (unmatched == idx).astype(jnp.int32)
         part = jnp.sum(eq, axis=0, keepdims=True)
         return lax.dynamic_update_slice(acc, part, (0, t * RULE_TILE))
 
@@ -166,8 +169,10 @@ def match_rows_and_hists_pallas(
         out_specs=(line_spec, hist_rows_spec, hist_deny_spec),
         out_shape=(
             jax.ShapeDtypeStruct((bp, 1), _U32),
-            jax.ShapeDtypeStruct((1, rp), _U32),
-            jax.ShapeDtypeStruct((1, ap), _U32),
+            # int32 histograms (Mosaic unsigned-reduction constraint);
+            # per-chunk totals are bounded by the batch size << 2^31.
+            jax.ShapeDtypeStruct((1, rp), jnp.int32),
+            jax.ShapeDtypeStruct((1, ap), jnp.int32),
         ),
         interpret=interpret,
     )(
@@ -180,7 +185,11 @@ def match_rows_and_hists_pallas(
         field(valid.astype(_U32)),
         rules_fm,
     )
-    return row.reshape(bp)[:b], hist_rows.reshape(rp), hist_deny.reshape(ap)
+    return (
+        row.reshape(bp)[:b],
+        hist_rows.reshape(rp).astype(_U32),
+        hist_deny.reshape(ap).astype(_U32),
+    )
 
 
 def counts_from_hists(

@@ -47,9 +47,17 @@ from ..hostside.pack import (
 from .match import NO_MATCH
 
 _U32 = jnp.uint32
+_I32 = jnp.int32
 #: Python-int twin of ops.match.NO_MATCH — pallas kernels cannot capture
 #: module-level jax arrays, only literals.
 _NO_MATCH = 0xFFFFFFFF
+#: int32 in-kernel sentinel: Mosaic TPU has no lowering for reductions
+#: over UNSIGNED integers (first compiled run, r5 TPU window:
+#: "NotImplementedError: Reductions over unsigned integers"), so the
+#: running-min over rule tiles is carried in int32 — row indices are far
+#: below 2^31 — and mapped back to the uint32 NO_MATCH at the kernel
+#: boundary, keeping callers bit-compatible with ops.match.
+_NO_MATCH_I32 = 0x7FFFFFFF
 
 #: Lines per grid step (sublane-major).  4096 lines x 128-rule tiles keeps
 #: the compare temporary at 2 MB and the six field blocks at 96 KB.
@@ -93,14 +101,15 @@ def tile_first_match(fields: tuple, rules, n_tiles: int):
             & in_range(R_DPLO, R_DPHI, dp)
         )
         idx = (
-            lax.broadcasted_iota(_U32, (1, RULE_TILE), 1)
-            + (t * RULE_TILE).astype(_U32)
+            lax.broadcasted_iota(_I32, (1, RULE_TILE), 1)
+            + (t * RULE_TILE).astype(_I32)
         )
-        cand = jnp.where(ok, jnp.broadcast_to(idx, ok.shape), _U32(_NO_MATCH))
+        cand = jnp.where(ok, jnp.broadcast_to(idx, ok.shape), _I32(_NO_MATCH_I32))
         return jnp.minimum(best, jnp.min(cand, axis=1, keepdims=True))
 
-    init = jnp.full((a.shape[0], 1), _NO_MATCH, dtype=_U32)
-    return lax.fori_loop(0, n_tiles, body, init)
+    init = jnp.full((a.shape[0], 1), _NO_MATCH_I32, dtype=_I32)
+    best = lax.fori_loop(0, n_tiles, body, init)
+    return jnp.where(best == _I32(_NO_MATCH_I32), _U32(_NO_MATCH), best.astype(_U32))
 
 
 def _kernel(acl, proto, src, sport, dst, dport, rules, out, *, n_tiles: int):
