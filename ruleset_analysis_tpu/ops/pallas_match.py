@@ -59,9 +59,13 @@ _NO_MATCH = 0xFFFFFFFF
 #: boundary, keeping callers bit-compatible with ops.match.
 _NO_MATCH_I32 = 0x7FFFFFFF
 
-#: Lines per grid step (sublane-major).  4096 lines x 128-rule tiles keeps
-#: the compare temporary at 2 MB and the six field blocks at 96 KB.
-BLOCK_LINES = 4096
+#: Lines per grid step (sublane-major).  A [BLOCK_LINES, 1] u32 block is
+#: physically tiled (8, 128), so it occupies BLOCK_LINES x 128 lanes of
+#: VMEM — 512 KB at 1024 lines.  Seven such blocks (6 in + 1 out), double-
+#: buffered across the grid, plus the [BLOCK_LINES, RULE_TILE] compare
+#: temporary must fit the 16 MB scoped-vmem limit; 4096 OOM'd at 28 MB on
+#: the first real-TPU compile (r5 window), 1024 leaves ~2x headroom.
+BLOCK_LINES = 1024
 
 #: Rules per lane tile — the VPU lane width.
 RULE_TILE = 128
