@@ -30,7 +30,16 @@ import re
 PERMIT, DENY = 1, 0
 
 U32_MAX = 0xFFFFFFFF
+U128_MAX = (1 << 128) - 1
 PORT_MAX = 0xFFFF
+
+#: Address families.  ``FAM_WILD`` marks a family-agnostic wildcard
+#: (``any`` / ``interface``) during expansion; it never survives into an
+#: :class:`Ace` — parse_asa_config resolves it per ruleset (v4-only for
+#: pure-v4 configs, both families when the ruleset carries explicit v6
+#: content — the ASA 9.0+ unified-ACL reading of ``any``, gated so
+#: v4-era configs keep their exact pre-v6 expansion).
+FAM_WILD, FAM_V4, FAM_V6 = 0, 4, 6
 
 #: IP protocol names ASA accepts in ACEs.
 PROTO_NUMBERS = {
@@ -137,7 +146,14 @@ ICMP_TYPE_NAMES = {
 
 FULL_PORTS = (0, PORT_MAX)
 FULL_ADDR = (0, U32_MAX)
+FULL_ADDR6 = (0, U128_MAX)
 FULL_PROTO = (0, 255)
+
+#: Family-tagged full-range address alternatives ((family, lo, hi) —
+#: the shape every address resolver returns).
+ANY4 = (FAM_V4, 0, U32_MAX)
+ANY6 = (FAM_V6, 0, U128_MAX)
+ANY_WILD = (FAM_WILD, 0, 0)  # bounds resolved at family expansion
 
 
 class AclParseError(ValueError):
@@ -145,12 +161,11 @@ class AclParseError(ValueError):
 
 
 def ip_to_u32(s: str) -> int:
-    # Name IPv6 explicitly in the skip reason: the packed model is
-    # v4-only (DESIGN.md "IPv6 position"), and lenient-mode accounting
-    # should say WHY a line was skipped, not just that the text looked
-    # wrong.  ASA spells v6 ACEs with colon literals or the any6 keyword.
+    # v6 literals are parsed by ip6_to_int; reaching here with one means
+    # the CONTEXT is v4-only (e.g. a standard ACL) — say so explicitly,
+    # the lenient-mode skip accounting surfaces this reason verbatim.
     if ":" in s or s == "any6":
-        raise AclParseError(f"IPv6 address (v4-only packed model): {s!r}")
+        raise AclParseError(f"IPv6 address in IPv4-only context: {s!r}")
     parts = s.split(".")
     if len(parts) != 4:
         raise AclParseError(f"bad IPv4 address: {s!r}")
@@ -179,6 +194,50 @@ def subnet_range(net: str, mask: str) -> tuple[int, int]:
     n, m = ip_to_u32(net), ip_to_u32(mask)
     lo = n & m
     return lo, lo | (~m & U32_MAX)
+
+
+def ip6_to_int(s: str) -> int:
+    """IPv6 literal -> 128-bit int (RFC 4291 text forms, incl. embedded v4).
+
+    Delegates to the stdlib ``ipaddress`` parser — strict (rejects zone
+    ids, malformed compressions) and battle-tested; the device side never
+    sees text, only the 4x uint32 limbs pack.py derives from this int.
+    """
+    import ipaddress
+
+    try:
+        return int(ipaddress.IPv6Address(s))
+    except (ipaddress.AddressValueError, ValueError):
+        raise AclParseError(f"bad IPv6 address: {s!r}") from None
+
+
+def int_to_ip6(v: int) -> str:
+    import ipaddress
+
+    return str(ipaddress.IPv6Address(v))
+
+
+def prefix6_range(tok: str) -> tuple[int, int]:
+    """``2001:db8::/64`` -> inclusive [lo, hi].
+
+    The ``/prefixlen`` is REQUIRED: ASA spells v6 network operands as one
+    prefix token (never address + mask pairs), and accepting a bare
+    literal here would let a v4-style ``ADDR MASK`` v6 spelling silently
+    parse as two /128 operands — a mis-parse, not a lenient read.  Bare
+    literals are only valid after ``host``.
+    """
+    if "/" not in tok:
+        raise AclParseError(
+            f"IPv6 network operand requires /prefixlen (or use host): {tok!r}"
+        )
+    addr, _, plen_s = tok.partition("/")
+    if not (plen_s.isascii() and plen_s.isdigit()) or not 0 <= int(plen_s) <= 128:
+        raise AclParseError(f"bad IPv6 prefix length: {tok!r}")
+    plen = int(plen_s)
+    a = ip6_to_int(addr)
+    mask = (U128_MAX << (128 - plen)) & U128_MAX
+    lo = a & mask
+    return lo, lo | (~mask & U128_MAX)
 
 
 def _port_value(tok: str) -> int:
@@ -213,7 +272,15 @@ def _proto_ranges(tok: str) -> list[tuple[int, int]]:
 
 @dataclasses.dataclass(frozen=True)
 class Ace:
-    """One concrete, fully-expanded match row (all-inclusive ranges)."""
+    """One concrete, fully-expanded match row (all-inclusive ranges).
+
+    ``family`` is FAM_V4 or FAM_V6; address bounds are Python ints (32-
+    or 128-bit).  A packet can only match an ACE of its own family —
+    pack.py exploits this to split rows into per-family device tensors
+    without breaking first-match order (cross-family matches are
+    impossible, so the min-matching-row within a family equals the
+    min-matching-row overall for that packet).
+    """
 
     action: int  # PERMIT / DENY
     proto_lo: int
@@ -226,10 +293,15 @@ class Ace:
     dst_hi: int
     dport_lo: int
     dport_hi: int
+    family: int = FAM_V4
 
-    def matches(self, proto: int, src: int, sport: int, dst: int, dport: int) -> bool:
+    def matches(
+        self, proto: int, src: int, sport: int, dst: int, dport: int,
+        family: int = FAM_V4,
+    ) -> bool:
         return (
-            self.proto_lo <= proto <= self.proto_hi
+            self.family == family
+            and self.proto_lo <= proto <= self.proto_hi
             and self.src_lo <= src <= self.src_hi
             and self.sport_lo <= sport <= self.sport_hi
             and self.dst_lo <= dst <= self.dst_hi
@@ -344,7 +416,18 @@ def _collect_blocks(lines: list[str]) -> tuple[_Groups, list[tuple[int, str]]]:
     return groups, rest
 
 
-def _resolve_network_group(groups: _Groups, name: str, _seen=None) -> list[tuple[int, int]]:
+def _host_triple(tok: str) -> tuple[int, int, int]:
+    """``host`` operand -> (family, lo, hi); family by v6 colon literal."""
+    if ":" in tok:
+        a = ip6_to_int(tok)
+        return (FAM_V6, a, a)
+    a = ip_to_u32(tok)
+    return (FAM_V4, a, a)
+
+
+def _resolve_network_group(
+    groups: _Groups, name: str, _seen=None
+) -> list[tuple[int, int, int]]:
     if _seen is None:
         _seen = set()
     if name in _seen:
@@ -352,16 +435,18 @@ def _resolve_network_group(groups: _Groups, name: str, _seen=None) -> list[tuple
     if name not in groups.network:
         raise AclParseError(f"unknown network object-group {name!r}")
     _seen.add(name)
-    out: list[tuple[int, int]] = []
+    out: list[tuple[int, int, int]] = []
     for toks in groups.network[name]:
         if toks[0] == "network-object":
             if toks[1] == "host":
-                a = ip_to_u32(toks[2])
-                out.append((a, a))
+                out.append(_host_triple(toks[2]))
             elif toks[1] == "object":
                 out.extend(_resolve_network_object(groups, toks[2]))
+            elif ":" in toks[1]:
+                # v6 members are spelled as a single prefix token
+                out.append((FAM_V6, *prefix6_range(toks[1])))
             else:
-                out.append(subnet_range(toks[1], toks[2]))
+                out.append((FAM_V4, *subnet_range(toks[1], toks[2])))
         elif toks[0] == "group-object":
             out.extend(_resolve_network_group(groups, toks[1], _seen))
         else:
@@ -370,18 +455,26 @@ def _resolve_network_group(groups: _Groups, name: str, _seen=None) -> list[tuple
     return out
 
 
-def _resolve_network_object(groups: _Groups, name: str) -> list[tuple[int, int]]:
+def _resolve_network_object(groups: _Groups, name: str) -> list[tuple[int, int, int]]:
     if name not in groups.net_objects:
         raise AclParseError(f"unknown network object {name!r}")
     out = []
     for toks in groups.net_objects[name]:
         if toks[0] == "host":
-            a = ip_to_u32(toks[1])
-            out.append((a, a))
+            out.append(_host_triple(toks[1]))
         elif toks[0] == "subnet":
-            out.append(subnet_range(toks[1], toks[2]))
+            if ":" in toks[1]:
+                # v6 subnets are one prefix token (``subnet 2001:db8::/64``)
+                out.append((FAM_V6, *prefix6_range(toks[1])))
+            else:
+                out.append((FAM_V4, *subnet_range(toks[1], toks[2])))
         elif toks[0] == "range":
-            lo, hi = ip_to_u32(toks[1]), ip_to_u32(toks[2])
+            if ":" in toks[1] or ":" in toks[2]:
+                lo, hi = ip6_to_int(toks[1]), ip6_to_int(toks[2])
+                fam = FAM_V6
+            else:
+                lo, hi = ip_to_u32(toks[1]), ip_to_u32(toks[2])
+                fam = FAM_V4
             if lo > hi:
                 # real ASA rejects inverted ranges; the device kernel's
                 # wraparound range check also requires lo <= hi
@@ -389,7 +482,7 @@ def _resolve_network_object(groups: _Groups, name: str) -> list[tuple[int, int]]
                     f"inverted address range {toks[1]}-{toks[2]} in network "
                     f"object {name!r}"
                 )
-            out.append((lo, hi))
+            out.append((fam, lo, hi))
         elif toks[0] in ("nat", "fqdn"):
             continue  # not matchable statically
         else:
@@ -599,28 +692,43 @@ def _resolve_icmp_type_group(groups: _Groups, name: str, _seen=None) -> list[tup
 # ACE parsing
 # ---------------------------------------------------------------------------
 
-_ADDR_STARTERS = {"any", "any4", "host", "object-group", "object", "interface"}
+_ADDR_STARTERS = {"any", "any4", "any6", "host", "object-group", "object", "interface"}
 _PORT_OPS = {"eq", "range", "gt", "lt", "neq"}
 _TRAILERS = {"log", "inactive", "time-range"}
 
 
-def _parse_address(groups: _Groups, toks: list[str], pos: int) -> tuple[list[tuple[int, int]], int]:
+def _parse_address(
+    groups: _Groups, toks: list[str], pos: int
+) -> tuple[list[tuple[int, int, int]], int]:
+    """Address spec at toks[pos] -> ((family, lo, hi) alternatives, new pos).
+
+    ``any`` yields the family wildcard (resolved per ruleset by
+    parse_asa_config); ``any4``/``any6`` pin a family; v6 operands are
+    recognised by their colon literals.
+    """
     t = toks[pos]
-    if t in ("any", "any4"):
-        return [FULL_ADDR], pos + 1
+    if t == "any":
+        return [ANY_WILD], pos + 1
+    if t == "any4":
+        return [ANY4], pos + 1
+    if t == "any6":
+        return [ANY6], pos + 1
     if t == "host":
-        a = ip_to_u32(toks[pos + 1])
-        return [(a, a)], pos + 2
+        return [_host_triple(toks[pos + 1])], pos + 2
     if t == "object-group":
         return _resolve_network_group(groups, toks[pos + 1]), pos + 2
     if t == "object":
         return _resolve_network_object(groups, toks[pos + 1]), pos + 2
     if t == "interface":
         # matches traffic to/from the interface address; not statically
-        # resolvable here — treat as any, as the reference's coarse parse does
-        return [FULL_ADDR], pos + 2
+        # resolvable here — treat as v4-any, as the reference's coarse
+        # parse does (v4-era construct; a v6 deployment would use any6)
+        return [ANY4], pos + 2
+    if ":" in t:
+        # v6 network operand: one prefix token (``2001:db8::/64``)
+        return [(FAM_V6, *prefix6_range(t))], pos + 1
     # plain "NET MASK"
-    return [subnet_range(t, toks[pos + 1])], pos + 2
+    return [(FAM_V4, *subnet_range(t, toks[pos + 1]))], pos + 2
 
 
 def _maybe_port_spec(
@@ -706,6 +814,7 @@ def parse_ace_line(
 
     # NB: an empty range list ([] from e.g. "gt 65535") means the spec can
     # never match — distinct from None (no spec -> full range).
+    n_pairs = 0
     for alt in proto_alts:
         if generic_service and alt.sport:
             alt_sports = [alt.sport]
@@ -713,12 +822,21 @@ def parse_ace_line(
             alt_sports = sports if sports is not None else [FULL_PORTS]
         if generic_service and alt.dport:
             alt_dports = [alt.dport]
-        elif icmp_types is not None and alt.proto == (1, 1):
+        elif icmp_types is not None and alt.proto in ((1, 1), (58, 58)):
+            # ICMP types ride the dport column for icmp AND icmp6
             alt_dports = icmp_types
         else:
             alt_dports = dports if dports is not None else [FULL_PORTS]
         for s in src:
             for d in dst:
+                sf, df = s[0], d[0]
+                if sf != FAM_WILD and df != FAM_WILD and sf != df:
+                    continue  # a cross-family pair can match no packet
+                fam = sf or df  # FAM_WILD only when both sides are wild
+                full = FULL_ADDR6 if fam == FAM_V6 else FULL_ADDR
+                slo, shi = (s[1], s[2]) if sf != FAM_WILD else full
+                dlo, dhi = (d[1], d[2]) if df != FAM_WILD else full
+                n_pairs += 1
                 for sp in alt_sports:
                     for dp in alt_dports:
                         rule.aces.append(
@@ -726,16 +844,23 @@ def parse_ace_line(
                                 action=action,
                                 proto_lo=alt.proto[0],
                                 proto_hi=alt.proto[1],
-                                src_lo=s[0],
-                                src_hi=s[1],
+                                src_lo=slo,
+                                src_hi=shi,
                                 sport_lo=sp[0],
                                 sport_hi=sp[1],
-                                dst_lo=d[0],
-                                dst_hi=d[1],
+                                dst_lo=dlo,
+                                dst_hi=dhi,
                                 dport_lo=dp[0],
                                 dport_hi=dp[1],
+                                family=fam,
                             )
                         )
+    if src and dst and proto_alts and n_pairs == 0:
+        # every src/dst pairing crossed families (e.g. ``any4`` source
+        # with a v6-only destination group) — real ASA rejects such
+        # entries; an unmatched-forever rule would silently distort the
+        # unused-rule report
+        raise AclParseError(f"no same-family src/dst combination in: {line!r}")
     return rule
 
 
@@ -813,7 +938,49 @@ def parse_asa_config(text: str, firewall: str, strict: bool = True) -> Ruleset:
             rs.skipped.append((lineno, str(e), line))
             continue
         rs.acls.setdefault(acl, []).append(rule)
+    _resolve_wildcard_families(rs)
     return rs
+
+
+def _resolve_wildcard_families(rs: Ruleset) -> None:
+    """Resolve FAM_WILD aces (``any`` src AND dst) per ruleset.
+
+    Pure-v4 configs: the wildcard is v4-only — every pre-v6 corpus keeps
+    its exact historical expansion (row counts, tensors, reports all
+    bit-identical).  Configs with explicit v6 content (a colon literal or
+    ``any6`` anywhere): ``any`` means both families, the ASA 9.0+
+    unified-ACL semantic, so ``permit ip any any`` really does cover v6
+    traffic there.  The v6 twin sits next to its v4 ace — same configured
+    rule, same key — so rule-level counts are unaffected by the order.
+    """
+    has_v6 = any(
+        a.family == FAM_V6
+        for rules in rs.acls.values()
+        for r in rules
+        for a in r.aces
+    )
+    for rules in rs.acls.values():
+        for r in rules:
+            if all(a.family != FAM_WILD for a in r.aces):
+                continue
+            new: list[Ace] = []
+            for a in r.aces:
+                if a.family != FAM_WILD:
+                    new.append(a)
+                    continue
+                new.append(dataclasses.replace(a, family=FAM_V4))
+                if has_v6:
+                    new.append(
+                        dataclasses.replace(
+                            a,
+                            family=FAM_V6,
+                            src_lo=0,
+                            src_hi=U128_MAX,
+                            dst_lo=0,
+                            dst_hi=U128_MAX,
+                        )
+                    )
+            r.aces = new
 
 
 def parse_config_file(path: str, firewall: str | None = None, strict: bool = True) -> Ruleset:

@@ -91,7 +91,7 @@ class Oracle:
     def _match_one(self, rs: Ruleset, acl: str, p: ParsedLine) -> RuleKey:
         for rule in rs.acls[acl]:
             for ace in rule.aces:
-                if ace.matches(p.proto, p.src, p.sport, p.dst, p.dport):
+                if ace.matches(p.proto, p.src, p.sport, p.dst, p.dport, p.family):
                     return (rs.firewall, acl, rule.index)
         return (rs.firewall, acl, 0)  # implicit deny
 

@@ -58,6 +58,48 @@ T_ACL, T_PROTO, T_SRC, T_SPORT, T_DST, T_DPORT, T_VALID = range(7)
 # wire columns (compact_batch): src | dst | sport<<16|dport | proto<<24|valid<<23|acl
 W_SRC, W_DST, W_PORTS, W_META = range(4)
 
+# ---------------------------------------------------------------------------
+# IPv6 family (DESIGN.md "IPv6 position"): 128-bit addresses as 4 uint32
+# big-endian limbs.  v6 rows/tuples live in SEPARATE tensors so the v4 hot
+# path is untouched; splitting by family preserves first-match order
+# because a packet can only match ACEs of its own family (aclparse.Ace).
+# Rule keys are shared across families — one report, one key universe.
+# ---------------------------------------------------------------------------
+
+RULE6_COLS = 24
+TUPLE6_COLS = 13
+
+# v6 rule matrix columns: acl | proto lo/hi | src lo limbs | src hi limbs
+# | sport lo/hi | dst lo limbs | dst hi limbs | dport lo/hi | key
+R6_ACL = 0
+R6_PLO, R6_PHI = 1, 2
+R6_SLO = 3   # ..6   (big-endian limbs: col R6_SLO+i is bits 127-32i..96-32i)
+R6_SHI = 7   # ..10
+R6_SPLO, R6_SPHI = 11, 12
+R6_DLO = 13  # ..16
+R6_DHI = 17  # ..20
+R6_DPLO, R6_DPHI = 21, 22
+R6_KEY = 23
+
+# v6 tuple columns
+T6_ACL = 0
+T6_PROTO = 1
+T6_SRC = 2   # ..5
+T6_SPORT = 6
+T6_DST = 7   # ..10
+T6_DPORT = 11
+T6_VALID = 12
+
+
+def u128_limbs(v: int) -> tuple[int, int, int, int]:
+    """128-bit int -> 4 big-endian uint32 limbs."""
+    m = 0xFFFFFFFF
+    return ((v >> 96) & m, (v >> 64) & m, (v >> 32) & m, v & m)
+
+
+def limbs_u128(l0: int, l1: int, l2: int, l3: int) -> int:
+    return (int(l0) << 96) | (int(l1) << 64) | (int(l2) << 32) | int(l3)
+
 #: acl gid budget in the wire meta word: 23 bits (proto takes 8, valid 1).
 WIRE_MAX_ACLS = 1 << 23
 
@@ -95,6 +137,18 @@ class PackedRuleset:
     #: report so a packed ruleset can't silently hide that its source
     #: config wasn't fully parsed.
     parse_skips: list[tuple[str, int, str]] = dataclasses.field(default_factory=list)
+    #: [R6, RULE6_COLS] uint32 — the IPv6 ACE rows (4x uint32 address
+    #: limbs), sharing the v4 rows' key universe.  Empty ([0, RULE6_COLS])
+    #: for pure-v4 rulesets, in which case the device v6 path never runs.
+    rules6: np.ndarray | None = None
+
+    def __post_init__(self):
+        if self.rules6 is None:
+            self.rules6 = np.zeros((0, RULE6_COLS), dtype=np.uint32)
+
+    @property
+    def has_v6(self) -> bool:
+        return self.rules6.shape[0] > 0
 
     @property
     def n_keys(self) -> int:
@@ -123,6 +177,7 @@ def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> P
             "acl-gid budget (23 bits of the packed meta word)"
         )
 
+    rows6: list[list[int]] = []
     for rs in rulesets:
         for acl, rules in rs.acls.items():
             gid = acl_gid[(rs.firewall, acl)]
@@ -132,6 +187,24 @@ def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> P
                     KeyMeta(firewall=rs.firewall, acl=acl, index=rule.index, text=rule.text)
                 )
                 for a in rule.aces:
+                    if a.family == 6:
+                        rows6.append(
+                            [
+                                gid,
+                                a.proto_lo,
+                                a.proto_hi,
+                                *u128_limbs(a.src_lo),
+                                *u128_limbs(a.src_hi),
+                                a.sport_lo,
+                                a.sport_hi,
+                                *u128_limbs(a.dst_lo),
+                                *u128_limbs(a.dst_hi),
+                                a.dport_lo,
+                                a.dport_hi,
+                                key,
+                            ]
+                        )
+                        continue
                     rows.append(
                         [
                             gid,
@@ -178,8 +251,14 @@ def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> P
     mat[:, R_ACL] = NO_ACL
     if rows:
         mat[:r] = np.asarray(rows, dtype=np.uint32)
+    mat6 = (
+        np.asarray(rows6, dtype=np.uint32)
+        if rows6
+        else np.zeros((0, RULE6_COLS), dtype=np.uint32)
+    )
     return PackedRuleset(
         rules=mat,
+        rules6=mat6,
         n_rules=n_rules,
         n_acls=n_acls,
         key_meta=key_meta,
@@ -280,38 +359,83 @@ class LinePacker:
         gids = self.resolve_gids(p)
         return gids[0] if gids else None
 
-    def pack_parsed(self, parsed: list[ParsedLine | None], batch_size: int | None = None) -> np.ndarray:
-        """Pack parsed lines into a [B, TUPLE_COLS] uint32 batch (padded).
+    def pack_parsed2(
+        self,
+        parsed: list[ParsedLine | None],
+        batch_size: int | None = None,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Pack parsed lines into per-family batches.
 
-        The default capacity is one row per line — two when any
-        out-direction binding exists, since a connection line can then
-        emit two evaluations.
+        Returns ``([B, TUPLE_COLS], [B6, TUPLE6_COLS])`` uint32 batches
+        (each padded with valid=0 rows).  The default capacity is one row
+        per line — two when any out-direction binding exists, since a
+        connection line can then emit two evaluations.  A line's
+        evaluations land in its family's batch; both batches share the
+        capacity bound (a chunk of N lines can never exceed N (or 2N)
+        evaluations across both families combined).
         """
         if batch_size is not None:
             b = batch_size
         else:
             b = (2 if self.packed.bindings_out else 1) * len(parsed)
         out = np.zeros((b, TUPLE_COLS), dtype=np.uint32)
+        out6 = np.zeros((b if self.packed.has_v6 else 0, TUPLE6_COLS), dtype=np.uint32)
         i = 0
+        i6 = 0
         for p in parsed:
             gids = [] if p is None else self.resolve_gids(p)
+            if gids and p.family == 6 and not self.packed.has_v6:
+                # a v6 line against a pure-v4 ruleset can only hit the
+                # implicit deny; without v6 rows the device path cannot
+                # represent it — counted-skip, exactly the pre-v6 behavior
+                gids = []
             if not gids:
                 self.skipped += 1
                 continue
-            if i + len(gids) > b:
+            if i + i6 + len(gids) > b:
                 raise ValueError(
                     f"more than batch_size={b} evaluations in chunk; "
                     "feed fewer lines per chunk (each connection line can "
                     "emit two rows when both in and out ACLs are bound)"
                 )
-            for gid in gids:
-                out[i] = (gid, p.proto, p.src, p.sport, p.dst, p.dport, 1)
-                i += 1
-                self.parsed += 1
+            if p.family == 6:
+                s = u128_limbs(p.src)
+                d = u128_limbs(p.dst)
+                for gid in gids:
+                    out6[i6] = (gid, p.proto, *s, p.sport, *d, p.dport, 1)
+                    i6 += 1
+                    self.parsed += 1
+            else:
+                for gid in gids:
+                    out[i] = (gid, p.proto, p.src, p.sport, p.dst, p.dport, 1)
+                    i += 1
+                    self.parsed += 1
+        return out, out6
+
+    def pack_parsed(self, parsed: list[ParsedLine | None], batch_size: int | None = None) -> np.ndarray:
+        """v4-only twin of :meth:`pack_parsed2` (the original API).
+
+        Raises :class:`AnalysisError` if any v6 evaluation was packed —
+        callers that may see v6 traffic against a v6-capable ruleset must
+        use pack_parsed2; silently dropping supported traffic here would
+        corrupt the hit counts.
+        """
+        out, out6 = self.pack_parsed2(parsed, batch_size)
+        if out6.size and int(out6[:, T6_VALID].sum()):
+            raise AnalysisError(
+                "IPv6 evaluations in a v4-only packing call; use "
+                "pack_parsed2 (or the streaming driver, which handles "
+                "both families)"
+            )
         return out
 
     def pack_lines(self, lines: list[str], batch_size: int | None = None) -> np.ndarray:
         return self.pack_parsed([parse_line(ln) for ln in lines], batch_size)
+
+    def pack_lines2(
+        self, lines: list[str], batch_size: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        return self.pack_parsed2([parse_line(ln) for ln in lines], batch_size)
 
 
 # ---------------------------------------------------------------------------
@@ -459,6 +583,7 @@ def save_packed(packed: PackedRuleset, path_prefix: str) -> None:
     np.savez_compressed(
         path_prefix + ".npz",
         rules=packed.rules,
+        rules6=packed.rules6,
         deny_key=packed.deny_key,
         n_rules=np.int64(packed.n_rules),
         n_acls=np.int64(packed.n_acls),
@@ -511,13 +636,53 @@ def validate_rule_ranges(rules: np.ndarray) -> None:
             )
 
 
+def validate_rule6_ranges(rules6: np.ndarray) -> None:
+    """Reject v6 rule rows with inverted lo/hi ranges (v4 twin above).
+
+    Scalar columns use the same check; 128-bit address bounds compare
+    lexicographically over their big-endian limbs.
+    """
+    if rules6.shape[0] == 0:
+        return
+    for lo, hi, name in ((R6_PLO, R6_PHI, "proto"), (R6_SPLO, R6_SPHI, "sport"),
+                         (R6_DPLO, R6_DPHI, "dport")):
+        bad = np.nonzero(rules6[:, lo] > rules6[:, hi])[0]
+        if bad.size:
+            raise AnalysisError(
+                f"packed v6 ruleset row {int(bad[0])} has inverted {name} "
+                f"range ({bad.size} offending row(s) total); re-pack the "
+                "artifact with parse-acls/convert"
+            )
+    for lo0, hi0, name in ((R6_SLO, R6_SHI, "src"), (R6_DLO, R6_DHI, "dst")):
+        lo_limbs = rules6[:, lo0:lo0 + 4].astype(np.uint64)
+        hi_limbs = rules6[:, hi0:hi0 + 4].astype(np.uint64)
+        n = rules6.shape[0]
+        lt = np.zeros(n, dtype=bool)
+        gt = np.zeros(n, dtype=bool)
+        for i in range(4):  # big-endian lexicographic compare
+            lt |= ~gt & (lo_limbs[:, i] < hi_limbs[:, i])
+            gt |= ~lt & (lo_limbs[:, i] > hi_limbs[:, i])
+        bad = np.nonzero(gt)[0]
+        if bad.size:
+            raise AnalysisError(
+                f"packed v6 ruleset row {int(bad[0])} has inverted {name} "
+                f"address range ({bad.size} offending row(s) total); re-pack "
+                "the artifact with parse-acls/convert"
+            )
+
+
 def load_packed(path_prefix: str) -> PackedRuleset:
     z = np.load(path_prefix + ".npz")
     with open(path_prefix + ".json", "r", encoding="utf-8") as f:
         meta = json.load(f)
     validate_rule_ranges(z["rules"])
+    # rules6 absent in pre-v6 artifacts: those are pure-v4 by construction
+    rules6 = z["rules6"] if "rules6" in z.files else None
+    if rules6 is not None:
+        validate_rule6_ranges(rules6)
     return PackedRuleset(
         rules=z["rules"],
+        rules6=rules6,
         n_rules=int(z["n_rules"]),
         n_acls=int(z["n_acls"]),
         key_meta=[KeyMeta(**m) for m in meta["key_meta"]],

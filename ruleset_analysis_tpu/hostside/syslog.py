@@ -37,12 +37,17 @@ from __future__ import annotations
 import dataclasses
 import re
 
-from .aclparse import PROTO_NUMBERS, ip_to_u32
+from .aclparse import FAM_V4, FAM_V6, PROTO_NUMBERS, ip6_to_int, ip_to_u32
 
 
 @dataclasses.dataclass(frozen=True)
 class ParsedLine:
-    """One successfully parsed ASA log line, ACL not yet resolved."""
+    """One successfully parsed ASA log line, ACL not yet resolved.
+
+    ``family`` is FAM_V4 or FAM_V6; src/dst are Python ints (32- or
+    128-bit).  ASA logs a connection's two endpoints in one family —
+    mixed-family text in a single message is malformed and skipped.
+    """
 
     firewall: str
     acl: str | None  # None for connection messages; resolve via binding
@@ -56,6 +61,7 @@ class ParsedLine:
     #: exit interface (302013/302015 only): evaluated against that
     #: interface's ``out`` access-group binding, when one exists
     egress_if: str | None = None
+    family: int = FAM_V4
 
 
 _PROTO_BY_NAME = {k: (v if v is not None else 0) for k, v in PROTO_NUMBERS.items()}
@@ -71,6 +77,17 @@ def _proto_num(tok: str) -> int:
         return 0
 
 
+def _addr(tok: str) -> tuple[int, int]:
+    """Address text -> (family, value); v6 recognised by colon literals.
+
+    Raises (a ValueError subclass) on malformed text of either family —
+    parse_line turns that into a clean line skip.
+    """
+    if ":" in tok:
+        return FAM_V6, ip6_to_int(tok)
+    return FAM_V4, ip_to_u32(tok)
+
+
 # hostname is the last whitespace token before the %ASA tag (syslog relay
 # prefixes vary; this is robust to "<pri>MMM dd hh:mm:ss host : %ASA-...").
 # re.ASCII everywhere: Python's \d otherwise matches Unicode digits,
@@ -81,14 +98,14 @@ _TAG_RE = re.compile(r"(?:^|\s)(\S+?)\s*:?\s*%ASA-\d-(\d{6}):\s*(.*)$", re.ASCII
 
 _M106100_RE = re.compile(
     r"access-list\s+(\S+)\s+(permitted|denied|est-allowed)\s+(\S+)\s+"
-    r"(\S+?)/([\d.]+)\((\d+)\)(?:\([^)]*\))?\s*->\s*"
-    r"(\S+?)/([\d.]+)\((\d+)\)"
+    r"(\S+?)/([\dA-Fa-f:.]+)\((\d+)\)(?:\([^)]*\))?\s*->\s*"
+    r"(\S+?)/([\dA-Fa-f:.]+)\((\d+)\)"
     , re.ASCII
 )
 
 _M106023_RE = re.compile(
-    r"Deny\s+(\S+)\s+src\s+(\S+?):([\d.]+)(?:/(\d+))?\s+"
-    r"dst\s+(\S+?):([\d.]+)(?:/(\d+))?"
+    r"Deny\s+(\S+)\s+src\s+(\S+?):([\dA-Fa-f:.]+)(?:/(\d+))?\s+"
+    r"dst\s+(\S+?):([\dA-Fa-f:.]+)(?:/(\d+))?"
     r"(?:\s+\(type\s+(\d+),\s*code\s+(\d+)\))?"
     r'.*?by\s+access-group\s+"([^"]+)"'
     , re.ASCII
@@ -96,26 +113,26 @@ _M106023_RE = re.compile(
 
 _M302013_RE = re.compile(
     r"Built\s+(inbound|outbound)\s+(TCP|UDP)\s+connection\s+\S+\s+for\s+"
-    r"(\S+?):([\d.]+)/(\d+)\s*(?:\([^)]*\))?\s*to\s+"
-    r"(\S+?):([\d.]+)/(\d+)"
+    r"(\S+?):([\dA-Fa-f:.]+)/(\d+)\s*(?:\([^)]*\))?\s*to\s+"
+    r"(\S+?):([\dA-Fa-f:.]+)/(\d+)"
     , re.ASCII
 )
 
 _M106001_RE = re.compile(
-    r"Inbound\s+TCP\s+connection\s+denied\s+from\s+([\d.]+)/(\d+)\s+to\s+"
-    r"([\d.]+)/(\d+)\s+flags\s+.*?\bon\s+interface\s+(\S+)"
+    r"Inbound\s+TCP\s+connection\s+denied\s+from\s+([\dA-Fa-f:.]+)/(\d+)\s+to\s+"
+    r"([\dA-Fa-f:.]+)/(\d+)\s+flags\s+.*?\bon\s+interface\s+(\S+)"
     , re.ASCII
 )
 
 _M106006_RE = re.compile(
-    r"Deny\s+inbound\s+UDP\s+from\s+([\d.]+)/(\d+)\s+to\s+"
-    r"([\d.]+)/(\d+)\s+on\s+interface\s+(\S+)"
+    r"Deny\s+inbound\s+UDP\s+from\s+([\dA-Fa-f:.]+)/(\d+)\s+to\s+"
+    r"([\dA-Fa-f:.]+)/(\d+)\s+on\s+interface\s+(\S+)"
     , re.ASCII
 )
 
 _M106015_RE = re.compile(
-    r"Deny\s+TCP\s+\(no connection\)\s+from\s+([\d.]+)/(\d+)\s+to\s+"
-    r"([\d.]+)/(\d+)\s+flags\s+.*?\bon\s+interface\s+(\S+)"
+    r"Deny\s+TCP\s+\(no connection\)\s+from\s+([\dA-Fa-f:.]+)/(\d+)\s+to\s+"
+    r"([\dA-Fa-f:.]+)/(\d+)\s+flags\s+.*?\bon\s+interface\s+(\S+)"
     , re.ASCII
 )
 
@@ -161,20 +178,25 @@ def _parse_line_raw(line: str) -> ParsedLine | None:
         proto = _proto_num(proto_tok)
         sport = int(b.group(6))
         dport = int(b.group(9))
-        if proto == 1:
-            # ICMP: the parenthesised values are type/code; type -> dport
+        if proto in (1, 58):
+            # ICMP/ICMPv6: the parenthesised values are type/code; type -> dport
             dport = sport
             sport = 0
+        sfam, src = _addr(b.group(5))
+        dfam, dst = _addr(b.group(8))
+        if sfam != dfam:
+            return None
         return ParsedLine(
             firewall=host,
             acl=acl,
             ingress_if=b.group(4),
             proto=proto,
-            src=ip_to_u32(b.group(5)),
+            src=src,
             sport=sport,
-            dst=ip_to_u32(b.group(8)),
+            dst=dst,
             dport=dport,
             permitted=(verdict != "denied"),
+            family=sfam,
         )
 
     if msgid == "106023":
@@ -184,19 +206,24 @@ def _parse_line_raw(line: str) -> ParsedLine | None:
         proto = _proto_num(b.group(1))
         sport = int(b.group(4) or 0)
         dport = int(b.group(7) or 0)
-        if proto == 1 and b.group(8) is not None:
+        if proto in (1, 58) and b.group(8) is not None:
             dport = int(b.group(8))  # icmp type
             sport = 0
+        sfam, src = _addr(b.group(3))
+        dfam, dst = _addr(b.group(6))
+        if sfam != dfam:
+            return None
         return ParsedLine(
             firewall=host,
             acl=b.group(10),
             ingress_if=b.group(2),
             proto=proto,
-            src=ip_to_u32(b.group(3)),
+            src=src,
             sport=sport,
-            dst=ip_to_u32(b.group(6)),
+            dst=dst,
             dport=dport,
             permitted=False,
+            family=sfam,
         )
 
     if msgid in ("302013", "302015"):
@@ -205,8 +232,12 @@ def _parse_line_raw(line: str) -> ParsedLine | None:
             return None
         direction = b.group(1)
         proto = 6 if b.group(2) == "TCP" else 17
-        if_a, ip_a, port_a = b.group(3), ip_to_u32(b.group(4)), int(b.group(5))
-        if_b, ip_b, port_b = b.group(6), ip_to_u32(b.group(7)), int(b.group(8))
+        fam_a, ip_a = _addr(b.group(4))
+        fam_b, ip_b = _addr(b.group(7))
+        if fam_a != fam_b:
+            return None
+        if_a, port_a = b.group(3), int(b.group(5))
+        if_b, port_b = b.group(6), int(b.group(8))
         # "Built ... for A to B": A is the lower-security side.  Inbound
         # connections are initiated at A (src=A); outbound are initiated at B
         # (src=B) with A as the destination side.  The packet enters on the
@@ -229,6 +260,7 @@ def _parse_line_raw(line: str) -> ParsedLine | None:
             dport=dport,
             permitted=True,
             egress_if=egress,
+            family=fam_a,
         )
 
     if msgid in ("106001", "106006", "106015"):
@@ -236,16 +268,21 @@ def _parse_line_raw(line: str) -> ParsedLine | None:
         b = rx.search(body)
         if not b:
             return None
+        sfam, src = _addr(b.group(1))
+        dfam, dst = _addr(b.group(3))
+        if sfam != dfam:
+            return None
         return ParsedLine(
             firewall=host,
             acl=None,
             ingress_if=b.group(5),
             proto=17 if msgid == "106006" else 6,
-            src=ip_to_u32(b.group(1)),
+            src=src,
             sport=int(b.group(2)),
-            dst=ip_to_u32(b.group(3)),
+            dst=dst,
             dport=int(b.group(4)),
             permitted=False,
+            family=sfam,
         )
 
     return None

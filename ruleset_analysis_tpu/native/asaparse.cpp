@@ -248,8 +248,9 @@ bool parse_106100(const char* b, const char* be, Parsed* out) {
         const char* j0; const char* j1; uint32_t dip, dpo;
         if (!endpoint_slash_paren(p, be, &j0, &j1, &dip, &dpo)) continue;
         uint32_t proto = proto_num(pr0, pr1);
-        // ICMP: parenthesised values are type/code; type -> dport, sport=0
-        if (proto == 1) { dpo = spo; spo = 0; }
+        // ICMP/ICMPv6: parenthesised values are type/code; type -> dport,
+        // sport=0 (58 added with the v6 data model; mirrors syslog.py)
+        if (proto == 1 || proto == 58) { dpo = spo; spo = 0; }
         out->acl0 = a0; out->acl1 = a1;
         out->if0 = i0; out->if1 = i1;
         out->proto = proto; out->src = sip; out->sport = spo;
@@ -322,7 +323,7 @@ bool parse_106023(const char* b, const char* be, Parsed* out) {
         }
         if (!a0) continue;
         uint32_t proto = proto_num(pr0, pr1);
-        if (proto == 1 && have_type) { dpo = icmp_type; spo = 0; }
+        if ((proto == 1 || proto == 58) && have_type) { dpo = icmp_type; spo = 0; }
         out->acl0 = a0; out->acl1 = a1;
         out->if0 = i0; out->if1 = i1;
         out->proto = proto; out->src = sip; out->sport = spo;

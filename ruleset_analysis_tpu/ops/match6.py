@@ -1,0 +1,160 @@
+"""IPv6 first-match kernel: 4x uint32 limb addresses, same semantics.
+
+The v6 twin of ops/match.py (DESIGN.md "IPv6 position"; SURVEY.md §8.0
+tags v6 "later as 4x uint32" — this is that extension).  Rows live in a
+SEPARATE [R6, RULE6_COLS] tensor (pack.py) so the v4 hot path is
+untouched; splitting by family preserves first-match order because a
+packet can only match ACEs of its own family.
+
+The per-field predicate changes only for addresses: the single uint32
+wraparound range check becomes a 128-bit lexicographic bound pair over
+four big-endian limbs — 7 compares + 3 and/or folds per bound, all VPU
+elementwise, still branch-free and fusable.  Scalar fields (proto,
+ports) keep the wraparound check.  Everything else (block scan over the
+rule axis, min matching row == first match, NO_MATCH -> implicit deny
+key) mirrors the v4 kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..hostside.pack import (
+    R6_ACL,
+    R6_DHI,
+    R6_DLO,
+    R6_DPHI,
+    R6_DPLO,
+    R6_KEY,
+    R6_PHI,
+    R6_PLO,
+    R6_SHI,
+    R6_SLO,
+    R6_SPHI,
+    R6_SPLO,
+    RULE_BLOCK,
+)
+from .match import NO_MATCH
+
+_U32 = jnp.uint32
+
+
+def _ge128(x, lo):
+    """x >= lo lexicographically; x/lo are 4-tuples of [B,1]/[1,Rb] u32."""
+    x0, x1, x2, x3 = x
+    l0, l1, l2, l3 = lo
+    return (x0 > l0) | (
+        (x0 == l0)
+        & ((x1 > l1) | ((x1 == l1) & ((x2 > l2) | ((x2 == l2) & (x3 >= l3)))))
+    )
+
+
+def _le128(x, hi):
+    x0, x1, x2, x3 = x
+    h0, h1, h2, h3 = hi
+    return (x0 < h0) | (
+        (x0 == h0)
+        & ((x1 < h1) | ((x1 == h1) & ((x2 < h2) | ((x2 == h2) & (x3 <= h3)))))
+    )
+
+
+def _block_min_row6(cols: dict, rules: jnp.ndarray, base: jnp.ndarray) -> jnp.ndarray:
+    """Min matching global v6 row index within one rule block."""
+    r = rules.astype(_U32)
+
+    def col(i):
+        return r[:, i][None, :]
+
+    def limbs_rule(c0):
+        return tuple(col(c0 + i) for i in range(4))
+
+    def limbs_line(name):
+        return tuple(cols[f"{name}{i}"][:, None] for i in range(4))
+
+    def in_range(lo_col, hi_col, x):
+        # scalar wraparound check, as in ops.match (lo <= hi guaranteed)
+        lo = col(lo_col)
+        return (x - lo) <= (col(hi_col) - lo)
+
+    src = limbs_line("src")
+    dst = limbs_line("dst")
+    ok = (
+        (col(R6_ACL) == cols["acl"][:, None])
+        & in_range(R6_PLO, R6_PHI, cols["proto"][:, None])
+        & _ge128(src, limbs_rule(R6_SLO))
+        & _le128(src, limbs_rule(R6_SHI))
+        & in_range(R6_SPLO, R6_SPHI, cols["sport"][:, None])
+        & _ge128(dst, limbs_rule(R6_DLO))
+        & _le128(dst, limbs_rule(R6_DHI))
+        & in_range(R6_DPLO, R6_DPHI, cols["dport"][:, None])
+    )
+    rb = rules.shape[0]
+    idx = base + lax.broadcasted_iota(_U32, (1, rb), 1)
+    return jnp.min(jnp.where(ok, idx, NO_MATCH), axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("rule_block",))
+def first_match_rows6(
+    cols: dict,
+    rules6: jnp.ndarray,
+    rule_block: int = RULE_BLOCK,
+) -> jnp.ndarray:
+    """Global row index of the first matching v6 ACE per line.
+
+    cols: dict of [B] uint32 arrays — acl, proto, sport, dport plus the
+    address limbs src0..src3 / dst0..dst3 (big-endian).  rules6:
+    [R6, RULE6_COLS] uint32, padded to a rule_block multiple when it
+    exceeds one block (padding rows carry NO_ACL).  Returns [B] u32,
+    NO_MATCH where nothing matches.
+    """
+    r = rules6.shape[0]
+    if r <= rule_block:
+        return _block_min_row6(cols, rules6, jnp.uint32(0))
+    assert r % rule_block == 0, "pad the v6 rule tensor to a rule_block multiple"
+    blocks = rules6.reshape(r // rule_block, rule_block, rules6.shape[1])
+
+    def body(best, xs):
+        block, base = xs
+        return jnp.minimum(best, _block_min_row6(cols, block, base)), None
+
+    bases = jnp.arange(r // rule_block, dtype=_U32) * _U32(rule_block)
+    init = jnp.full(cols["acl"].shape, NO_MATCH, dtype=_U32)
+    best, _ = lax.scan(body, init, (blocks, bases))
+    return best
+
+
+def match_keys6(
+    cols: dict,
+    rules6: jnp.ndarray,
+    deny_key: jnp.ndarray,
+    rule_block: int = RULE_BLOCK,
+) -> jnp.ndarray:
+    """Count-key per v6 line: first-match rule key or the ACL's deny key."""
+    row = first_match_rows6(cols, rules6, rule_block)
+    matched = row != NO_MATCH
+    safe_row = jnp.where(matched, row, _U32(0))
+    rule_key = rules6[:, R6_KEY].astype(_U32)[safe_row]
+    deny = deny_key.astype(_U32)[
+        jnp.minimum(cols["acl"], _U32(deny_key.shape[0] - 1))
+    ]
+    return jnp.where(matched, rule_key, deny)
+
+
+def fold_src32(cols: dict) -> jnp.ndarray:
+    """[B] u32 sketch identity for a v6 source address.
+
+    HLL / talker registers key sources by one uint32 lane; v6 sources
+    fold their four limbs through multiply-xor mixing.  Distinct
+    addresses collide with probability ~2^-32 per pair — negligible
+    against the sketches' own error floors.  The fold is deterministic
+    and documented so reports can label these ids as v6 digests.
+    """
+    h = cols["src0"] * _U32(0x9E3779B1)
+    h = (h ^ cols["src1"]) * _U32(0x85EBCA77)
+    h = (h ^ cols["src2"]) * _U32(0xC2B2AE3D)
+    h = (h ^ cols["src3"]) * _U32(0x27D4EB2F)
+    return h ^ (h >> _U32(15))

@@ -77,22 +77,6 @@ def _kernel(
     # _NO_MATCH-1 can never equal a row index (< Rp << 2^32-2) nor the
     # NO_MATCH sentinel, so invalid lines fall out of BOTH histograms.
 
-    def hrow(t, acc):
-        idx = (
-            lax.broadcasted_iota(_U32, (1, RULE_TILE), 1)
-            + (t * RULE_TILE).astype(_U32)
-        )
-        # int32 sum: Mosaic TPU has no unsigned-reduction lowering (same
-        # constraint as tile_first_match's running min); block counts are
-        # <= BLOCK_LINES so int32 cannot overflow.
-        eq = (bv == idx).astype(jnp.int32)  # [BLOCK, RULE_TILE]
-        part = jnp.sum(eq, axis=0, keepdims=True)  # [1, RULE_TILE]
-        return lax.dynamic_update_slice(acc, part, (0, t * RULE_TILE))
-
-    rows_acc = lax.fori_loop(
-        0, n_tiles, hrow, jnp.zeros_like(hist_rows[:])
-    )
-
     # Clamp out-of-range ACL ids exactly as the keys epilogue does
     # (jnp.minimum(acl, n_acls-1)): a valid line with a corrupt acl gid
     # must land on the LAST ACL's deny key in BOTH the keys and the
@@ -100,26 +84,34 @@ def _kernel(
     a_cl = jnp.minimum(a, _U32(n_acls - 1))
     unmatched = jnp.where(bv == _U32(_NO_MATCH), a_cl, _U32(_NO_MATCH - 1))
 
-    def hdeny(t, acc):
-        idx = (
-            lax.broadcasted_iota(_U32, (1, RULE_TILE), 1)
-            + (t * RULE_TILE).astype(_U32)
-        )
-        eq = (unmatched == idx).astype(jnp.int32)
-        part = jnp.sum(eq, axis=0, keepdims=True)
-        return lax.dynamic_update_slice(acc, part, (0, t * RULE_TILE))
-
-    deny_acc = lax.fori_loop(
-        0, n_acl_tiles, hdeny, jnp.zeros_like(hist_deny[:])
-    )
-
     @pl.when(pl.program_id(0) == 0)
     def _init():
         hist_rows[:] = jnp.zeros_like(hist_rows[:])
         hist_deny[:] = jnp.zeros_like(hist_deny[:])
 
-    hist_rows[:] += rows_acc
-    hist_deny[:] += deny_acc
+    # The tile loops are STATIC Python unrolls accumulating straight into
+    # the revisited output refs with static slices: the first compiled
+    # run (r5 TPU window) showed Mosaic implements neither unsigned
+    # reductions (hence the int32 sums; block counts <= BLOCK_LINES
+    # cannot overflow) nor dynamic_update_slice on values (hence no
+    # fori_loop-carried accumulator).  Unrolling is n_tiles = Rp/128
+    # bodies — trivial at bench/production slab sizes; a 100k-row flat
+    # ruleset would pay compile time and should prefer match_impl=xla
+    # or plain pallas there.
+    def tile_hist(t, masked, ref):
+        idx = (
+            lax.broadcasted_iota(_U32, (1, RULE_TILE), 1)
+            + _U32(t * RULE_TILE)
+        )
+        eq = (masked == idx).astype(jnp.int32)  # [BLOCK, RULE_TILE]
+        part = jnp.sum(eq, axis=0, keepdims=True)  # [1, RULE_TILE]
+        sl = slice(t * RULE_TILE, (t + 1) * RULE_TILE)
+        ref[:, sl] += part
+
+    for t in range(n_tiles):
+        tile_hist(t, bv, hist_rows)
+    for t in range(n_acl_tiles):
+        tile_hist(t, unmatched, hist_deny)
 
 
 @functools.partial(

@@ -1,13 +1,18 @@
-"""IPv6 position (DESIGN.md; SURVEY.md §8.0 tags v6 "later").
+"""IPv6 data model (DESIGN.md "IPv6 position" — now BUILT, not skipped).
 
-The packed model is v4-only: IPv6 ACEs are counted-skipped in lenient
-mode (preserving later rules' device-side indices), rejected loudly in
-strict mode, and IPv6 syslog lines are parse-skipped — NEVER mis-parsed
-into uint32 columns."""
+128-bit addresses as 4x uint32 limbs in a separate rule/tuple family
+(pack.rules6 / TUPLE6 layout); family split preserves first-match order
+because cross-family matches are impossible.  ``any`` resolves per
+ruleset: v4-only for pure-v4 configs (bit-identical historical
+expansion), both families when the config carries explicit v6 content
+(ASA 9.0+ unified-ACL semantics).
+"""
 
+import numpy as np
 import pytest
 
-from ruleset_analysis_tpu.hostside import aclparse, pack, syslog
+from ruleset_analysis_tpu.errors import AnalysisError
+from ruleset_analysis_tpu.hostside import aclparse, oracle, pack, syslog
 
 CFG_MIXED = """\
 hostname fw1
@@ -18,48 +23,141 @@ access-list A extended deny ip any any
 access-group A in interface outside
 """
 
-
-def test_lenient_counts_ipv6_aces_and_preserves_indices():
-    rs = aclparse.parse_asa_config(CFG_MIXED, "fw1", strict=False)
-    # both v6 ACEs are recorded with an explicit IPv6 reason
-    assert len(rs.skipped) == 2
-    for _lineno, reason, _line in rs.skipped:
-        assert "IPv6" in reason
-    # surviving rules keep their config positions: 1 and 4
-    assert [r.index for r in rs.acls["A"]] == [1, 4]
-    # and the pack carries the skip accounting forward
-    packed = pack.pack_rulesets([rs])
-    assert len(packed.parse_skips) == 2
-    assert all("IPv6" in reason for _fw, _lineno, reason in packed.parse_skips)
+V4_LINE = (
+    "Jul 29 07:48:01 fw1 : %ASA-6-106100: access-list A permitted tcp "
+    "inside/1.2.3.4(1000) -> outside/10.0.0.5(443) hit-cnt 1 first hit [0x0, 0x0]"
+)
+V6_LINE = (
+    "Jul 29 07:48:02 fw1 : %ASA-6-106100: access-list A permitted tcp "
+    "inside/2001:db8::9(1000) -> outside/2001:db8::5(443) hit-cnt 1 "
+    "first hit [0x0, 0x0]"
+)
 
 
-def test_strict_rejects_ipv6_loudly():
-    with pytest.raises(aclparse.AclParseError, match="IPv6"):
-        aclparse.parse_asa_config(CFG_MIXED, "fw1", strict=True)
+def test_mixed_config_parses_both_families():
+    rs = aclparse.parse_asa_config(CFG_MIXED, "fw1", strict=True)
+    assert rs.skipped == []
+    rules = rs.acls["A"]
+    assert [r.index for r in rules] == [1, 2, 3, 4]
+    fams = [sorted({a.family for a in r.aces}) for r in rules]
+    # rule 1 v4; rules 2-3 v6; rule 4 (any/any in a v6-bearing config)
+    # covers both families
+    assert fams == [[4], [6], [6], [4, 6]]
 
 
-def test_ipv6_syslog_line_is_skipped_not_misparsed():
-    line = (
-        "Jul 29 07:48:01 fw1 : %ASA-6-106100: access-list A permitted tcp "
-        "inside/2001:db8::9(1000) -> outside/10.0.0.5(443) hit-cnt 1 "
-        "first hit [0x0, 0x0]"
+def test_pure_v4_config_expansion_is_unchanged():
+    """The wildcard gate: without explicit v6 content, ``any`` stays v4."""
+    rs = aclparse.parse_asa_config(
+        "access-list B extended permit ip any any\n", "fw", strict=True
     )
+    aces = rs.acls["B"][0].aces
+    assert [a.family for a in aces] == [4]
+    assert aces[0].src_hi == aclparse.U32_MAX
+    packed = pack.pack_rulesets([rs])
+    assert not packed.has_v6 and packed.rules6.shape == (0, pack.RULE6_COLS)
+
+
+def test_cross_family_only_rule_rejected():
+    with pytest.raises(aclparse.AclParseError, match="same-family"):
+        aclparse.parse_asa_config(
+            "access-list D extended permit ip any4 host 2001:db8::1\n",
+            "fw", strict=True,
+        )
+
+
+def test_pack_splits_families_and_shares_keys():
+    rs = aclparse.parse_asa_config(CFG_MIXED, "fw1")
+    packed = pack.pack_rulesets([rs])
+    assert packed.has_v6
+    # v4 tensor: rule 1 + rule 4's v4 ace; v6 tensor: rules 2, 3 + rule 4's twin
+    assert packed.rules.shape[0] == 2 and packed.rules6.shape[0] == 3
+    v4_keys = sorted(int(k) for k in packed.rules[:, pack.R_KEY])
+    v6_keys = sorted(int(k) for k in packed.rules6[:, pack.R6_KEY])
+    assert v4_keys == [0, 3] and v6_keys == [1, 2, 3]
+
+
+def test_limb_roundtrip():
+    v = aclparse.ip6_to_int("2001:db8:dead:beef::1234:5678")
+    assert pack.limbs_u128(*pack.u128_limbs(v)) == v
+
+
+def test_save_load_roundtrip_with_rules6(tmp_path):
+    rs = aclparse.parse_asa_config(CFG_MIXED, "fw1")
+    packed = pack.pack_rulesets([rs])
+    pack.save_packed(packed, str(tmp_path / "p"))
+    p2 = pack.load_packed(str(tmp_path / "p"))
+    np.testing.assert_array_equal(p2.rules6, packed.rules6)
+    assert p2.has_v6
+
+
+def test_load_rejects_inverted_v6_address_range(tmp_path):
+    rs = aclparse.parse_asa_config(CFG_MIXED, "fw1")
+    packed = pack.pack_rulesets([rs])
+    # invert a src bound pair in the LOW limb only: the lexicographic
+    # validator must still catch it
+    packed.rules6[0, pack.R6_SLO:pack.R6_SLO + 4] = (0, 0, 0, 5)
+    packed.rules6[0, pack.R6_SHI:pack.R6_SHI + 4] = (0, 0, 0, 4)
+    pack.save_packed(packed, str(tmp_path / "bad"))
+    with pytest.raises(AnalysisError, match="inverted src address range"):
+        pack.load_packed(str(tmp_path / "bad"))
+
+
+def test_v6_syslog_line_parses():
+    p = syslog.parse_line(V6_LINE)
+    assert p is not None and p.family == 6
+    assert p.src == aclparse.ip6_to_int("2001:db8::9")
+    assert p.dst == aclparse.ip6_to_int("2001:db8::5")
+    assert (p.sport, p.dport) == (1000, 443)
+
+
+def test_mixed_family_syslog_line_skipped():
+    line = V6_LINE.replace("outside/2001:db8::5(443)", "outside/10.0.0.5(443)")
     assert syslog.parse_line(line) is None
 
 
-def test_ipv6_syslog_lines_land_in_lines_skipped():
-    rs = aclparse.parse_asa_config(CFG_MIXED, "fw1", strict=False)
+def test_packer_routes_families():
+    rs = aclparse.parse_asa_config(CFG_MIXED, "fw1")
     packed = pack.pack_rulesets([rs])
     lp = pack.LinePacker(packed)
-    v4 = (
-        "Jul 29 07:48:01 fw1 : %ASA-6-106100: access-list A permitted tcp "
-        "inside/1.2.3.4(1000) -> outside/10.0.0.5(443) hit-cnt 1 first hit [0x0, 0x0]"
+    b4, b6 = lp.pack_lines2([V4_LINE, V6_LINE, V4_LINE], batch_size=4)
+    assert lp.parsed == 3 and lp.skipped == 0
+    assert int(b4[:, pack.T_VALID].sum()) == 2
+    assert int(b6[:, pack.T6_VALID].sum()) == 1
+    row = b6[0]
+    assert pack.limbs_u128(*row[pack.T6_SRC:pack.T6_SRC + 4]) == aclparse.ip6_to_int(
+        "2001:db8::9"
     )
-    v6 = (
-        "Jul 29 07:48:02 fw1 : %ASA-6-106100: access-list A permitted tcp "
-        "inside/2001:db8::9(1000) -> outside/10.0.0.5(443) hit-cnt 1 first hit [0x0, 0x0]"
+
+
+def test_v6_line_against_pure_v4_ruleset_is_counted_skip():
+    rs = aclparse.parse_asa_config(
+        "access-list A extended permit ip any any\naccess-group A in interface i0\n",
+        "fw1",
     )
-    batch = lp.pack_lines([v4, v6, v4], batch_size=4)
-    assert lp.parsed == 2 and lp.skipped == 1
-    # the skipped line contributed no valid evaluation row
-    assert int(batch[:, pack.T_VALID].sum()) == 2
+    packed = pack.pack_rulesets([rs])
+    lp = pack.LinePacker(packed)
+    b4, b6 = lp.pack_lines2([V6_LINE], batch_size=2)
+    assert lp.skipped == 1 and b6.shape[0] == 0
+    assert int(b4[:, pack.T_VALID].sum()) == 0
+
+
+def test_v4_only_pack_parsed_raises_on_v6_rows():
+    rs = aclparse.parse_asa_config(CFG_MIXED, "fw1")
+    packed = pack.pack_rulesets([rs])
+    lp = pack.LinePacker(packed)
+    with pytest.raises(AnalysisError, match="IPv6"):
+        lp.pack_lines([V6_LINE], batch_size=2)
+
+
+def test_oracle_family_guard():
+    rs = aclparse.parse_asa_config(CFG_MIXED, "fw1")
+    orc = oracle.Oracle([rs])
+    p4 = syslog.parse_line(V4_LINE)
+    p6 = syslog.parse_line(V6_LINE)
+    assert orc.match_keys(p4) == [("fw1", "A", 1)]
+    assert orc.match_keys(p6) == [("fw1", "A", 2)]
+    # v6 packet matching no v6 ACE falls through to rule 4's v6 twin
+    p6b = syslog.parse_line(
+        V6_LINE.replace("tcp", "udp").replace("(443)", "(53)").replace("(1000)", "(53)")
+    )
+    assert orc.match_keys(p6b) == [("fw1", "A", 4)]

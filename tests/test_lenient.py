@@ -22,15 +22,18 @@ def test_strict_mode_still_raises():
 
 def test_lenient_skips_and_counts_exactly():
     rs = aclparse.parse_asa_config(MIXED_CFG, "fw6", strict=False)
-    assert len(rs.skipped) == 3  # two v6 lines + the unknown group
+    # the any6 rule PARSES now (v6 data model); the net+mask v6 spelling
+    # is a mis-parse hazard and skips, as does the unknown group
+    assert len(rs.skipped) == 2
     assert [ln for ln, _, _ in rs.skipped] != []
     reasons = " ".join(r for _, r, _ in rs.skipped)
     assert "NOSUCHGROUP" in reasons
-    # the v4 entries survive with their DEVICE-side rule positions:
-    # line 1 -> index 1, the two skipped v6 lines consume 2 and 3,
-    # the final deny keeps index 4
+    assert "IPv6 network operand requires /prefixlen" in reasons
+    # surviving entries keep their DEVICE-side rule positions: line 1 ->
+    # index 1, the v6 rule -> 2, the skipped line consumes 3, deny -> 4
     a = rs.acls["A"]
-    assert [r.index for r in a] == [1, 4]
+    assert [r.index for r in a] == [1, 2, 4]
+    assert {ace.family for ace in a[1].aces} == {6}
     # ACL B exists (bindable, reportable) even though its only entry skipped
     assert rs.acls["B"] == []
 
@@ -74,7 +77,7 @@ def test_cli_lenient_flag(tmp_path, capsys):
     rc = cli.main(["parse-acls", str(p), "--lenient", "--out", str(tmp_path / "packed")])
     assert rc == 0
     err = capsys.readouterr().err
-    assert "skipped=3" in err
+    assert "skipped=2" in err
     assert "NOSUCHGROUP" in err
 
 
@@ -84,14 +87,14 @@ def test_parse_skips_surface_in_report(tmp_path):
 
     rs = aclparse.parse_asa_config(MIXED_CFG, "fw6", strict=False)
     packed = pack.pack_rulesets([rs])
-    assert len(packed.parse_skips) == 3
+    assert len(packed.parse_skips) == 2
     prefix = str(tmp_path / "p")
     pack.save_packed(packed, prefix)
     loaded = pack.load_packed(prefix)
     assert loaded.parse_skips == packed.parse_skips
 
     rep = build_report(loaded, {}, backend="tpu")
-    assert rep.totals["config_entries_skipped"] == 3
+    assert rep.totals["config_entries_skipped"] == 2
     assert "WARNING" in rep.to_text()
 
     strict_rs = aclparse.parse_asa_config(
