@@ -100,6 +100,22 @@ def u128_limbs(v: int) -> tuple[int, int, int, int]:
 def limbs_u128(l0: int, l1: int, l2: int, l3: int) -> int:
     return (int(l0) << 96) | (int(l1) << 64) | (int(l2) << 32) | int(l3)
 
+
+def fold_src32_host(v: int) -> int:
+    """Host twin of ops.match6.fold_src32 (the v6 sketch identity).
+
+    Must stay bit-identical to the device fold: the stream driver records
+    digest -> address so reports can render v6 talkers as real addresses.
+    tests/test_match6.py pins host/device agreement.
+    """
+    m = 0xFFFFFFFF
+    l0, l1, l2, l3 = u128_limbs(v)
+    h = (l0 * 0x9E3779B1) & m
+    h = ((h ^ l1) * 0x85EBCA77) & m
+    h = ((h ^ l2) * 0xC2B2AE3D) & m
+    h = ((h ^ l3) * 0x27D4EB2F) & m
+    return h ^ (h >> 15)
+
 #: acl gid budget in the wire meta word: 23 bits (proto takes 8, valid 1).
 WIRE_MAX_ACLS = 1 << 23
 

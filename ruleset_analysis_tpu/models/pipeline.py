@@ -27,7 +27,9 @@ from ..config import AnalysisConfig
 from ..hostside.pack import (
     PackedRuleset,
     T_ACL, T_DPORT, T_DST, T_PROTO, T_SPORT, T_SRC, T_VALID,
-    TUPLE_COLS, W_DST, W_META, W_PORTS, W_SRC, WIRE_COLS, WIRE_MAX_ACLS,
+    T6_ACL, T6_DPORT, T6_DST, T6_PROTO, T6_SPORT, T6_SRC, T6_VALID,
+    TUPLE_COLS, TUPLE6_COLS, W_DST, W_META, W_PORTS, W_SRC, WIRE_COLS,
+    WIRE_MAX_ACLS,
 )
 from ..ops import cms as cms_ops
 from ..ops import counts as count_ops
@@ -46,6 +48,25 @@ class DeviceRuleset(NamedTuple):
     #: field-major lane-padded twin for the pallas kernel; None on the
     #: default XLA path (ship_ruleset(match_impl="pallas") fills it)
     rules_fm: jax.Array | None = None
+
+
+class DeviceRuleset6(NamedTuple):
+    """Device-resident IPv6 rule tensor (pack.rules6, limb layout).
+
+    Shares the v4 key universe and deny_key; shipped only when the packed
+    ruleset carries v6 rows, so pure-v4 runs never touch the v6 path.
+    """
+
+    rules6: jax.Array  # [R6, RULE6_COLS] uint32, R6 % rule_block == 0
+    deny_key: jax.Array  # [n_acls] uint32
+
+
+#: High bit tagged onto ACL gids of IPv6 talker candidates: v6 source
+#: identities are 32-bit limb digests (ops.match6.fold_src32), and the tag
+#: keeps them from ever merging with a numerically-equal v4 address in the
+#: talker tracker.  gids are bounded by WIRE_MAX_ACLS (23 bits), so bit 31
+#: is always free; reports strip the tag and render these as v6 digests.
+V6_ACL_TAG = np.uint32(0x80000000)
 
 
 class AnalysisState(NamedTuple):
@@ -102,6 +123,59 @@ def batch_cols(batch: jax.Array) -> tuple[dict, jax.Array]:
     raise ValueError(
         f"batch field axis must be TUPLE_COLS={TUPLE_COLS} or "
         f"WIRE_COLS={WIRE_COLS}, got shape {batch.shape}"
+    )
+
+
+def batch_cols6(batch: jax.Array) -> tuple[dict, jax.Array]:
+    """Field columns + valid mask from a v6 batch ``[TUPLE6_COLS, B]``.
+
+    Address limbs surface as src0..src3 / dst0..dst3 (big-endian), the
+    shape ops.match6 consumes.  (The bit-packed v6 wire layout is wire
+    format v2 — see hostside.wire — and is expanded host-side.)
+    """
+    if batch.shape[-2] != TUPLE6_COLS:
+        raise ValueError(
+            f"v6 batch field axis must be TUPLE6_COLS={TUPLE6_COLS}, "
+            f"got shape {batch.shape}"
+        )
+    cols = {
+        "acl": batch[..., T6_ACL, :],
+        "proto": batch[..., T6_PROTO, :],
+        "sport": batch[..., T6_SPORT, :],
+        "dport": batch[..., T6_DPORT, :],
+    }
+    for i in range(4):
+        cols[f"src{i}"] = batch[..., T6_SRC + i, :]
+        cols[f"dst{i}"] = batch[..., T6_DST + i, :]
+    return cols, batch[..., T6_VALID, :]
+
+
+def pad_rules6(rules6: np.ndarray, rule_block: int = RULE_BLOCK) -> np.ndarray:
+    """Pad the v6 rule matrix to a block multiple (NO_ACL padding rows)."""
+    from ..hostside.pack import NO_ACL, R6_ACL, RULE6_COLS
+
+    r = rules6.shape[0]
+    target = max(rule_block, ((r + rule_block - 1) // rule_block) * rule_block)
+    if r == target:
+        return rules6
+    out = np.zeros((target, RULE6_COLS), dtype=np.uint32)
+    out[:, R6_ACL] = NO_ACL
+    out[:r] = rules6
+    return out
+
+
+def ship_ruleset6(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> DeviceRuleset6:
+    return DeviceRuleset6(
+        rules6=jnp.asarray(pad_rules6(packed.rules6, rule_block)),
+        deny_key=jnp.asarray(packed.deny_key.astype(np.uint32)),
+    )
+
+
+def ship_ruleset6_host(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> DeviceRuleset6:
+    """Numpy twin of :func:`ship_ruleset6` — no backend touched."""
+    return DeviceRuleset6(
+        rules6=pad_rules6(packed.rules6, rule_block),
+        deny_key=packed.deny_key.astype(np.uint32),
     )
 
 
@@ -310,6 +384,37 @@ def analysis_step(
     )
 
 
+def analysis_step6(
+    state: AnalysisState,
+    ruleset6: DeviceRuleset6,
+    batch6: jax.Array,  # [TUPLE6_COLS, B6] uint32, column-major
+    *,
+    n_keys: int,
+    topk_k: int,
+    exact_counts: bool = True,
+    rule_block: int = RULE_BLOCK,
+    salt: jax.Array | int = 0,
+    topk_sample_shift: int = 0,
+    counts_impl: str = "scatter",
+) -> tuple[AnalysisState, ChunkOut]:
+    """One fused device step over a batch of v6 lines.
+
+    Updates the SAME register state as the v4 step (shared key universe):
+    exact counts and CMS key by rule key; HLL / talker source identity is
+    the 32-bit limb digest (ops.match6.fold_src32), with the talker ACL
+    gid tagged V6_ACL_TAG so v6 digests never merge with v4 addresses.
+    """
+    from ..ops.match6 import fold_src32, match_keys6
+
+    cols, valid = batch_cols6(batch6)
+    keys = match_keys6(cols, ruleset6.rules6, ruleset6.deny_key, rule_block)
+    return _update_registers(
+        state, keys, valid, fold_src32(cols), cols["acl"] | V6_ACL_TAG,
+        n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts, salt=salt,
+        topk_sample_shift=topk_sample_shift, counts_impl=counts_impl,
+    )
+
+
 class DeviceRulesetStacked(NamedTuple):
     """Device-resident stacked rule slabs (BASELINE.json config #4)."""
 
@@ -408,8 +513,16 @@ def finalize(
     *,
     topk: int = 10,
     totals: dict | None = None,
+    v6_digests: dict[int, int] | None = None,
 ):
-    """Pull registers to host and assemble the Report (SURVEY.md L5)."""
+    """Pull registers to host and assemble the Report (SURVEY.md L5).
+
+    ``v6_digests`` maps fold_src32 digests -> 128-bit source ints (built
+    by the stream driver as it packs v6 lines, bounded) so v6 talkers
+    render as real addresses; digests missing from the map (map capped,
+    or resume discarded pre-crash entries) render as ``v6#<8 hex>``.
+    """
+    from ..hostside.aclparse import int_to_ip6
     from ..runtime.report import build_report
 
     lo = np.asarray(jax.device_get(state.counts_lo))
@@ -435,10 +548,30 @@ def finalize(
     if tracker is not None:
         gid_to_name = {gid: name for name, gid in packed.acl_gid.items()}
         talkers = {}
+        tag = int(V6_ACL_TAG)
         for gid in tracker.acls():
-            name = gid_to_name.get(gid)
-            if name is not None:
-                talkers[name] = tracker.top(gid, topk)
+            is6 = bool(int(gid) & tag)
+            name = gid_to_name.get(int(gid) & ~tag)
+            if name is None:
+                continue
+            items = tracker.top(gid, topk)
+            if is6:
+                dig = v6_digests or {}
+                items = [
+                    (
+                        int_to_ip6(dig[int(s)])
+                        if int(s) in dig
+                        else f"v6#{int(s):08x}",
+                        c,
+                    )
+                    for s, c in items
+                ]
+            talkers.setdefault(name, []).extend(items)
+        # one merged per-ACL section across families, ranked by count
+        talkers = {
+            k: sorted(v, key=lambda kv: -kv[1])[:topk]
+            for k, v in talkers.items()
+        }
 
     return build_report(
         packed,

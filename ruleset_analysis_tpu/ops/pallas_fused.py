@@ -81,7 +81,10 @@ def _kernel(
     # (jnp.minimum(acl, n_acls-1)): a valid line with a corrupt acl gid
     # must land on the LAST ACL's deny key in BOTH the keys and the
     # counts, or delta would diverge from segment_counts(keys, valid).
-    a_cl = jnp.minimum(a, _U32(n_acls - 1))
+    # Spelled compare+select: Mosaic has no arith.minui legalization
+    # (r5 TPU window), while unsigned compares lower fine.
+    a_max = _U32(n_acls - 1)
+    a_cl = jnp.where(a > a_max, a_max, a)
     unmatched = jnp.where(bv == _U32(_NO_MATCH), a_cl, _U32(_NO_MATCH - 1))
 
     @pl.when(pl.program_id(0) == 0)

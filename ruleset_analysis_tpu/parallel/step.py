@@ -29,7 +29,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..config import AnalysisConfig
 from ..models.pipeline import (
-    AnalysisState, ChunkOut, DeviceRuleset, DeviceRulesetStacked, batch_cols,
+    AnalysisState, ChunkOut, DeviceRuleset, DeviceRuleset6,
+    DeviceRulesetStacked, V6_ACL_TAG, batch_cols, batch_cols6,
 )
 from ..ops import cms as cms_ops
 from ..ops import counts as count_ops
@@ -182,6 +183,36 @@ def _local_shard_step_stacked(
     )
 
 
+def _local_shard_step6(
+    state: AnalysisState,
+    ruleset6: DeviceRuleset6,
+    batch: jax.Array,  # [TUPLE6_COLS, B6/n] local shard
+    salt: jax.Array,
+    *,
+    axis: str,
+    n_keys: int,
+    topk_k: int,
+    exact_counts: bool,
+    rule_block: int,
+    topk_sample_shift: int = 0,
+    counts_impl: str = "scatter",
+) -> tuple[AnalysisState, ChunkOut]:
+    # IPv6 twin of _local_shard_step: lexicographic limb match, then the
+    # SAME mergeable register tail into the shared key universe.  Source
+    # identity for HLL/talkers is the 32-bit limb digest; the talker ACL
+    # gid carries V6_ACL_TAG so digests never merge with v4 addresses.
+    from ..ops.match6 import fold_src32, match_keys6
+
+    cols, valid = batch_cols6(batch)
+    keys = match_keys6(cols, ruleset6.rules6, ruleset6.deny_key, rule_block)
+    return _merge_tail(
+        state, keys, valid, fold_src32(cols),
+        cols["acl"] | jnp.uint32(V6_ACL_TAG), salt,
+        axis=axis, n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts,
+        topk_sample_shift=topk_sample_shift, counts_impl=counts_impl,
+    )
+
+
 #: Bake the rule tensor into the compiled step as an XLA constant when it
 #: is at most this many bytes.  The ruleset is fixed for a whole stream,
 #: and constant rules let XLA specialize the [B, R] predicate evaluation —
@@ -295,6 +326,33 @@ def make_parallel_step(
         exact_counts=cfg.exact_counts,
         rule_block=rule_block,
         match_impl=cfg.match_impl,
+        topk_sample_shift=cfg.sketch.topk_sample_shift,
+        counts_impl=cfg.counts_impl,
+    )
+    return _make_step(mesh, local, P(None, axis))
+
+
+def make_parallel_step6(
+    mesh: Mesh,
+    cfg: AnalysisConfig,
+    n_keys: int,
+    rule_block: int = RULE_BLOCK,
+):
+    """Build the jitted data-parallel IPv6 step for `mesh`.
+
+    Same sharding contract as :func:`make_parallel_step`: state/ruleset
+    replicated, v6 batch sharded on the data axis, merged registers and
+    candidates replicated.  The v6 and v4 steps update ONE shared state,
+    so the driver may interleave them freely (mergeable registers).
+    """
+    axis = cfg.mesh_axis
+    local = functools.partial(
+        _local_shard_step6,
+        axis=axis,
+        n_keys=n_keys,
+        topk_k=cfg.sketch.topk_chunk_candidates,
+        exact_counts=cfg.exact_counts,
+        rule_block=rule_block,
         topk_sample_shift=cfg.sketch.topk_sample_shift,
         counts_impl=cfg.counts_impl,
     )

@@ -64,6 +64,10 @@ def fingerprint(
     """
     h = hashlib.sha256()
     h.update(np.ascontiguousarray(packed.rules).tobytes())
+    if packed.has_v6:
+        # pure-v4 rulesets hash exactly as before the v6 data model, so
+        # pre-v6 snapshots of pure-v4 runs stay resumable
+        h.update(np.ascontiguousarray(packed.rules6).tobytes())
     h.update(np.ascontiguousarray(packed.deny_key).tobytes())
     s = cfg.sketch
     padded = ((cfg.batch_size + n_shards - 1) // n_shards) * n_shards

@@ -102,7 +102,12 @@ def build_report(
             unused.append(key)
     talk = {}
     for (fw, acl), items in (talkers or {}).items():
-        talk[f"{fw} {acl}"] = [[u32_to_ip(int(ip)), int(c)] for ip, c in items]
+        # items carry uint32 v4 addresses OR pre-rendered labels (IPv6
+        # talkers arrive as address/digest strings from pipeline.finalize)
+        talk[f"{fw} {acl}"] = [
+            [ip if isinstance(ip, str) else u32_to_ip(int(ip)), int(c)]
+            for ip, c in items
+        ]
     t = dict(totals or {})
     t["backend"] = backend
     t["n_rules"] = packed.n_rules

@@ -55,12 +55,27 @@ class _TextSource:
     boundaries) always agree with the batches actually emitted.
     """
 
+    #: digest->address map size cap: ~6 MB of host dict at the cap; beyond
+    #: it new v6 sources keep full analysis fidelity but render as raw
+    #: ``v6#`` digests in the talker section.
+    V6_DIGEST_CAP = 1 << 18
+
     def __init__(self, packed: PackedRuleset, lines: Iterable[str]):
         self.packer = LinePacker(packed)
         self._lines = lines
+        self._has_v6 = packed.has_v6
+        self._v6rows: list[tuple] = []
+        #: fold_src32 digest -> 128-bit source int (report rendering)
+        self.v6_digests: dict[int, int] = {}
 
     def set_counts(self, parsed: int, skipped: int) -> None:
         self.packer.parsed, self.packer.skipped = parsed, skipped
+
+    def take_v6(self) -> list[tuple]:
+        """Drain v6 tuple rows staged since the last call (driver-pulled)."""
+        out = self._v6rows
+        self._v6rows = []
+        return out
 
     def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
         it = iter(self._lines)
@@ -83,6 +98,32 @@ class _TextSource:
         for line in it:
             p = parse_line(line)
             gids = [] if p is None else packer.resolve_gids(p)
+            if gids and p.family == 6:
+                if not self._has_v6:
+                    # v6 traffic vs a pure-v4 ruleset: counted skip (the
+                    # device path has no v6 rows to evaluate against)
+                    gids = []
+                else:
+                    # v6 evaluations ride a side channel the driver pulls
+                    # via take_v6 and steps through the v6 device program;
+                    # they never consume v4 batch capacity
+                    s = pack_mod.u128_limbs(p.src)
+                    d = pack_mod.u128_limbs(p.dst)
+                    for gid in gids:
+                        self._v6rows.append(
+                            (gid, p.proto, *s, p.sport, *d, p.dport, 1)
+                        )
+                    dig = self.v6_digests
+                    if len(dig) < self.V6_DIGEST_CAP:
+                        dig.setdefault(pack_mod.fold_src32_host(p.src), p.src)
+                    packer.parsed += len(gids)
+                    raw += 1
+                    if raw == batch_size:
+                        yield out, raw
+                        out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
+                        fill = 0
+                        raw = 0
+                    continue
             if gids and fill + len(gids) > batch_size:
                 yield out, raw
                 out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
@@ -367,6 +408,20 @@ def run_stream_file(
     if isinstance(paths, str):
         paths = [paths]
     use_native = native if native is not None else fastparse.available()
+    if packed.has_v6 and (use_native or (feed_workers and feed_workers > 1)):
+        # The native parser/feeder tier is v4-only; against a v6-capable
+        # ruleset it would silently count v6 traffic as skipped instead of
+        # analyzing it.  Auto-select falls back to the Python text path;
+        # an EXPLICIT native/feeder request fails loudly.
+        if native is True or (feed_workers and feed_workers > 1):
+            from ..errors import AnalysisError
+
+            raise AnalysisError(
+                "the native parser tier is v4-only but this ruleset has "
+                "IPv6 rules; run without --parser native / --feed-workers "
+                "(the Python parser handles both families)"
+            )
+        use_native = False
     if feed_workers and feed_workers > 1:
         if native is False:
             from ..errors import AnalysisError
@@ -430,6 +485,14 @@ def run_stream_file_distributed(
     stacked = cfg.layout == "stacked"
     if isinstance(local_paths, str):
         local_paths = [local_paths]
+    if packed.has_v6:
+        # v6 needs its own collective flush protocol in this driver (the
+        # v6 side buffer drains data-dependently per process); until that
+        # lands, refuse loudly rather than silently skip v6 traffic.
+        raise AnalysisError(
+            "distributed runs do not yet evaluate IPv6 rules; run "
+            "single-process (full v6 support) or strip v6 ACEs"
+        )
     from ..hostside.wire import is_wire_file
 
     n_wire = sum(1 for p in local_paths if is_wire_file(p))
@@ -870,6 +933,20 @@ def _run_core_impl(
         dev_rules = pipeline.ship_ruleset(packed, match_impl=cfg.match_impl)
         step = make_parallel_step(mesh, cfg, packed.n_keys)
         gbuf = None
+    # IPv6 side path: sources that parse text stage v6 evaluations in a
+    # separate buffer (take_v6); full [TUPLE6_COLS, batch] chunks step
+    # through the v6 device program into the SAME registers.  Partial
+    # buffers flush at checkpoints and end-of-stream, so snapshots never
+    # leave consumed lines unstepped.
+    step6 = None
+    dev_rules6 = None
+    if packed.has_v6 and hasattr(source, "take_v6"):
+        from ..parallel.step import make_parallel_step6
+
+        dev_rules6 = pipeline.ship_ruleset6(packed)
+        step6 = make_parallel_step6(mesh, cfg, packed.n_keys)
+    buf6 = None
+    fill6 = 0
     packer = source.packer
     wire_src = getattr(source, "yields_wire", False)
     # wire offsets count evaluation rows, text offsets count raw lines —
@@ -913,6 +990,7 @@ def _run_core_impl(
         if gbuf is not None:
             for grouped in gbuf.flush():
                 run_grouped(grouped)
+        flush_v6()
         last_snap_chunks = n_chunks
         while pending:
             drain(pending.popleft())
@@ -946,6 +1024,51 @@ def _run_core_impl(
         wire = pack_mod.compact_grouped(grouped_np)
         run_chunk(mesh_lib.shard_grouped(mesh, wire, cfg.mesh_axis))
 
+    def run_chunk6(batch6_np: np.ndarray) -> None:
+        nonlocal state, n_chunks
+        state, out = step6(
+            state, dev_rules6,
+            mesh_lib.shard_batch(mesh, batch6_np, cfg.mesh_axis), n_chunks,
+        )
+        pending.append(out)
+        if len(pending) > 2:
+            drain(pending.popleft())
+        n_chunks += 1
+
+    def stage_v6() -> None:
+        # pull staged v6 rows from the source; step full chunks
+        nonlocal buf6, fill6
+        rows = source.take_v6()
+        i = 0
+        while i < len(rows):
+            if buf6 is None:
+                buf6 = np.zeros(
+                    (pack_mod.TUPLE6_COLS, batch_size), dtype=np.uint32
+                )
+            take = min(batch_size - fill6, len(rows) - i)
+            buf6[:, fill6:fill6 + take] = np.asarray(
+                rows[i:i + take], dtype=np.uint32
+            ).T
+            fill6 += take
+            i += take
+            if fill6 == batch_size:
+                run_chunk6(buf6)  # fresh array allocated next fill
+                buf6 = None
+                fill6 = 0
+
+    def flush_v6() -> None:
+        # partial v6 chunk (padding columns carry valid=0) — called at
+        # checkpoints and end-of-stream so consumed lines are never in
+        # limbo across a snapshot
+        nonlocal buf6, fill6
+        if step6 is None:
+            return
+        stage_v6()
+        if fill6:
+            run_chunk6(buf6)
+            buf6 = None
+            fill6 = 0
+
     # Candidates drain with a 2-chunk lag: by the time chunk N-2's arrays
     # are fetched, their compute is long done, so the host never stalls on
     # the device — and memory stays O(1) chunks instead of O(n_chunks).
@@ -969,6 +1092,8 @@ def _run_core_impl(
                 # device unpack is three VPU shifts (pipeline.batch_cols)
                 wire = batch_np if wire_src else pack_mod.compact_batch(batch_np)
                 run_chunk(mesh_lib.shard_batch(mesh, wire, cfg.mesh_axis))
+            if step6 is not None:
+                stage_v6()
             lines_consumed += n_raw_lines
             chunks_this_run += 1
             meter.tick(n_raw_lines)
@@ -991,6 +1116,9 @@ def _run_core_impl(
         # in losing buffered work from the returned report.)
         for grouped in gbuf.flush():
             run_grouped(grouped)
+    # v6 rows buffered from consumed lines must step for the same reason
+    # the grouped buffer drains above (totals already claim those lines)
+    flush_v6()
 
     # device_get-based sync, NOT block_until_ready: the remote-tunnel PJRT
     # plugin returns immediately from block_until_ready on shard_map
@@ -1024,5 +1152,6 @@ def _run_core_impl(
         # whole file is consumed (rows != raw text lines)
         totals.update(patch(not aborted))
     return pipeline.finalize(
-        state, packed, cfg, tracker, topk=topk, totals=totals
+        state, packed, cfg, tracker, topk=topk, totals=totals,
+        v6_digests=getattr(source, "v6_digests", None),
     )
