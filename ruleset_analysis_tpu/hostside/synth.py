@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from .aclparse import u32_to_ip
+from .aclparse import int_to_ip6, u32_to_ip
 from .pack import (
     PackedRuleset,
     R_ACL,
@@ -33,9 +33,29 @@ from .pack import (
     R_SLO,
     R_SPHI,
     R_SPLO,
+    R6_ACL,
+    R6_DHI,
+    R6_DLO,
+    R6_DPHI,
+    R6_DPLO,
+    R6_PHI,
+    R6_PLO,
+    R6_SHI,
+    R6_SLO,
+    R6_SPHI,
+    R6_SPLO,
     T_VALID,
+    T6_DPORT,
+    T6_DST,
+    T6_PROTO,
+    T6_SPORT,
+    T6_SRC,
+    T6_VALID,
     TUPLE_COLS,
+    TUPLE6_COLS,
     NO_ACL,
+    limbs_u128,
+    u128_limbs,
 )
 
 _COMMON_PROTOS = np.array([6, 6, 6, 17, 17, 1], dtype=np.uint32)
@@ -48,8 +68,14 @@ def synth_config(
     seed: int = 0,
     hostname: str = "fw1",
     egress_acls: bool = False,
+    v6_fraction: float = 0.0,
 ) -> str:
-    """Generate ASA configuration text with object-groups and varied ACEs."""
+    """Generate ASA configuration text with object-groups and varied ACEs.
+
+    ``v6_fraction`` > 0 spells that share of ACEs with IPv6 operands
+    (any6 / host literals / prefixes) — the unified-ACL tier; 0 (the
+    default) keeps every historical fixture bit-identical.
+    """
     rng = np.random.default_rng(seed)
     lines = [f"hostname {hostname}", "!"]
 
@@ -74,6 +100,28 @@ def synth_config(
         for r in range(rules_per_acl):
             action = "permit" if rng.random() < 0.7 else "deny"
             proto = protos[int(rng.integers(0, len(protos)))]
+            if v6_fraction and rng.random() < v6_fraction:
+                # v6 ACE: any6 / host literal / prefix operands
+                roll = rng.random()
+                if roll < 0.3:
+                    src = "any6"
+                elif roll < 0.65:
+                    src = f"host 2001:db8:{a:x}::{rng.integers(1, 0xFFFF):x}"
+                else:
+                    src = f"2001:db8:{rng.integers(0, 16):x}::/{int(rng.choice([48, 64, 96]))}"
+                if rng.random() < 0.4:
+                    dst = "any6"
+                else:
+                    dst = f"2001:db8:{rng.integers(0, 16):x}:1::/{int(rng.choice([64, 80]))}"
+                if proto == "icmp":
+                    proto = "icmp6"
+                port = ""
+                if proto in ("tcp", "udp") and rng.random() < 0.4:
+                    port = f" eq {rng.integers(1, 1024)}"
+                lines.append(
+                    f"access-list {acl} extended {action} {proto} {src} {dst}{port}"
+                )
+                continue
             # source
             roll = rng.random()
             if roll < 0.25:
@@ -159,6 +207,90 @@ def synth_tuples(
     return out
 
 
+def synth_tuples6(
+    packed: PackedRuleset,
+    n: int,
+    seed: int = 0,
+    miss_fraction: float = 0.1,
+) -> np.ndarray:
+    """v6 twin of :func:`synth_tuples`: [n, TUPLE6_COLS] biased at rules6.
+
+    128-bit address sampling runs per-row with Python ints (arbitrary-
+    precision ranges); v6 feedstock volumes are test/bench-mix scale, not
+    the 1e8-line packed v4 tier, so this stays simple and exact.
+    """
+    import random as _random
+
+    rng = np.random.default_rng(seed)
+    prng = _random.Random(seed ^ 0x76C0FFEE)
+    r6 = packed.rules6
+    real = r6[r6[:, R6_ACL] != NO_ACL]
+    if real.shape[0] == 0:
+        raise ValueError("packed ruleset has no v6 rules")
+    pick = rng.integers(0, real.shape[0], size=n)
+    miss = rng.random(n) < miss_fraction
+    out = np.zeros((n, TUPLE6_COLS), dtype=np.uint32)
+    for i in range(n):
+        row = real[pick[i]]
+        if miss[i]:
+            out[i, T6_PROTO] = prng.randrange(256)
+            out[i, T6_SRC:T6_SRC + 4] = u128_limbs(prng.getrandbits(128))
+            out[i, T6_SPORT] = prng.randrange(1 << 16)
+            out[i, T6_DST:T6_DST + 4] = u128_limbs(prng.getrandbits(128))
+            out[i, T6_DPORT] = prng.randrange(1 << 16)
+            out[i, 0] = row[R6_ACL]
+            out[i, T6_VALID] = 1
+            continue
+        slo = limbs_u128(*row[R6_SLO:R6_SLO + 4])
+        shi = limbs_u128(*row[R6_SHI:R6_SHI + 4])
+        dlo = limbs_u128(*row[R6_DLO:R6_DLO + 4])
+        dhi = limbs_u128(*row[R6_DHI:R6_DHI + 4])
+        proto = prng.randint(int(row[R6_PLO]), int(row[R6_PHI]))
+        if row[R6_PLO] == 0 and row[R6_PHI] == 255:
+            proto = int(_COMMON_PROTOS[prng.randrange(len(_COMMON_PROTOS))])
+        out[i, 0] = row[R6_ACL]
+        out[i, T6_PROTO] = proto
+        out[i, T6_SRC:T6_SRC + 4] = u128_limbs(prng.randint(slo, shi))
+        out[i, T6_SPORT] = prng.randint(int(row[R6_SPLO]), int(row[R6_SPHI]))
+        out[i, T6_DST:T6_DST + 4] = u128_limbs(prng.randint(dlo, dhi))
+        out[i, T6_DPORT] = prng.randint(int(row[R6_DPLO]), int(row[R6_DPHI]))
+        out[i, T6_VALID] = 1
+    return out
+
+
+def render_syslog6(
+    packed: PackedRuleset,
+    tuples6: np.ndarray,
+    seed: int = 0,
+    timestamp: str = "Jul 29 07:48:01",
+) -> list[str]:
+    """Render v6 tuple batches as 106100 ASA syslog text (text tier)."""
+    gid_to_name = {gid: (fw, acl) for (fw, acl), gid in packed.acl_gid.items()}
+    rng = np.random.default_rng(seed)
+    verdicts = rng.random(tuples6.shape[0])
+    out = []
+    for i, row in enumerate(tuples6):
+        if not row[T6_VALID]:
+            out.append(f"{timestamp} noise : not an ASA message")
+            continue
+        fw, acl = gid_to_name[int(row[0])]
+        proto = int(row[T6_PROTO])
+        pname = _PROTO_NAMES.get(proto, str(proto))
+        src = int_to_ip6(limbs_u128(*row[T6_SRC:T6_SRC + 4]))
+        dst = int_to_ip6(limbs_u128(*row[T6_DST:T6_DST + 4]))
+        sport, dport = int(row[T6_SPORT]), int(row[T6_DPORT])
+        verdict = "permitted" if verdicts[i] < 0.8 else "denied"
+        if proto in (1, 58):
+            paren_s, paren_d = dport, 0  # icmp type rides dport
+        else:
+            paren_s, paren_d = sport, dport
+        out.append(
+            f"{timestamp} {fw} : %ASA-6-106100: access-list {acl} {verdict} {pname} "
+            f"inside/{src}({paren_s}) -> outside/{dst}({paren_d}) hit-cnt 1 first hit [0x0, 0x0]"
+        )
+    return out
+
+
 def synth_syslog_file(
     packed: PackedRuleset,
     path: str,
@@ -185,7 +317,7 @@ def synth_syslog_file(
             i += 1
 
 
-_PROTO_NAMES = {6: "tcp", 17: "udp", 1: "icmp"}
+_PROTO_NAMES = {6: "tcp", 17: "udp", 1: "icmp", 58: "icmp6"}
 
 
 def render_syslog(

@@ -158,3 +158,27 @@ def test_fold_host_device_agree():
     dev = np.asarray(match6_ops.fold_src32(cols))
     host = np.array([pack.fold_src32_host(v) for v in vals], dtype=np.uint32)
     np.testing.assert_array_equal(dev, host)
+
+
+def test_synth_unified_corpus_end_to_end():
+    """Randomized unified (v4+v6) synth config through the full stream."""
+    from ruleset_analysis_tpu.hostside import synth
+
+    cfg_text = synth.synth_config(
+        n_acls=3, rules_per_acl=14, seed=33, v6_fraction=0.35
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    assert packed.has_v6 and packed.rules.shape[0] > 0
+    t4 = synth.synth_tuples(packed, 900, seed=33)
+    t6 = synth.synth_tuples6(packed, 600, seed=33)
+    lines = synth.render_syslog(packed, t4, seed=33) + synth.render_syslog6(
+        packed, t6, seed=34
+    )
+    rng = random.Random(7)
+    rng.shuffle(lines)
+    res = oracle.Oracle([rs]).consume(list(lines))
+    rep = run_stream(packed, iter(lines), run_cfg(), topk=5)
+    assert report_hits(rep) == dict(res.hits)
+    assert rep.unused == res.unused_rules([rs])
+    assert rep.totals["lines_matched"] == res.lines_matched
