@@ -138,7 +138,6 @@ def run_bench(cpu_scale: bool) -> dict:
         raise BenchInvalid(
             f"3x timed window did not execute: counts moved {delta3}, expected {expect3}"
         )
-    profile = _capture_profile(step, state, rules, feeds)
     linearity = (dt3 / 3.0) / dt1  # ~1.0 when per-step time dominates
     if not dt3 > dt1:
         raise BenchInvalid(
@@ -237,6 +236,12 @@ def run_bench(cpu_scale: bool) -> dict:
             log(f"talk_cms_depth1 bench failed: {e!r}")
 
     e2e = _bench_e2e(packed, cpu_scale, mesh, per_chip * n_dev)
+
+    # Profile capture runs LAST: on the remote-tunnel plugin, everything
+    # stepped after a jax.profiler.trace window runs ~13x slower (r5
+    # window measured every post-profile variant at a uniform ~830 ms vs
+    # the 62 ms default), so tracing must never precede a timed section.
+    profile = _capture_profile(step, state, rules, feeds)
 
     detail = {
         "platform": platform,
