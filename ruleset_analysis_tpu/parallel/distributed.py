@@ -123,6 +123,29 @@ def value_across_processes(value: int) -> np.ndarray:
     return np.asarray(multihost_utils.process_allgather(arr)).reshape(-1)
 
 
+def allgather_rows(rows: np.ndarray) -> np.ndarray:
+    """Concatenate a small per-process [n_i, C] uint32 array across processes.
+
+    ``process_allgather`` needs equal shapes, so row counts gather first
+    and each contribution pads to the max before the data gather.  Meant
+    for tiny side tables (e.g. v6 talker digest->address rows), not bulk
+    data.
+    """
+    from jax.experimental import multihost_utils
+
+    rows = np.ascontiguousarray(rows, dtype=np.uint32)
+    counts = value_across_processes(rows.shape[0])
+    m = int(counts.max()) if counts.size else 0
+    if m == 0:
+        return rows.reshape(0, rows.shape[1] if rows.ndim == 2 else 0)
+    padded = np.zeros((m, rows.shape[1]), dtype=np.uint32)
+    padded[: rows.shape[0]] = rows
+    gathered = np.asarray(multihost_utils.process_allgather(padded))
+    return np.concatenate(
+        [gathered[p, : int(counts[p])] for p in range(gathered.shape[0])]
+    )
+
+
 def sum_across_processes(values: dict[str, int]) -> dict[str, int]:
     """Aggregate per-process counters (parsed/skipped/lines) for totals."""
     from jax.experimental import multihost_utils

@@ -485,14 +485,6 @@ def run_stream_file_distributed(
     stacked = cfg.layout == "stacked"
     if isinstance(local_paths, str):
         local_paths = [local_paths]
-    if packed.has_v6:
-        # v6 needs its own collective flush protocol in this driver (the
-        # v6 side buffer drains data-dependently per process); until that
-        # lands, refuse loudly rather than silently skip v6 traffic.
-        raise AnalysisError(
-            "distributed runs do not yet evaluate IPv6 rules; run "
-            "single-process (full v6 support) or strip v6 ACEs"
-        )
     from ..hostside.wire import is_wire_file
 
     n_wire = sum(1 for p in local_paths if is_wire_file(p))
@@ -503,8 +495,19 @@ def run_stream_file_distributed(
     if n_wire:
         source = _WireFileSource(packed, local_paths)
     else:
+        explicit_native = native is True
         if native is None:
             native = fastparse.available()
+        if packed.has_v6 and native:
+            # native parse tier is v4-only (see run_stream_file): explicit
+            # requests fail loudly, auto-select falls back to Python
+            if explicit_native:
+                raise AnalysisError(
+                    "the native parser tier is v4-only but this ruleset "
+                    "has IPv6 rules; drop native=True (the Python parser "
+                    "handles both families)"
+                )
+            native = False
         source = _FileSource(packed, local_paths) if native else _TextSource(
             packed, _iter_files(local_paths)
         )
@@ -544,6 +547,25 @@ def run_stream_file_distributed(
             )
             step = make_parallel_step(mesh, cfg, packed.n_keys)
             gbuf = None
+        # IPv6 side path (collective twin of _run_core's): v6 rows stage
+        # per process at a data-dependent rate, so full chunks drain
+        # through the same lockstep ready-round protocol as the stacked
+        # layout — every process steps the v6 program the same number of
+        # times, padding with all-invalid batches when its queue is dry.
+        step6 = None
+        rules6_g = None
+        if packed.has_v6 and hasattr(source, "take_v6"):
+            from ..parallel.step import make_parallel_step6
+
+            r6h = pipeline.ship_ruleset6_host(packed)
+            rules6_g = pipeline.DeviceRuleset6(
+                rules6=dist.to_global(mesh, r6h.rules6, P()),
+                deny_key=dist.to_global(mesh, r6h.deny_key, P()),
+            )
+            step6 = make_parallel_step6(mesh, cfg, packed.n_keys)
+        ready6: deque[np.ndarray] = deque()  # full [TUPLE6_COLS, local_batch]
+        buf6 = None
+        fill6 = 0
         packer = source.packer
         pending: deque[pipeline.ChunkOut] = deque()
 
@@ -656,9 +678,71 @@ def run_stream_file_distributed(
                     break
                 step_grouped_round(has)
 
+        def pull_v6() -> None:
+            # stage source-parsed v6 rows; enqueue each full local chunk
+            nonlocal buf6, fill6
+            rows = source.take_v6()
+            i = 0
+            while i < len(rows):
+                if buf6 is None:
+                    buf6 = np.zeros(
+                        (pack_mod.TUPLE6_COLS, local_batch), dtype=np.uint32
+                    )
+                take = min(local_batch - fill6, len(rows) - i)
+                buf6[:, fill6:fill6 + take] = np.asarray(
+                    rows[i:i + take], dtype=np.uint32
+                ).T
+                fill6 += take
+                i += take
+                if fill6 == local_batch:
+                    ready6.append(buf6)
+                    buf6 = None
+                    fill6 = 0
+
+        def step_v6_round(has: bool) -> None:
+            nonlocal state, n_chunks
+            b = (
+                ready6.popleft()
+                if has
+                else np.zeros(
+                    (pack_mod.TUPLE6_COLS, local_batch), dtype=np.uint32
+                )
+            )
+            gb = dist.to_global(mesh, b, P(None, cfg.mesh_axis))
+            state, out = step6(state, rules6_g, gb, n_chunks)
+            pending.append(out)
+            if len(pending) > 2:
+                drain(pending.popleft())
+            n_chunks += 1
+
+        def drain_v6_rounds() -> None:
+            # step full v6 chunks in lockstep; one tiny allgather per round
+            # plus a terminating one (skipped entirely for pure-v4 rulesets)
+            if step6 is None:
+                return
+            while True:
+                has = bool(ready6)
+                if not dist.all_processes_have_data(has):
+                    break
+                step_v6_round(has)
+
+        def collective_flush_v6() -> None:
+            # snapshot/EOF barrier: drain EVERYTHING including the partial
+            # chunk, so no consumed line is in limbo across a snapshot
+            nonlocal buf6, fill6
+            if step6 is None:
+                return
+            pull_v6()
+            if fill6:
+                ready6.append(buf6)  # padding columns carry valid=0
+                buf6 = None
+                fill6 = 0
+            drain_v6_rounds()
+
         def save_snapshot() -> None:
             if stacked:
                 collective_flush()
+            collective_flush_v6()
             while pending:
                 drain(pending.popleft())
             pipeline.sync_state(state)
@@ -748,6 +832,9 @@ def run_stream_file_distributed(
                 if len(pending) > 2:
                     drain(pending.popleft())
                 n_chunks += 1
+            if step6 is not None:
+                pull_v6()
+                drain_v6_rounds()
             chunks_this_run += 1
             # the loop is collective, so every process reaches the cadence at
             # the same n_chunks and snapshots the same register state
@@ -775,6 +862,9 @@ def run_stream_file_distributed(
                 if not dist.all_processes_have_data(has):
                     break
                 step_grouped_round(has)
+        # v6 rows from consumed lines drain collectively on BOTH the
+        # normal and aborted exits (same invariant as the stacked drain)
+        collective_flush_v6()
 
         pipeline.sync_state(state)
         elapsed = meter.elapsed()  # before the final snapshot write (as _run_core)
@@ -806,7 +896,36 @@ def run_stream_file_distributed(
             "elapsed_sec": round(elapsed, 4),
             "lines_per_sec": round(lines_this_run / elapsed, 1) if elapsed > 0 else 0.0,
         }
-        report = pipeline.finalize(state, packed, cfg, tracker, topk=topk, totals=totals)
+        v6_digests = getattr(source, "v6_digests", None)
+        if step6 is not None:
+            # The tracker is replicated but each process's digest map only
+            # covers ITS split's sources; gather just the rows the final
+            # candidates need (tiny) so every process renders the SAME
+            # report — the driver's identical-everywhere contract.
+            tag = int(pipeline.V6_ACL_TAG)
+            needed = {
+                int(s)
+                for gid, table in tracker.tables().items()
+                if int(gid) & tag
+                for s in table
+            }
+            local = v6_digests or {}
+            rows = np.array(
+                [
+                    (d, *pack_mod.u128_limbs(local[d]))
+                    for d in sorted(needed)
+                    if d in local
+                ],
+                dtype=np.uint32,
+            ).reshape(-1, 5)
+            merged = dist.allgather_rows(rows)
+            v6_digests = {
+                int(r[0]): pack_mod.limbs_u128(*r[1:5]) for r in merged
+            }
+        report = pipeline.finalize(
+            state, packed, cfg, tracker, topk=topk, totals=totals,
+            v6_digests=v6_digests,
+        )
         if return_state:
             return report, pipeline.state_to_host(state)
         return report

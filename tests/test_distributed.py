@@ -454,3 +454,74 @@ def test_corrupt_one_process_snapshot_fails_all_loudly(corpus):
         errs.append((p.returncode, err))
     assert all(rc != 0 for rc, _ in errs), f"some worker succeeded: {errs}"
     assert any("CheckpointCorrupt" in err or "corrupt" in err for _, err in errs)
+
+
+@pytest.fixture(scope="module")
+def corpus6(tmp_path_factory):
+    """Unified (v4+v6) corpus: exercises the distributed v6 side path."""
+    import random as _random
+
+    from ruleset_analysis_tpu.hostside import oracle as oracle_mod
+
+    td = tmp_path_factory.mktemp("dist6")
+    cfg_text = synth.synth_config(
+        n_acls=3, rules_per_acl=8, seed=51, v6_fraction=0.4
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    assert packed.has_v6
+    t4 = synth.synth_tuples(packed, 700, seed=52)
+    t6 = synth.synth_tuples6(packed, 500, seed=53)
+    lines = synth.render_syslog(packed, t4, seed=54) + synth.render_syslog6(
+        packed, t6, seed=55
+    )
+    _random.Random(5).shuffle(lines)
+    res = oracle_mod.Oracle([rs]).consume(list(lines))
+    prefix = str(td / "packed")
+    pack.save_packed(packed, prefix)
+    (td / "full.log").write_text("\n".join(lines) + "\n", encoding="utf-8")
+    (td / "half0.log").write_text("\n".join(lines[:600]) + "\n", encoding="utf-8")
+    (td / "half1.log").write_text("\n".join(lines[600:]) + "\n", encoding="utf-8")
+    return td, prefix, res
+
+
+def test_two_process_v6_bit_identical_and_oracle_exact(corpus6):
+    td, prefix, res = corpus6
+    _run_workers(1, _free_port(), prefix, [str(td / "full.log")],
+                 [str(td / "ref6")], 8)
+    _run_workers(2, _free_port(), prefix,
+                 [str(td / "half0.log"), str(td / "half1.log")],
+                 [str(td / "o60"), str(td / "o61")], 4)
+    ref = np.load(str(td / "ref6.npz"))
+    o0 = np.load(str(td / "o60.npz"))
+    o1 = np.load(str(td / "o61.npz"))
+    for k in ref.files:
+        np.testing.assert_array_equal(ref[k], o0[k], err_msg=f"register {k}")
+        np.testing.assert_array_equal(o0[k], o1[k], err_msg=f"register {k} ranks")
+    rep0 = json.loads((td / "o60.json").read_text())
+    rep1 = json.loads((td / "o61.json").read_text())
+    got = {
+        (e["firewall"], e["acl"], e["index"]): e["hits"]
+        for e in rep0["per_rule"] if e["hits"] > 0
+    }
+    assert got == dict(res.hits)
+    # identical-everywhere contract holds for v6 talker rendering too
+    assert rep0["talkers"] == rep1["talkers"]
+
+
+def test_two_process_v6_crash_resume(corpus6):
+    td, prefix, res = corpus6
+    ck = str(td / "ck6")
+    _run_workers(2, _free_port(), prefix,
+                 [str(td / "half0.log"), str(td / "half1.log")],
+                 [str(td / "u60"), str(td / "u61")], 4)
+    _run_workers(2, _free_port(), prefix,
+                 [str(td / "half0.log"), str(td / "half1.log")],
+                 [str(td / "c60"), str(td / "c61")], 4, extra=(ck, "crash"))
+    _run_workers(2, _free_port(), prefix,
+                 [str(td / "half0.log"), str(td / "half1.log")],
+                 [str(td / "r60"), str(td / "r61")], 4, extra=(ck, "resume"))
+    ref = np.load(str(td / "u60.npz"))
+    got = np.load(str(td / "r60.npz"))
+    for k in ref.files:
+        np.testing.assert_array_equal(ref[k], got[k], err_msg=f"register {k}")
