@@ -340,6 +340,7 @@ def _cmd_wire_info(args: argparse.Namespace) -> int:
             "file": path,
             "ok": True,
             "rows": r.n_rows,
+            "rows6": r.n6_rows,
             "raw_lines": r.raw_lines,
             "skipped_lines": r.n_skipped,
             "block_rows": r.block_rows,

@@ -90,6 +90,46 @@ T6_DST = 7   # ..10
 T6_DPORT = 11
 T6_VALID = 12
 
+#: v6 wire columns (DESIGN.md "wire format v2", 40 B/line): the address
+#: limbs ride uncompressed, ports pack as sport<<16|dport and meta as
+#: proto<<24|valid<<23|acl — the same two packed words as the v4 format,
+#: so the device unpack is the same three VPU shifts.
+WIRE6_COLS = 10
+W6_SRC = 0   # ..3
+W6_DST = 4   # ..7
+W6_PORTS = 8
+W6_META = 9
+
+
+def compact_batch6(batch6: np.ndarray) -> np.ndarray:
+    """Column-major working v6 batch ``[TUPLE6_COLS, B]`` -> ``[WIRE6_COLS, B]``."""
+    u32 = np.uint32
+    out = np.empty((WIRE6_COLS, batch6.shape[1]), dtype=u32)
+    out[W6_SRC:W6_SRC + 4] = batch6[T6_SRC:T6_SRC + 4]
+    out[W6_DST:W6_DST + 4] = batch6[T6_DST:T6_DST + 4]
+    out[W6_PORTS] = (batch6[T6_SPORT] << u32(16)) | (batch6[T6_DPORT] & u32(0xFFFF))
+    out[W6_META] = (
+        (batch6[T6_PROTO] << u32(24))
+        | ((batch6[T6_VALID] & u32(1)) << u32(23))
+        | (batch6[T6_ACL] & u32(WIRE_MAX_ACLS - 1))
+    )
+    return out
+
+
+def expand_batch6(wire6: np.ndarray) -> np.ndarray:
+    """Inverse of :func:`compact_batch6` (tests / debugging)."""
+    u32 = np.uint32
+    out = np.zeros((TUPLE6_COLS, wire6.shape[1]), dtype=u32)
+    meta = wire6[W6_META]
+    out[T6_SRC:T6_SRC + 4] = wire6[W6_SRC:W6_SRC + 4]
+    out[T6_DST:T6_DST + 4] = wire6[W6_DST:W6_DST + 4]
+    out[T6_SPORT] = wire6[W6_PORTS] >> u32(16)
+    out[T6_DPORT] = wire6[W6_PORTS] & u32(0xFFFF)
+    out[T6_PROTO] = meta >> u32(24)
+    out[T6_VALID] = (meta >> u32(23)) & u32(1)
+    out[T6_ACL] = meta & u32(WIRE_MAX_ACLS - 1)
+    return out
+
 
 def u128_limbs(v: int) -> tuple[int, int, int, int]:
     """128-bit int -> 4 big-endian uint32 limbs."""
@@ -99,6 +139,17 @@ def u128_limbs(v: int) -> tuple[int, int, int, int]:
 
 def limbs_u128(l0: int, l1: int, l2: int, l3: int) -> int:
     return (int(l0) << 96) | (int(l1) << 64) | (int(l2) << 32) | int(l3)
+
+
+def fold_src32_np(limbs: np.ndarray) -> np.ndarray:
+    """Vectorized :func:`fold_src32_host` over ``[4, n]`` uint32 limbs."""
+    u32 = np.uint32
+    with np.errstate(over="ignore"):
+        h = limbs[0] * u32(0x9E3779B1)
+        h = (h ^ limbs[1]) * u32(0x85EBCA77)
+        h = (h ^ limbs[2]) * u32(0xC2B2AE3D)
+        h = (h ^ limbs[3]) * u32(0x27D4EB2F)
+    return h ^ (h >> u32(15))
 
 
 def fold_src32_host(v: int) -> int:

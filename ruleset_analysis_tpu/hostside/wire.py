@@ -56,11 +56,18 @@ from .pack import (
     TUPLE_COLS,
     W_META,
     WIRE_COLS,
+    WIRE6_COLS,
     PackedRuleset,
     compact_batch,
+    compact_batch6,
 )
 
 MAGIC = b"RAWIREv1"
+#: Wire format v2 (DESIGN.md "IPv6 position"): a second payload section
+#: of IPv6 rows (40 B/line) follows the v4 blocks.  The writer only
+#: upgrades to v2 when a v6 row was actually written, so all-v4 corpora
+#: keep producing byte-identical v1 files; readers sniff by magic.
+MAGIC6 = b"RAWIREv2"
 #: Placeholder magic while a convert is in flight; only a successful
 #: ``WireWriter.close()`` upgrades it to MAGIC, so a crashed or aborted
 #: convert leaves a file every reader refuses ("not a wire file") instead
@@ -68,11 +75,15 @@ MAGIC = b"RAWIREv1"
 MAGIC_PARTIAL = b"RAWIRE??"
 HEADER_BYTES = 64
 _HEADER_FMT = "<8sII4Q16s"
+#: v2 header: the v1 fields plus the v6-section row count.
+HEADER6_BYTES = 72
+_HEADER6_FMT = "<8sII5Q16s"
 #: Default rows per payload block.  Matches the default run batch size so
 #: the aligned read path hands mmap views straight to device_put.
 DEFAULT_BLOCK_ROWS = 1 << 16
 
 ROW_BYTES = WIRE_COLS * 4  # 16 B/line
+ROW6_BYTES = 40  # WIRE6_COLS * 4
 
 
 def ruleset_fingerprint(packed: PackedRuleset) -> bytes:
@@ -116,18 +127,67 @@ class WireWriter:
     ):
         if block_rows <= 0:
             raise ValueError("block_rows must be positive")
+        self._path = path
         self._f = open(path, "wb")
         self._fp = fp
         self.block_rows = block_rows
         self.n_rows = 0
+        self.n6_rows = 0
         self.raw_lines = 0
         self.n_skipped = 0
         self._buf = np.empty((WIRE_COLS, block_rows), dtype=np.uint32)
         self._fill = 0
-        # placeholder header; rewritten with the final magic + counts on close
+        #: v6 rows spill to a sibling temp file while v4 blocks stream to
+        #: the main file (the v6 section must FOLLOW every v4 block); a
+        #: successful close appends the spill and deletes it.  Memory
+        #: stays one block per family regardless of corpus size.
+        self._f6 = None
+        self._buf6 = None
+        self._fill6 = 0
+        # The v2 header is longer; reserve the larger size up front so a
+        # v6 row arriving late never forces a payload rewrite.  All-v4
+        # closes rewind to the v1 64-byte header and the payload starts
+        # at HEADER6_BYTES... which would break v1 readers, so instead
+        # the HEADER SIZE is chosen by the first add: we always write the
+        # v1-sized placeholder and, if v6 rows exist at close, rewrite
+        # the file with the v2 header via a rename-free tail shuffle —
+        # avoided entirely by just padding: v1 files put payload at 64,
+        # v2 files at 72.  Since rows stream out as they arrive, the
+        # choice must be made BEFORE the first v4 block lands; a ruleset
+        # without v6 rows never calls add6, so the caller passes
+        # has_v6 via begin6() before any add when v6 is possible.
+        self._payload_at = HEADER_BYTES
+        self._f.write(self._header(final=False))
+
+    def begin6(self) -> None:
+        """Declare that v6 rows MAY follow (call before the first add).
+
+        Reserves the v2 header size.  A file that declared begin6 but saw
+        no v6 rows still closes as v2 with an empty v6 section — readers
+        handle n6_rows == 0, and all-v4 corpora (no begin6) keep their
+        exact v1 bytes.
+        """
+        if self.n_rows or self._fill or self.n6_rows:
+            raise RuntimeError("begin6() must precede the first add")
+        self._payload_at = HEADER6_BYTES
+        self._f.seek(0)
+        self._f.truncate()
         self._f.write(self._header(final=False))
 
     def _header(self, final: bool = True) -> bytes:
+        if self._payload_at == HEADER6_BYTES:
+            return struct.pack(
+                _HEADER6_FMT,
+                MAGIC6 if final else MAGIC_PARTIAL,
+                self.block_rows,
+                0,
+                self.n_rows,
+                self.n6_rows,
+                self.raw_lines,
+                self.n_rows + self.n6_rows,  # n_evals == stored rows
+                self.n_skipped,
+                self._fp,
+            )
         return struct.pack(
             _HEADER_FMT,
             MAGIC if final else MAGIC_PARTIAL,
@@ -158,12 +218,57 @@ class WireWriter:
                 self._f.write(self._buf.tobytes())
                 self._fill = 0
 
+    def add6(self, wire6: np.ndarray, raw_lines: int, skipped: int) -> None:
+        """Append v6 rows (``[WIRE6_COLS, k]``) to the spill section.
+
+        Requires :meth:`begin6` to have reserved the v2 header.
+        """
+        if self._payload_at != HEADER6_BYTES:
+            raise RuntimeError("call begin6() before the first add to write v6 rows")
+        if wire6.dtype != np.uint32 or wire6.shape[0] != WIRE6_COLS:
+            raise ValueError(
+                f"expected [WIRE6_COLS, k] uint32, got {wire6.shape} {wire6.dtype}"
+            )
+        if self._f6 is None:
+            self._f6 = open(self._path + ".spill6", "wb")
+            self._buf6 = np.empty((WIRE6_COLS, self.block_rows), dtype=np.uint32)
+        self.raw_lines += raw_lines
+        self.n_skipped += skipped
+        pos = 0
+        k = wire6.shape[1]
+        while pos < k:
+            m = min(self.block_rows - self._fill6, k - pos)
+            self._buf6[:, self._fill6:self._fill6 + m] = wire6[:, pos:pos + m]
+            self._fill6 += m
+            pos += m
+            self.n6_rows += m
+            if self._fill6 == self.block_rows:
+                self._f6.write(self._buf6.tobytes())
+                self._fill6 = 0
+
     def close(self) -> None:
         if self._f.closed:
             return
         if self._fill:
             self._f.write(np.ascontiguousarray(self._buf[:, : self._fill]).tobytes())
             self._fill = 0
+        if self._f6 is not None:
+            # append the v6 section after the last v4 block
+            if self._fill6:
+                self._f6.write(
+                    np.ascontiguousarray(self._buf6[:, : self._fill6]).tobytes()
+                )
+                self._fill6 = 0
+            self._f6.flush()
+            self._f6.close()
+            with open(self._path + ".spill6", "rb") as sf:
+                while True:
+                    chunk = sf.read(1 << 22)
+                    if not chunk:
+                        break
+                    self._f.write(chunk)
+            os.unlink(self._path + ".spill6")
+            self._f6 = None
         self._f.flush()
         self._f.seek(0)
         self._f.write(self._header(final=True))
@@ -176,6 +281,13 @@ class WireWriter:
         file is refused by every reader rather than read short."""
         if not self._f.closed:
             self._f.close()
+        if self._f6 is not None:
+            self._f6.close()
+            try:
+                os.unlink(self._path + ".spill6")
+            except OSError:
+                pass
+            self._f6 = None
 
     def __enter__(self):
         return self
@@ -199,7 +311,7 @@ def is_wire_file(path: str) -> bool:
     try:
         with open(path, "rb") as f:
             head = f.read(len(MAGIC))
-            return head == MAGIC or head == MAGIC_PARTIAL
+            return head in (MAGIC, MAGIC6, MAGIC_PARTIAL)
     except OSError:
         return False
 
@@ -211,16 +323,34 @@ class _WireFile:
         self.path = path
         f = open(path, "rb")
         try:
-            head = f.read(HEADER_BYTES)
+            head = f.read(HEADER6_BYTES)
             if len(head) >= len(MAGIC_PARTIAL) and head.startswith(MAGIC_PARTIAL):
                 raise WireFormatError(
                     f"{path!r} is an incomplete wire file (the convert that "
                     "wrote it crashed or was aborted); re-run the convert"
                 )
-            if len(head) < HEADER_BYTES or not head.startswith(MAGIC):
+            if head.startswith(MAGIC6):
+                if len(head) < HEADER6_BYTES:
+                    raise WireFormatError(
+                        f"{path!r} is not a wire file (bad magic/header)"
+                    )
+                (_, self.block_rows, _r, self.n_rows, self.n6_rows,
+                 self.raw_lines, self.n_evals, self.n_skipped,
+                 self.fp) = struct.unpack(_HEADER6_FMT, head)
+                self._payload_at = HEADER6_BYTES
+            elif head.startswith(MAGIC):
+                if len(head) < HEADER_BYTES:
+                    raise WireFormatError(
+                        f"{path!r} is not a wire file (bad magic/header)"
+                    )
+                (_, self.block_rows, _r, self.n_rows, self.raw_lines,
+                 self.n_evals, self.n_skipped, self.fp) = struct.unpack(
+                    _HEADER_FMT, head[:HEADER_BYTES]
+                )
+                self.n6_rows = 0
+                self._payload_at = HEADER_BYTES
+            else:
                 raise WireFormatError(f"{path!r} is not a wire file (bad magic/header)")
-            (_, self.block_rows, _r, self.n_rows, self.raw_lines,
-             self.n_evals, self.n_skipped, self.fp) = struct.unpack(_HEADER_FMT, head)
             if self.block_rows < 1:
                 raise WireFormatError(
                     f"{path!r} has a corrupt header (block_rows == 0)"
@@ -231,14 +361,16 @@ class _WireFile:
                     "(fingerprint mismatch); re-run `ruleset-analyze convert` "
                     "with the current packed ruleset"
                 )
-            need = HEADER_BYTES + self.n_rows * ROW_BYTES
+            self._v6_at = self._payload_at + self.n_rows * ROW_BYTES
+            need = self._v6_at + self.n6_rows * ROW6_BYTES
             size = os.fstat(f.fileno()).st_size
             if size < need:
                 raise WireFormatError(
-                    f"{path!r} is truncated: header claims {self.n_rows} rows "
-                    f"({need} bytes) but the file has {size}"
+                    f"{path!r} is truncated: header claims "
+                    f"{self.n_rows}+{self.n6_rows} rows ({need} bytes) but "
+                    f"the file has {size}"
                 )
-            if self.n_rows:
+            if self.n_rows or self.n6_rows:
                 self._mm = mmap.mmap(f.fileno(), need, access=mmap.ACCESS_READ)
             else:
                 self._mm = None
@@ -263,13 +395,31 @@ class _WireFile:
         """Read-only [WIRE_COLS, r] view of payload block ``b``."""
         start = b * self.block_rows
         r = min(self.block_rows, self.n_rows - start)
-        off = HEADER_BYTES + start * ROW_BYTES
+        off = self._payload_at + start * ROW_BYTES
         arr = np.frombuffer(self._mm, dtype=np.uint32, count=WIRE_COLS * r, offset=off)
         return arr.reshape(WIRE_COLS, r)
+
+    def block6(self, b: int) -> np.ndarray:
+        """Read-only [WIRE6_COLS, r] view of v6-section block ``b``."""
+        start = b * self.block_rows
+        r = min(self.block_rows, self.n6_rows - start)
+        off = self._v6_at + start * ROW6_BYTES
+        arr = np.frombuffer(
+            self._mm, dtype=np.uint32, count=WIRE6_COLS * r, offset=off
+        )
+        return arr.reshape(WIRE6_COLS, r)
 
     @property
     def n_blocks(self) -> int:
         return (self.n_rows + self.block_rows - 1) // self.block_rows if self.n_rows else 0
+
+    @property
+    def n6_blocks(self) -> int:
+        return (
+            (self.n6_rows + self.block_rows - 1) // self.block_rows
+            if self.n6_rows
+            else 0
+        )
 
 
 class WireReader:
@@ -301,6 +451,7 @@ class WireReader:
         #: meaningless then).
         self.block_rows = blocks.pop() if len(blocks) == 1 else 0
         self.n_rows = sum(f.n_rows for f in self._files)
+        self.n6_rows = sum(f.n6_rows for f in self._files)
         self.raw_lines = sum(f.raw_lines for f in self._files)
         self.n_evals = sum(f.n_evals for f in self._files)
         self.n_skipped = sum(f.n_skipped for f in self._files)
@@ -363,6 +514,60 @@ class WireReader:
         if fill:
             yield pend, fill
 
+    def iter_batches6(
+        self, skip_rows: int, batch_size: int
+    ) -> Iterator[tuple[np.ndarray, int]]:
+        """Yield ``([WIRE6_COLS, batch_size] uint32, rows_in_batch)``.
+
+        The v6 sections of every file, concatenated — consumed AFTER the
+        v4 stream (drivers run the two phases in that fixed order, so
+        resume offsets over the concatenated v4-then-v6 row stream are
+        deterministic).  Padding and zero-copy behavior mirror
+        :meth:`iter_batches`.
+        """
+        if skip_rows > self.n6_rows:
+            from ..errors import ResumeInputMismatch
+
+            raise ResumeInputMismatch(
+                f"snapshot consumed {skip_rows} v6 rows but the wire input "
+                f"has only {self.n6_rows}; wrong or truncated input"
+            )
+        pend: np.ndarray | None = None
+        fill = 0
+        to_skip = skip_rows
+        for wf in self._files:
+            if to_skip >= wf.n6_rows:
+                to_skip -= wf.n6_rows
+                continue
+            b0 = to_skip // wf.block_rows if wf.block_rows else 0
+            to_skip -= b0 * wf.block_rows
+            for b in range(b0, wf.n6_blocks):
+                blk = wf.block6(b)
+                if to_skip:
+                    drop = min(to_skip, blk.shape[1])
+                    blk = blk[:, drop:]
+                    to_skip -= drop
+                    if not blk.shape[1]:
+                        continue
+                pos = 0
+                n = blk.shape[1]
+                if fill == 0 and n == batch_size:
+                    yield blk, n
+                    continue
+                while pos < n:
+                    if pend is None:
+                        pend = np.zeros((WIRE6_COLS, batch_size), dtype=np.uint32)
+                    m = min(batch_size - fill, n - pos)
+                    pend[:, fill:fill + m] = blk[:, pos:pos + m]
+                    fill += m
+                    pos += m
+                    if fill == batch_size:
+                        yield pend, fill
+                        pend = None
+                        fill = 0
+        if fill:
+            yield pend, fill
+
 
 def convert_logs(
     packed: PackedRuleset,
@@ -385,6 +590,20 @@ def convert_logs(
     """
     from . import fastparse
 
+    text_src = None
+    if packed.has_v6 and (
+        (feed_workers and feed_workers > 1) or native is True
+        or (native is None and fastparse.available())
+    ):
+        # native/feeder tiers are v4-only: explicit requests fail loudly,
+        # auto-select falls back to the Python source (run path twin)
+        if native is True or (feed_workers and feed_workers > 1):
+            raise AnalysisError(
+                "the native parser tier is v4-only but this ruleset has "
+                "IPv6 rules; convert without --parser native / "
+                "--feed-workers (the Python parser handles both families)"
+            )
+        native = False
     if feed_workers and feed_workers > 1:
         if native is False:
             raise ValueError(
@@ -404,13 +623,15 @@ def convert_logs(
         else:
             from ..runtime.stream import _iter_files, _TextSource
 
-            src = _TextSource(packed, _iter_files(log_paths))
-            packer = src.packer
-            batches = src.batches(0, batch_size)
+            text_src = _TextSource(packed, _iter_files(log_paths))
+            packer = text_src.packer
+            batches = text_src.batches(0, batch_size)
         parser_name = "native" if use_native else "python"
 
     last_skipped = 0
     with WireWriter(out_path, ruleset_fingerprint(packed), block_rows) as w:
+        if packed.has_v6:
+            w.begin6()
         for batch, n_raw in batches:
             skipped = packer.skipped
             # keep only evaluation rows, wherever the source put them
@@ -419,10 +640,16 @@ def convert_logs(
             valid = batch[:, batch[T_VALID] == 1]
             w.add(compact_batch(valid), n_raw, skipped - last_skipped)
             last_skipped = skipped
+            if text_src is not None and packed.has_v6:
+                rows6 = text_src.take_v6()
+                if rows6:
+                    t6 = np.asarray(rows6, dtype=np.uint32).T
+                    w.add6(compact_batch6(t6), 0, 0)
     return {
         "rows": w.n_rows,
+        "rows6": w.n6_rows,
         "raw_lines": w.raw_lines,
-        "evals": w.n_rows,
+        "evals": w.n_rows + w.n6_rows,
         "skipped": w.n_skipped,
         "bytes": os.path.getsize(out_path),
         "parser": parser_name,

@@ -127,16 +127,35 @@ def batch_cols(batch: jax.Array) -> tuple[dict, jax.Array]:
 
 
 def batch_cols6(batch: jax.Array) -> tuple[dict, jax.Array]:
-    """Field columns + valid mask from a v6 batch ``[TUPLE6_COLS, B]``.
+    """Field columns + valid mask from a v6 batch in EITHER layout.
 
-    Address limbs surface as src0..src3 / dst0..dst3 (big-endian), the
-    shape ops.match6 consumes.  (The bit-packed v6 wire layout is wire
-    format v2 — see hostside.wire — and is expanded host-side.)
+    Accepts the working ``[TUPLE6_COLS, B]`` layout or the wire-v2
+    ``[WIRE6_COLS, B]`` layout (40 B/line; ports/meta bit-packed exactly
+    like the v4 wire words, so the on-device unpack is the same three VPU
+    shifts).  Address limbs surface as src0..src3 / dst0..dst3.
     """
+    from ..hostside.pack import (
+        W6_DST, W6_META, W6_PORTS, W6_SRC, WIRE6_COLS,
+    )
+
+    u32 = jnp.uint32
+    if batch.shape[-2] == WIRE6_COLS:
+        meta = batch[..., W6_META, :]
+        ports = batch[..., W6_PORTS, :]
+        cols = {
+            "acl": meta & u32(WIRE_MAX_ACLS - 1),
+            "proto": meta >> u32(24),
+            "sport": ports >> u32(16),
+            "dport": ports & u32(0xFFFF),
+        }
+        for i in range(4):
+            cols[f"src{i}"] = batch[..., W6_SRC + i, :]
+            cols[f"dst{i}"] = batch[..., W6_DST + i, :]
+        return cols, (meta >> u32(23)) & u32(1)
     if batch.shape[-2] != TUPLE6_COLS:
         raise ValueError(
-            f"v6 batch field axis must be TUPLE6_COLS={TUPLE6_COLS}, "
-            f"got shape {batch.shape}"
+            f"v6 batch field axis must be TUPLE6_COLS={TUPLE6_COLS} or "
+            f"WIRE6_COLS={WIRE6_COLS}, got shape {batch.shape}"
         )
     cols = {
         "acl": batch[..., T6_ACL, :],

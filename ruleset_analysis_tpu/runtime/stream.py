@@ -253,19 +253,56 @@ class _WireFileSource:
 
         self.reader = WireReader(paths, packed)
         self.packer = _PackedCounters()
+        #: fold digest -> 128-bit source (populated by batches6; report
+        #: rendering of v6 talkers, same contract as _TextSource)
+        self.v6_digests: dict[int, int] = {}
 
     def set_counts(self, parsed: int, skipped: int) -> None:
         self.packer.parsed, self.packer.skipped = parsed, skipped
 
+    @property
+    def n4_rows(self) -> int:
+        return self.reader.n_rows
+
     def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
         from ..hostside.wire import sanity_check_valid_bits
 
-        for wire, n in self.reader.iter_batches(skip_lines, batch_size):
+        # resume offsets count the CONCATENATED v4-then-v6 row stream; an
+        # offset past the v4 section means phase 1 is already complete
+        skip4 = min(skip_lines, self.reader.n_rows)
+        for wire, n in self.reader.iter_batches(skip4, batch_size):
             v, inv = sanity_check_valid_bits(wire)
             # padding columns of a short final batch are not stored rows
             self.packer.parsed += v
             self.packer.skipped += inv - (wire.shape[1] - n)
             yield wire, n
+
+    def batches6(self, skip_rows6: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
+        """Wire-v2 v6 section (consumed after the v4 stream — phase 2)."""
+        import numpy as _np
+
+        from ..hostside.pack import (
+            W6_META, W6_SRC, fold_src32_np, limbs_u128,
+        )
+
+        cap = _TextSource.V6_DIGEST_CAP
+        for w6, n in self.reader.iter_batches6(skip_rows6, batch_size):
+            v = int(_np.count_nonzero(w6[W6_META] & _np.uint32(1 << 23)))
+            self.packer.parsed += v
+            self.packer.skipped += (w6.shape[1] - v) - (w6.shape[1] - n)
+            if len(self.v6_digests) < cap and n:
+                # digest -> address map for talker rendering (vectorized
+                # fold; dict inserts bounded by unique sources + the cap)
+                limbs = w6[W6_SRC:W6_SRC + 4, :n]
+                folds = fold_src32_np(limbs)
+                dig = self.v6_digests
+                for j in range(n):
+                    f = int(folds[j])
+                    if f not in dig:
+                        if len(dig) >= cap:
+                            break
+                        dig[f] = limbs_u128(*limbs[:, j])
+            yield w6, n
 
     def close(self) -> None:
         """Release the reader's mmaps/fds (called from _run_core's finally)."""
@@ -283,7 +320,7 @@ class _WireFileSource:
         return {
             "lines_total": self.reader.raw_lines,
             "lines_skipped": self.reader.n_skipped + self.packer.skipped,
-            "wire_rows": self.reader.n_rows,
+            "wire_rows": self.reader.n_rows + self.reader.n6_rows,
         }
 
 
@@ -554,7 +591,9 @@ def run_stream_file_distributed(
         # times, padding with all-invalid batches when its queue is dry.
         step6 = None
         rules6_g = None
-        if packed.has_v6 and hasattr(source, "take_v6"):
+        if packed.has_v6 and (
+            hasattr(source, "take_v6") or hasattr(source, "batches6")
+        ):
             from ..parallel.step import make_parallel_step6
 
             r6h = pipeline.ship_ruleset6_host(packed)
@@ -680,7 +719,10 @@ def run_stream_file_distributed(
 
         def pull_v6() -> None:
             # stage source-parsed v6 rows; enqueue each full local chunk
+            # (text sources; wire v6 rows arrive via the phase-2 loop)
             nonlocal buf6, fill6
+            if not hasattr(source, "take_v6"):
+                return
             rows = source.take_v6()
             i = 0
             while i < len(rows):
@@ -862,6 +904,42 @@ def run_stream_file_distributed(
                 if not dist.all_processes_have_data(has):
                     break
                 step_grouped_round(has)
+        # Phase 2 — wire-v2 v6 sections, in collective rounds: every
+        # process steps while ANY still has v6 rows, padding when dry,
+        # so the jitted v6 program's collectives stay aligned.
+        b6fn = getattr(source, "batches6", None)
+        if b6fn is not None and step6 is not None and not aborted:
+            it6 = b6fn(max(0, lines_at_start - source.n4_rows), local_batch)
+            while True:
+                nxt6 = next(it6, None)
+                has6 = nxt6 is not None
+                if not dist.all_processes_have_data(has6):
+                    break
+                if has6:
+                    b6, n_rows6 = nxt6
+                    lines_consumed += n_rows6
+                    meter.tick(n_rows6)
+                else:
+                    b6 = np.zeros(
+                        (pack_mod.WIRE6_COLS, local_batch), dtype=np.uint32
+                    )
+                gb6 = dist.to_global(mesh, b6, P(None, cfg.mesh_axis))
+                state, out = step6(state, rules6_g, gb6, n_chunks)
+                pending.append(out)
+                if len(pending) > 2:
+                    drain(pending.popleft())
+                n_chunks += 1
+                chunks_this_run += 1
+                if (
+                    cfg.checkpoint_every_chunks
+                    and n_chunks - last_snap_chunks >= cfg.checkpoint_every_chunks
+                ):
+                    save_snapshot()
+                    last_snap_chunks = n_chunks
+                if max_chunks is not None and chunks_this_run >= max_chunks:
+                    aborted = True
+                    break
+
         # v6 rows from consumed lines drain collectively on BOTH the
         # normal and aborted exits (same invariant as the stacked drain)
         collective_flush_v6()
@@ -1059,7 +1137,9 @@ def _run_core_impl(
     # leave consumed lines unstepped.
     step6 = None
     dev_rules6 = None
-    if packed.has_v6 and hasattr(source, "take_v6"):
+    if packed.has_v6 and (
+        hasattr(source, "take_v6") or hasattr(source, "batches6")
+    ):
         from ..parallel.step import make_parallel_step6
 
         dev_rules6 = pipeline.ship_ruleset6(packed)
@@ -1155,8 +1235,11 @@ def _run_core_impl(
         n_chunks += 1
 
     def stage_v6() -> None:
-        # pull staged v6 rows from the source; step full chunks
+        # pull staged v6 rows from the source; step full chunks (text
+        # sources only — wire v6 rows arrive via the phase-2 batches6)
         nonlocal buf6, fill6
+        if not hasattr(source, "take_v6"):
+            return
         rows = source.take_v6()
         i = 0
         while i < len(rows):
@@ -1238,6 +1321,26 @@ def _run_core_impl(
     # v6 rows buffered from consumed lines must step for the same reason
     # the grouped buffer drains above (totals already claim those lines)
     flush_v6()
+
+    # Phase 2 — wire-v2 v6 section: the v6 rows of a .rawire input are
+    # stored after every v4 block and consume here, with resume offsets
+    # continuing over the concatenated row stream.
+    b6fn = getattr(source, "batches6", None)
+    if b6fn is not None and step6 is not None and not aborted:
+        skip6 = max(0, lines_at_start - source.n4_rows)
+        for b6, n_rows6 in b6fn(skip6, batch_size):
+            run_chunk6(mesh_lib.shard_batch(mesh, b6, cfg.mesh_axis))
+            lines_consumed += n_rows6
+            chunks_this_run += 1
+            meter.tick(n_rows6)
+            if (
+                cfg.checkpoint_every_chunks
+                and n_chunks - last_snap_chunks >= cfg.checkpoint_every_chunks
+            ):
+                save_snapshot()
+            if max_chunks is not None and chunks_this_run >= max_chunks:
+                aborted = True
+                break
 
     # device_get-based sync, NOT block_until_ready: the remote-tunnel PJRT
     # plugin returns immediately from block_until_ready on shard_map

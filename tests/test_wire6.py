@@ -1,0 +1,144 @@
+"""Wire format v2: the IPv6 section of .rawire files (DESIGN.md).
+
+v4 rows keep the exact v1 bytes (all-v4 corpora still emit v1 files);
+v6 rows append as a 40 B/line second section.  The wire run must be
+bit-equal to the text run over the same corpus.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+from ruleset_analysis_tpu.hostside import aclparse, oracle, pack, synth, wire
+from ruleset_analysis_tpu.runtime.stream import run_stream, run_stream_wire
+
+from tests.test_stream6 import CFG, mixed_lines
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    td = tmp_path_factory.mktemp("wire6")
+    rs = aclparse.parse_asa_config(CFG, "fw1")
+    packed = pack.pack_rulesets([rs])
+    lines = mixed_lines(2000, seed=11)
+    log = td / "logs.txt"
+    log.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    res = oracle.Oracle([rs]).consume(list(lines))
+    return td, packed, rs, lines, str(log), res
+
+
+def run_cfg(**kw):
+    return AnalysisConfig(
+        backend="tpu",
+        batch_size=256,
+        sketch=SketchConfig(cms_width=1 << 12, cms_depth=4, hll_p=8),
+        **kw,
+    )
+
+
+def test_convert_writes_v2_and_counts(corpus, tmp_path):
+    td, packed, rs, lines, log, res = corpus
+    out = str(tmp_path / "logs.rawire")
+    stats = wire.convert_logs(packed, [log], out, native=None)
+    assert stats["parser"] == "python"  # native tier refuses v6 rulesets
+    assert stats["rows"] > 0 and stats["rows6"] > 0
+    assert stats["rows"] + stats["rows6"] == res.lines_matched
+    with open(out, "rb") as f:
+        assert f.read(8) == wire.MAGIC6
+    r = wire.WireReader([out], packed)
+    assert (r.n_rows, r.n6_rows) == (stats["rows"], stats["rows6"])
+    r.close()
+
+
+def test_all_v4_corpus_still_writes_v1(tmp_path):
+    cfg_text = synth.synth_config(n_acls=2, rules_per_acl=8, seed=3)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    t = synth.synth_tuples(packed, 300, seed=3)
+    log = tmp_path / "v4.txt"
+    log.write_text("\n".join(synth.render_syslog(packed, t, seed=3)) + "\n")
+    out = str(tmp_path / "v4.rawire")
+    stats = wire.convert_logs(packed, [str(log)], out)
+    assert "rows6" in stats and stats["rows6"] == 0
+    with open(out, "rb") as f:
+        assert f.read(8) == wire.MAGIC
+
+
+def test_wire_run_equals_text_run(corpus, tmp_path):
+    td, packed, rs, lines, log, res = corpus
+    out = str(tmp_path / "logs.rawire")
+    wire.convert_logs(packed, [log], out)
+    rep_text = run_stream(packed, iter(lines), run_cfg(), topk=5)
+    rep_wire = run_stream_wire(packed, out, run_cfg(), topk=5)
+    hits = lambda r: {  # noqa: E731
+        (e["firewall"], e["acl"], e["index"]): e["hits"]
+        for e in r.per_rule
+        if e["hits"] > 0
+    }
+    assert hits(rep_wire) == hits(rep_text) == dict(res.hits)
+    assert rep_wire.unused == rep_text.unused == res.unused_rules([rs])
+    assert rep_wire.totals["lines_total"] == len(lines)
+    # v6 talkers render real addresses from the wire digest map too
+    talk = rep_wire.talkers.get("fw1 A", [])
+    assert any(":" in ip and not ip.startswith("v6#") for ip, _ in talk) or not any(
+        ":" in ip or ip.startswith("v6#") for ip, _ in talk
+    )
+
+
+def test_wire_crash_resume_across_phase_boundary(corpus, tmp_path):
+    """Resume from a snapshot taken INSIDE the v6 phase must be exact."""
+    td, packed, rs, lines, log, res = corpus
+    out = str(tmp_path / "logs.rawire")
+    wire.convert_logs(packed, [log], out)
+    uninterrupted = run_stream_wire(packed, out, run_cfg(), topk=5)
+
+    # count total chunks first so the crash lands in the v6 phase
+    r = wire.WireReader([out], packed)
+    n4_chunks = (r.n_rows + 255) // 256
+    r.close()
+    ck = str(tmp_path / "ck")
+    crash_cfg = run_cfg(checkpoint_every_chunks=1, checkpoint_dir=ck)
+    run_stream_wire(packed, out, crash_cfg, topk=5, max_chunks=n4_chunks + 1)
+    resumed = run_stream_wire(
+        packed, out,
+        run_cfg(checkpoint_every_chunks=1, checkpoint_dir=ck, resume=True),
+        topk=5,
+    )
+    hits = lambda r: {  # noqa: E731
+        (e["firewall"], e["acl"], e["index"]): e["hits"]
+        for e in r.per_rule
+        if e["hits"] > 0
+    }
+    assert hits(resumed) == hits(uninterrupted) == dict(res.hits)
+    assert resumed.unused == uninterrupted.unused
+
+
+def test_truncated_v6_section_refused(corpus, tmp_path):
+    td, packed, rs, lines, log, res = corpus
+    out = str(tmp_path / "t.rawire")
+    wire.convert_logs(packed, [log], out)
+    import os
+
+    size = os.path.getsize(out)
+    with open(out, "r+b") as f:
+        f.truncate(size - 17)  # cut into the v6 section
+    with pytest.raises(wire.WireFormatError, match="truncated"):
+        wire.WireReader([out], packed)
+
+
+def test_compact_expand6_roundtrip():
+    rng = np.random.default_rng(4)
+    b = np.zeros((pack.TUPLE6_COLS, 128), dtype=np.uint32)
+    for i in (pack.T6_SRC, pack.T6_SRC + 1, pack.T6_SRC + 2, pack.T6_SRC + 3,
+              pack.T6_DST, pack.T6_DST + 1, pack.T6_DST + 2, pack.T6_DST + 3):
+        b[i] = rng.integers(0, 1 << 32, 128, dtype=np.uint32)
+    b[pack.T6_ACL] = rng.integers(0, 1 << 23, 128, dtype=np.uint32)
+    b[pack.T6_PROTO] = rng.integers(0, 256, 128, dtype=np.uint32)
+    b[pack.T6_SPORT] = rng.integers(0, 1 << 16, 128, dtype=np.uint32)
+    b[pack.T6_DPORT] = rng.integers(0, 1 << 16, 128, dtype=np.uint32)
+    b[pack.T6_VALID] = rng.integers(0, 2, 128, dtype=np.uint32)
+    np.testing.assert_array_equal(
+        pack.expand_batch6(pack.compact_batch6(b)), b
+    )
