@@ -26,7 +26,7 @@ from collections.abc import Iterator
 
 import numpy as np
 
-from .pack import PackedRuleset, TUPLE_COLS
+from .pack import PackedRuleset, TUPLE_COLS, TUPLE6_COLS
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "_asaparse.so")
@@ -144,6 +144,22 @@ def _bind(lib: ctypes.CDLL) -> None:
         lib.asa_pack_chunk.restype = ctypes.c_int64
         lib.asa_pack_chunk_mt.argtypes = lib.asa_pack_chunk.argtypes + [ctypes.c_int]
         lib.asa_pack_chunk_mt.restype = ctypes.c_int64
+        # dual-family parse (v6-capable rulesets): v4 plane + TUPLE6 plane
+        lib.asa_pack_chunk2.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_int,
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.asa_pack_chunk2.restype = ctypes.c_int64
         lib.asa_count_lines.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64),
@@ -190,6 +206,22 @@ class NativePacker:
         #: with out-bindings a connection line can emit two rows; sizes
         #: the default pack_lines capacity like LinePacker.pack_parsed
         self._rows_per_line = 2 if packed.bindings_out else 1
+        #: v6-capable ruleset: parse through the dual-family native entry
+        #: and stage v6 rows for the driver's take_v6 side channel
+        self._has_v6 = packed.has_v6
+        self._staged6: list[np.ndarray] = []
+
+    def take_v6(self) -> list:
+        """Drain v6 row arrays staged since the last call ([n, 13] each).
+
+        Only meaningful for v6-capable rulesets; the stream driver pulls
+        this after every batch, exactly as with the Python text source.
+        """
+        out: list = []
+        for a in self._staged6:
+            out.extend(a)  # rows concatenate; consumers re-stack
+        self._staged6 = []
+        return out
 
     def __del__(self):
         h = getattr(self, "_h", None)
@@ -246,12 +278,40 @@ class NativePacker:
                 raise ValueError("out must be C-contiguous")
         n_lines = ctypes.c_int64(0)
         n_valid = ctypes.c_int64(0)
+        ml = max_lines if max_lines is not None else batch_size
+        if self._has_v6:
+            # dual-family entry (single-threaded streaming loop): the v6
+            # plane is sized 2*max_lines so v6 rows never close a batch,
+            # mirroring the Python text source's side buffer
+            cap6 = 2 * ml
+            out6 = np.empty((TUPLE6_COLS, cap6), dtype=np.uint32)
+            n_valid6 = ctypes.c_int64(0)
+            used = self._lib.asa_pack_chunk2(
+                self._h,
+                arg,
+                n,
+                1 if final else 0,
+                ml,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                batch_size,
+                out6.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                cap6,
+                ctypes.byref(n_lines),
+                ctypes.byref(n_valid),
+                ctypes.byref(n_valid6),
+            )
+            del arg
+            if int(n_valid6.value):
+                self._staged6.append(
+                    np.ascontiguousarray(out6[:, : int(n_valid6.value)].T)
+                )
+            return out, int(n_lines.value), int(used)
         used = self._lib.asa_pack_chunk_mt(
             self._h,
             arg,
             n,
             1 if final else 0,
-            max_lines if max_lines is not None else batch_size,
+            ml,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
             batch_size,
             ctypes.byref(n_lines),
@@ -267,6 +327,18 @@ class NativePacker:
         b = batch_size if batch_size is not None else self._rows_per_line * len(lines)
         out, _, _ = self.pack_chunk(data, b, final=True, max_lines=len(lines))
         return np.ascontiguousarray(out.T)
+
+    def pack_lines2(
+        self, lines: list[str], batch_size: int | None = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """LinePacker.pack_lines2-compatible helper (padded row-major pair)."""
+        b4 = self.pack_lines(lines, batch_size)
+        b = b4.shape[0]
+        rows6 = self.take_v6()
+        out6 = np.zeros((b if self._has_v6 else 0, TUPLE6_COLS), dtype=np.uint32)
+        for i, r in enumerate(rows6):
+            out6[i] = r
+        return b4, out6
 
 
 class _ChainedReader:

@@ -590,20 +590,13 @@ def convert_logs(
     """
     from . import fastparse
 
-    text_src = None
-    if packed.has_v6 and (
-        (feed_workers and feed_workers > 1) or native is True
-        or (native is None and fastparse.available())
-    ):
-        # native/feeder tiers are v4-only: explicit requests fail loudly,
-        # auto-select falls back to the Python source (run path twin)
-        if native is True or (feed_workers and feed_workers > 1):
-            raise AnalysisError(
-                "the native parser tier is v4-only but this ruleset has "
-                "IPv6 rules; convert without --parser native / "
-                "--feed-workers (the Python parser handles both families)"
-            )
-        native = False
+    if packed.has_v6 and feed_workers and feed_workers > 1:
+        # the multi-process feeder is v4-only (the in-process native
+        # parser handles v6 via its dual-family entry)
+        raise AnalysisError(
+            "the feeder tier is v4-only but this ruleset has IPv6 rules; "
+            "convert without --feed-workers"
+        )
     if feed_workers and feed_workers > 1:
         if native is False:
             raise ValueError(
@@ -614,18 +607,21 @@ def convert_logs(
         src = ParallelFeeder(packed, log_paths, n_workers=feed_workers)
         packer = src.packer
         batches = src.batches(0, batch_size)
+        take_v6 = None  # feeder tier is v4-only (refused above for v6)
         parser_name = f"native-feeder-x{feed_workers}"
     else:
         use_native = native if native is not None else fastparse.available()
         if use_native:
             packer = fastparse.NativePacker(packed)
             batches = fastparse.batches_from_files(log_paths, packer, batch_size)
+            take_v6 = packer.take_v6 if packed.has_v6 else None
         else:
             from ..runtime.stream import _iter_files, _TextSource
 
             text_src = _TextSource(packed, _iter_files(log_paths))
             packer = text_src.packer
             batches = text_src.batches(0, batch_size)
+            take_v6 = text_src.take_v6 if packed.has_v6 else None
         parser_name = "native" if use_native else "python"
 
     last_skipped = 0
@@ -640,8 +636,8 @@ def convert_logs(
             valid = batch[:, batch[T_VALID] == 1]
             w.add(compact_batch(valid), n_raw, skipped - last_skipped)
             last_skipped = skipped
-            if text_src is not None and packed.has_v6:
-                rows6 = text_src.take_v6()
+            if take_v6 is not None:
+                rows6 = take_v6()
                 if rows6:
                     t6 = np.asarray(rows6, dtype=np.uint32).T
                     w.add6(compact_batch6(t6), 0, 0)

@@ -69,6 +69,16 @@ bool parse_u32(const char*& p, const char* end, uint32_t* out) {
     return true;
 }
 
+inline bool is_hex(char c) {
+    return is_dig(c) || (c >= 'a' && c <= 'f') || (c >= 'A' && c <= 'F');
+}
+inline bool is_addr_char(char c) { return is_hex(c) || c == ':' || c == '.'; }
+inline uint32_t hex_val(char c) {
+    if (is_dig(c)) return (uint32_t)(c - '0');
+    if (c >= 'a' && c <= 'f') return (uint32_t)(c - 'a' + 10);
+    return (uint32_t)(c - 'A' + 10);
+}
+
 // Dotted-quad IPv4 over a [0-9.] run: exactly 4 octets, each 0..255
 // (hostside.aclparse.ip_to_u32 semantics).  Advances p past the run on
 // success; on failure leaves p unspecified and returns false.
@@ -98,6 +108,121 @@ bool parse_ipv4_run(const char*& p, const char* end, uint32_t* out) {
     *out = v;
     p = q;
     return true;
+}
+
+// One parsed address of either family: fam is 4 or 6; v6 addresses carry
+// 4 big-endian uint32 limbs (pack.u128_limbs layout).
+struct Addr {
+    uint32_t fam = 4;
+    uint32_t v4 = 0;
+    uint32_t l[4] = {0, 0, 0, 0};
+};
+
+// Parse [rs, re) — one complete address text run — as an IPv6 literal
+// (RFC 4291 forms: hex groups, one '::' compression, optional embedded
+// trailing dotted quad).  Mirrors the stdlib ipaddress acceptance the
+// Python path delegates to (hostside.aclparse.ip6_to_int): groups are
+// 1-4 hex digits, exactly 8 groups without '::', fewer with, the
+// embedded v4 counts as two groups and may only appear last.
+bool parse_ipv6_text(const char* rs, const char* re, uint32_t limbs[4]) {
+    uint16_t head[8];
+    uint16_t tail[8];
+    int n_head = 0, n_tail = 0;
+    bool compressed = false;
+    const char* p = rs;
+    if (p >= re) return false;
+    if (*p == ':') {
+        // must be a leading '::'
+        if (p + 1 >= re || p[1] != ':') return false;
+        compressed = true;
+        p += 2;
+    }
+    bool want_group = !(compressed && p == re);
+    while (p < re) {
+        // embedded trailing dotted quad? detect a digit run followed by '.'
+        const char* q = p;
+        while (q < re && is_dig(*q)) ++q;
+        if (q > p && q < re && *q == '.') {
+            const char* v4p = p;
+            uint32_t v4;
+            if (!parse_ipv4_run(v4p, re, &v4) || v4p != re) return false;
+            uint16_t* dst = compressed ? tail : head;
+            int& n = compressed ? n_tail : n_head;
+            if (n + 2 > 8) return false;
+            dst[n++] = (uint16_t)(v4 >> 16);
+            dst[n++] = (uint16_t)(v4 & 0xFFFF);
+            p = re;
+            want_group = false;
+            break;
+        }
+        // hex group: 1-4 hex digits
+        uint32_t g = 0;
+        int nd = 0;
+        while (p < re && is_hex(*p) && nd < 5) {
+            g = (g << 4) | hex_val(*p);
+            ++p;
+            ++nd;
+        }
+        if (nd == 0 || nd > 4) return false;
+        uint16_t* dst = compressed ? tail : head;
+        int& n = compressed ? n_tail : n_head;
+        if (n >= 8) return false;
+        dst[n++] = (uint16_t)g;
+        want_group = false;
+        if (p < re) {
+            if (*p != ':') return false;
+            ++p;
+            if (p < re && *p == ':') {
+                if (compressed) return false;  // second '::'
+                compressed = true;
+                ++p;
+                if (p == re) { want_group = false; break; }
+            } else {
+                if (p == re) return false;  // single trailing ':'
+                want_group = true;
+            }
+        }
+    }
+    if (want_group) return false;
+    int total = n_head + n_tail;
+    if (compressed ? total >= 8 : total != 8) return false;
+    uint16_t groups[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    for (int i = 0; i < n_head; ++i) groups[i] = head[i];
+    for (int i = 0; i < n_tail; ++i) groups[8 - n_tail + i] = tail[i];
+    for (int i = 0; i < 4; ++i)
+        limbs[i] = ((uint32_t)groups[2 * i] << 16) | groups[2 * i + 1];
+    return true;
+}
+
+// Parse the maximal [0-9A-Fa-f:.] run at p as an address of either
+// family (the Python regexes capture exactly this class and then parse
+// by ':' presence).  Returns 1 on success (p past the run), 0 when the
+// run is not address-shaped at all (structural failure — caller keeps
+// scanning), -1 when the run IS the address capture but its value is
+// invalid (semantic failure: Python raises inside _addr and the whole
+// line skips with no rescan).
+int parse_addr_run(const char*& p, const char* end, Addr* a) {
+    const char* rs = p;
+    const char* re = rs;
+    bool has_colon = false;
+    while (re < end && is_addr_char(*re)) {
+        has_colon |= (*re == ':');
+        ++re;
+    }
+    if (re == rs) return 0;
+    if (!has_colon) {
+        const char* q = rs;
+        uint32_t v4;
+        if (!parse_ipv4_run(q, re, &v4) || q != re) return -1;
+        a->fam = 4;
+        a->v4 = v4;
+        p = re;
+        return 1;
+    }
+    if (!parse_ipv6_text(rs, re, a->l)) return -1;
+    a->fam = 6;
+    p = re;
+    return 1;
 }
 
 void skip_ws(const char*& p, const char* end) {
@@ -158,62 +283,76 @@ struct Parsed {
     const char* if0; const char* if1;     // ingress interface (in binding)
     const char* eif0 = nullptr;           // egress interface (out binding);
     const char* eif1 = nullptr;           // 302013/302015 only
-    uint32_t proto, src, sport, dst, dport;
+    uint32_t proto, sport, dport;
+    Addr src, dst;                        // either family; must agree
 };
 
-// "if/ip(port)" endpoint of 106100: iface is the shortest prefix whose
-// '/' is followed by a parseable "ip(port)".
-bool endpoint_slash_paren(const char*& p, const char* end,
-                          const char** if0, const char** if1,
-                          uint32_t* ip, uint32_t* port) {
+// "if/ADDR(port)" endpoint of 106100: iface is the shortest prefix whose
+// '/' is followed by a parseable "ADDR(port)" of either family.
+// Returns 1 ok / 0 structural mismatch (caller keeps scanning) /
+// -1 semantic failure (address text captured but invalid — Python raises
+// inside _addr and the whole line skips, so callers must abort).
+int endpoint_slash_paren(const char*& p, const char* end,
+                         const char** if0, const char** if1,
+                         Addr* addr, uint32_t* port) {
     const char* t0; const char* t1;
     const char* q = p;
-    if (!token(q, end, &t0, &t1)) return false;
+    if (!token(q, end, &t0, &t1)) return 0;
     for (const char* s = t0; s < t1; ++s) {
         if (*s != '/') continue;
-        const char* c = s + 1;
-        uint32_t ipv;
-        if (!parse_ipv4_run(c, t1, &ipv)) continue;
-        if (c >= t1 || *c != '(') continue;
-        ++c;
-        uint32_t pv;
-        if (!parse_u32(c, t1, &pv)) continue;
-        if (c >= t1 || *c != ')') continue;
-        ++c;
         if (s == t0) continue;  // iface must be non-empty
-        *if0 = t0; *if1 = s; *ip = ipv; *port = pv;
-        p = c;  // just past ')': an extra paren group may follow unspaced
-        return true;
+        const char* c = s + 1;
+        // structure first: maximal addr run, then '(digits)'
+        const char* re = c;
+        while (re < t1 && is_addr_char(*re)) ++re;
+        if (re == c || re >= t1 || *re != '(') continue;
+        const char* pc = re + 1;
+        uint32_t pv;
+        if (!parse_u32(pc, t1, &pv)) continue;
+        if (pc >= t1 || *pc != ')') continue;
+        ++pc;
+        Addr a;
+        const char* ac = c;
+        if (parse_addr_run(ac, re, &a) != 1 || ac != re) return -1;
+        *if0 = t0; *if1 = s; *addr = a; *port = pv;
+        p = pc;  // just past ')': an extra paren group may follow unspaced
+        return 1;
     }
-    return false;
+    return 0;
 }
 
-// "if:ip[/port]" endpoint of 106023 (port optional, defaults 0) and
-// 302013 (port required).
-bool endpoint_colon(const char*& p, const char* end, bool port_required,
-                    const char** if0, const char** if1,
-                    uint32_t* ip, uint32_t* port) {
+// "if:ADDR[/port]" endpoint of 106023 (port optional, defaults 0) and
+// 302013 (port required).  Same 1/0/-1 contract as endpoint_slash_paren.
+int endpoint_colon(const char*& p, const char* end, bool port_required,
+                   const char** if0, const char** if1,
+                   Addr* addr, uint32_t* port) {
     const char* t0; const char* t1;
     const char* q = p;
-    if (!token(q, end, &t0, &t1)) return false;
+    if (!token(q, end, &t0, &t1)) return 0;
     for (const char* s = t0; s < t1; ++s) {
         if (*s != ':') continue;
+        if (s == t0) continue;
         const char* c = s + 1;
-        uint32_t ipv;
-        if (!parse_ipv4_run(c, t1, &ipv)) continue;
+        const char* re = c;
+        while (re < t1 && is_addr_char(*re)) ++re;
+        if (re == c) continue;
         uint32_t pv = 0;
-        if (c < t1 && *c == '/') {
-            const char* c2 = c + 1;
-            if (parse_u32(c2, t1, &pv)) c = c2; else if (port_required) continue;
+        const char* after = re;
+        if (after < t1 && *after == '/') {
+            const char* c2 = after + 1;
+            if (parse_u32(c2, t1, &pv)) after = c2;
+            else if (port_required) continue;
         } else if (port_required) {
             continue;
         }
-        if (s == t0) continue;
-        *if0 = t0; *if1 = s; *ip = ipv; *port = pv;
-        p = c;
-        return true;
+        Addr a;
+        const char* ac = c;
+        if (parse_addr_run(ac, re, &a) != 1 || ac != re) return -1;
+        *if0 = t0; *if1 = s; *addr = a; *port = pv;
+        p = after;
+        return 1;
     }
-    return false;
+    return 0;
 }
 
 bool parse_106100(const char* b, const char* be, Parsed* out) {
@@ -235,8 +374,10 @@ bool parse_106100(const char* b, const char* be, Parsed* out) {
         if (!skip_ws1(p, be)) continue;
         if (!token(p, be, &pr0, &pr1)) continue;
         if (!skip_ws1(p, be)) continue;
-        const char* i0; const char* i1; uint32_t sip, spo;
-        if (!endpoint_slash_paren(p, be, &i0, &i1, &sip, &spo)) continue;
+        const char* i0; const char* i1; Addr sa; uint32_t spo;
+        int rc = endpoint_slash_paren(p, be, &i0, &i1, &sa, &spo);
+        if (rc < 0) return false;  // invalid address text: line skips
+        if (!rc) continue;
         if (p < be && *p == '(') {  // optional "(...)" (e.g. identity info)
             const char* c = (const char*)memchr(p, ')', be - p);
             if (c) p = c + 1;
@@ -245,16 +386,19 @@ bool parse_106100(const char* b, const char* be, Parsed* out) {
         if (p + 1 >= be || p[0] != '-' || p[1] != '>') continue;
         p += 2;
         skip_ws(p, be);
-        const char* j0; const char* j1; uint32_t dip, dpo;
-        if (!endpoint_slash_paren(p, be, &j0, &j1, &dip, &dpo)) continue;
+        const char* j0; const char* j1; Addr da; uint32_t dpo;
+        rc = endpoint_slash_paren(p, be, &j0, &j1, &da, &dpo);
+        if (rc < 0) return false;
+        if (!rc) continue;
+        if (sa.fam != da.fam) return false;  // mixed-family line: skip
         uint32_t proto = proto_num(pr0, pr1);
         // ICMP/ICMPv6: parenthesised values are type/code; type -> dport,
         // sport=0 (58 added with the v6 data model; mirrors syslog.py)
         if (proto == 1 || proto == 58) { dpo = spo; spo = 0; }
         out->acl0 = a0; out->acl1 = a1;
         out->if0 = i0; out->if1 = i1;
-        out->proto = proto; out->src = sip; out->sport = spo;
-        out->dst = dip; out->dport = dpo;
+        out->proto = proto; out->src = sa; out->sport = spo;
+        out->dst = da; out->dport = dpo;
         return true;
     }
 }
@@ -272,13 +416,18 @@ bool parse_106023(const char* b, const char* be, Parsed* out) {
         if (!skip_ws1(p, be)) continue;
         if (!token(p, be, &s0, &s1) || !tok_eq(s0, s1, "src")) continue;
         if (!skip_ws1(p, be)) continue;
-        const char* i0; const char* i1; uint32_t sip, spo;
-        if (!endpoint_colon(p, be, false, &i0, &i1, &sip, &spo)) continue;
+        const char* i0; const char* i1; Addr sa; uint32_t spo;
+        int rc = endpoint_colon(p, be, false, &i0, &i1, &sa, &spo);
+        if (rc < 0) return false;
+        if (!rc) continue;
         if (!skip_ws1(p, be)) continue;
         if (!token(p, be, &s0, &s1) || !tok_eq(s0, s1, "dst")) continue;
         if (!skip_ws1(p, be)) continue;
-        const char* j0; const char* j1; uint32_t dip, dpo;
-        if (!endpoint_colon(p, be, false, &j0, &j1, &dip, &dpo)) continue;
+        const char* j0; const char* j1; Addr da; uint32_t dpo;
+        rc = endpoint_colon(p, be, false, &j0, &j1, &da, &dpo);
+        if (rc < 0) return false;
+        if (!rc) continue;
+        if (sa.fam != da.fam) return false;
         // optional " (type T, code C)"
         bool have_type = false;
         uint32_t icmp_type = 0, tmp;
@@ -326,8 +475,8 @@ bool parse_106023(const char* b, const char* be, Parsed* out) {
         if ((proto == 1 || proto == 58) && have_type) { dpo = icmp_type; spo = 0; }
         out->acl0 = a0; out->acl1 = a1;
         out->if0 = i0; out->if1 = i1;
-        out->proto = proto; out->src = sip; out->sport = spo;
-        out->dst = dip; out->dport = dpo;
+        out->proto = proto; out->src = sa; out->sport = spo;
+        out->dst = da; out->dport = dpo;
         return true;
     }
 }
@@ -359,8 +508,10 @@ bool parse_302013(const char* b, const char* be, Parsed* out) {
         if (!skip_ws1(p, be)) continue;
         if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "for")) continue;
         if (!skip_ws1(p, be)) continue;
-        const char* ia0; const char* ia1; uint32_t ipa, poa;
-        if (!endpoint_colon(p, be, true, &ia0, &ia1, &ipa, &poa)) continue;
+        const char* ia0; const char* ia1; Addr aa; uint32_t poa;
+        int rc = endpoint_colon(p, be, true, &ia0, &ia1, &aa, &poa);
+        if (rc < 0) return false;
+        if (!rc) continue;
         skip_ws(p, be);
         if (p < be && *p == '(') {
             const char* c = (const char*)memchr(p, ')', be - p);
@@ -369,8 +520,11 @@ bool parse_302013(const char* b, const char* be, Parsed* out) {
         skip_ws(p, be);
         if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "to")) continue;
         if (!skip_ws1(p, be)) continue;
-        const char* ib0; const char* ib1; uint32_t ipb, pob;
-        if (!endpoint_colon(p, be, true, &ib0, &ib1, &ipb, &pob)) continue;
+        const char* ib0; const char* ib1; Addr ab; uint32_t pob;
+        rc = endpoint_colon(p, be, true, &ib0, &ib1, &ab, &pob);
+        if (rc < 0) return false;
+        if (!rc) continue;
+        if (aa.fam != ab.fam) return false;
         out->acl0 = nullptr; out->acl1 = nullptr;
         // inbound: initiated at A (src=A, ingress=ifA, egress=ifB);
         // outbound: initiated at B (src=B, ingress=ifB, egress=ifA).
@@ -378,30 +532,34 @@ bool parse_302013(const char* b, const char* be, Parsed* out) {
         if (inbound) {
             out->if0 = ia0; out->if1 = ia1;
             out->eif0 = ib0; out->eif1 = ib1;
-            out->src = ipa; out->sport = poa; out->dst = ipb; out->dport = pob;
+            out->src = aa; out->sport = poa; out->dst = ab; out->dport = pob;
         } else {
             out->if0 = ib0; out->if1 = ib1;
             out->eif0 = ia0; out->eif1 = ia1;
-            out->src = ipb; out->sport = pob; out->dst = ipa; out->dport = poa;
+            out->src = ab; out->sport = pob; out->dst = aa; out->dport = poa;
         }
         out->proto = proto;
         return true;
     }
 }
 
-// "ip/port" endpoint of the 106001/106006/106015 family ("from A/p to
-// B/q"): a bare dotted quad, '/', decimal port — no interface prefix.
-bool endpoint_bare(const char*& p, const char* end, uint32_t* ip, uint32_t* port) {
-    const char* q = p;
-    uint32_t ipv;
-    if (!parse_ipv4_run(q, end, &ipv)) return false;
-    if (q >= end || *q != '/') return false;
-    ++q;
+// "ADDR/port" endpoint of the 106001/106006/106015 family ("from A/p to
+// B/q"): a bare address of either family, '/', decimal port — no
+// interface prefix.  Same 1/0/-1 contract as the other endpoints.
+int endpoint_bare(const char*& p, const char* end, Addr* addr, uint32_t* port) {
+    const char* re = p;
+    while (re < end && is_addr_char(*re)) ++re;
+    if (re == p) return 0;
+    if (re >= end || *re != '/') return 0;
+    const char* q = re + 1;
     uint32_t pv;
-    if (!parse_u32(q, end, &pv)) return false;
-    *ip = ipv; *port = pv;
+    if (!parse_u32(q, end, &pv)) return 0;
+    Addr a;
+    const char* ac = p;
+    if (parse_addr_run(ac, re, &a) != 1 || ac != re) return -1;
+    *addr = a; *port = pv;
     p = q;
-    return true;
+    return 1;
 }
 
 // First "on interface <if>" at or after p (the 106001/106015 regexes use
@@ -468,13 +626,18 @@ bool parse_106001_like(const char* b, const char* be,
         }
         if (!lead_ok) continue;
         if (!skip_ws1(p, be)) continue;
-        uint32_t sip, spo;
-        if (!endpoint_bare(p, be, &sip, &spo)) continue;
+        Addr sa; uint32_t spo;
+        int rc = endpoint_bare(p, be, &sa, &spo);
+        if (rc < 0) return false;
+        if (!rc) continue;
         if (!skip_ws1(p, be)) continue;
         if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "to")) continue;
         if (!skip_ws1(p, be)) continue;
-        uint32_t dip, dpo;
-        if (!endpoint_bare(p, be, &dip, &dpo)) continue;
+        Addr da; uint32_t dpo;
+        rc = endpoint_bare(p, be, &da, &dpo);
+        if (rc < 0) return false;
+        if (!rc) continue;
+        if (sa.fam != da.fam) return false;
         const char* i0; const char* i1;
         if (need_flags) {
             if (!skip_ws1(p, be)) continue;
@@ -492,7 +655,7 @@ bool parse_106001_like(const char* b, const char* be,
         out->acl0 = nullptr; out->acl1 = nullptr;
         out->if0 = i0; out->if1 = i1;
         out->proto = proto;
-        out->src = sip; out->sport = spo; out->dst = dip; out->dport = dpo;
+        out->src = sa; out->sport = spo; out->dst = da; out->dport = dpo;
         return true;
     }
 }
@@ -511,7 +674,9 @@ bool parse_106001_like(const char* b, const char* be,
 // msgid or a failed body parse means the line is skipped, with no retry
 // against later markers.  Only malformed markers keep the scan going.
 int handle_line(LocalCtx* pk, const char* ls, const char* le,
-                uint32_t* out, int64_t cap, int64_t row) {
+                uint32_t* out, int64_t cap, int64_t row,
+                uint32_t* out6 = nullptr, int64_t cap6 = 0,
+                int64_t* row6 = nullptr) {
     const char* pos = ls;
     const char* msgid = nullptr;
     const char* body = nullptr;
@@ -599,13 +764,32 @@ int handle_line(LocalCtx* pk, const char* ls, const char* le,
         }
     }
     if (n_gids == 0) return 0;
+    if (pr.src.fam == 6) {
+        // v6 line: rows land in the [TUPLE6_COLS=13, cap6] side plane
+        // (mirrors LinePacker.pack_parsed2 / _TextSource staging); a v6
+        // line against a pure-v4 ruleset is a counted skip
+        if (!out6 || !row6) return 0;
+        int64_t r6 = *row6;
+        if (r6 + n_gids > cap6) return -1;
+        for (int g = 0; g < n_gids; ++g, ++r6) {
+            out6[0 * cap6 + r6] = gids[g];
+            out6[1 * cap6 + r6] = pr.proto;
+            for (int i = 0; i < 4; ++i) out6[(2 + i) * cap6 + r6] = pr.src.l[i];
+            out6[6 * cap6 + r6] = pr.sport;
+            for (int i = 0; i < 4; ++i) out6[(7 + i) * cap6 + r6] = pr.dst.l[i];
+            out6[11 * cap6 + r6] = pr.dport;
+            out6[12 * cap6 + r6] = 1;
+        }
+        *row6 = r6;
+        return n_gids;
+    }
     if (row + n_gids > cap) return -1;  // close the batch; line unconsumed
     for (int g = 0; g < n_gids; ++g, ++row) {
         out[0 * cap + row] = gids[g];
         out[1 * cap + row] = pr.proto;
-        out[2 * cap + row] = pr.src;
+        out[2 * cap + row] = pr.src.v4;
         out[3 * cap + row] = pr.sport;
-        out[4 * cap + row] = pr.dst;
+        out[4 * cap + row] = pr.dst.v4;
         out[5 * cap + row] = pr.dport;
         out[6 * cap + row] = 1;
     }
@@ -819,6 +1003,51 @@ int64_t asa_pack_chunk(void* h, const char* buf, int64_t len, int final_,
                        int64_t* n_lines_out, int64_t* n_valid_out) {
     return asa_pack_chunk_mt(h, buf, len, final_, max_lines, out, cap,
                              n_lines_out, n_valid_out, 1);
+}
+
+// Dual-family chunk parse (v6-capable rulesets): v4 rows pack into the
+// [TUPLE_COLS, cap] plane exactly as asa_pack_chunk, v6 rows into the
+// [13, cap6] TUPLE6 plane (limb layout, pack.py).  Single-threaded
+// streaming loop — the parity reference; callers size cap6 >= 2 *
+// max_lines so the v6 side never closes a batch (mirrors the Python
+// _TextSource, whose v6 rows ride a side buffer and never close a
+// batch either).  Returns bytes consumed.
+int64_t asa_pack_chunk2(void* h, const char* buf, int64_t len, int final_,
+                        int64_t max_lines, uint32_t* out, int64_t cap,
+                        uint32_t* out6, int64_t cap6,
+                        int64_t* n_lines_out, int64_t* n_valid_out,
+                        int64_t* n_valid6_out) {
+    Packer* pk = (Packer*)h;
+    const char* end = buf + len;
+    LocalCtx cx{&pk->resolve, {}};
+    const char* p = buf;
+    int64_t lines = 0, valid = 0, valid6 = 0;
+    int64_t parsed = 0, skipped = 0;
+    while (p < end && lines < max_lines) {
+        const char* nl = (const char*)memchr(p, '\n', end - p);
+        const char* le = nl ? nl : end;
+        if (!nl && !final_) break;  // incomplete tail line
+        int64_t v6_before = valid6;
+        int n = handle_line(&cx, p, le, out, cap, valid, out6, cap6, &valid6);
+        if (n < 0) break;  // rows don't fit: close batch, keep line
+        if (n == 0) ++skipped;
+        else {
+            parsed += n;
+            if (valid6 == v6_before) valid += n;  // v4 rows advanced
+        }
+        ++lines;
+        p = nl ? nl + 1 : end;
+    }
+    pk->parsed += parsed;
+    pk->skipped += skipped;
+    zero_tail(out, cap, valid);
+    for (int64_t c = 0; c < 13; ++c)
+        memset(out6 + c * cap6 + valid6, 0,
+               (size_t)(cap6 - valid6) * sizeof(uint32_t));
+    *n_lines_out = lines;
+    *n_valid_out = valid;
+    *n_valid6_out = valid6;
+    return p - buf;
 }
 
 // Plain newline count (streaming buffer bookkeeping; memchr is ~5-10x

@@ -363,9 +363,24 @@ class _FileSource:
 
         self.packer = fastparse.NativePacker(packed)
         self._paths = paths
+        self._has_v6 = packed.has_v6
+        self.v6_digests: dict[int, int] = {}
 
     def set_counts(self, parsed: int, skipped: int) -> None:
         self.packer.set_counts(parsed, skipped)
+
+    def take_v6(self) -> list:
+        """v6 rows the native parser staged (driver side channel)."""
+        rows = self.packer.take_v6()
+        if rows:
+            dig = self.v6_digests
+            cap = _TextSource.V6_DIGEST_CAP
+            for r in rows:
+                if len(dig) >= cap:
+                    break
+                src = pack_mod.limbs_u128(*r[pack_mod.T6_SRC:pack_mod.T6_SRC + 4])
+                dig.setdefault(pack_mod.fold_src32_host(src), src)
+        return rows
 
     def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
         from ..hostside import fastparse
@@ -445,20 +460,18 @@ def run_stream_file(
     if isinstance(paths, str):
         paths = [paths]
     use_native = native if native is not None else fastparse.available()
-    if packed.has_v6 and (use_native or (feed_workers and feed_workers > 1)):
-        # The native parser/feeder tier is v4-only; against a v6-capable
-        # ruleset it would silently count v6 traffic as skipped instead of
-        # analyzing it.  Auto-select falls back to the Python text path;
-        # an EXPLICIT native/feeder request fails loudly.
-        if native is True or (feed_workers and feed_workers > 1):
-            from ..errors import AnalysisError
+    if packed.has_v6 and feed_workers and feed_workers > 1:
+        # The multi-process feeder is v4-only; against a v6-capable
+        # ruleset it would silently count v6 traffic as skipped instead
+        # of analyzing it.  (The in-process native parser IS v6-capable
+        # via its dual-family entry point.)
+        from ..errors import AnalysisError
 
-            raise AnalysisError(
-                "the native parser tier is v4-only but this ruleset has "
-                "IPv6 rules; run without --parser native / --feed-workers "
-                "(the Python parser handles both families)"
-            )
-        use_native = False
+        raise AnalysisError(
+            "the feeder tier is v4-only but this ruleset has IPv6 rules; "
+            "run without --feed-workers (native and Python parsers both "
+            "handle v6)"
+        )
     if feed_workers and feed_workers > 1:
         if native is False:
             from ..errors import AnalysisError
@@ -532,19 +545,8 @@ def run_stream_file_distributed(
     if n_wire:
         source = _WireFileSource(packed, local_paths)
     else:
-        explicit_native = native is True
         if native is None:
             native = fastparse.available()
-        if packed.has_v6 and native:
-            # native parse tier is v4-only (see run_stream_file): explicit
-            # requests fail loudly, auto-select falls back to Python
-            if explicit_native:
-                raise AnalysisError(
-                    "the native parser tier is v4-only but this ruleset "
-                    "has IPv6 rules; drop native=True (the Python parser "
-                    "handles both families)"
-                )
-            native = False
         source = _FileSource(packed, local_paths) if native else _TextSource(
             packed, _iter_files(local_paths)
         )

@@ -129,17 +129,23 @@ def test_crash_resume_bit_identity_with_v6(corpus, tmp_path):
     assert resumed.unused == uninterrupted.unused
 
 
-def test_native_parser_refused_loudly_for_v6_rulesets(corpus, tmp_path):
+def test_native_parser_analyzes_v6_corpora(corpus, tmp_path):
+    """The native parse tier handles v6 via its dual-family entry; the
+    multi-process feeder remains v4-only and refuses loudly."""
     packed, rs, lines, res = corpus
     p = tmp_path / "logs.txt"
     p.write_text("\n".join(lines) + "\n")
     from ruleset_analysis_tpu.hostside import fastparse
 
     if fastparse.available():
-        with pytest.raises(AnalysisError, match="v4-only"):
-            run_stream_file(packed, str(p), run_cfg(), native=True)
-    # auto-select falls back to the Python path and analyzes everything
-    rep = run_stream_file(packed, str(p), run_cfg(), topk=5)
+        rep_native = run_stream_file(
+            packed, str(p), run_cfg(), native=True, topk=5
+        )
+        assert report_hits(rep_native) == dict(res.hits)
+        assert rep_native.unused == res.unused_rules([rs])
+        with pytest.raises(AnalysisError, match="feeder"):
+            run_stream_file(packed, str(p), run_cfg(), feed_workers=2)
+    rep = run_stream_file(packed, str(p), run_cfg(), native=False, topk=5)
     assert report_hits(rep) == dict(res.hits)
 
 
@@ -182,3 +188,82 @@ def test_synth_unified_corpus_end_to_end():
     assert report_hits(rep) == dict(res.hits)
     assert rep.unused == res.unused_rules([rs])
     assert rep.totals["lines_matched"] == res.lines_matched
+
+
+@pytest.mark.parametrize("seed", [2, 8])
+def test_native_python_v6_differential(seed):
+    """Python LinePacker vs native parser: bit-identical dual-family packs."""
+    from ruleset_analysis_tpu.hostside import fastparse, synth
+
+    if not fastparse.available():
+        pytest.skip("no native toolchain")
+    cfg_text = synth.synth_config(
+        n_acls=3, rules_per_acl=10, seed=seed, v6_fraction=0.4
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    t4 = synth.synth_tuples(packed, 400, seed=seed)
+    t6 = synth.synth_tuples6(packed, 300, seed=seed)
+    lines = synth.render_syslog(packed, t4, seed=seed) + synth.render_syslog6(
+        packed, t6, seed=seed + 1
+    )
+    rng = random.Random(seed)
+    rng.shuffle(lines)
+    py = pack.LinePacker(packed)
+    ref4, ref6 = py.pack_lines2(lines, batch_size=2 * len(lines))
+    nat = fastparse.NativePacker(packed)
+    got4, got6 = nat.pack_lines2(lines, batch_size=2 * len(lines))
+    np.testing.assert_array_equal(ref4, got4)
+    np.testing.assert_array_equal(ref6, got6)
+    assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
+
+
+V6_EDGE_LINES = [
+    # valid compressions and embedded v4 forms
+    "Jul 29 0 fw1 : %ASA-6-106100: access-list A permitted tcp i/::1(1) -> o/::(2) hit",
+    "Jul 29 0 fw1 : %ASA-6-106100: access-list A permitted tcp "
+    "i/1:2:3:4:5:6:7:8(1) -> o/::ffff:10.0.0.5(2) hit",
+    "Jul 29 0 fw1 : %ASA-6-106100: access-list A permitted tcp "
+    "i/1:2:3:4:5:6:1.2.3.4(1) -> o/fe80::(2) hit",
+    # invalid: too many groups / double '::' / bad embedded v4 / stray ':'
+    "Jul 29 0 fw1 : %ASA-6-106100: access-list A permitted tcp "
+    "i/1:2:3:4:5:6:7:8:9(1) -> o/::1(2) hit",
+    "Jul 29 0 fw1 : %ASA-6-106100: access-list A permitted tcp "
+    "i/1::2::3(1) -> o/::1(2) hit",
+    "Jul 29 0 fw1 : %ASA-6-106100: access-list A permitted tcp "
+    "i/::ffff:1.2.3.999(1) -> o/::1(2) hit",
+    "Jul 29 0 fw1 : %ASA-6-106100: access-list A permitted tcp "
+    "i/1:2:(1) -> o/::1(2) hit",
+    "Jul 29 0 fw1 : %ASA-6-106100: access-list A permitted tcp "
+    "i/12345::1(1) -> o/::1(2) hit",
+    # mixed family: skipped by both parsers
+    "Jul 29 0 fw1 : %ASA-6-106100: access-list A permitted tcp "
+    "i/::1(1) -> o/10.0.0.5(2) hit",
+    # 106023 / 302013 / 106001 v6 shapes
+    'Jul 29 0 fw1 : %ASA-4-106023: Deny tcp src inside:2001:db8::9/100 '
+    'dst outside:2001:db8:1::5/200 by access-group "A" [0x0]',
+    "Jul 29 0 fw1 : %ASA-6-302013: Built inbound TCP connection 9 for "
+    "outside:2001:db8::7/1000 (2001:db8::7/1000) to inside:2001:db8::8/80 "
+    "(2001:db8::8/80)",
+    "Jul 29 0 fw1 : %ASA-2-106001: Inbound TCP connection denied from "
+    "2001:db8::9/5555 to 2001:db8:1::5/443 flags SYN on interface outside",
+    # icmp6 with type/code parens
+    "Jul 29 0 fw1 : %ASA-6-106100: access-list A permitted icmp6 "
+    "i/2001:db8::9(128) -> o/2001:db8::5(0) hit-cnt 1",
+]
+
+
+def test_native_python_v6_edge_lines_bit_identical():
+    from ruleset_analysis_tpu.hostside import fastparse
+
+    if not fastparse.available():
+        pytest.skip("no native toolchain")
+    rs = aclparse.parse_asa_config(CFG, "fw1")
+    packed = pack.pack_rulesets([rs])
+    py = pack.LinePacker(packed)
+    ref4, ref6 = py.pack_lines2(V6_EDGE_LINES, batch_size=32)
+    nat = fastparse.NativePacker(packed)
+    got4, got6 = nat.pack_lines2(V6_EDGE_LINES, batch_size=32)
+    np.testing.assert_array_equal(ref4, got4)
+    np.testing.assert_array_equal(ref6, got6)
+    assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
