@@ -74,7 +74,7 @@ run_headline() {
             > "$BANK/headline.json" 2> "$BANK/headline.log" \
             && grep -q '"platform": "tpu"' "$BANK/headline.json"; then
         cp "$BANK/headline.json" BENCH_r05_local.json
-        touch "$BANK/headline.done"
+        echo done > "$BANK/headline.done"
         echo "$(date -u +%T) banked headline (tpu)" >&2
         return 0
     fi
