@@ -898,6 +898,98 @@ def bench_convert() -> dict:
     }
 
 
+def bench_v6() -> dict:
+    """IPv6 step cost: the lexicographic limb predicate vs the v4 step.
+
+    DESIGN.md's v6 extension predicts ~1.5x step cost (3x the
+    address-compare FLOPs on a step whose match is ~22% of time); this
+    config measures the actual per-line ratio on device, over a unified
+    ruleset with comparable expanded row counts per family, plus a
+    device-vs-host correctness check of the v6 counts path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+    from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+    from ruleset_analysis_tpu.models import pipeline
+    from ruleset_analysis_tpu.ops.match6 import match_keys6
+
+    rs = aclparse.parse_asa_config(
+        synth.synth_config(n_acls=4, rules_per_acl=64, seed=7, v6_fraction=0.5),
+        "fw0",
+    )
+    packed = pack.pack_rulesets([rs])
+    b = 1 << 19
+    cfg = AnalysisConfig(batch_size=b, sketch=SketchConfig(cms_width=1 << 14, cms_depth=4))
+    topk_k = cfg.sketch.topk_chunk_candidates
+
+    # v4 leg
+    state = pipeline.init_state(packed.n_keys, cfg)
+    rules4 = pipeline.ship_ruleset(packed)
+    feeds4_np = [np.ascontiguousarray(_tuples(packed, b, seed=i).T) for i in range(2)]
+    valid4 = [int(f[pack.T_VALID].sum()) for f in feeds4_np]
+    feeds4 = [jnp.asarray(f) for f in feeds4_np]
+    step4 = jax.jit(
+        functools.partial(
+            pipeline.analysis_step, n_keys=packed.n_keys, topk_k=topk_k
+        ),
+        donate_argnums=(0,),
+    )
+    iters = 10
+    state, dt4 = _time_steps(step4, state, rules4, feeds4, iters, valid4)
+
+    # v6 leg (same state/key space — the production arrangement)
+    rules6 = pipeline.ship_ruleset6(packed)
+    feeds6_np = [
+        np.ascontiguousarray(synth.synth_tuples6(packed, b, seed=i).T)
+        for i in range(2)
+    ]
+    valid6 = [int(f[pack.T6_VALID].sum()) for f in feeds6_np]
+    feeds6 = [jnp.asarray(f) for f in feeds6_np]
+    step6 = jax.jit(
+        functools.partial(
+            pipeline.analysis_step6, n_keys=packed.n_keys, topk_k=topk_k
+        ),
+        donate_argnums=(0,),
+    )
+    state, dt6 = _time_steps(step6, state, rules6, feeds6, iters, valid6)
+
+    # correctness: v6 counts == host bincount of device-matched keys
+    t6 = synth.synth_tuples6(packed, 4096, seed=99)
+    b6 = jnp.asarray(np.ascontiguousarray(t6.T))
+    cols6, _ = pipeline.batch_cols6(b6)
+    keys6 = np.asarray(match_keys6(cols6, rules6.rules6, rules6.deny_key))
+    chk = pipeline.init_state(packed.n_keys, cfg)
+    chk, _ = pipeline.analysis_step6(
+        chk, rules6, b6, n_keys=packed.n_keys, topk_k=topk_k
+    )
+    want = np.bincount(
+        keys6[t6[:, pack.T6_VALID] == 1], minlength=packed.n_keys
+    )
+    v6_ok = bool((np.asarray(chk.counts_lo) == want.astype(np.uint32)).all())
+
+    n_dev = len(jax.devices())
+    v4_rate = iters * b / dt4 / n_dev
+    v6_rate = iters * b / dt6 / n_dev
+    return {
+        "metric": "config_v6_step_lines_per_sec_per_chip",
+        "value": round(v6_rate, 1),
+        "unit": "lines/sec/chip",
+        "vs_baseline": round(v6_rate / (1e9 / 60 / 8), 4),
+        "detail": {
+            "batch": b,
+            "iters": iters,
+            "v4_rows": int(packed.rules.shape[0]),
+            "v6_rows": int(packed.rules6.shape[0]),
+            "v4_lines_per_sec_per_chip": round(v4_rate, 1),
+            "v6_relative_cost": round(v4_rate / v6_rate, 3),
+            "design_predicted_cost": 1.5,
+            "v6_counts_ok": v6_ok,
+        },
+    }
+
+
 BENCHES = {
     "stage": bench_stage,
     "exact": bench_exact,
@@ -909,6 +1001,7 @@ BENCHES = {
     "recall": bench_recall,
     "e2e": bench_e2e,
     "convert": bench_convert,
+    "v6": bench_v6,
 }
 
 
