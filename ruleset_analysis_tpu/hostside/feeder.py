@@ -37,7 +37,7 @@ from multiprocessing import shared_memory
 import numpy as np
 
 from . import fastparse
-from .pack import PackedRuleset, TUPLE_COLS
+from .pack import PackedRuleset, TUPLE_COLS, TUPLE6_COLS
 
 #: Coordinator read granularity while scanning for batch boundaries.
 SCAN_BLOCK = 8 << 20
@@ -125,11 +125,11 @@ def _scan_batches(paths: list[str], batch_size: int, skip_lines: int):
         )
 
 
-def _worker(packed_blob, paths, rows_cap, shm_name, task_q, done_q):
+def _worker(packed_blob, paths, rows_cap, rows6_cap, shm_name, task_q, done_q):
     packed = pickle.loads(packed_blob)
     packer = fastparse.NativePacker(packed)
     shm = shared_memory.SharedMemory(name=shm_name)
-    slot_words = TUPLE_COLS * rows_cap
+    slot_words = TUPLE_COLS * rows_cap + TUPLE6_COLS * rows6_cap
     files = {}
     try:
         while True:
@@ -152,11 +152,25 @@ def _worker(packed_blob, paths, rows_cap, shm_name, task_q, done_q):
                     data, rows_cap, final=True, max_lines=n_lines, n_threads=1,
                     out=out,
                 )
+                n6 = 0
+                if rows6_cap:
+                    # v6 rows the dual-family parse staged for this range
+                    # ride the slot's second plane (input order preserved:
+                    # the coordinator attributes them when idx yields)
+                    rows6 = packer.take_v6()
+                    n6 = len(rows6)
+                    if n6:
+                        plane6 = np.ndarray(
+                            (TUPLE6_COLS, rows6_cap), dtype=np.uint32,
+                            buffer=shm.buf,
+                            offset=4 * (slot * slot_words + TUPLE_COLS * rows_cap),
+                        )
+                        plane6[:, :n6] = np.stack(rows6).T
             except Exception as e:  # forward instead of dying silently
                 done_q.put(("error", idx, f"{type(e).__name__}: {e}"))
                 return
             done_q.put(
-                (idx, slot, lines, packer.parsed - p0, packer.skipped - s0)
+                (idx, slot, lines, packer.parsed - p0, packer.skipped - s0, n6)
             )
     finally:
         for f in files.values():
@@ -190,15 +204,28 @@ class ParallelFeeder:
         self.n_workers = n_workers or default_feed_workers()
         self.packer = _FeedCounters()
         self._resume_counts = (0, 0)
+        self._v6rows: list = []
+        #: digest -> 128-bit source for talker rendering (same contract
+        #: as the other sources)
+        self.v6_digests: dict[int, int] = {}
 
     def set_counts(self, parsed: int, skipped: int) -> None:
         self._resume_counts = (parsed, skipped)
 
+    def take_v6(self) -> list:
+        out = self._v6rows
+        self._v6rows = []
+        return out
+
     def batches(self, skip_lines: int, batch_size: int):
+        from .pack import T6_SRC, fold_src32_host, limbs_u128
+
         self.packer.parsed, self.packer.skipped = self._resume_counts
         rows_cap = (2 if self.packed.bindings_out else 1) * batch_size
+        # v6 plane: any line of a batch can be a dual-evaluation v6 line
+        rows6_cap = 2 * batch_size if self.packed.has_v6 else 0
         n_slots = 2 * self.n_workers + 2
-        slot_bytes = 4 * TUPLE_COLS * rows_cap
+        slot_bytes = 4 * (TUPLE_COLS * rows_cap + TUPLE6_COLS * rows6_cap)
         shm = shared_memory.SharedMemory(create=True, size=n_slots * slot_bytes)
         # spawn, not fork: the driver process runs JAX's thread pools, and
         # forking a multi-threaded process can deadlock the child.  The
@@ -210,7 +237,8 @@ class ParallelFeeder:
         workers = [
             ctx.Process(
                 target=_worker,
-                args=(blob, self.paths, rows_cap, shm.name, task_q, done_q),
+                args=(blob, self.paths, rows_cap, rows6_cap, shm.name,
+                      task_q, done_q),
                 daemon=True,
             )
             for _ in range(self.n_workers)
@@ -259,13 +287,29 @@ class ParallelFeeder:
                         raise RuntimeError(
                             f"feeder worker failed on batch {msg[1]}: {msg[2]}"
                         )
-                    idx, slot, lines, dp, ds = msg
-                    ready[idx] = (slot, lines, dp, ds)
-                slot, lines, dp, ds = ready.pop(next_yield)
+                    idx, slot, lines, dp, ds, n6 = msg
+                    ready[idx] = (slot, lines, dp, ds, n6)
+                slot, lines, dp, ds, n6 = ready.pop(next_yield)
+                slot_words = TUPLE_COLS * rows_cap + TUPLE6_COLS * rows6_cap
                 out = np.ndarray(
                     (TUPLE_COLS, rows_cap), dtype=np.uint32, buffer=shm.buf,
-                    offset=4 * slot * TUPLE_COLS * rows_cap,
+                    offset=4 * slot * slot_words,
                 ).copy()  # the slot is reused; the driver may hold the batch
+                if n6:
+                    plane6 = np.ndarray(
+                        (TUPLE6_COLS, rows6_cap), dtype=np.uint32,
+                        buffer=shm.buf,
+                        offset=4 * (slot * slot_words + TUPLE_COLS * rows_cap),
+                    )
+                    rows6 = np.ascontiguousarray(plane6[:, :n6].T)
+                    dig = self.v6_digests
+                    cap = 1 << 18
+                    for r in rows6:
+                        if len(dig) >= cap:
+                            break
+                        src = limbs_u128(*r[T6_SRC:T6_SRC + 4])
+                        dig.setdefault(fold_src32_host(src), src)
+                    self._v6rows.extend(rows6)
                 free_slots.append(slot)
                 next_yield += 1
                 self.packer.parsed += dp

@@ -590,13 +590,6 @@ def convert_logs(
     """
     from . import fastparse
 
-    if packed.has_v6 and feed_workers and feed_workers > 1:
-        # the multi-process feeder is v4-only (the in-process native
-        # parser handles v6 via its dual-family entry)
-        raise AnalysisError(
-            "the feeder tier is v4-only but this ruleset has IPv6 rules; "
-            "convert without --feed-workers"
-        )
     if feed_workers and feed_workers > 1:
         if native is False:
             raise ValueError(
@@ -607,7 +600,7 @@ def convert_logs(
         src = ParallelFeeder(packed, log_paths, n_workers=feed_workers)
         packer = src.packer
         batches = src.batches(0, batch_size)
-        take_v6 = None  # feeder tier is v4-only (refused above for v6)
+        take_v6 = src.take_v6 if packed.has_v6 else None
         parser_name = f"native-feeder-x{feed_workers}"
     else:
         use_native = native if native is not None else fastparse.available()

@@ -460,18 +460,6 @@ def run_stream_file(
     if isinstance(paths, str):
         paths = [paths]
     use_native = native if native is not None else fastparse.available()
-    if packed.has_v6 and feed_workers and feed_workers > 1:
-        # The multi-process feeder is v4-only; against a v6-capable
-        # ruleset it would silently count v6 traffic as skipped instead
-        # of analyzing it.  (The in-process native parser IS v6-capable
-        # via its dual-family entry point.)
-        from ..errors import AnalysisError
-
-        raise AnalysisError(
-            "the feeder tier is v4-only but this ruleset has IPv6 rules; "
-            "run without --feed-workers (native and Python parsers both "
-            "handle v6)"
-        )
     if feed_workers and feed_workers > 1:
         if native is False:
             from ..errors import AnalysisError

@@ -267,3 +267,32 @@ def test_native_python_v6_edge_lines_bit_identical():
     np.testing.assert_array_equal(ref4, got4)
     np.testing.assert_array_equal(ref6, got6)
     assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
+
+
+def test_feeder_v6_registers_match_text_run(tmp_path):
+    """Multi-process feeder on a unified corpus: same hits as the text run."""
+    from ruleset_analysis_tpu.hostside import fastparse, synth
+
+    if not fastparse.available():
+        pytest.skip("no native toolchain")
+    cfg_text = synth.synth_config(
+        n_acls=3, rules_per_acl=10, seed=77, v6_fraction=0.4
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    t4 = synth.synth_tuples(packed, 700, seed=77)
+    t6 = synth.synth_tuples6(packed, 400, seed=77)
+    lines = synth.render_syslog(packed, t4, seed=77) + synth.render_syslog6(
+        packed, t6, seed=78
+    )
+    random.Random(3).shuffle(lines)
+    p = tmp_path / "logs.txt"
+    p.write_text("\n".join(lines) + "\n")
+    res = oracle.Oracle([rs]).consume(list(lines))
+    rep_text = run_stream(packed, iter(lines), run_cfg(), topk=5)
+    rep_feed = run_stream_file(
+        packed, str(p), run_cfg(), feed_workers=2, topk=5
+    )
+    assert report_hits(rep_feed) == report_hits(rep_text) == dict(res.hits)
+    assert rep_feed.unused == rep_text.unused == res.unused_rules([rs])
+    assert rep_feed.totals["lines_matched"] == res.lines_matched
