@@ -548,6 +548,42 @@ def stack_rules(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> np.ndarr
     return out
 
 
+def stacked_slab_rows6(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> int:
+    """R6max of :func:`stack_rules6` without building the slab tensor."""
+    g = max(packed.n_acls, 1)
+    real = packed.rules6[packed.rules6[:, R6_ACL] != NO_ACL]
+    counts = (
+        np.bincount(real[:, R6_ACL].astype(np.int64), minlength=g)
+        if real.size
+        else np.zeros(g, np.int64)
+    )
+    rmax = max(int(counts.max()) if counts.size else 0, 1)
+    if rmax > rule_block:
+        rmax = ((rmax + rule_block - 1) // rule_block) * rule_block
+    return rmax
+
+
+def stack_rules6(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> np.ndarray:
+    """[G, R6max, RULE6_COLS] uint32: each ACL's v6 rows, padded.
+
+    The v6 twin of :func:`stack_rules` (BASELINE config #4 "vmap over
+    rulesets"): slab row order preserves global config order so
+    first-match == min local row carries over; NO_ACL padding never
+    matches.
+    """
+    g = max(packed.n_acls, 1)
+    real = packed.rules6[packed.rules6[:, R6_ACL] != NO_ACL]
+    rmax = stacked_slab_rows6(packed, rule_block)
+    out = np.zeros((g, rmax, RULE6_COLS), dtype=np.uint32)
+    out[:, :, R6_ACL] = NO_ACL
+    fill = np.zeros(g, dtype=np.int64)
+    for row in real:
+        gid = int(row[R6_ACL])
+        out[gid, fill[gid]] = row
+        fill[gid] += 1
+    return out
+
+
 def group_tuples(batch: np.ndarray, n_groups: int, lane: int) -> np.ndarray:
     """One-shot grouping: [B, TUPLE_COLS] rows -> [G, TUPLE_COLS, lane].
 

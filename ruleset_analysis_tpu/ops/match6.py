@@ -144,6 +144,41 @@ def match_keys6(
     return jnp.where(matched, rule_key, deny)
 
 
+def first_match_rows6_stacked(
+    cols: dict,
+    rules3d: jnp.ndarray,
+    rule_block: int = RULE_BLOCK,
+) -> jnp.ndarray:
+    """Grouped v6 first-match: vmap over stacked per-ACL limb slabs.
+
+    cols: dict of [G, Bg] uint32 arrays (v6 field names incl. limbs),
+    lines pre-bucketed by ACL gid; rules3d: [G, R6max, RULE6_COLS] from
+    pack.stack_rules6.  Returns [G, Bg] LOCAL slab rows (NO_MATCH where
+    nothing matches) — O(R6max) per line instead of O(total v6 rows),
+    the BASELINE config-#4 scaling for the v6 family.
+    """
+    return jax.vmap(
+        lambda c, r: first_match_rows6(c, r, rule_block), in_axes=(0, 0)
+    )(cols, rules3d)
+
+
+def match_keys6_stacked(
+    cols: dict,
+    rules3d: jnp.ndarray,
+    deny_key: jnp.ndarray,
+    rule_block: int = RULE_BLOCK,
+) -> jnp.ndarray:
+    """Count-key per v6 line for the grouped layout ([G, Bg] in and out)."""
+    row = first_match_rows6_stacked(cols, rules3d, rule_block)
+    matched = row != NO_MATCH
+    safe_row = jnp.where(matched, row, _U32(0))
+    keys3 = rules3d[:, :, R6_KEY].astype(_U32)  # [G, R6max]
+    rule_key = jnp.take_along_axis(keys3, safe_row, axis=1)
+    acl = jnp.minimum(cols["acl"], _U32(deny_key.shape[0] - 1))
+    deny = deny_key.astype(_U32)[acl]
+    return jnp.where(matched, rule_key, deny)
+
+
 def fold_src32(cols: dict) -> jnp.ndarray:
     """[B] u32 sketch identity for a v6 source address.
 

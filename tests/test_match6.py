@@ -217,3 +217,59 @@ def test_fold_src32_distinct_and_deterministic():
     np.testing.assert_array_equal(f1, f2)
     # 2000 random 128-bit values: expect no 32-bit collisions (p ~ 5e-4)
     assert len(set(f1.tolist())) == len(vals)
+
+
+def test_stacked6_equals_flat_keys():
+    """Grouped v6 match must produce the same keys as the flat scan."""
+    rng = random.Random(21)
+    lines = ["hostname fw1"]
+    for a in range(4):
+        for i in range(6):
+            lines.append(
+                f"access-list ACL{a} extended permit tcp any6 "
+                f"2001:db8:{a:x}{i:x}::/48 eq {1000 + i}"
+            )
+        lines.append(f"access-list ACL{a} extended deny ip any6 any6")
+    packed, _ = make_packed("\n".join(lines) + "\n")
+    g = packed.n_acls
+    lane = 64
+    ip6 = aclparse.ip6_to_int
+
+    # grouped batch: [G, TUPLE6_COLS, lane], plus the equivalent flat rows
+    grouped = np.zeros((g, pack.TUPLE6_COLS, lane), dtype=np.uint32)
+    flat_rows = []
+    for gid in range(g):
+        for j in range(lane):
+            a = gid
+            i = rng.randrange(8)
+            dst = ip6(f"2001:db8:{a:x}{i % 6:x}::{rng.randrange(1, 999):x}")
+            row = (gid, 6, rng.getrandbits(128), rng.randrange(1 << 16),
+                   dst, 1000 + rng.randrange(7), 1)
+            grouped[gid, :, j] = (
+                row[0], row[1], *pack.u128_limbs(row[2]), row[3],
+                *pack.u128_limbs(row[4]), row[5], row[6],
+            )
+            flat_rows.append(row)
+
+    gb = jnp.asarray(grouped)
+    cols_g = {
+        "acl": gb[:, pack.T6_ACL, :],
+        "proto": gb[:, pack.T6_PROTO, :],
+        "sport": gb[:, pack.T6_SPORT, :],
+        "dport": gb[:, pack.T6_DPORT, :],
+    }
+    for i in range(4):
+        cols_g[f"src{i}"] = gb[:, pack.T6_SRC + i, :]
+        cols_g[f"dst{i}"] = gb[:, pack.T6_DST + i, :]
+    rules3d = jnp.asarray(pack.stack_rules6(packed))
+    deny = jnp.asarray(packed.deny_key)
+    keys_stacked = np.asarray(
+        match6_ops.match_keys6_stacked(cols_g, rules3d, deny)
+    ).reshape(-1)
+
+    flat_batch = tuples6(flat_rows)
+    cols_f, _ = cols6_from_batch(flat_batch)
+    keys_flat = np.asarray(
+        match6_ops.match_keys6(cols_f, jnp.asarray(packed.rules6), deny)
+    )
+    np.testing.assert_array_equal(keys_stacked, keys_flat)
