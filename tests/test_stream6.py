@@ -294,3 +294,95 @@ def test_feeder_v6_registers_match_text_run(tmp_path):
     assert report_hits(rep_feed) == report_hits(rep_text) == dict(res.hits)
     assert rep_feed.unused == rep_text.unused == res.unused_rules([rs])
     assert rep_feed.totals["lines_matched"] == res.lines_matched
+
+
+@pytest.mark.parametrize("seed", [1, 7])
+def test_v6_mutation_fuzz_parity(seed):
+    """Randomized mutations of v6/v4 syslog: both parsers bit-identical."""
+    from ruleset_analysis_tpu.hostside import fastparse, synth
+
+    if not fastparse.available():
+        pytest.skip("no native toolchain")
+    cfg_text = synth.synth_config(n_acls=3, rules_per_acl=10, seed=5, v6_fraction=0.5)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    base = synth.render_syslog6(packed, synth.synth_tuples6(packed, 60, seed=5), seed=6)
+    base += synth.render_syslog(packed, synth.synth_tuples(packed, 60, seed=5), seed=6)
+    rng = random.Random(seed)
+    chars = ":abcdef0123456789./ ()->%ASA"
+    mutants = []
+    for _ in range(800):
+        ln = rng.choice(base)
+        pos = rng.randrange(len(ln))
+        k = rng.randrange(5)
+        if k == 0:
+            ln = ln[:pos] + rng.choice(chars) + ln[pos + 1:]
+        elif k == 1:
+            ln = ln[:pos] + ln[pos + 1:]
+        elif k == 2:
+            ln = ln[:pos] + rng.choice(chars) + ln[pos:]
+        elif k == 3:
+            ln = ln[:pos]
+        else:
+            p2 = rng.randrange(len(ln))
+            ls = list(ln)
+            ls[pos], ls[p2] = ls[p2], ls[pos]
+            ln = "".join(ls)
+        mutants.append(ln)
+    py = pack.LinePacker(packed)
+    r4, r6 = py.pack_lines2(mutants, batch_size=4 * len(mutants))
+    nat = fastparse.NativePacker(packed)
+    g4, g6 = nat.pack_lines2(mutants, batch_size=4 * len(mutants))
+    np.testing.assert_array_equal(r4, g4)
+    np.testing.assert_array_equal(r6, g6)
+    assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
+
+
+def test_v6_adversarial_endpoint_parity():
+    """Hex-ish iface names x pathological v6 literals across all message
+    classes: the iface:addr split and address validation must agree
+    between the regex path and the native scanner."""
+    from ruleset_analysis_tpu.hostside import fastparse
+
+    if not fastparse.available():
+        pytest.skip("no native toolchain")
+    cfg = (
+        "access-list A extended permit ip any any\n"
+        "access-list A extended permit ip any6 any6\n"
+        "access-group A in interface abc\n"
+        "access-group A in interface fe80\n"
+        "access-group A out interface def0\n"
+    )
+    rs = aclparse.parse_asa_config(cfg, "fw1")
+    packed = pack.pack_rulesets([rs])
+    rng = random.Random(7)
+    ifaces = ["abc", "fe80", "def0", "a:b", "x", "0", "eth0"]
+    addrs = ["1.2.3.4", "::1", "fe80::1", "1:2:3:4:5:6:7:8", "::ffff:1.2.3.4",
+             "1:2:3:4:5:6:1.2.3.4", "2001:db8::9", "::", "abcd::", "a::b",
+             "1:::2", "1::2::3", ":::", "1.2.3", "1.2.3.4.5", "12345::",
+             "g::1", "0:0:0:0:0:0:0:0", "::1.2.3.999",
+             "1:2:3:4:5:6:7:1.2.3.4",
+             "ffff:ffff:ffff:ffff:ffff:ffff:ffff:ffff"]
+    msgs = []
+    for _ in range(1500):
+        a, b = rng.choice(addrs), rng.choice(addrs)
+        fi, fo = rng.choice(ifaces), rng.choice(ifaces)
+        sp, dp = rng.randrange(1 << 17), rng.randrange(1 << 17)
+        k = rng.randrange(5)
+        if k == 0:
+            msgs.append(f"J 1 0 fw1 : %ASA-6-106100: access-list A permitted tcp {fi}/{a}({sp}) -> {fo}/{b}({dp}) hit")
+        elif k == 1:
+            msgs.append(f'J 1 0 fw1 : %ASA-4-106023: Deny udp src {fi}:{a}/{sp} dst {fo}:{b}/{dp} by access-group "A"')
+        elif k == 2:
+            msgs.append(f'J 1 0 fw1 : %ASA-4-106023: Deny icmp6 src {fi}:{a} dst {fo}:{b} (type 128, code 0) by access-group "A"')
+        elif k == 3:
+            msgs.append(f"J 1 0 fw1 : %ASA-6-302013: Built inbound TCP connection 7 for {fi}:{a}/{sp} ({a}/{sp}) to {fo}:{b}/{dp} ({b}/{dp})")
+        else:
+            msgs.append(f"J 1 0 fw1 : %ASA-2-106001: Inbound TCP connection denied from {a}/{sp} to {b}/{dp} flags SYN on interface {fi}")
+    py = pack.LinePacker(packed)
+    r4, r6 = py.pack_lines2(msgs, batch_size=4 * len(msgs))
+    nat = fastparse.NativePacker(packed)
+    g4, g6 = nat.pack_lines2(msgs, batch_size=4 * len(msgs))
+    np.testing.assert_array_equal(r4, g4)
+    np.testing.assert_array_equal(r6, g6)
+    assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)

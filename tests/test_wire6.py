@@ -142,3 +142,44 @@ def test_compact_expand6_roundtrip():
     np.testing.assert_array_equal(
         pack.expand_batch6(pack.compact_batch6(b)), b
     )
+
+
+def test_v2_corruption_fuzz_refuses_loudly_never_crashes(corpus, tmp_path):
+    """Byte-flips/truncations/extensions of a v2 file: the reader either
+    refuses with WireFormatError or reads masked rows — never raises raw
+    (same contract the v1 fuzz pinned in round 5)."""
+    import random
+
+    td, packed, rs, lines, log, res = corpus
+    out = str(tmp_path / "f.rawire")
+    wire.convert_logs(packed, [log], out)
+    blob = open(out, "rb").read()
+    rng = random.Random(3)
+    crashes = []
+    for _ in range(300):
+        b = bytearray(blob)
+        k = rng.randrange(4)
+        if k == 0:
+            pos = rng.randrange(len(b))
+            b[pos] ^= 1 << rng.randrange(8)
+        elif k == 1:
+            b = b[: rng.randrange(len(b))]
+        elif k == 2:
+            b += bytes(rng.randrange(1, 64))
+        else:
+            pos = rng.randrange(len(b))
+            b[pos:pos + 8] = rng.randbytes(8)
+        p = str(tmp_path / "m.rawire")
+        open(p, "wb").write(bytes(b))
+        try:
+            r = wire.WireReader([p], packed)
+            for _batch, _n in r.iter_batches(0, 256):
+                pass
+            for _batch, _n in r.iter_batches6(0, 256):
+                pass
+            r.close()
+        except wire.WireFormatError:
+            pass
+        except Exception as e:  # noqa: BLE001 - the point of the fuzz
+            crashes.append((type(e).__name__, str(e)[:120]))
+    assert not crashes, crashes[:3]
