@@ -452,15 +452,23 @@ def _cmd_synth(args: argparse.Namespace) -> int:
 
     os.makedirs(args.out_dir, exist_ok=True)
     cfg_text = synth.synth_config(
-        n_acls=args.acls, rules_per_acl=args.rules, seed=args.seed, hostname=args.hostname
+        n_acls=args.acls, rules_per_acl=args.rules, seed=args.seed,
+        hostname=args.hostname, v6_fraction=args.v6_fraction,
     )
     cfg_path = f"{args.out_dir}/{args.hostname}.cfg"
     with open(cfg_path, "w", encoding="utf-8") as f:
         f.write(cfg_text)
     rs = aclparse.parse_asa_config(cfg_text, args.hostname)
     packed = pack.pack_rulesets([rs])
-    tuples = synth.synth_tuples(packed, args.lines, seed=args.seed)
+    n6 = int(args.lines * args.v6_fraction) if packed.has_v6 else 0
+    tuples = synth.synth_tuples(packed, args.lines - n6, seed=args.seed)
     log_lines = synth.render_syslog(packed, tuples, seed=args.seed)
+    if n6:
+        import random as _random
+
+        t6 = synth.synth_tuples6(packed, n6, seed=args.seed)
+        log_lines = log_lines + synth.render_syslog6(packed, t6, seed=args.seed + 1)
+        _random.Random(args.seed).shuffle(log_lines)
     log_path = f"{args.out_dir}/{args.hostname}.log"
     with open(log_path, "w", encoding="utf-8") as f:
         f.write("\n".join(log_lines) + "\n")
@@ -611,6 +619,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--lines", type=int, default=10000)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--hostname", default="fw1")
+    p.add_argument("--v6-fraction", type=float, default=0.0,
+                   help="fraction of ACEs (and log lines) spelled IPv6 — "
+                        "generates a unified v4+v6 config and mixed corpus")
     p.set_defaults(fn=_cmd_synth)
     return ap
 
