@@ -158,6 +158,7 @@ def _bind(lib: ctypes.CDLL) -> None:
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64),
             ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int,
         ]
         lib.asa_pack_chunk2.restype = ctypes.c_int64
         lib.asa_count_lines.argtypes = [
@@ -280,9 +281,11 @@ class NativePacker:
         n_valid = ctypes.c_int64(0)
         ml = max_lines if max_lines is not None else batch_size
         if self._has_v6:
-            # dual-family entry (single-threaded streaming loop): the v6
-            # plane is sized 2*max_lines so v6 rows never close a batch,
-            # mirroring the Python text source's side buffer
+            # dual-family entry: the v6 plane is sized 2*max_lines so v6
+            # rows never close a batch, mirroring the Python text
+            # source's side buffer; parses across n_threads workers with
+            # bit-identical output (same slab/compaction structure as
+            # the v4 MT path)
             cap6 = 2 * ml
             out6 = np.empty((TUPLE6_COLS, cap6), dtype=np.uint32)
             n_valid6 = ctypes.c_int64(0)
@@ -299,6 +302,7 @@ class NativePacker:
                 ctypes.byref(n_lines),
                 ctypes.byref(n_valid),
                 ctypes.byref(n_valid6),
+                n_threads if n_threads is not None else default_parse_threads(),
             )
             del arg
             if int(n_valid6.value):

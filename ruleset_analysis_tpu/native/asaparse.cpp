@@ -1007,47 +1007,169 @@ int64_t asa_pack_chunk(void* h, const char* buf, int64_t len, int final_,
 
 // Dual-family chunk parse (v6-capable rulesets): v4 rows pack into the
 // [TUPLE_COLS, cap] plane exactly as asa_pack_chunk, v6 rows into the
-// [13, cap6] TUPLE6 plane (limb layout, pack.py).  Single-threaded
-// streaming loop — the parity reference; callers size cap6 >= 2 *
-// max_lines so the v6 side never closes a batch (mirrors the Python
-// _TextSource, whose v6 rows ride a side buffer and never close a
-// batch either).  Returns bytes consumed.
+// [13, cap6] TUPLE6 plane (limb layout, pack.py).  Callers size
+// cap6 >= 2 * max_lines so the v6 side never closes a batch (mirrors
+// the Python _TextSource, whose v6 rows ride a side buffer and never
+// close a batch either).  ``n_threads`` splits the parse across workers
+// with the same slab/compaction structure as asa_pack_chunk_mt —
+// output, counters, and consumed bytes are bit-identical for any
+// thread count.  Returns bytes consumed.
 int64_t asa_pack_chunk2(void* h, const char* buf, int64_t len, int final_,
                         int64_t max_lines, uint32_t* out, int64_t cap,
                         uint32_t* out6, int64_t cap6,
                         int64_t* n_lines_out, int64_t* n_valid_out,
-                        int64_t* n_valid6_out) {
+                        int64_t* n_valid6_out, int n_threads) {
+    constexpr int64_t T6 = 13;  // TUPLE6_COLS
     Packer* pk = (Packer*)h;
     const char* end = buf + len;
-    LocalCtx cx{&pk->resolve, {}};
-    const char* p = buf;
-    int64_t lines = 0, valid = 0, valid6 = 0;
-    int64_t parsed = 0, skipped = 0;
-    while (p < end && lines < max_lines) {
-        const char* nl = (const char*)memchr(p, '\n', end - p);
-        const char* le = nl ? nl : end;
-        if (!nl && !final_) break;  // incomplete tail line
-        int64_t v6_before = valid6;
-        int n = handle_line(&cx, p, le, out, cap, valid, out6, cap6, &valid6);
-        if (n < 0) break;  // rows don't fit: close batch, keep line
-        if (n == 0) ++skipped;
-        else {
-            parsed += n;
-            if (valid6 == v6_before) valid += n;  // v4 rows advanced
+    int64_t want = max_lines < cap ? max_lines : cap;
+    if (n_threads != 1 && (len > (int64_t)0xFFFFFFFF || max_lines > cap))
+        n_threads = 1;  // same constraints as the v4 MT path
+
+    if (n_threads == 1) {
+        LocalCtx cx{&pk->resolve, {}};
+        const char* p = buf;
+        int64_t lines = 0, valid = 0, valid6 = 0;
+        int64_t parsed = 0, skipped = 0;
+        while (p < end && lines < max_lines) {
+            const char* nl = (const char*)memchr(p, '\n', end - p);
+            const char* le = nl ? nl : end;
+            if (!nl && !final_) break;  // incomplete tail line
+            int64_t v6_before = valid6;
+            int n = handle_line(&cx, p, le, out, cap, valid, out6, cap6, &valid6);
+            if (n < 0) break;  // rows don't fit: close batch, keep line
+            if (n == 0) ++skipped;
+            else {
+                parsed += n;
+                if (valid6 == v6_before) valid += n;  // v4 rows advanced
+            }
+            ++lines;
+            p = nl ? nl + 1 : end;
         }
-        ++lines;
+        pk->parsed += parsed;
+        pk->skipped += skipped;
+        zero_tail(out, cap, valid);
+        for (int64_t c = 0; c < T6; ++c)
+            memset(out6 + c * cap6 + valid6, 0,
+                   (size_t)(cap6 - valid6) * sizeof(uint32_t));
+        *n_lines_out = lines;
+        *n_valid_out = valid;
+        *n_valid6_out = valid6;
+        return p - buf;
+    }
+
+    // ---- pass 1: line-offset index (as asa_pack_chunk_mt)
+    std::vector<uint32_t> off;
+    off.reserve((size_t)(want > 0 ? want + 1 : 1));
+    const char* p = buf;
+    while (p < end && (int64_t)off.size() < want) {
+        const char* nl = (const char*)memchr(p, '\n', end - p);
+        if (!nl && !final_) break;
+        off.push_back((uint32_t)(p - buf));
         p = nl ? nl + 1 : end;
+    }
+    const int64_t L = (int64_t)off.size();
+    if (L == 0) {
+        zero_tail(out, cap, 0);
+        for (int64_t c = 0; c < T6; ++c)
+            memset(out6 + c * cap6, 0, (size_t)cap6 * sizeof(uint32_t));
+        *n_lines_out = 0;
+        *n_valid_out = 0;
+        *n_valid6_out = 0;
+        return 0;
+    }
+    const int64_t consumed = p - buf;
+    off.push_back((uint32_t)consumed);
+    auto line_end = [&](int64_t i) {
+        const char* q = buf + off[i + 1];
+        return (q > buf + off[i] && q[-1] == '\n') ? q - 1 : q;
+    };
+
+    int W = n_threads;
+    if (W <= 0) W = (int)std::thread::hardware_concurrency();
+    if (W < 1) W = 1;
+    if (W > (int)(L / 1024) + 1) W = (int)(L / 1024) + 1;
+
+    // ---- workers: private slabs per family + per-line row counts
+    std::vector<uint32_t> scratch4((size_t)(TUPLE_COLS * 2 * L));
+    std::vector<uint32_t> scratch6((size_t)(T6 * 2 * L));
+    std::vector<uint8_t> rows4_per_line((size_t)L);
+    std::vector<uint8_t> rows6_per_line((size_t)L);
+    std::vector<int64_t> lo(W + 1);
+    for (int w = 0; w <= W; ++w) lo[w] = L * w / W;
+    std::vector<LocalCtx> ctx((size_t)W);
+    std::vector<std::thread> threads;
+    threads.reserve((size_t)W);
+    for (int w = 0; w < W; ++w) {
+        ctx[w].resolve = &pk->resolve;
+        threads.emplace_back([&, w]() {
+            const int64_t i0 = lo[w], i1 = lo[w + 1];
+            const int64_t slab_cap = 2 * (i1 - i0);
+            uint32_t* slab4 = scratch4.data() + (size_t)(2 * i0 * TUPLE_COLS);
+            uint32_t* slab6 = scratch6.data() + (size_t)(2 * i0 * T6);
+            LocalCtx* cx = &ctx[w];
+            int64_t v4 = 0, v6 = 0;
+            for (int64_t i = i0; i < i1; ++i) {
+                int64_t v6_before = v6;
+                int n = handle_line(cx, buf + off[i], line_end(i),
+                                    slab4, slab_cap, v4,
+                                    slab6, slab_cap, &v6);
+                // n < 0 impossible: slab caps are 2 * range lines
+                if (n > 0 && v6 != v6_before) {
+                    rows6_per_line[(size_t)i] = (uint8_t)n;
+                } else {
+                    rows4_per_line[(size_t)i] = (uint8_t)(n > 0 ? n : 0);
+                    if (n > 0) v4 += n;
+                }
+            }
+        });
+    }
+    for (auto& t : threads) t.join();
+
+    // ---- line-atomic cap on the v4 plane only (cap6 >= 2*max_lines by
+    // the caller contract, so v6 rows can never close the batch)
+    int64_t K = 0, total4 = 0;
+    int64_t parsed = 0, skipped = 0;
+    for (; K < L; ++K) {
+        const int64_t r4 = rows4_per_line[(size_t)K];
+        const int64_t r6 = rows6_per_line[(size_t)K];
+        if (total4 + r4 > cap) break;
+        total4 += r4;
+        if (r4 == 0 && r6 == 0) ++skipped;
+        else parsed += r4 + r6;
+    }
+
+    // ---- compaction: per family, concatenating consumed lines' rows
+    int64_t valid = 0, valid6 = 0;
+    for (int w = 0; w < W && lo[w] < K; ++w) {
+        const int64_t i0 = lo[w], i1 = lo[w + 1] < K ? lo[w + 1] : K;
+        const int64_t slab_cap = 2 * (lo[w + 1] - i0);
+        const uint32_t* slab4 = scratch4.data() + (size_t)(2 * i0 * TUPLE_COLS);
+        const uint32_t* slab6 = scratch6.data() + (size_t)(2 * i0 * T6);
+        int64_t take4 = 0, take6 = 0;
+        for (int64_t i = i0; i < i1; ++i) {
+            take4 += rows4_per_line[(size_t)i];
+            take6 += rows6_per_line[(size_t)i];
+        }
+        for (int64_t c = 0; c < TUPLE_COLS; ++c)
+            memcpy(out + c * cap + valid, slab4 + c * slab_cap,
+                   (size_t)take4 * sizeof(uint32_t));
+        for (int64_t c = 0; c < T6; ++c)
+            memcpy(out6 + c * cap6 + valid6, slab6 + c * slab_cap,
+                   (size_t)take6 * sizeof(uint32_t));
+        valid += take4;
+        valid6 += take6;
     }
     pk->parsed += parsed;
     pk->skipped += skipped;
     zero_tail(out, cap, valid);
-    for (int64_t c = 0; c < 13; ++c)
+    for (int64_t c = 0; c < T6; ++c)
         memset(out6 + c * cap6 + valid6, 0,
                (size_t)(cap6 - valid6) * sizeof(uint32_t));
-    *n_lines_out = lines;
+    *n_lines_out = K;
     *n_valid_out = valid;
     *n_valid6_out = valid6;
-    return p - buf;
+    return K < L ? (int64_t)off[K] : consumed;
 }
 
 // Plain newline count (streaming buffer bookkeeping; memchr is ~5-10x

@@ -386,3 +386,37 @@ def test_v6_adversarial_endpoint_parity():
     np.testing.assert_array_equal(r4, g4)
     np.testing.assert_array_equal(r6, g6)
     assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
+
+
+def test_native_v6_mt_bit_identical_to_single_thread():
+    """asa_pack_chunk2's worker/compaction path (n_threads>1) must match
+    the sequential loop bit-for-bit, including line-atomic batch closes."""
+    from ruleset_analysis_tpu.hostside import fastparse, synth
+
+    if not fastparse.available():
+        pytest.skip("no native toolchain")
+    cfg_text = synth.synth_config(
+        n_acls=3, rules_per_acl=10, seed=9, v6_fraction=0.5, egress_acls=True
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    t4 = synth.synth_tuples(packed, 1500, seed=9)
+    t6 = synth.synth_tuples6(packed, 1200, seed=9)
+    lines = synth.render_syslog(packed, t4, seed=9, variety=0.4)
+    lines += synth.render_syslog6(packed, t6, seed=10)
+    random.Random(9).shuffle(lines)
+    data = ("\n".join(lines) + "\n").encode()
+
+    for cap in (4096, 700):  # ample AND batch-closing capacities
+        p1 = fastparse.NativePacker(packed)
+        o1, l1, u1 = p1.pack_chunk(data, cap, final=True, max_lines=cap,
+                                   n_threads=1)
+        r61 = p1.take_v6()
+        p4 = fastparse.NativePacker(packed)
+        o4, l4, u4 = p4.pack_chunk(data, cap, final=True, max_lines=cap,
+                                   n_threads=4)
+        r64 = p4.take_v6()
+        assert (l1, u1) == (l4, u4)
+        np.testing.assert_array_equal(o1, o4)
+        np.testing.assert_array_equal(np.asarray(r61), np.asarray(r64))
+        assert (p1.parsed, p1.skipped) == (p4.parsed, p4.skipped)
