@@ -307,7 +307,8 @@ def _cmd_convert(args: argparse.Namespace) -> int:
     )
     mb = stats["bytes"] / 1e6
     print(
-        f"wrote {args.out}: {stats['rows']} evaluation rows from "
+        f"wrote {args.out}: {stats['evals']} evaluation rows"
+        f"{' (' + str(stats['rows6']) + ' v6)' if stats.get('rows6') else ''} from "
         f"{stats['raw_lines']} lines ({stats['skipped']} skipped), "
         f"{mb:.1f} MB, parser={stats['parser']}",
         file=sys.stderr,
@@ -356,7 +357,9 @@ def _cmd_wire_info(args: argparse.Namespace) -> int:
         for e in rows:
             if e["ok"]:
                 print(
-                    f"{e['file']}: {e['rows']} rows from {e['raw_lines']} lines "
+                    f"{e['file']}: {e['rows']} rows"
+                    f"{' + ' + str(e['rows6']) + ' v6 rows' if e.get('rows6') else ''}"
+                    f" from {e['raw_lines']} lines "
                     f"({e['skipped_lines']} skipped), block={e['block_rows']}"
                     + (", ruleset OK" if args.ruleset else "")
                 )

@@ -291,17 +291,21 @@ class _WireFileSource:
             self.packer.parsed += v
             self.packer.skipped += (w6.shape[1] - v) - (w6.shape[1] - n)
             if len(self.v6_digests) < cap and n:
-                # digest -> address map for talker rendering (vectorized
-                # fold; dict inserts bounded by unique sources + the cap)
+                # digest -> address map for talker rendering: vectorized
+                # fold + unique first, so the Python dict loop touches
+                # each DISTINCT source once per batch, not each row
                 limbs = w6[W6_SRC:W6_SRC + 4, :n]
                 folds = fold_src32_np(limbs)
+                _, idx = _np.unique(folds, return_index=True)
+                idx.sort()  # stream order: first-seen wins at the cap,
+                # matching _TextSource's documented contract
                 dig = self.v6_digests
-                for j in range(n):
+                for j in idx:
                     f = int(folds[j])
                     if f not in dig:
                         if len(dig) >= cap:
                             break
-                        dig[f] = limbs_u128(*limbs[:, j])
+                        dig[f] = limbs_u128(*limbs[:, int(j)])
             yield w6, n
 
     def close(self) -> None:
