@@ -665,3 +665,8 @@ def main(argv: list[str] | None = None) -> int:
 
 if __name__ == "__main__":
     raise SystemExit(main())
+
+
+def main_entry() -> None:
+    """console_scripts entry point (pyproject.toml: ``ruleset-analyze``)."""
+    raise SystemExit(main())
