@@ -212,17 +212,22 @@ class NativePacker:
         self._has_v6 = packed.has_v6
         self._staged6: list[np.ndarray] = []
 
-    def take_v6(self) -> list:
-        """Drain v6 row arrays staged since the last call ([n, 13] each).
+    def take_v6(self):
+        """Drain staged v6 rows as ONE ``[n, TUPLE6_COLS]`` uint32 array.
 
         Only meaningful for v6-capable rulesets; the stream driver pulls
         this after every batch, exactly as with the Python text source.
+        Returned whole (not per-row objects) so consumers slice/transpose
+        vectorized — per-row Python views would negate the native parse
+        speed on v6-heavy corpora.  Empty list when nothing staged.
         """
-        out: list = []
-        for a in self._staged6:
-            out.extend(a)  # rows concatenate; consumers re-stack
+        staged = self._staged6
         self._staged6 = []
-        return out
+        if not staged:
+            return []
+        if len(staged) == 1:
+            return staged[0]
+        return np.concatenate(staged)
 
     def __del__(self):
         h = getattr(self, "_h", None)

@@ -165,7 +165,7 @@ def _worker(packed_blob, paths, rows_cap, rows6_cap, shm_name, task_q, done_q):
                             buffer=shm.buf,
                             offset=4 * (slot * slot_words + TUPLE_COLS * rows_cap),
                         )
-                        plane6[:, :n6] = np.stack(rows6).T
+                        plane6[:, :n6] = np.asarray(rows6, dtype=np.uint32).T
             except Exception as e:  # forward instead of dying silently
                 done_q.put(("error", idx, f"{type(e).__name__}: {e}"))
                 return
@@ -204,7 +204,7 @@ class ParallelFeeder:
         self.n_workers = n_workers or default_feed_workers()
         self.packer = _FeedCounters()
         self._resume_counts = (0, 0)
-        self._v6rows: list = []
+        self._v6chunks: list[np.ndarray] = []  # [n,13] arrays, input order
         #: digest -> 128-bit source for talker rendering (same contract
         #: as the other sources)
         self.v6_digests: dict[int, int] = {}
@@ -212,10 +212,15 @@ class ParallelFeeder:
     def set_counts(self, parsed: int, skipped: int) -> None:
         self._resume_counts = (parsed, skipped)
 
-    def take_v6(self) -> list:
-        out = self._v6rows
-        self._v6rows = []
-        return out
+    def take_v6(self):
+        """Staged v6 rows as one [n, 13] array (or [] when none)."""
+        chunks = self._v6chunks
+        self._v6chunks = []
+        if not chunks:
+            return []
+        if len(chunks) == 1:
+            return chunks[0]
+        return np.concatenate(chunks)
 
     def batches(self, skip_lines: int, batch_size: int):
         from .pack import T6_SRC, fold_src32_host, limbs_u128
@@ -303,13 +308,14 @@ class ParallelFeeder:
                     )
                     rows6 = np.ascontiguousarray(plane6[:, :n6].T)
                     dig = self.v6_digests
-                    cap = 1 << 18
+                    from .pack import V6_DIGEST_CAP
+
                     for r in rows6:
-                        if len(dig) >= cap:
+                        if len(dig) >= V6_DIGEST_CAP:
                             break
                         src = limbs_u128(*r[T6_SRC:T6_SRC + 4])
                         dig.setdefault(fold_src32_host(src), src)
-                    self._v6rows.extend(rows6)
+                    self._v6chunks.append(rows6)
                 free_slots.append(slot)
                 next_yield += 1
                 self.packer.parsed += dp

@@ -141,6 +141,13 @@ def limbs_u128(l0: int, l1: int, l2: int, l3: int) -> int:
     return (int(l0) << 96) | (int(l1) << 64) | (int(l2) << 32) | int(l3)
 
 
+#: v6 talker digest->address map size cap (~6 MB of host dict at the
+#: cap); past it new v6 sources keep full analysis fidelity but render
+#: as raw ``v6#`` digests in the talker section.  One knob for every
+#: source tier (text / native / feeder / wire).
+V6_DIGEST_CAP = 1 << 18
+
+
 def fold_src32_np(limbs: np.ndarray) -> np.ndarray:
     """Vectorized :func:`fold_src32_host` over ``[4, n]`` uint32 limbs."""
     u32 = np.uint32

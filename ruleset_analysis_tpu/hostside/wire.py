@@ -94,6 +94,11 @@ def ruleset_fingerprint(packed: PackedRuleset) -> bytes:
     """
     h = hashlib.sha256()
     h.update(np.ascontiguousarray(packed.rules).tobytes())
+    if packed.has_v6:
+        # v6 rows change which evaluations a line produces, so they are
+        # part of the identity; pure-v4 rulesets hash exactly as before
+        # the v6 data model, keeping pre-v6 wire artifacts valid
+        h.update(np.ascontiguousarray(packed.rules6).tobytes())
     h.update(np.ascontiguousarray(packed.deny_key).tobytes())
     for (fw, acl), gid in sorted(packed.acl_gid.items()):
         h.update(f"a:{fw}/{acl}={gid};".encode())
@@ -631,7 +636,7 @@ def convert_logs(
             last_skipped = skipped
             if take_v6 is not None:
                 rows6 = take_v6()
-                if rows6:
+                if len(rows6):
                     t6 = np.asarray(rows6, dtype=np.uint32).T
                     w.add6(compact_batch6(t6), 0, 0)
     return {

@@ -55,10 +55,8 @@ class _TextSource:
     boundaries) always agree with the batches actually emitted.
     """
 
-    #: digest->address map size cap: ~6 MB of host dict at the cap; beyond
-    #: it new v6 sources keep full analysis fidelity but render as raw
-    #: ``v6#`` digests in the talker section.
-    V6_DIGEST_CAP = 1 << 18
+    #: one shared knob for every source tier (see pack.V6_DIGEST_CAP)
+    V6_DIGEST_CAP = pack_mod.V6_DIGEST_CAP
 
     def __init__(self, packed: PackedRuleset, lines: Iterable[str]):
         self.packer = LinePacker(packed)
@@ -268,7 +266,19 @@ class _WireFileSource:
         from ..hostside.wire import sanity_check_valid_bits
 
         # resume offsets count the CONCATENATED v4-then-v6 row stream; an
-        # offset past the v4 section means phase 1 is already complete
+        # offset past the v4 section means phase 1 is already complete.
+        # The truncation/mismatch guard must live HERE against the total:
+        # clamping alone would let a wrong or truncated wire input resume
+        # "successfully" (iter_batches6's own guard never runs for
+        # pure-v4 rulesets, where phase 2 is skipped entirely).
+        total = self.reader.n_rows + self.reader.n6_rows
+        if skip_lines > total:
+            from ..errors import ResumeInputMismatch
+
+            raise ResumeInputMismatch(
+                f"snapshot consumed {skip_lines} rows but the wire input "
+                f"has only {total}; wrong or truncated input"
+            )
         skip4 = min(skip_lines, self.reader.n_rows)
         for wire, n in self.reader.iter_batches(skip4, batch_size):
             v, inv = sanity_check_valid_bits(wire)
@@ -373,10 +383,10 @@ class _FileSource:
     def set_counts(self, parsed: int, skipped: int) -> None:
         self.packer.set_counts(parsed, skipped)
 
-    def take_v6(self) -> list:
+    def take_v6(self):
         """v6 rows the native parser staged (driver side channel)."""
         rows = self.packer.take_v6()
-        if rows:
+        if len(rows):
             dig = self.v6_digests
             cap = _TextSource.V6_DIGEST_CAP
             for r in rows:
