@@ -183,3 +183,38 @@ def test_v2_corruption_fuzz_refuses_loudly_never_crashes(corpus, tmp_path):
         except Exception as e:  # noqa: BLE001 - the point of the fuzz
             crashes.append((type(e).__name__, str(e)[:120]))
     assert not crashes, crashes[:3]
+
+
+def test_wire_resume_past_input_refused_pure_v4(tmp_path):
+    """Resume offset beyond the wire input must raise, even for pure-v4
+    rulesets where the v6 phase never runs (guard regression pinned)."""
+    from ruleset_analysis_tpu.errors import ResumeInputMismatch
+    from ruleset_analysis_tpu.runtime.stream import _WireFileSource
+
+    cfg_text = synth.synth_config(n_acls=2, rules_per_acl=6, seed=8)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    t = synth.synth_tuples(packed, 200, seed=8)
+    log = tmp_path / "v4.txt"
+    log.write_text("\n".join(synth.render_syslog(packed, t, seed=8)) + "\n")
+    out = str(tmp_path / "v4.rawire")
+    stats = wire.convert_logs(packed, [str(log)], out)
+    src = _WireFileSource(packed, [out])
+    with pytest.raises(ResumeInputMismatch):
+        list(src.batches(stats["rows"] + stats["rows6"] + 1, 64))
+    src.close()
+
+
+def test_wire_fingerprint_covers_v6_rules(corpus, tmp_path):
+    """A ruleset differing ONLY in v6 content must refuse the wire file."""
+    td, packed, rs, lines, log, res = corpus
+    out = str(tmp_path / "fp.rawire")
+    wire.convert_logs(packed, [log], out)
+    # same config with one v6 ACE's ADDRESS changed: v4 rows, key
+    # numbering, and gids all identical - only rules6 bytes differ
+    cfg2 = CFG.replace("host 2001:db8::bad", "host 2001:db8::bae")
+    rs2 = aclparse.parse_asa_config(cfg2, "fw1")
+    packed2 = pack.pack_rulesets([rs2])
+    np.testing.assert_array_equal(packed2.rules, packed.rules)
+    with pytest.raises(wire.WireFormatError, match="different ruleset"):
+        wire.WireReader([out], packed2)
