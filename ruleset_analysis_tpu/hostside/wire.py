@@ -53,7 +53,6 @@ import numpy as np
 from ..errors import AnalysisError
 from .pack import (
     T_VALID,
-    TUPLE_COLS,
     W_META,
     WIRE_COLS,
     WIRE6_COLS,

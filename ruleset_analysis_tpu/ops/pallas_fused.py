@@ -39,7 +39,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 
 from ..hostside.pack import R_KEY, RULE_COLS
-from .match import NO_MATCH, rows_to_keys
+from .match import rows_to_keys
 from .pallas_match import (  # noqa: F401
     BLOCK_LINES,
     RULE_TILE,
