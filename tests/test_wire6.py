@@ -218,3 +218,19 @@ def test_wire_fingerprint_covers_v6_rules(corpus, tmp_path):
     np.testing.assert_array_equal(packed2.rules, packed.rules)
     with pytest.raises(wire.WireFormatError, match="different ruleset"):
         wire.WireReader([out], packed2)
+
+
+def test_stacked_wire_v6_matches_flat(corpus, tmp_path):
+    """Stacked layout over a v2 wire file: same report as the flat run."""
+    td, packed, rs, lines, log, res = corpus
+    out = str(tmp_path / "s.rawire")
+    wire.convert_logs(packed, [log], out)
+    rep_flat = run_stream_wire(packed, out, run_cfg(), topk=5)
+    rep_st = run_stream_wire(packed, out, run_cfg(layout="stacked"), topk=5)
+    hits = lambda r: {  # noqa: E731
+        (e["firewall"], e["acl"], e["index"]): e["hits"]
+        for e in r.per_rule
+        if e["hits"] > 0
+    }
+    assert hits(rep_st) == hits(rep_flat) == dict(res.hits)
+    assert rep_st.unused == rep_flat.unused == res.unused_rules([rs])
