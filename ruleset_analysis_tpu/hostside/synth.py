@@ -263,22 +263,90 @@ def render_syslog6(
     tuples6: np.ndarray,
     seed: int = 0,
     timestamp: str = "Jul 29 07:48:01",
+    variety: float = 0.0,
 ) -> list[str]:
-    """Render v6 tuple batches as 106100 ASA syslog text (text tier)."""
+    """Render v6 tuple batches as ASA syslog text (text tier).
+
+    Mirrors :func:`render_syslog`: 106100 by default; with ``variety`` a
+    fraction of eligible lines render as the other handled message
+    classes (106023, 302013/302015, 106001, 106006, 106015) with v6
+    literals, constrained by protocol and resolvable bindings.
+    """
     gid_to_name = {gid: (fw, acl) for (fw, acl), gid in packed.acl_gid.items()}
+    in_iface = {}
+    for (fw, iface), gid in packed.bindings.items():
+        in_iface.setdefault((fw, gid), iface)
+    out_ifaces: dict[str, list[str]] = {}
+    for (fw, iface), _gid in packed.bindings_out.items():
+        out_ifaces.setdefault(fw, []).append(iface)
     rng = np.random.default_rng(seed)
     verdicts = rng.random(tuples6.shape[0])
+    kinds = rng.random(tuples6.shape[0])
+    picks = rng.integers(0, 1 << 30, size=tuples6.shape[0])
     out = []
     for i, row in enumerate(tuples6):
         if not row[T6_VALID]:
             out.append(f"{timestamp} noise : not an ASA message")
             continue
-        fw, acl = gid_to_name[int(row[0])]
+        gid = int(row[0])
+        fw, acl = gid_to_name[gid]
         proto = int(row[T6_PROTO])
         pname = _PROTO_NAMES.get(proto, str(proto))
         src = int_to_ip6(limbs_u128(*row[T6_SRC:T6_SRC + 4]))
         dst = int_to_ip6(limbs_u128(*row[T6_DST:T6_DST + 4]))
         sport, dport = int(row[T6_SPORT]), int(row[T6_DPORT])
+        iface = in_iface.get((fw, gid))
+
+        if variety and kinds[i] < variety:
+            eligible = ["106023"]
+            if iface is not None and proto in (6, 17):
+                eligible.append("302013")
+                eligible.append("106001" if proto == 6 else "106006")
+                if proto == 6:
+                    eligible.append("106015")
+            kind = eligible[int(picks[i]) % len(eligible)]
+            if kind == "106023":
+                if proto in (1, 58):
+                    ep = (f"src inside:{src} dst outside:{dst} "
+                          f"(type {dport}, code 0)")
+                else:
+                    ep = f"src inside:{src}/{sport} dst outside:{dst}/{dport}"
+                out.append(
+                    f'{timestamp} {fw} : %ASA-4-106023: Deny {pname} {ep} '
+                    f'by access-group "{acl}" [0x0, 0x0]'
+                )
+                continue
+            if kind == "302013":
+                egs = out_ifaces.get(fw)
+                egress = egs[int(picks[i]) % len(egs)] if egs else "outside"
+                tname = "TCP" if proto == 6 else "UDP"
+                mid = "302013" if proto == 6 else "302015"
+                out.append(
+                    f"{timestamp} {fw} : %ASA-6-{mid}: Built inbound {tname} "
+                    f"connection {int(picks[i])} for {iface}:{src}/{sport} "
+                    f"({src}/{sport}) to {egress}:{dst}/{dport} ({dst}/{dport})"
+                )
+                continue
+            if kind == "106001":
+                out.append(
+                    f"{timestamp} {fw} : %ASA-2-106001: Inbound TCP connection "
+                    f"denied from {src}/{sport} to {dst}/{dport} flags SYN "
+                    f"on interface {iface}"
+                )
+                continue
+            if kind == "106015":
+                out.append(
+                    f"{timestamp} {fw} : %ASA-6-106015: Deny TCP (no connection) "
+                    f"from {src}/{sport} to {dst}/{dport} flags RST "
+                    f"on interface {iface}"
+                )
+                continue
+            out.append(
+                f"{timestamp} {fw} : %ASA-2-106006: Deny inbound UDP "
+                f"from {src}/{sport} to {dst}/{dport} on interface {iface}"
+            )
+            continue
+
         verdict = "permitted" if verdicts[i] < 0.8 else "denied"
         if proto in (1, 58):
             paren_s, paren_d = dport, 0  # icmp type rides dport

@@ -420,3 +420,29 @@ def test_native_v6_mt_bit_identical_to_single_thread():
         np.testing.assert_array_equal(o1, o4)
         np.testing.assert_array_equal(np.asarray(r61), np.asarray(r64))
         assert (p1.parsed, p1.skipped) == (p4.parsed, p4.skipped)
+
+
+def test_synth_v6_variety_corpus_end_to_end_and_native_parity():
+    """v6 variety tier (all message classes, binding-resolved): oracle-
+    exact through the stream AND bit-identical across both parsers."""
+    from ruleset_analysis_tpu.hostside import fastparse, synth
+
+    cfg_text = synth.synth_config(
+        n_acls=3, rules_per_acl=10, seed=44, v6_fraction=0.5, egress_acls=True
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    t6 = synth.synth_tuples6(packed, 800, seed=44)
+    lines = synth.render_syslog6(packed, t6, seed=45, variety=0.5)
+    res = oracle.Oracle([rs]).consume(list(lines))
+    rep = run_stream(packed, iter(lines), run_cfg(), topk=5)
+    assert report_hits(rep) == dict(res.hits)
+    assert rep.totals["lines_matched"] == res.lines_matched
+    if fastparse.available():
+        py = pack.LinePacker(packed)
+        r4, r6 = py.pack_lines2(lines, batch_size=4 * len(lines))
+        nat = fastparse.NativePacker(packed)
+        g4, g6 = nat.pack_lines2(lines, batch_size=4 * len(lines))
+        np.testing.assert_array_equal(r4, g4)
+        np.testing.assert_array_equal(r6, g6)
+        assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
