@@ -234,3 +234,24 @@ def test_stacked_wire_v6_matches_flat(corpus, tmp_path):
     }
     assert hits(rep_st) == hits(rep_flat) == dict(res.hits)
     assert rep_st.unused == rep_flat.unused == res.unused_rules([rs])
+
+
+def test_convert_v6_byte_identical_across_parse_tiers(corpus, tmp_path):
+    """python / native / feeder converts of a unified corpus must write
+    byte-identical v2 files (the row stream is parser-independent)."""
+    from ruleset_analysis_tpu.hostside import fastparse
+
+    td, packed, rs, lines, log, res = corpus
+    outs = {}
+    wire.convert_logs(packed, [log], str(tmp_path / "py.rawire"), native=False)
+    outs["python"] = open(tmp_path / "py.rawire", "rb").read()
+    if fastparse.available():
+        wire.convert_logs(packed, [log], str(tmp_path / "nat.rawire"), native=True)
+        outs["native"] = open(tmp_path / "nat.rawire", "rb").read()
+        wire.convert_logs(
+            packed, [log], str(tmp_path / "fd.rawire"), feed_workers=2
+        )
+        outs["feeder"] = open(tmp_path / "fd.rawire", "rb").read()
+    ref = outs.pop("python")
+    for name, blob in outs.items():
+        assert blob == ref, f"{name} convert differs from python"
