@@ -990,6 +990,129 @@ def bench_v6() -> dict:
     }
 
 
+def bench_v6recall() -> dict:
+    """Sketch-only unused-rule recall on a MIXED v4+v6 stream.
+
+    The north-star accuracy criterion certified with both families live
+    in the SAME registers: one exact direct-step run (v4 and v6 chunks
+    interleaved) is ground truth; each CMS geometry then re-runs
+    sketch-only and the unused sets compare.  Direct step calls (not the
+    stream driver) — driver-level mixed correctness is pinned
+    oracle-exact by tests/test_stream6.py; this config isolates the
+    register-geometry question at scale.
+    """
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+    from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+    from ruleset_analysis_tpu.hostside.oracle import unused_rule_recall
+    from ruleset_analysis_tpu.models import pipeline
+    from ruleset_analysis_tpu.ops import cms as cms_ops
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    rs = aclparse.parse_asa_config(
+        synth.synth_config(n_acls=12, rules_per_acl=48, seed=13, v6_fraction=0.35),
+        "fw0",
+    )
+    packed = pack.pack_rulesets([rs])
+    b4, b6 = 1 << 20, 1 << 18
+    feeds4 = [
+        jnp.asarray(np.ascontiguousarray(synth.synth_tuples(packed, b4, seed=200 + i).T))
+        for i in range(2)
+    ]
+    feeds6 = [
+        jnp.asarray(np.ascontiguousarray(synth.synth_tuples6(packed, b6, seed=200 + i).T))
+        for i in range(2)
+    ]
+    epochs = int(os.environ.get("RA_V6RECALL_EPOCHS", "0")) or (24 if on_tpu else 3)
+    total = epochs * (2 * b4 + 2 * b6)
+    total6 = epochs * 2 * b6
+    log(f"v6recall: {packed.n_keys} keys ({packed.rules6.shape[0]} v6 rows), "
+        f"{total} lines ({total6} v6), tpu={on_tpu}")
+
+    def run(width: int, depth: int, exact: bool):
+        cfg = AnalysisConfig(
+            batch_size=b4,
+            sketch=SketchConfig(cms_width=width, cms_depth=depth, hll_p=8),
+            exact_counts=exact,
+        )
+        topk_k = cfg.sketch.topk_chunk_candidates
+        rules4 = pipeline.ship_ruleset(packed)
+        rules6 = pipeline.ship_ruleset6(packed)
+        state = pipeline.init_state(packed.n_keys, cfg)
+        step4 = jax.jit(
+            functools.partial(
+                pipeline.analysis_step, n_keys=packed.n_keys, topk_k=topk_k,
+                exact_counts=exact,
+            ),
+            donate_argnums=(0,),
+        )
+        step6 = jax.jit(
+            functools.partial(
+                pipeline.analysis_step6, n_keys=packed.n_keys, topk_k=topk_k,
+                exact_counts=exact,
+            ),
+            donate_argnums=(0,),
+        )
+        for e in range(epochs):
+            for f in feeds4:
+                state, _ = step4(state, rules4, f)
+            for f in feeds6:
+                state, _ = step6(state, rules6, f)
+        host = pipeline.state_to_host(state)
+        if exact:
+            import ruleset_analysis_tpu.ops.counts as count_ops
+
+            per_key = count_ops.to_u64(host["counts_lo"], host["counts_hi"])
+        else:
+            per_key = cms_ops.cms_query_np(
+                host["cms"], np.arange(packed.n_keys, dtype=np.uint32)
+            )
+        unused = [
+            (m.firewall, m.acl, m.index)
+            for k, m in enumerate(packed.key_meta)
+            if not m.implicit_deny and per_key[k] == 0
+        ]
+        return unused
+
+    t0 = time.perf_counter()
+    exact_unused = run(1 << 14, 4, True)
+    t_exact = time.perf_counter() - t0
+    sweep = []
+    for width, depth in [(1 << 12, 4), (1 << 14, 4), (1 << 16, 4)]:
+        t0 = time.perf_counter()
+        got = run(width, depth, False)
+        dt = time.perf_counter() - t0
+        recall = unused_rule_recall(exact_unused, got)
+        false_unused = [k for k in got if k not in set(exact_unused)]
+        sweep.append({
+            "width": width, "depth": depth,
+            "recall_unused": round(recall, 4),
+            "false_unused": len(false_unused),
+            "lines_per_sec": round(total / dt, 1),
+        })
+        log(f"v6recall w={width} d={depth}: {recall:.4f}")
+    headline = next(s for s in sweep if s["width"] == 1 << 14)
+    return {
+        "metric": "v6_mixed_recall_sketch_only_unused_vs_exact",
+        "value": headline["recall_unused"],
+        "unit": "recall",
+        "vs_baseline": round(headline["recall_unused"] / 0.99, 4),
+        "detail": {
+            "lines_total": total,
+            "lines_v6": total6,
+            "n_keys": packed.n_keys,
+            "v6_rows": int(packed.rules6.shape[0]),
+            "n_unused_exact": len(exact_unused),
+            "exact_run_sec": round(t_exact, 1),
+            "sweep": sweep,
+        },
+    }
+
+
 BENCHES = {
     "stage": bench_stage,
     "exact": bench_exact,
@@ -1002,6 +1125,7 @@ BENCHES = {
     "e2e": bench_e2e,
     "convert": bench_convert,
     "v6": bench_v6,
+    "v6recall": bench_v6recall,
 }
 
 
