@@ -144,6 +144,27 @@ ICMP_TYPE_NAMES = {
     "traceroute": 30,
 }
 
+#: ICMPv6 type names (RFC 4443 / 4861) usable after the destination in an
+#: ``icmp6`` ACE — the numbers differ from their v4 namesakes (echo-reply
+#: is 129, not 0), so icmp6 entries resolve through THIS table.
+ICMP6_TYPE_NAMES = {
+    "unreachable": 1,
+    "packet-too-big": 2,
+    "time-exceeded": 3,
+    "parameter-problem": 4,
+    "echo": 128,
+    "echo-reply": 129,
+    "membership-query": 130,
+    "membership-report": 131,
+    "membership-reduction": 132,
+    "router-solicitation": 133,
+    "router-advertisement": 134,
+    "neighbor-solicitation": 135,
+    "neighbor-advertisement": 136,
+    "neighbor-redirect": 137,
+    "router-renumbering": 138,
+}
+
 FULL_PORTS = (0, PORT_MAX)
 FULL_ADDR = (0, U32_MAX)
 FULL_ADDR6 = (0, U128_MAX)
@@ -795,13 +816,16 @@ def parse_ace_line(
 
     icmp_types: list[tuple[int, int]] | None = None
     is_icmp = any(a.proto == (1, 1) for a in proto_alts) or ptok in ("icmp", "icmp6")
+    # named types resolve per family: ICMPv6 numbers differ from their v4
+    # namesakes (echo-reply is 129, not 0)
+    type_names = ICMP6_TYPE_NAMES if ptok == "icmp6" else ICMP_TYPE_NAMES
     if dports is None and is_icmp and pos < len(toks) and toks[pos] not in _TRAILERS:
         t = toks[pos]
         if t == "object-group" and pos + 1 < len(toks) and toks[pos + 1] in groups.icmp_type:
             icmp_types = _resolve_icmp_type_group(groups, toks[pos + 1])
             pos += 2
-        elif t in ICMP_TYPE_NAMES:
-            v = ICMP_TYPE_NAMES[t]
+        elif t in type_names:
+            v = type_names[t]
             icmp_types = [(v, v)]
             pos += 1
         elif t.isdigit():

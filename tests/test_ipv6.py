@@ -161,3 +161,24 @@ def test_oracle_family_guard():
         V6_LINE.replace("tcp", "udp").replace("(443)", "(53)").replace("(1000)", "(53)")
     )
     assert orc.match_keys(p6b) == [("fw1", "A", 4)]
+
+
+def test_icmp6_named_types_resolve_per_family():
+    """ICMPv6 named types use RFC 4443 numbers, not their v4 namesakes."""
+    cfg = (
+        "access-list I extended permit icmp6 any6 any6 echo-reply\n"
+        "access-list I4 extended permit icmp any any echo-reply\n"
+    )
+    rs = aclparse.parse_asa_config(cfg, "fw1", strict=True)
+    (a6,) = rs.acls["I"][0].aces
+    assert (a6.dport_lo, a6.dport_hi) == (129, 129)  # v6 echo-reply
+    (a4,) = rs.acls["I4"][0].aces
+    assert (a4.dport_lo, a4.dport_hi) == (0, 0)  # v4 echo-reply
+    # and the matching line (type rides dport) hits rule 1
+    p = syslog.parse_line(
+        "J 1 0 fw1 : %ASA-6-106100: access-list I permitted icmp6 "
+        "i/2001:db8::9(129) -> o/2001:db8::5(0) hit-cnt 1"
+    )
+    assert p is not None and p.dport == 129
+    orc = oracle.Oracle([rs])
+    assert orc.match_keys(p) == [("fw1", "I", 1)]
