@@ -172,7 +172,9 @@ def test_icmp6_named_types_resolve_per_family():
     rs = aclparse.parse_asa_config(cfg, "fw1", strict=True)
     (a6,) = rs.acls["I"][0].aces
     assert (a6.dport_lo, a6.dport_hi) == (129, 129)  # v6 echo-reply
-    (a4,) = rs.acls["I4"][0].aces
+    # I4's any/any wildcard expands to both families in this v6-bearing
+    # ruleset; check its v4-family ace
+    a4 = next(a for a in rs.acls["I4"][0].aces if a.family == 4)
     assert (a4.dport_lo, a4.dport_hi) == (0, 0)  # v4 echo-reply
     # and the matching line (type rides dport) hits rule 1
     p = syslog.parse_line(
