@@ -446,3 +446,11 @@ def test_synth_v6_variety_corpus_end_to_end_and_native_parity():
         np.testing.assert_array_equal(r4, g4)
         np.testing.assert_array_equal(r6, g6)
         assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
+
+
+def test_stacked_text_v6_matches_flat(corpus):
+    """Single-process stacked layout over the mixed text corpus."""
+    packed, rs, lines, res = corpus
+    rep = run_stream(packed, iter(lines), run_cfg(layout="stacked"), topk=5)
+    assert report_hits(rep) == dict(res.hits)
+    assert rep.unused == res.unused_rules([rs])
