@@ -157,8 +157,17 @@ def _cmd_run(args: argparse.Namespace) -> int:
         ]
         orc = oracle.Oracle(rulesets)
         res = orc.consume(lines)
+        # render per family: oracle talker identities are (family, addr)
+        # so a v6 source prints as a v6 literal, never a garbled quad
         talkers = {
-            k: c.most_common(args.topk) for k, c in res.talkers.items()
+            k: [
+                (
+                    aclparse.int_to_ip6(s) if f == 6 else aclparse.u32_to_ip(s),
+                    c,
+                )
+                for (f, s), c in cnt.most_common(args.topk)
+            ]
+            for k, cnt in res.talkers.items()
         }
         rep = report_mod.build_report(
             packed,

@@ -112,12 +112,15 @@ class Oracle:
             r.lines_skipped += 1
             return
         # lines_matched counts ACL evaluations (a dual-bound connection
-        # line contributes two), matching the packers' `parsed` counter
+        # line contributes two), matching the packers' `parsed` counter.
+        # Source identity is (family, address): a v4 address and a v6
+        # address with equal low bits (10.0.0.1 vs ::a00:1) are DISTINCT
+        # sources and must not merge in exact sets/counters.
         for key in keys:
             r.lines_matched += 1
             r.hits[key] += 1
-            r.sources[key].add(p.src)
-            r.talkers[(key[0], key[1])][p.src] += 1
+            r.sources[key].add((p.family, p.src))
+            r.talkers[(key[0], key[1])][(p.family, p.src)] += 1
 
     def consume(self, lines: Iterable[str]) -> OracleResult:
         for line in lines:

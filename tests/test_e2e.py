@@ -83,7 +83,8 @@ def test_tpu_talkers_cover_oracle_heavy_hitters(corpus):
     from ruleset_analysis_tpu.hostside.aclparse import u32_to_ip
 
     for (fw, acl), counter in res.talkers.items():
-        heavy = [ip for ip, c in counter.most_common(3) if c >= 50]
+        # oracle talker identities are (family, addr); this corpus is v4
+        heavy = [src for (_fam, src), c in counter.most_common(3) if c >= 50]
         if not heavy:
             continue
         got = {ip for ip, _ in rep.talkers.get(f"{fw} {acl}", [])}
