@@ -323,9 +323,18 @@ int endpoint_slash_paren(const char*& p, const char* end,
 
 // "if:ADDR[/port]" endpoint of 106023 (port optional, defaults 0) and
 // 302013 (port required).  Same 1/0/-1 contract as endpoint_slash_paren.
+//
+// ``require_token_end``: the 106023 SRC endpoint is followed by ``\s+dst``
+// in the regex, so Python only commits to a colon split whose endpoint
+// reaches the end of the token — a mid-token leftover is a STRUCTURAL
+// mismatch that backtracks to a later colon (fuzz: "inside:1side:A.B.C.D"
+// must split at the SECOND colon).  The DST endpoint is followed by
+// ``.*?by`` (anything matches), so it commits to the first structural
+// split and a bad value there skips the line — require_token_end=false.
 int endpoint_colon(const char*& p, const char* end, bool port_required,
                    const char** if0, const char** if1,
-                   Addr* addr, uint32_t* port) {
+                   Addr* addr, uint32_t* port,
+                   bool require_token_end = false) {
     const char* t0; const char* t1;
     const char* q = p;
     if (!token(q, end, &t0, &t1)) return 0;
@@ -345,6 +354,7 @@ int endpoint_colon(const char*& p, const char* end, bool port_required,
         } else if (port_required) {
             continue;
         }
+        if (require_token_end && after != t1) continue;
         Addr a;
         const char* ac = c;
         if (parse_addr_run(ac, re, &a) != 1 || ac != re) return -1;
@@ -417,7 +427,8 @@ bool parse_106023(const char* b, const char* be, Parsed* out) {
         if (!token(p, be, &s0, &s1) || !tok_eq(s0, s1, "src")) continue;
         if (!skip_ws1(p, be)) continue;
         const char* i0; const char* i1; Addr sa; uint32_t spo;
-        int rc = endpoint_colon(p, be, false, &i0, &i1, &sa, &spo);
+        int rc = endpoint_colon(p, be, false, &i0, &i1, &sa, &spo,
+                                /*require_token_end=*/true);
         if (rc < 0) return false;
         if (!rc) continue;
         if (!skip_ws1(p, be)) continue;

@@ -454,3 +454,40 @@ def test_stacked_text_v6_matches_flat(corpus):
     rep = run_stream(packed, iter(lines), run_cfg(layout="stacked"), topk=5)
     assert report_hits(rep) == dict(res.hits)
     assert rep.unused == res.unused_rules([rs])
+
+
+def test_106023_src_mid_token_colon_backtracks_like_regex():
+    """The 106023 SRC endpoint must try LATER colon splits when an earlier
+    one leaves token residue (regex \\s+dst backtracking); the DST endpoint
+    commits to its first structural split (followed by .*?by).  Soak-found
+    divergence pinned."""
+    from ruleset_analysis_tpu.hostside import fastparse
+
+    if not fastparse.available():
+        pytest.skip("no native toolchain")
+    cfg = (
+        "access-list A extended permit ip any any\n"
+        "access-group A in interface outside\n"
+    )
+    rs = aclparse.parse_asa_config(cfg, "fw1")
+    packed = pack.pack_rulesets([rs])
+    lines = [
+        # src splits at the SECOND colon (first leaves "side:..." residue)
+        'J 1 0 fw1 : %ASA-4-106023: Deny icmp src inside:1side:172.17.70.70 '
+        'dst outside:198.51.0.225 (type 9, code 0) by access-group "A"',
+        # dst with the same shape: first split commits, value "1" invalid,
+        # line skips (both parsers)
+        'J 1 0 fw1 : %ASA-4-106023: Deny tcp src inside:10.0.0.1/1 '
+        'dst outside:1side:1.2.3.4/2 by access-group "A"',
+        # port residue in the src token also backtracks/fails structurally
+        'J 1 0 fw1 : %ASA-4-106023: Deny tcp src inside:2.3.4.5/19x '
+        'dst outside:1.2.3.4/2 by access-group "A"',
+    ]
+    py = pack.LinePacker(packed)
+    r4, _ = py.pack_lines2(lines, batch_size=8)
+    nat = fastparse.NativePacker(packed)
+    g4, _ = nat.pack_lines2(lines, batch_size=8)
+    np.testing.assert_array_equal(r4, g4)
+    assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
+    # and the first line really did parse (src = 172.17.70.70)
+    assert py.parsed >= 1
