@@ -683,7 +683,15 @@ def _resolve_protocol_group(groups: _Groups, name: str, _seen=None) -> list[tupl
     return out
 
 
-def _resolve_icmp_type_group(groups: _Groups, name: str, _seen=None) -> list[tuple[int, int]]:
+def _resolve_icmp_type_group(
+    groups: _Groups, name: str, _seen=None,
+    type_names: dict | None = None,
+) -> list[tuple[int, int]]:
+    """Resolve an icmp-type group; names resolve through ``type_names``
+    (the referencing ACE's family table — ICMPv6 numbers differ from
+    their v4 namesakes, so an icmp6 ACE must pass ICMP6_TYPE_NAMES)."""
+    if type_names is None:
+        type_names = ICMP_TYPE_NAMES
     if _seen is None:
         _seen = set()
     if name in _seen:
@@ -694,7 +702,7 @@ def _resolve_icmp_type_group(groups: _Groups, name: str, _seen=None) -> list[tup
     out = []
     for toks in groups.icmp_type[name]:
         if toks[0] == "icmp-object":
-            t = ICMP_TYPE_NAMES.get(toks[1])
+            t = type_names.get(toks[1])
             if t is None:
                 try:
                     t = int(toks[1])
@@ -702,7 +710,7 @@ def _resolve_icmp_type_group(groups: _Groups, name: str, _seen=None) -> list[tup
                     raise AclParseError(f"unknown icmp type {toks[1]!r}") from None
             out.append((t, t))
         elif toks[0] == "group-object":
-            out.extend(_resolve_icmp_type_group(groups, toks[1], _seen))
+            out.extend(_resolve_icmp_type_group(groups, toks[1], _seen, type_names))
         else:
             raise AclParseError(f"unsupported icmp-type member: {' '.join(toks)!r}")
     _seen.discard(name)
@@ -822,7 +830,9 @@ def parse_ace_line(
     if dports is None and is_icmp and pos < len(toks) and toks[pos] not in _TRAILERS:
         t = toks[pos]
         if t == "object-group" and pos + 1 < len(toks) and toks[pos + 1] in groups.icmp_type:
-            icmp_types = _resolve_icmp_type_group(groups, toks[pos + 1])
+            icmp_types = _resolve_icmp_type_group(
+                groups, toks[pos + 1], type_names=type_names
+            )
             pos += 2
         elif t in type_names:
             v = type_names[t]

@@ -298,53 +298,10 @@ def render_syslog6(
         iface = in_iface.get((fw, gid))
 
         if variety and kinds[i] < variety:
-            eligible = ["106023"]
-            if iface is not None and proto in (6, 17):
-                eligible.append("302013")
-                eligible.append("106001" if proto == 6 else "106006")
-                if proto == 6:
-                    eligible.append("106015")
-            kind = eligible[int(picks[i]) % len(eligible)]
-            if kind == "106023":
-                if proto in (1, 58):
-                    ep = (f"src inside:{src} dst outside:{dst} "
-                          f"(type {dport}, code 0)")
-                else:
-                    ep = f"src inside:{src}/{sport} dst outside:{dst}/{dport}"
-                out.append(
-                    f'{timestamp} {fw} : %ASA-4-106023: Deny {pname} {ep} '
-                    f'by access-group "{acl}" [0x0, 0x0]'
-                )
-                continue
-            if kind == "302013":
-                egs = out_ifaces.get(fw)
-                egress = egs[int(picks[i]) % len(egs)] if egs else "outside"
-                tname = "TCP" if proto == 6 else "UDP"
-                mid = "302013" if proto == 6 else "302015"
-                out.append(
-                    f"{timestamp} {fw} : %ASA-6-{mid}: Built inbound {tname} "
-                    f"connection {int(picks[i])} for {iface}:{src}/{sport} "
-                    f"({src}/{sport}) to {egress}:{dst}/{dport} ({dst}/{dport})"
-                )
-                continue
-            if kind == "106001":
-                out.append(
-                    f"{timestamp} {fw} : %ASA-2-106001: Inbound TCP connection "
-                    f"denied from {src}/{sport} to {dst}/{dport} flags SYN "
-                    f"on interface {iface}"
-                )
-                continue
-            if kind == "106015":
-                out.append(
-                    f"{timestamp} {fw} : %ASA-6-106015: Deny TCP (no connection) "
-                    f"from {src}/{sport} to {dst}/{dport} flags RST "
-                    f"on interface {iface}"
-                )
-                continue
-            out.append(
-                f"{timestamp} {fw} : %ASA-2-106006: Deny inbound UDP "
-                f"from {src}/{sport} to {dst}/{dport} on interface {iface}"
-            )
+            out.append(_variety_line(
+                timestamp, fw, acl, pname, proto, src, dst, sport, dport,
+                iface, out_ifaces, int(picks[i]), icmp_protos=(1, 58),
+            ))
             continue
 
         verdict = "permitted" if verdicts[i] < 0.8 else "denied"
@@ -386,6 +343,64 @@ def synth_syslog_file(
 
 
 _PROTO_NAMES = {6: "tcp", 17: "udp", 1: "icmp", 58: "icmp6"}
+
+
+
+def _variety_line(
+    timestamp: str, fw: str, acl: str, pname: str, proto: int,
+    src: str, dst: str, sport: int, dport: int,
+    iface, out_ifaces: dict, pick: int, icmp_protos: tuple,
+) -> str:
+    """One non-106100 message line (shared by both family renderers).
+
+    Eligibility mirrors what the parsers can resolve: 106023 always
+    (names the ACL); the connection/deny classes need a resolvable
+    ingress interface and a TCP/UDP protocol.  ``icmp_protos`` is the
+    family's ICMP set ((1,) for v4, (1, 58) for v6) for the 106023
+    type/code rendering.
+    """
+    eligible = ["106023"]
+    if iface is not None and proto in (6, 17):
+        eligible.append("302013")
+        eligible.append("106001" if proto == 6 else "106006")
+        if proto == 6:
+            eligible.append("106015")
+    kind = eligible[pick % len(eligible)]
+    if kind == "106023":
+        if proto in icmp_protos:
+            ep = f"src inside:{src} dst outside:{dst} (type {dport}, code 0)"
+        else:
+            ep = f"src inside:{src}/{sport} dst outside:{dst}/{dport}"
+        return (
+            f'{timestamp} {fw} : %ASA-4-106023: Deny {pname} {ep} '
+            f'by access-group "{acl}" [0x0, 0x0]'
+        )
+    if kind == "302013":
+        egs = out_ifaces.get(fw)
+        egress = egs[pick % len(egs)] if egs else "outside"
+        tname = "TCP" if proto == 6 else "UDP"
+        mid = "302013" if proto == 6 else "302015"
+        return (
+            f"{timestamp} {fw} : %ASA-6-{mid}: Built inbound {tname} "
+            f"connection {pick} for {iface}:{src}/{sport} "
+            f"({src}/{sport}) to {egress}:{dst}/{dport} ({dst}/{dport})"
+        )
+    if kind == "106001":
+        return (
+            f"{timestamp} {fw} : %ASA-2-106001: Inbound TCP connection "
+            f"denied from {src}/{sport} to {dst}/{dport} flags SYN "
+            f"on interface {iface}"
+        )
+    if kind == "106015":
+        return (
+            f"{timestamp} {fw} : %ASA-6-106015: Deny TCP (no connection) "
+            f"from {src}/{sport} to {dst}/{dport} flags RST "
+            f"on interface {iface}"
+        )
+    return (
+        f"{timestamp} {fw} : %ASA-2-106006: Deny inbound UDP "
+        f"from {src}/{sport} to {dst}/{dport} on interface {iface}"
+    )
 
 
 def render_syslog(
@@ -431,54 +446,10 @@ def render_syslog(
         iface = in_iface.get((fw, gid))
 
         if variety and kinds[i] < variety:
-            eligible = ["106023"]
-            if iface is not None and proto in (6, 17):
-                eligible.append("302013")
-                eligible.append("106001" if proto == 6 else "106006")
-                if proto == 6:
-                    eligible.append("106015")
-            kind = eligible[int(picks[i]) % len(eligible)]
-            if kind == "106023":
-                if proto == 1:
-                    ep = (f"src inside:{src} dst outside:{dst} "
-                          f"(type {dport}, code 0)")
-                else:
-                    ep = f"src inside:{src}/{sport} dst outside:{dst}/{dport}"
-                out.append(
-                    f'{timestamp} {fw} : %ASA-4-106023: Deny {pname} {ep} '
-                    f'by access-group "{acl}" [0x0, 0x0]'
-                )
-                continue
-            if kind == "302013":
-                egs = out_ifaces.get(fw)
-                egress = egs[int(picks[i]) % len(egs)] if egs else "outside"
-                tname = "TCP" if proto == 6 else "UDP"
-                mid = "302013" if proto == 6 else "302015"
-                out.append(
-                    f"{timestamp} {fw} : %ASA-6-{mid}: Built inbound {tname} "
-                    f"connection {int(picks[i])} for {iface}:{src}/{sport} "
-                    f"({src}/{sport}) to {egress}:{dst}/{dport} ({dst}/{dport})"
-                )
-                continue
-            if kind == "106001":
-                out.append(
-                    f"{timestamp} {fw} : %ASA-2-106001: Inbound TCP connection "
-                    f"denied from {src}/{sport} to {dst}/{dport} flags SYN "
-                    f"on interface {iface}"
-                )
-                continue
-            if kind == "106015":
-                out.append(
-                    f"{timestamp} {fw} : %ASA-6-106015: Deny TCP (no connection) "
-                    f"from {src}/{sport} to {dst}/{dport} flags RST "
-                    f"on interface {iface}"
-                )
-                continue
-            # 106006
-            out.append(
-                f"{timestamp} {fw} : %ASA-2-106006: Deny inbound UDP "
-                f"from {src}/{sport} to {dst}/{dport} on interface {iface}"
-            )
+            out.append(_variety_line(
+                timestamp, fw, acl, pname, proto, src, dst, sport, dport,
+                iface, out_ifaces, int(picks[i]), icmp_protos=(1,),
+            ))
             continue
 
         verdict = "permitted" if verdicts[i] < 0.8 else "denied"

@@ -184,3 +184,27 @@ def test_icmp6_named_types_resolve_per_family():
     assert p is not None and p.dport == 129
     orc = oracle.Oracle([rs])
     assert orc.match_keys(p) == [("fw1", "I", 1)]
+
+
+def test_icmp6_type_object_groups_resolve_per_family():
+    """icmp-type object-group members referenced from an icmp6 ACE must
+    resolve through the v6 table (review finding: they used the v4 one)."""
+    cfg = (
+        "object-group icmp-type G\n"
+        " icmp-object echo-reply\n"
+        " icmp-object packet-too-big\n"
+        "access-list I extended permit icmp6 any6 any6 object-group G\n"
+    )
+    rs = aclparse.parse_asa_config(cfg, "fw1", strict=True)
+    types = sorted((a.dport_lo, a.dport_hi) for a in rs.acls["I"][0].aces)
+    assert types == [(2, 2), (129, 129)]  # packet-too-big, v6 echo-reply
+    # the same group from a v4 icmp ACE keeps v4 numbers (and rejects
+    # the v6-only name)
+    cfg4 = (
+        "object-group icmp-type G4\n"
+        " icmp-object echo-reply\n"
+        "access-list I4 extended permit icmp any any object-group G4\n"
+    )
+    rs4 = aclparse.parse_asa_config(cfg4, "fw1", strict=True)
+    (a4,) = rs4.acls["I4"][0].aces
+    assert (a4.dport_lo, a4.dport_hi) == (0, 0)
