@@ -790,16 +790,25 @@ def bench_e2e() -> dict:
     whole path the CLI takes: native C++ parse of raw bytes, packing,
     device analysis, report assembly.  The host parse runs on one CPU
     core here; on multi-core v5e hosts it scales per-process/per-core.
+
+    One-time jit/compile cost is measured SEPARATELY (VERDICT r5 Weak #1:
+    committed artifacts ranged 113k-873k lines/s purely on how much of
+    the run was compile): a tiny warmup run with the identical batch
+    geometry fills the persistent XLA cache first, so the headline
+    ``value`` is the sustained rate and ``compile_warmup_sec`` prices the
+    one-time cost explicitly in the emitted JSON.
     """
     import os
     import tempfile
 
     from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
     from ruleset_analysis_tpu.hostside import fastparse, synth
+    from ruleset_analysis_tpu.runtime.compcache import enable_persistent_cache
     from ruleset_analysis_tpu.runtime.stream import run_stream_file
 
     packed = _setup()
     n = 2_000_000
+    n_warm = 10_000
     log(f"rendering {n} syslog lines...")
     tuples = _tuples(packed, n, seed=0)
     lines = synth.render_syslog(packed, tuples, seed=1)
@@ -807,12 +816,26 @@ def bench_e2e() -> dict:
         path = os.path.join(d, "bench.log")
         with open(path, "w", encoding="utf-8") as f:
             f.write("\n".join(lines) + "\n")
+        warm_path = os.path.join(d, "warm.log")
+        with open(warm_path, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines[:n_warm]) + "\n")
         del lines
         size_mb = os.path.getsize(path) / 1e6
         cfg = AnalysisConfig(
             batch_size=1 << 19,
             sketch=SketchConfig(cms_width=1 << 14, cms_depth=4, hll_p=8),
         )
+        # the warm run only de-compiles the measured run where compiled
+        # programs persist across the two fresh jit wrappers — i.e. when
+        # the persistent cache is active (always on TPU, the platform
+        # whose committed e2e artifacts motivated this split; the CPU
+        # cache is unsafe on some jaxlibs and stays off by default, and
+        # there the emitted persistent_cache:false flags that the
+        # sustained rate still includes a compile share)
+        cache = enable_persistent_cache()
+        t0 = time.perf_counter()
+        run_stream_file(packed, warm_path, cfg, native=None)
+        warm_sec = time.perf_counter() - t0
         rep = run_stream_file(packed, path, cfg, native=None)  # auto-select
     lps = rep.totals["lines_per_sec"]
     return {
@@ -826,6 +849,12 @@ def bench_e2e() -> dict:
             "native_parse": fastparse.available(),
             "host_cores": os.cpu_count(),
             "totals": rep.totals,
+            # one-time cost, priced separately from the sustained rate
+            # (dominated by jit trace + XLA compile; includes n_warm
+            # lines of real work, negligible at the sustained rate)
+            "compile_warmup_sec": round(warm_sec, 3),
+            "warmup_lines": n_warm,
+            "persistent_cache": bool(cache),
         },
     }
 

@@ -139,6 +139,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--packed-input": args.packed_input,
             "--no-exact-counts": not args.exact_counts,
             "--feed-workers": args.feed_workers > 1,
+            "--elastic": args.elastic,
         }
         bad = [k for k, v in tpu_only.items() if v]
         if bad:
@@ -226,6 +227,90 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 "and is not available with --distributed", file=sys.stderr,
             )
             return 2
+        if args.elastic:
+            # Elastic tier: this process becomes a recovery SUPERVISOR
+            # (runtime/elastic.py) — --logs is the FULL shard list, the
+            # same on every launcher; the supervisor rendezvous elects a
+            # coordinator, spawns the analysis workers, and re-forms the
+            # cluster automatically when a peer dies.  Only the final
+            # generation's reporting member prints/writes the report.
+            if not args.distributed:
+                print("--elastic requires --distributed", file=sys.stderr)
+                return 2
+            if not file_input or wire_input:
+                print(
+                    "--elastic requires text file shards (not '-' or "
+                    ".rawire)", file=sys.stderr,
+                )
+                return 2
+            if args.num_processes is None or args.process_id is None:
+                print(
+                    "--elastic requires --num-processes and --process-id "
+                    "(the launcher membership)", file=sys.stderr,
+                )
+                return 2
+            if args.coordinator:
+                print(
+                    "--elastic elects its own coordinator; drop "
+                    "--coordinator", file=sys.stderr,
+                )
+                return 2
+            if not args.elastic_dir:
+                print(
+                    "--elastic requires --elastic-dir (shared rendezvous "
+                    "+ epoch-checkpoint directory)", file=sys.stderr,
+                )
+                return 2
+            if not args.json:
+                print(
+                    "--elastic reports via the JSON result the workers "
+                    "write; add --json", file=sys.stderr,
+                )
+                return 2
+            import json as json_mod
+            import os as os_mod
+
+            from .errors import AnalysisError as _AErr
+            from .runtime.elastic import ElasticSupervisor
+
+            fault = None
+            fault_env = os_mod.environ.get("RA_ELASTIC_FAULT")
+            if fault_env:
+                # test-only crash injection: "tag=K,after_batches=M[,gen=G]"
+                fault = dict(
+                    kv.split("=", 1) for kv in fault_env.split(",")
+                )
+            try:
+                sup = ElasticSupervisor(
+                    args.elastic_dir,
+                    args.process_id,
+                    args.num_processes,
+                    args.ruleset,
+                    args.logs,
+                    cfg,
+                    max_reforms=args.max_reforms,
+                    topk=args.topk,
+                    native=args.native_parse,
+                    out_prefix=os_mod.path.join(
+                        args.elastic_dir, "result"
+                    ),
+                    fault=fault,
+                )
+            except _AErr as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            rc, result_path = sup.run()
+            if rc != 0 or result_path is None:
+                return rc
+            with open(result_path, "r", encoding="utf-8") as f:
+                rep_obj = json_mod.load(f)
+            payload = json_mod.dumps(rep_obj, indent=2)
+            if args.out:
+                with open(args.out, "w", encoding="utf-8") as f:
+                    f.write(payload + "\n")
+            else:
+                print(payload)
+            return 0
         if args.distributed:
             # multi-process job: this process joins the cluster and feeds
             # only ITS OWN --logs (the input-split analog); every process
@@ -580,6 +665,19 @@ def make_parser() -> argparse.ArgumentParser:
                    help="jax.distributed coordinator (default: environment)")
     p.add_argument("--num-processes", type=int, default=None)
     p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--elastic", action="store_true",
+                   help="supervise the distributed job elastically: when a "
+                        "peer dies the survivors re-form automatically at "
+                        "the surviving world size and resume from the "
+                        "shared epoch checkpoint.  --logs becomes the FULL "
+                        "shard list (identical on every launcher); needs "
+                        "--elastic-dir, --checkpoint-every and --json")
+    p.add_argument("--elastic-dir", default=None, metavar="DIR",
+                   help="shared rendezvous + epoch-checkpoint directory "
+                        "for --elastic (must be visible to every launcher)")
+    p.add_argument("--max-reforms", type=int, default=2, metavar="N",
+                   help="abort after N automatic cluster re-formations "
+                        "(the Hadoop max-task-retries analog; default 2)")
     p.add_argument("--json", action="store_true")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_run)

@@ -179,3 +179,14 @@ class AnalysisConfig:
 
     def replace(self, **kw) -> "AnalysisConfig":
         return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict:
+        """JSON-serializable image (elastic supervisor -> worker handoff)."""
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "AnalysisConfig":
+        """Inverse of :meth:`to_dict`; validation re-runs in __post_init__."""
+        d = dict(d)
+        d["sketch"] = SketchConfig(**d["sketch"])
+        return AnalysisConfig(**d)

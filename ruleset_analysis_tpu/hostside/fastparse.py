@@ -330,18 +330,41 @@ class NativePacker:
         del arg  # release any buffer export before the caller resizes
         return out, int(n_lines.value), int(used)
 
-    def pack_lines(self, lines: list[str], batch_size: int | None = None) -> np.ndarray:
-        """LinePacker-compatible helper (row-major [B, TUPLE_COLS])."""
+    def _pack_lines_v4(self, lines: list[str], batch_size: int | None) -> np.ndarray:
+        """The shared v4-plane pack (v6 rows, if any, land in _staged6)."""
         data = "".join(ln if ln.endswith("\n") else ln + "\n" for ln in lines).encode()
         b = batch_size if batch_size is not None else self._rows_per_line * len(lines)
         out, _, _ = self.pack_chunk(data, b, final=True, max_lines=len(lines))
         return np.ascontiguousarray(out.T)
 
+    def pack_lines(self, lines: list[str], batch_size: int | None = None) -> np.ndarray:
+        """LinePacker-compatible helper (row-major [B, TUPLE_COLS]).
+
+        Mirrors ``LinePacker.pack_parsed``: raises :class:`AnalysisError`
+        when the parse staged v6 evaluations — this v4-only call has no
+        channel to return them, and silently leaving them in ``_staged6``
+        both loses supported traffic and accumulates memory across calls
+        (ADVICE r5 #2).  Use :meth:`pack_lines2` (or the streaming driver,
+        which drains :meth:`take_v6`) for unified corpora.
+        """
+        out = self._pack_lines_v4(lines, batch_size)
+        if self._staged6:
+            n6 = sum(a.shape[0] for a in self._staged6)
+            self._staged6 = []  # don't leak the rows into a later take_v6
+            from ..errors import AnalysisError
+
+            raise AnalysisError(
+                f"pack_lines is v4-only but the parse staged {n6} IPv6 "
+                "evaluation row(s); use pack_lines2 (or the streaming "
+                "driver, which handles both families)"
+            )
+        return out
+
     def pack_lines2(
         self, lines: list[str], batch_size: int | None = None
     ) -> tuple[np.ndarray, np.ndarray]:
         """LinePacker.pack_lines2-compatible helper (padded row-major pair)."""
-        b4 = self.pack_lines(lines, batch_size)
+        b4 = self._pack_lines_v4(lines, batch_size)
         b = b4.shape[0]
         rows6 = self.take_v6()
         out6 = np.zeros((b if self._has_v6 else 0, TUPLE6_COLS), dtype=np.uint32)

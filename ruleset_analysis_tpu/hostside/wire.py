@@ -53,6 +53,7 @@ import numpy as np
 from ..errors import AnalysisError
 from .pack import (
     T_VALID,
+    TUPLE_COLS,
     W_META,
     WIRE_COLS,
     WIRE6_COLS,
@@ -629,8 +630,15 @@ def convert_logs(
             skipped = packer.skipped
             # keep only evaluation rows, wherever the source put them
             # (every current source packs them densely from column 0, but
-            # the mask keeps this correct for any conforming source)
-            valid = batch[:, batch[T_VALID] == 1]
+            # the mask keeps this correct for any conforming source).
+            # The text source marks a zero-v4-row batch as None (a
+            # mostly-v6/unparseable stretch): no v4 rows to store, but
+            # its raw-line/skip accounting must still land in the header.
+            valid = (
+                np.zeros((TUPLE_COLS, 0), dtype=np.uint32)
+                if batch is None
+                else batch[:, batch[T_VALID] == 1]
+            )
             w.add(compact_batch(valid), n_raw, skipped - last_skipped)
             last_skipped = skipped
             if take_v6 is not None:

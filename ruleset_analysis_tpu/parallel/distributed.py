@@ -27,6 +27,7 @@ def init_distributed(
     num_processes: int | None = None,
     process_id: int | None = None,
     heartbeat_timeout_seconds: int | None = None,
+    initialization_timeout: int | None = None,
 ) -> None:
     """Join (or bootstrap) the multi-host cluster.
 
@@ -39,11 +40,33 @@ def init_distributed(
     long and every surviving process's pending collective aborts with an
     error instead of hanging — the rebuilt analog of YARN failing a job
     whose task died (SURVEY.md §6 failure detection).  None keeps JAX's
-    default (100s).
+    default (100s).  Older jax releases take no such parameter; it is
+    silently dropped there (the elastic supervisor's own stale-heartbeat
+    watchdog — runtime/elastic.py — then provides the detection bound,
+    which is why recovery stays bounded-time on every supported jax).
+
+    ``initialization_timeout`` bounds cluster FORMATION: a member listed
+    in a re-formation plan that dies before joining would otherwise hold
+    everyone in initialize() for jax's 300 s default.
     """
+    import inspect
+
+    # Cross-process collectives on the CPU backend (the fake-mesh test
+    # idiom and any CPU-host deployment) need a CPU collectives library;
+    # 0.4.x-era jax defaults to "none" and fails every multi-process
+    # computation with "not implemented on the CPU backend".  Newer jax
+    # defaults this on (or renames the option) — failures are ignored.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     kw = {}
     if heartbeat_timeout_seconds is not None:
         kw["heartbeat_timeout_seconds"] = heartbeat_timeout_seconds
+    if initialization_timeout is not None:
+        kw["initialization_timeout"] = initialization_timeout
+    supported = inspect.signature(jax.distributed.initialize).parameters
+    kw = {k: v for k, v in kw.items() if k in supported}
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -141,6 +164,10 @@ def allgather_rows(rows: np.ndarray) -> np.ndarray:
     padded = np.zeros((m, rows.shape[1]), dtype=np.uint32)
     padded[: rows.shape[0]] = rows
     gathered = np.asarray(multihost_utils.process_allgather(padded))
+    if gathered.ndim == 2:
+        # some jax versions return the single-process gather UNSTACKED
+        # (no leading process axis); normalize to [n_procs, m, C]
+        gathered = gathered[None]
     return np.concatenate(
         [gathered[p, : int(counts[p])] for p in range(gathered.shape[0])]
     )

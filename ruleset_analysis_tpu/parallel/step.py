@@ -41,6 +41,26 @@ from ..ops.match import RULE_BLOCK, match_keys, match_keys_stacked
 _U32 = jnp.uint32
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs):
+    """Version-portable shard_map with replication checking off.
+
+    ``jax.shard_map`` (kwarg ``check_vma``) landed after 0.4.x; older
+    installs ship ``jax.experimental.shard_map`` (kwarg ``check_rep``).
+    Both compile the identical program here — the collectives are written
+    explicitly, so the replication checker adds nothing but version skew.
+    """
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    from jax.experimental.shard_map import shard_map as sm_exp
+
+    return sm_exp(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
+
+
 def _merge_tail(
     state: AnalysisState,
     keys: jax.Array,  # [b] u32 count keys, local shard
@@ -280,12 +300,11 @@ def _make_step(mesh: Mesh, local, batch_spec):
                 by_id[id_key] = (fp, leaves)
             fn = by_value.get(fp)
             if fn is None:
-                sharded = jax.shard_map(
+                sharded = _shard_map(
                     lambda st, b, s: local(st, ruleset, b, s),
                     mesh=mesh,
                     in_specs=(P(), batch_spec, P()),
                     out_specs=(P(), P()),
-                    check_vma=False,
                 )
                 fn = jax.jit(sharded, donate_argnums=(0,))
                 if len(by_value) >= _SPECIALIZED_CACHE_MAX:
@@ -293,12 +312,11 @@ def _make_step(mesh: Mesh, local, batch_spec):
                 by_value[fp] = fn
             return fn(state, batch, salt)
         if generic is None:
-            sharded = jax.shard_map(
+            sharded = _shard_map(
                 local,
                 mesh=mesh,
                 in_specs=(P(), P(), batch_spec, P()),
                 out_specs=(P(), P()),
-                check_vma=False,
             )
             generic = jax.jit(sharded, donate_argnums=(0,))
         return generic(state, ruleset, batch, salt)

@@ -90,6 +90,12 @@ class Snapshot:
     skipped: int
     tracker_tables: dict[int, dict[int, int]]
     fingerprint: str
+    #: Schema extension point (JSON-serializable).  The elastic subsystem
+    #: stores its epoch tag and world-size-independent per-shard cursor
+    #: manifest here (runtime/elastic.py); plain per-process snapshots
+    #: leave it None, and old snapshots load with None — the base schema
+    #: is unchanged either way.
+    extra: dict | None = None
 
 
 def save(ckpt_dir: str, snap: Snapshot) -> None:
@@ -110,6 +116,8 @@ def save(ckpt_dir: str, snap: Snapshot) -> None:
             [acl, list(table.items())] for acl, table in snap.tracker_tables.items()
         ],
     }
+    if snap.extra is not None:
+        manifest["extra"] = snap.extra
     with open(os.path.join(tmp_dir, MANIFEST_FILE), "w", encoding="utf-8") as f:
         json.dump(manifest, f)
         f.flush()
@@ -222,6 +230,7 @@ def load(ckpt_dir: str) -> Snapshot | None:
                 for acl, items in m["tracker"]
             },
             fingerprint=m["fingerprint"],
+            extra=m.get("extra"),
         )
     except (
         ValueError,  # json.JSONDecodeError, np.load format errors
@@ -246,6 +255,7 @@ def snapshot_of(
     skipped: int,
     tracker: TopKTracker,
     fingerprint: str,
+    extra: dict | None = None,
 ) -> Snapshot:
     """Host-side Snapshot of a device AnalysisState (fetches registers)."""
     from ..models.pipeline import state_to_host
@@ -258,6 +268,7 @@ def snapshot_of(
         skipped=skipped,
         tracker_tables=tracker.tables(),
         fingerprint=fingerprint,
+        extra=extra,
     )
 
 

@@ -28,9 +28,12 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     # CPU-only runs (the dev/test fallback) skip the persistent cache by
     # default: XLA:CPU re-loads its AOT result with pseudo machine
     # features (+prefer-no-scatter, ...) and emits a scary
-    # possible-SIGILL error log on every cache hit.  RA_XLA_CACHE_DIR
-    # forces it on anyway.  TPU runs — where the ~15s step compile
-    # actually hurts — always cache.
+    # possible-SIGILL error log on every cache hit — and on some jaxlib
+    # builds the reloaded executable computes WRONG values (observed:
+    # corrupted HLL registers when test workers shared a cache dir).
+    # RA_XLA_CACHE_DIR forces it on anyway, at the caller's own risk.
+    # TPU runs — where the ~15s step compile actually hurts — always
+    # cache.
     if platforms == "cpu" and not os.environ.get("RA_XLA_CACHE_DIR"):
         return None
     # namespace by backend selection so axon/tpu and cpu runs never share

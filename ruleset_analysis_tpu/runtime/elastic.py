@@ -1,0 +1,641 @@
+"""Elastic recovery: automatic cluster re-formation after peer death.
+
+The reference's Hadoop runtime re-executes failed tasks *automatically*
+(YARN restarts a mapper whose node died and the job completes); our
+distributed runtime could only detect a dead peer and abort cleanly,
+leaving a human to restart.  This module closes that gap — the SURVEY §3b
+"elastic/retry analog" promoted from manual to automatic:
+
+- every worker process runs under an :class:`ElasticSupervisor` that
+  registers a filesystem heartbeat in a shared rendezvous directory and
+  spawns the actual analysis worker as a child process per *generation*;
+- the distributed chunk loop snapshots an **epoch-tagged, world-size-
+  independent checkpoint** (replicated registers + a per-shard cursor
+  manifest) into the shared ``epoch/`` directory at the configured
+  cadence (stream.py ``save_epoch_snapshot``);
+- when a peer dies, the survivors' collectives abort (jax heartbeat
+  where supported; the supervisor's own watchdog — stale member
+  heartbeats for whole-node death, per-generation failure markers for
+  worker-only death — kills a wedged child as the version-proof
+  backstop), the supervisors detect the loss, **re-elect** a coordinator
+  (lowest surviving member tag), re-form ``jax.distributed`` at the
+  surviving world size on a fresh port, and spawn the next generation;
+- the new generation loads the epoch checkpoint, **re-splits the unread
+  input shards** across the survivors (deterministic round-robin over the
+  cursor manifest), and resumes.
+
+Teardown of the failed ``jax.distributed`` cluster is by child-process
+exit — the one teardown that can never wedge on a half-dead coordinator.
+
+Because the registers are mergeable and order-invariant, the final
+per-rule hit counts and the unused-rule report are **bit-identical** to an
+uninterrupted run over the same shards, at any surviving world size (the
+top-K talker candidate pool is chunk-boundary-sensitive by design and may
+differ — the same caveat the feeder tier documents).
+
+Rendezvous directory layout (shared filesystem)::
+
+    elastic_dir/
+      members/<tag>.hb        heartbeat file (mtime refreshed ~2x/sec)
+      members/<tag>.job.json  this member's job spec for its workers
+      epoch/                  epoch checkpoints (runtime/checkpoint.py)
+      gen-<g>/join/<tag>      generation-g membership markers
+      gen-<g>/plan.json       leader-written formation plan
+      gen-<g>/worker-<t>.log  per-worker stdio capture
+
+Liveness notes: every wait has a timeout, exhausting ``max_reforms``
+aborts with the existing clean-abort behavior, and a member that misses a
+formation (slow heartbeat) aborts rather than wedging the others.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from ..errors import AnalysisError
+from .metrics import RecoveryMeter
+
+#: seconds between heartbeat-file touches
+HB_INTERVAL = 0.5
+#: a member whose heartbeat is older than this is presumed dead (15 missed
+#: beats: wide enough that host load spikes — a fleet of workers jitting
+#: at once — don't read as death)
+STALE_SEC = 7.5
+#: after a peer is presumed dead, how long a still-running worker gets to
+#: abort on its own (jax's heartbeat surface) before the supervisor kills it
+KILL_GRACE_SEC = 10.0
+#: generation-formation waits (join barrier, plan publication)
+FORM_TIMEOUT_SEC = 180.0
+#: dead-peer detection bound passed to jax.distributed (where supported)
+JAX_HEARTBEAT_SEC = 10
+#: cluster-formation bound: a planned member that died before joining must
+#: not hold everyone in initialize() for jax's 300 s default
+JAX_INIT_TIMEOUT_SEC = 60
+
+#: child exit code that simulates abrupt node death (test fault injection:
+#: the supervisor re-raises it with os._exit, taking the heartbeat with it)
+DIE_RC = 77
+
+
+class FormationTimeout(AnalysisError):
+    """A generation could not form within the rendezvous timeout."""
+
+
+# ---------------------------------------------------------------------------
+# Cursor manifest + shard re-splitting
+# ---------------------------------------------------------------------------
+
+
+def manifest_of(snap) -> tuple[list[str] | None, dict[int, int], set[int]]:
+    """(shards, cursors, done) from an epoch Snapshot (None -> empty)."""
+    if snap is None or not snap.extra or "elastic" not in snap.extra:
+        return None, {}, set()
+    man = snap.extra["elastic"]
+    return (
+        list(man["shards"]),
+        {int(k): int(v) for k, v in man["cursors"].items()},
+        {int(i) for i in man["done"]},
+    )
+
+
+def assign_shards(
+    shards: list[str],
+    cursors: dict[int, int],
+    done: set[int],
+    world_size: int,
+) -> list[list[tuple[int, str, int]]]:
+    """Deterministic re-split of unread shard work across ``world_size`` ranks.
+
+    Whole shards are the assignment unit (the HDFS-input-split analog); a
+    partially-consumed shard travels with its cursor so the new owner
+    resumes mid-file.  Round-robin over the remaining shards in index
+    order — every worker computes the identical split from the shared
+    manifest, so no coordination message is needed.
+    """
+    remaining = [i for i in range(len(shards)) if i not in done]
+    out: list[list[tuple[int, str, int]]] = [[] for _ in range(world_size)]
+    for pos, idx in enumerate(remaining):
+        out[pos % world_size].append((idx, shards[idx], cursors.get(idx, 0)))
+    return out
+
+
+@dataclasses.dataclass
+class ElasticRunSpec:
+    """Everything stream.run_stream_file_distributed needs for one generation."""
+
+    epoch_dir: str
+    shards: list[str]  # the GLOBAL ordered shard list (identical everywhere)
+    assignments: list[tuple[int, str, int]]  # this rank's (idx, path, start)
+    snapshot: object | None  # checkpoint.Snapshot of the epoch, or None
+    base_cursors: dict[int, int]  # manifest cursors at epoch load
+    base_done: set[int]  # shards fully consumed before this generation
+    epoch: int  # generation tag stamped into new snapshots
+    die_after_batches: int | None = None  # TEST-ONLY crash injection
+
+
+# ---------------------------------------------------------------------------
+# Rendezvous helpers
+# ---------------------------------------------------------------------------
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    """fsync'd write-then-rename; ``obj`` may be a pre-serialized string."""
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
+    with os.fdopen(fd, "w", encoding="utf-8") as f:
+        if isinstance(obj, str):
+            f.write(obj)
+        else:
+            json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class _Heartbeat(threading.Thread):
+    """Touches ``members/<tag>.hb`` until stopped (daemon: dies with us)."""
+
+    def __init__(self, path: str):
+        super().__init__(daemon=True)
+        self._path = path
+        self._stop = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                with open(self._path, "a"):
+                    os.utime(self._path, None)
+            except OSError:
+                pass
+            self._stop.wait(HB_INTERVAL)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# Supervisor
+# ---------------------------------------------------------------------------
+
+
+class ElasticSupervisor:
+    """Per-process recovery supervisor: heartbeat, re-election, respawn.
+
+    One supervisor runs in each of the job's N launcher processes (the
+    ``run --distributed --elastic`` CLI path).  The analysis itself runs
+    in a child process per generation, so tearing down a failed
+    ``jax.distributed`` cluster is a child exit — never an in-process
+    re-initialize that can wedge on a half-dead coordinator.
+    """
+
+    def __init__(
+        self,
+        elastic_dir: str,
+        tag: int,
+        n_procs: int,
+        ruleset_prefix: str,
+        shards: list[str],
+        cfg,
+        *,
+        max_reforms: int = 2,
+        topk: int = 10,
+        native: bool | None = None,
+        out_prefix: str | None = None,
+        fault: dict | None = None,
+        heartbeat_timeout: int = JAX_HEARTBEAT_SEC,
+        coordinator_host: str | None = None,
+    ):
+        from ..hostside.wire import is_wire_file
+
+        if not 0 <= tag < n_procs:
+            raise AnalysisError(f"tag {tag} outside 0..{n_procs - 1}")
+        wired = [p for p in shards if is_wire_file(p)]
+        if wired:
+            raise AnalysisError(
+                f"--elastic re-splits text shards; {wired[0]!r} is a "
+                ".rawire wire file (convert-tier elastic is not built yet)"
+            )
+        if cfg.checkpoint_every_chunks < 1:
+            raise AnalysisError(
+                "--elastic needs an epoch-checkpoint cadence; set "
+                "--checkpoint-every N (recovery replays at most N chunks)"
+            )
+        self.dir = os.path.abspath(elastic_dir)
+        self.tag = int(tag)
+        self.n_procs = int(n_procs)
+        self.max_reforms = int(max_reforms)
+        # children always start fresh from the shared epoch dir; the
+        # per-process --resume machinery must not engage
+        self.cfg = cfg.replace(resume=False)
+        self.job = {
+            "ruleset": os.path.abspath(ruleset_prefix),
+            "shards": [os.path.abspath(p) for p in shards],
+            "cfg": self.cfg.to_dict(),
+            "topk": int(topk),
+            "native": native,
+            "out": os.path.abspath(out_prefix) if out_prefix else None,
+            "heartbeat_timeout": int(heartbeat_timeout),
+            "init_timeout": JAX_INIT_TIMEOUT_SEC,
+            "fault": fault,
+        }
+        self.coordinator_host = coordinator_host or os.environ.get(
+            "RA_ELASTIC_HOST", "127.0.0.1"
+        )
+        self.meter = RecoveryMeter()
+        self.reforms_used = 0
+        self.final_world: list[int] | None = None
+        self._hb: _Heartbeat | None = None
+
+    # -- paths ------------------------------------------------------------
+    def _members_dir(self) -> str:
+        return os.path.join(self.dir, "members")
+
+    def _hb_path(self, tag: int) -> str:
+        return os.path.join(self._members_dir(), f"{tag}.hb")
+
+    def _gen_dir(self, gen: int) -> str:
+        return os.path.join(self.dir, f"gen-{gen}")
+
+    def _plan_path(self, gen: int) -> str:
+        return os.path.join(self._gen_dir(gen), "plan.json")
+
+    @property
+    def epoch_dir(self) -> str:
+        return os.path.join(self.dir, "epoch")
+
+    # -- membership -------------------------------------------------------
+    def _fresh_members(self) -> set[int]:
+        now = time.time()
+        fresh = set()
+        try:
+            entries = os.listdir(self._members_dir())
+        except OSError:
+            return fresh
+        for e in entries:
+            if not e.endswith(".hb"):
+                continue
+            try:
+                t = int(e[:-3])
+                if now - os.path.getmtime(os.path.join(self._members_dir(), e)) < STALE_SEC:
+                    fresh.add(t)
+            except (ValueError, OSError):
+                continue
+        return fresh
+
+    def _join(self, gen: int) -> None:
+        d = os.path.join(self._gen_dir(gen), "join")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, str(self.tag)), "w") as f:
+            f.write(str(os.getpid()))
+
+    def _joined(self, gen: int) -> set[int]:
+        d = os.path.join(self._gen_dir(gen), "join")
+        try:
+            return {int(e) for e in os.listdir(d) if e.isdigit()}
+        except OSError:
+            return set()
+
+    def _mark_failed(self, gen: int) -> None:
+        d = os.path.join(self._gen_dir(gen), "failed")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, str(self.tag)), "w") as f:
+            f.write("")
+
+    def _peer_failed(self, gen: int) -> bool:
+        d = os.path.join(self._gen_dir(gen), "failed")
+        try:
+            return any(e.isdigit() and int(e) != self.tag for e in os.listdir(d))
+        except OSError:
+            return False
+
+    # -- formation --------------------------------------------------------
+    def _form(self, gen: int) -> list[int]:
+        """Join the generation-``gen`` barrier; return the agreed world.
+
+        Membership rule: wait until every member with a FRESH heartbeat
+        has joined this generation — a slow-failing survivor keeps its
+        heartbeat fresh, so the barrier waits for it; a dead member's
+        heartbeat goes stale and it simply drops out of the set.  Gen 0
+        additionally waits for the full launch-time membership (processes
+        may still be starting, heartbeat-less).  The member with the
+        lowest surviving tag is the leader: it allocates the coordinator
+        port and publishes the plan; everyone else polls for it.
+        """
+        self._join(gen)
+        deadline = time.monotonic() + FORM_TIMEOUT_SEC
+        plan_path = self._plan_path(gen)
+        while True:
+            if os.path.exists(plan_path):
+                break  # someone already published the plan
+            fresh = self._fresh_members()
+            fresh.add(self.tag)  # our own hb file may lag a beat
+            joined = self._joined(gen)
+            ready = (
+                joined >= set(range(self.n_procs))
+                if gen == 0
+                else fresh <= joined
+            )
+            if ready:
+                world = sorted(joined & fresh | {self.tag})
+                if world and world[0] == self.tag:
+                    # re-elected coordinator: publish the formation plan
+                    plan = {
+                        "gen": gen,
+                        "world": world,
+                        "coordinator": f"{self.coordinator_host}:{_free_port()}",
+                    }
+                    _atomic_write_json(plan_path, plan)
+                    break
+                # not the leader: fall through and poll for the plan (if
+                # the presumed leader died before writing, its heartbeat
+                # goes stale and a later iteration elects the next tag)
+            if time.monotonic() > deadline:
+                raise FormationTimeout(
+                    f"generation {gen} did not form within "
+                    f"{FORM_TIMEOUT_SEC:.0f}s (joined={sorted(joined)}, "
+                    f"fresh={sorted(fresh)})"
+                )
+            time.sleep(0.1)
+        with open(plan_path, "r", encoding="utf-8") as f:
+            plan = json.load(f)
+        if self.tag not in plan["world"]:
+            # our heartbeat was stale when the plan was cut; aborting THIS
+            # member is the safe outcome (the formed world runs without us)
+            raise AnalysisError(
+                f"member {self.tag} missed generation {gen} formation "
+                f"(world={plan['world']}); aborting this launcher"
+            )
+        return list(plan["world"])
+
+    # -- child lifecycle --------------------------------------------------
+    def _spawn_worker(self, gen: int) -> tuple[subprocess.Popen, object]:
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        env["PYTHONPATH"] = os.pathsep.join(
+            [pkg_root, *filter(None, [env.get("PYTHONPATH", "")])]
+        )
+        log = open(
+            os.path.join(self._gen_dir(gen), f"worker-{self.tag}.log"), "ab"
+        )
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "ruleset_analysis_tpu.runtime.elastic",
+                "worker",
+                self.dir,
+                str(self.tag),
+                str(gen),
+            ],
+            env=env,
+            stdout=log,
+            stderr=subprocess.STDOUT,
+        )
+        return proc, log
+
+    def _watch_worker(
+        self, proc: subprocess.Popen, world: list[int], gen: int
+    ) -> int:
+        """Wait for the worker; kill it when a peer is known lost.
+
+        Two loss signals feed the same grace-then-kill path, making
+        detection bounded on EVERY supported jax (where the installed jax
+        has collective heartbeats those usually abort the survivors
+        first; this watchdog is the version-proof bound):
+
+        - a peer's rendezvous heartbeat went stale (whole-node death);
+        - a peer marked this generation failed (worker-only death — its
+          supervisor is alive and heartbeating, but our worker may be
+          wedged in a collective that will never complete).
+
+        A worker still running KILL_GRACE_SEC after either signal is
+        presumed wedged and killed, which counts as an ordinary
+        generation failure and feeds re-formation.
+        """
+        lost_since: float | None = None
+        while True:
+            rc = proc.poll()
+            if rc is not None:
+                return rc
+            peers = set(world) - {self.tag}
+            stale = bool(peers - self._fresh_members())
+            failed = self._peer_failed(gen)
+            if stale or failed:
+                if lost_since is None:
+                    lost_since = time.monotonic()
+                    self.meter.detect(
+                        "peer heartbeat lost" if stale else "peer worker failed"
+                    )
+                elif time.monotonic() - lost_since > KILL_GRACE_SEC:
+                    proc.kill()
+                    proc.wait()
+                    return -9
+            else:
+                # the lagging peer came back (load spike, not death): a
+                # one-off stale reading must not arm a later kill
+                lost_since = None
+            time.sleep(0.2)
+
+    # -- the supervised driver loop ---------------------------------------
+    def run(self) -> tuple[int, str | None]:
+        """Supervise until success or budget exhaustion.
+
+        Returns ``(rc, result_json_path)``: rc 0 on success; the path is
+        set only on the member whose worker held rank 0 of the final
+        generation (the one that wrote the report).
+        """
+        os.makedirs(self._members_dir(), exist_ok=True)
+        os.makedirs(self.epoch_dir, exist_ok=True)
+        _atomic_write_json(
+            os.path.join(self._members_dir(), f"{self.tag}.job.json"), self.job
+        )
+        self._hb = _Heartbeat(self._hb_path(self.tag))
+        self._hb.start()
+        try:
+            gen = 0
+            while True:
+                try:
+                    world = self._form(gen)
+                except FormationTimeout as e:
+                    print(f"elastic: {e}", file=sys.stderr)
+                    return 1, None
+                if gen > 0:
+                    # the moment the replacement cluster is formed and its
+                    # worker is about to run — the recovery is complete
+                    self.meter.recovered(world=len(world))
+                proc, log = self._spawn_worker(gen)
+                try:
+                    rc = self._watch_worker(proc, world, gen)
+                finally:
+                    log.close()
+                if rc == 0:
+                    self.final_world = world
+                    out = self.job["out"]
+                    if world[0] == self.tag and out:
+                        return 0, self._patch_result(out + ".json")
+                    return 0, None
+                if rc == DIE_RC:
+                    # fault injection: this NODE is simulated dead — take
+                    # the heartbeat down with us, abruptly
+                    os._exit(DIE_RC)
+                # tell the peers this generation is dead even though WE
+                # are alive — their workers may be wedged in a collective
+                # and their supervisors see our heartbeat as healthy (the
+                # worker-only-death signal; see _watch_worker)
+                self._mark_failed(gen)
+                self.meter.detect(f"worker exited rc={rc}")
+                self.reforms_used += 1
+                if self.reforms_used > self.max_reforms:
+                    self.meter.abandon()
+                    print(
+                        f"elastic: re-formation budget exhausted "
+                        f"({self.reforms_used - 1} re-forms used, "
+                        f"--max-reforms {self.max_reforms}); aborting "
+                        f"(last worker rc={rc}, log: "
+                        f"{self._gen_dir(gen)}/worker-{self.tag}.log)",
+                        file=sys.stderr,
+                    )
+                    return 2, None
+                print(
+                    f"elastic: generation {gen} failed (worker rc={rc}); "
+                    f"re-forming ({self.reforms_used}/{self.max_reforms})",
+                    file=sys.stderr,
+                )
+                gen += 1
+        finally:
+            if self._hb is not None:
+                self._hb.stop()
+
+    def _patch_result(self, result_path: str) -> str:
+        """Fold the supervisor's recovery metrics into the report totals."""
+        try:
+            with open(result_path, "r", encoding="utf-8") as f:
+                rep = json.load(f)
+        except (OSError, ValueError):
+            return result_path  # report stands as written
+        rec = {"reforms_used": self.reforms_used, **self.meter.summary()}
+        rep.setdefault("totals", {})["recovery"] = rec
+        _atomic_write_json(result_path, rep)
+        return result_path
+
+
+# ---------------------------------------------------------------------------
+# Worker (child) entry — one generation of actual analysis
+# ---------------------------------------------------------------------------
+
+
+def _worker_main(elastic_dir: str, tag: int, gen: int) -> int:
+    with open(
+        os.path.join(elastic_dir, "members", f"{tag}.job.json"),
+        "r",
+        encoding="utf-8",
+    ) as f:
+        job = json.load(f)
+    with open(
+        os.path.join(elastic_dir, f"gen-{gen}", "plan.json"),
+        "r",
+        encoding="utf-8",
+    ) as f:
+        plan = json.load(f)
+    world = list(plan["world"])
+    if tag not in world:
+        print(f"worker {tag}: not in generation {gen} world {world}", file=sys.stderr)
+        return 4
+    rank, nproc = world.index(tag), len(world)
+
+    from ..parallel.distributed import init_distributed
+    from .compcache import enable_persistent_cache
+
+    # every generation is a fresh process: without the on-disk cache each
+    # re-formation would re-pay the full step compile, inflating
+    # time-to-recover by the compile time
+    enable_persistent_cache()
+    init_distributed(
+        plan["coordinator"],
+        nproc,
+        rank,
+        heartbeat_timeout_seconds=job["heartbeat_timeout"],
+        initialization_timeout=job["init_timeout"],
+    )
+
+    import numpy as np
+
+    from ..config import AnalysisConfig
+    from ..hostside import pack
+    from . import checkpoint as ckpt
+    from .stream import run_stream_file_distributed
+
+    packed = pack.load_packed(job["ruleset"])
+    cfg = AnalysisConfig.from_dict(job["cfg"])
+    epoch_dir = os.path.join(elastic_dir, "epoch")
+    snap = ckpt.load(epoch_dir)
+    shards = list(job["shards"])
+    man_shards, cursors, done = manifest_of(snap)
+    if man_shards is not None and man_shards != shards:
+        raise ckpt.CheckpointMismatch(
+            f"epoch snapshot in {epoch_dir!r} covers different shards; "
+            "refusing to merge"
+        )
+    fault = job.get("fault")
+    die = None
+    if (
+        fault is not None
+        and int(fault["tag"]) == tag
+        and (fault.get("gen") is None or gen == int(fault["gen"]))
+    ):
+        # no gen filter: the fault arms at this tag's FIRST opportunity
+        # (its supervisor dies with it, so it never fires twice)
+        die = int(fault["after_batches"])
+    spec = ElasticRunSpec(
+        epoch_dir=epoch_dir,
+        shards=shards,
+        assignments=assign_shards(shards, cursors, done, nproc)[rank],
+        snapshot=snap,
+        base_cursors=cursors,
+        base_done=done,
+        epoch=gen,
+        die_after_batches=die,
+    )
+    report, regs = run_stream_file_distributed(
+        packed,
+        [],
+        cfg,
+        native=job["native"],
+        topk=job["topk"],
+        return_state=True,
+        elastic=spec,
+    )
+    if rank == 0 and job["out"]:
+        np.savez(job["out"] + ".npz", **regs)
+        _atomic_write_json(job["out"] + ".json", report.to_json())
+    print(f"worker {tag} (rank {rank}/{nproc}, gen {gen}) done", file=sys.stderr)
+    return 0
+
+
+
+if __name__ == "__main__":
+    if len(sys.argv) == 5 and sys.argv[1] == "worker":
+        raise SystemExit(
+            _worker_main(sys.argv[2], int(sys.argv[3]), int(sys.argv[4]))
+        )
+    print(
+        "usage: python -m ruleset_analysis_tpu.runtime.elastic worker "
+        "ELASTIC_DIR TAG GEN  (spawned by ElasticSupervisor)",
+        file=sys.stderr,
+    )
+    raise SystemExit(2)

@@ -43,6 +43,55 @@ class ThroughputMeter:
         return time.perf_counter() - self.t0
 
 
+class RecoveryMeter:
+    """Recovery-event counters for the elastic supervisor (runtime/elastic.py).
+
+    One event per cluster re-formation: ``detect()`` marks the moment a
+    peer death (or any generation failure) is observed, ``recovered()``
+    the moment the replacement generation's workers are running again.
+    ``summary()`` feeds the end-of-run report totals, so an operator sees
+    how often the job healed itself and how long each heal took — the
+    observability half of the SURVEY §3b elastic/retry analog.
+    """
+
+    def __init__(self):
+        self.events: list[dict] = []
+        self._t_detect: float | None = None
+
+    def detect(self, reason: str = "") -> None:
+        if self._t_detect is None:  # first detection wins per event
+            self._t_detect = time.perf_counter()
+            self._reason = reason
+
+    def recovered(self, *, world: int) -> None:
+        t = time.perf_counter()
+        t0 = self._t_detect if self._t_detect is not None else t
+        self.events.append(
+            {
+                "time_to_recover_sec": round(t - t0, 3),
+                "world": world,
+                "reason": self._reason if self._t_detect is not None else "",
+            }
+        )
+        self._t_detect = None
+
+    def abandon(self) -> None:
+        """Forget an open detection (budget exhausted: no recovery happened)."""
+        self._t_detect = None
+
+    def summary(self) -> dict:
+        """Totals patch: {} when the run never re-formed (zero-noise)."""
+        if not self.events:
+            return {}
+        return {
+            "recovery_events": len(self.events),
+            "recovery_total_sec": round(
+                sum(e["time_to_recover_sec"] for e in self.events), 3
+            ),
+            "recoveries": self.events,
+        }
+
+
 class Profiler:
     """Context manager around jax.profiler tracing (no-op when dir is None)."""
 

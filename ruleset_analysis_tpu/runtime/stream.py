@@ -53,6 +53,11 @@ class _TextSource:
     an ``in`` and an ``out`` ACL emits two rows.  Counters update as lines
     are assigned to batches, so checkpoint snapshots (taken at batch
     boundaries) always agree with the batches actually emitted.
+
+    A batch whose raw lines produced NO v4 tuple rows (a mostly-IPv6 or
+    mostly-unparseable stretch of the corpus) is yielded as ``(None,
+    n_raw)``: the driver accounts the raw lines (and drains any staged v6
+    rows) without stepping an all-invalid device chunk — ADVICE r5 #3.
     """
 
     #: one shared knob for every source tier (see pack.V6_DIGEST_CAP)
@@ -117,7 +122,7 @@ class _TextSource:
                     packer.parsed += len(gids)
                     raw += 1
                     if raw == batch_size:
-                        yield out, raw
+                        yield (out if fill else None), raw
                         out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
                         fill = 0
                         raw = 0
@@ -135,12 +140,12 @@ class _TextSource:
                 packer.skipped += 1
             raw += 1
             if raw == batch_size:
-                yield out, raw
+                yield (out if fill else None), raw
                 out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
                 fill = 0
                 raw = 0
         if raw:
-            yield out, raw
+            yield (out if fill else None), raw
 
 
 class _PackedCounters:
@@ -369,6 +374,18 @@ def run_stream_wire(
     )
 
 
+def _stage_v6_digests(rows, dig: dict[int, int]) -> None:
+    """Fold native-parser v6 rows into the capped digest->address map."""
+    if not len(rows):
+        return
+    cap = _TextSource.V6_DIGEST_CAP
+    for r in rows:
+        if len(dig) >= cap:
+            break
+        src = pack_mod.limbs_u128(*r[pack_mod.T6_SRC:pack_mod.T6_SRC + 4])
+        dig.setdefault(pack_mod.fold_src32_host(src), src)
+
+
 class _FileSource:
     """Batch source over syslog file(s) via the native C++ parser."""
 
@@ -386,14 +403,7 @@ class _FileSource:
     def take_v6(self):
         """v6 rows the native parser staged (driver side channel)."""
         rows = self.packer.take_v6()
-        if len(rows):
-            dig = self.v6_digests
-            cap = _TextSource.V6_DIGEST_CAP
-            for r in rows:
-                if len(dig) >= cap:
-                    break
-                src = pack_mod.limbs_u128(*r[pack_mod.T6_SRC:pack_mod.T6_SRC + 4])
-                dig.setdefault(pack_mod.fold_src32_host(src), src)
+        _stage_v6_digests(rows, self.v6_digests)
         return rows
 
     def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
@@ -402,6 +412,117 @@ class _FileSource:
         return fastparse.batches_from_files(
             self._paths, self.packer, batch_size, skip_lines=skip_lines
         )
+
+
+class _ShardCursorSource:
+    """Sequential multi-shard source with per-shard resume cursors.
+
+    The elastic tier's input view (runtime/elastic.py): a worker owns a
+    LIST of ``(shard_index, path, start_line)`` assignments instead of one
+    opaque split, consumes them in order, and tracks how many raw lines of
+    each shard have been assigned to emitted batches.  Cursors snapshot at
+    batch boundaries, in world-size-independent per-shard units — exactly
+    what lets a re-formed cluster of ANY surviving size re-split the
+    remaining work and resume with registers covering every consumed line
+    exactly once.
+
+    ``die_after_batches`` is TEST-ONLY fault injection (the elastic analog
+    of ``max_chunks`` crash simulation): the process exits abruptly —
+    ``os._exit``, no teardown — after that many emitted batches, exactly
+    as a failing node would mid-collective.
+    """
+
+    yields_wire = False
+
+    def __init__(
+        self,
+        packed: PackedRuleset,
+        assignments: list[tuple[int, str, int]],
+        native: bool,
+        die_after_batches: int | None = None,
+    ):
+        self._packed = packed
+        self._assignments = list(assignments)
+        self._native = native
+        self._has_v6 = packed.has_v6
+        self.v6_digests: dict[int, int] = {}
+        #: shard_index -> raw lines of that shard assigned to emitted batches
+        self.cursors = {int(i): int(start) for i, _p, start in self._assignments}
+        self.done: set[int] = set()
+        self._die_after = die_after_batches
+        self._yielded = 0
+        self._subs: list[_TextSource] = []
+        if native:
+            from ..hostside import fastparse
+
+            self.packer = fastparse.NativePacker(packed)
+        else:
+            self.packer = LinePacker(packed)
+
+    def set_counts(self, parsed: int, skipped: int) -> None:
+        if self._native:
+            self.packer.set_counts(parsed, skipped)
+        else:
+            self.packer.parsed, self.packer.skipped = parsed, skipped
+
+    def take_v6(self):
+        if self._native:
+            rows = self.packer.take_v6()
+            _stage_v6_digests(rows, self.v6_digests)
+            return rows
+        out: list[tuple] = []
+        for sub in self._subs:
+            out.extend(sub.take_v6())
+        return out
+
+    def cursor_rows(self) -> np.ndarray:
+        """``[n, 4]`` uint32 (idx, cursor_lo, cursor_hi, done) rows.
+
+        The shape the per-epoch manifest gather uses
+        (parallel.distributed.allgather_rows is uint32-only; cursors split
+        into 32-bit limbs so shards past 2^32 lines stay representable).
+        """
+        rows = [
+            (idx, cur & 0xFFFFFFFF, cur >> 32, 1 if idx in self.done else 0)
+            for idx, cur in sorted(self.cursors.items())
+        ]
+        return np.asarray(rows, dtype=np.uint32).reshape(-1, 4)
+
+    def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
+        if skip_lines:
+            from ..errors import AnalysisError
+
+            raise AnalysisError(
+                "elastic sources resume via per-shard cursors, not a "
+                "global skip offset"
+            )
+        for idx, path, start in self._assignments:
+            if self._native:
+                from ..hostside import fastparse
+
+                it = fastparse.batches_from_files(
+                    [path], self.packer, batch_size, skip_lines=start
+                )
+            else:
+                sub = _TextSource(self._packed, _iter_files([path]))
+                sub.packer = self.packer  # shared cumulative counters
+                sub.v6_digests = self.v6_digests  # shared capped digest map
+                self._subs.append(sub)
+                it = sub.batches(start, batch_size)
+            for batch, n_raw in it:
+                # cursor moves as lines are ASSIGNED to a batch, so a
+                # snapshot taken after this batch steps (the driver always
+                # flushes in-flight work first) covers exactly the lines
+                # the cursors claim
+                self.cursors[idx] += n_raw
+                yield batch, n_raw
+                self._yielded += 1
+                if self._die_after is not None and self._yielded >= self._die_after:
+                    # crash injection: abrupt, mid-collective (the exit
+                    # code is elastic.DIE_RC — the supervisor propagates
+                    # it to simulate whole-node death)
+                    os._exit(77)
+            self.done.add(idx)
 
 
 def run_stream(
@@ -508,6 +629,7 @@ def run_stream_file_distributed(
     topk: int = 10,
     return_state: bool = False,
     max_chunks: int | None = None,
+    elastic=None,
 ):
     """Multi-process analysis: each process feeds ITS OWN input split.
 
@@ -525,6 +647,17 @@ def run_stream_file_distributed(
     OWN offset into its OWN split.  The chunk loop is collective, so all
     processes snapshot at the same chunk count; resume verifies that in
     lockstep and refuses a changed process count.
+
+    ``elastic`` (a ``runtime.elastic.ElasticRunSpec``) switches the run
+    into the supervised elastic tier: the source becomes a per-shard
+    cursor source over the spec's assignments (``local_paths`` is
+    ignored), per-process snapshots are replaced by ONE epoch-tagged,
+    world-size-independent checkpoint in ``spec.epoch_dir`` (registers +
+    merged cursor manifest, written by the generation's rank 0), and the
+    fingerprint deliberately excludes mesh width and process layout so a
+    re-formed cluster of any surviving size can resume it.  Driven by
+    ``runtime.elastic.ElasticSupervisor``, never called this way directly
+    by operators.
     """
     from ..hostside import fastparse
     from ..parallel import distributed as dist
@@ -544,7 +677,16 @@ def run_stream_file_distributed(
         raise AnalysisError(
             "cannot mix .rawire and text inputs in one --logs list"
         )
-    if n_wire:
+    if elastic is not None:
+        if native is None:
+            native = fastparse.available()
+        source = _ShardCursorSource(
+            packed,
+            elastic.assignments,
+            native,
+            die_after_batches=elastic.die_after_batches,
+        )
+    elif n_wire:
         source = _WireFileSource(packed, local_paths)
     else:
         if native is None:
@@ -617,17 +759,45 @@ def run_stream_file_distributed(
         # per-process snapshot dir: registers are identical everywhere, but
         # the offset is into THIS process's own input split
         my_ckpt_dir = os.path.join(cfg.checkpoint_dir, f"proc-{pid}-of-{nproc}")
-        fp = (
-            ckpt.fingerprint(
-                packed, cfg, mesh.shape[cfg.mesh_axis], local_lane if stacked else 0
+        if elastic is not None:
+            # Elastic epoch checkpoints are WORLD-SIZE-INDEPENDENT: the
+            # fingerprint pins ruleset + sketch geometry + layout but NOT
+            # mesh width or process layout, because re-formation resumes
+            # on a smaller world by design.  (Candidate-table chunk
+            # boundaries shift across world sizes; the order-invariant
+            # registers — exact counts, CMS, HLL — and therefore the
+            # unused-rule report cannot.)
+            fp = ckpt.fingerprint(packed, cfg, 1, 0) + "-elastic"
+        else:
+            fp = (
+                ckpt.fingerprint(
+                    packed, cfg, mesh.shape[cfg.mesh_axis], local_lane if stacked else 0
+                )
+                + f"-dist{pid}of{nproc}"
+                + ("-wire" if wire_src else "")
             )
-            + f"-dist{pid}of{nproc}"
-            + ("-wire" if wire_src else "")
-        )
         lines_consumed = 0
         n_chunks = 0
         snap = None
-        if cfg.resume:
+        if elastic is not None:
+            snap = elastic.snapshot
+            if snap is not None and snap.fingerprint != fp:
+                raise ckpt.CheckpointMismatch(
+                    f"elastic epoch snapshot in {elastic.epoch_dir!r} was "
+                    "taken with a different ruleset, sketch geometry, or "
+                    "layout; refusing to merge"
+                )
+            # every process read the same epoch file; one tiny allgather
+            # catches a stale-storage torn view before any work happens
+            chunks_all = dist.value_across_processes(
+                snap.n_chunks if snap is not None else -1
+            )
+            if not (chunks_all == chunks_all[0]).all():
+                raise ckpt.CheckpointMismatch(
+                    "processes loaded different elastic epoch snapshots "
+                    f"({chunks_all.tolist()}); shared storage is inconsistent"
+                )
+        elif cfg.resume:
             # Every process must reach every allgather: evaluate ALL local
             # conditions first, gather once, and raise the SAME verdict
             # everywhere — a lone early raise would leave the other processes
@@ -687,8 +857,17 @@ def run_stream_file_distributed(
         if snap is not None:
             state = ckpt.state_of(snap, lambda v: dist.to_global(mesh, v, P()))
             tracker = ckpt.restore_tracker(snap, cfg.sketch.topk_capacity)
-            source.set_counts(snap.parsed, snap.skipped)
-            lines_consumed = snap.lines_consumed
+            if elastic is not None:
+                # the epoch snapshot stores GLOBAL cumulative counters;
+                # seed them on rank 0 only — the final totals re-aggregate
+                # with sum_across_processes, so base + every rank's new
+                # contributions add exactly once
+                if pid == 0:
+                    source.set_counts(snap.parsed, snap.skipped)
+                    lines_consumed = snap.lines_consumed
+            else:
+                source.set_counts(snap.parsed, snap.skipped)
+                lines_consumed = snap.lines_consumed
             n_chunks = snap.n_chunks
         else:
             state_host = pipeline.init_state_host(packed.n_keys, cfg)
@@ -785,6 +964,52 @@ def run_stream_file_distributed(
                 fill6 = 0
             drain_v6_rounds()
 
+        def save_epoch_snapshot() -> None:
+            # Elastic epoch checkpoint: replicated registers + the merged
+            # world-size-independent cursor manifest.  EVERY rank takes
+            # part in the gathers (they are collective); only the
+            # generation's rank 0 writes, atomically, so survivors of a
+            # later failure all load one consistent epoch.
+            merged = dist.allgather_rows(source.cursor_rows())
+            cursors = dict(elastic.base_cursors)
+            done = set(elastic.base_done)
+            for r in merged:
+                cursors[int(r[0])] = int(r[1]) | (int(r[2]) << 32)
+                if int(r[3]):
+                    done.add(int(r[0]))
+            agg = dist.sum_across_processes(
+                {
+                    "lines": lines_consumed,
+                    "parsed": packer.parsed,
+                    "skipped": packer.skipped,
+                }
+            )
+            if pid != 0:
+                return
+            ckpt.save(
+                elastic.epoch_dir,
+                ckpt.snapshot_of(
+                    state,
+                    lines_consumed=agg["lines"],
+                    n_chunks=n_chunks,
+                    parsed=agg["parsed"],
+                    skipped=agg["skipped"],
+                    tracker=tracker,
+                    fingerprint=fp,
+                    extra={
+                        "elastic": {
+                            "epoch": elastic.epoch,
+                            "world": nproc,
+                            "shards": list(elastic.shards),
+                            "cursors": {
+                                str(k): v for k, v in sorted(cursors.items())
+                            },
+                            "done": sorted(done),
+                        }
+                    },
+                ),
+            )
+
         def save_snapshot() -> None:
             if stacked:
                 collective_flush()
@@ -792,6 +1017,9 @@ def run_stream_file_distributed(
             while pending:
                 drain(pending.popleft())
             pipeline.sync_state(state)
+            if elastic is not None:
+                save_epoch_snapshot()
+                return
             ckpt.save(
                 my_ckpt_dir,
                 ckpt.snapshot_of(
@@ -808,7 +1036,11 @@ def run_stream_file_distributed(
         from .metrics import ThroughputMeter
 
         meter = ThroughputMeter(cfg.report_every_chunks)
-        it = source.batches(lines_consumed, local_batch)
+        # elastic sources resume via their per-shard cursors; the global
+        # offset (rank 0's cumulative base) must not be re-skipped
+        it = source.batches(
+            0 if elastic is not None else lines_consumed, local_batch
+        )
         empty_cols = pack_mod.WIRE_COLS if wire_src else TUPLE_COLS
         empty = (
             None if stacked else np.zeros((empty_cols, local_batch), dtype=np.uint32)
@@ -835,8 +1067,24 @@ def run_stream_file_distributed(
                 batch_np, n_raw = nxt
                 lines_consumed += n_raw
                 meter.tick(n_raw)
+                if batch_np is None:  # zero-valid text batch: lines only
+                    continue
                 cols = pack_mod.expand_batch(batch_np) if wire_src else batch_np
                 ready.extend(gbuf.add(np.ascontiguousarray(cols.T)))
+
+        def next_real():
+            # pull the next steppable batch, absorbing zero-valid (None)
+            # text batches as pure raw-line accounting — the collective
+            # round protocol only ever sees batches that need a step
+            nonlocal lines_consumed
+            while True:
+                nxt = next(it, None)
+                if nxt is None or nxt[0] is not None:
+                    return nxt
+                lines_consumed += nxt[1]
+                meter.tick(nxt[1])
+                if step6 is not None:
+                    pull_v6()
 
         def step_grouped_round(has: bool) -> None:
             nonlocal state, n_chunks
@@ -860,7 +1108,7 @@ def run_stream_file_distributed(
                 refill_ready()
                 has = bool(ready)
             else:
-                nxt = next(it, None)
+                nxt = next_real()
                 has = nxt is not None
             # collective agreement: everyone steps while anyone has data
             if not dist.all_processes_have_data(has):
@@ -978,6 +1226,9 @@ def run_stream_file_distributed(
             "elapsed_sec": round(elapsed, 4),
             "lines_per_sec": round(lines_this_run / elapsed, 1) if elapsed > 0 else 0.0,
         }
+        if elastic is not None:
+            # which generation of the elastic cluster produced the report
+            totals["elastic_epoch"] = elastic.epoch
         v6_digests = getattr(source, "v6_digests", None)
         if step6 is not None:
             # The tracker is replicated but each process's digest map only
@@ -1285,6 +1536,21 @@ def _run_core_impl(
     last_snap_chunks = n_chunks  # snapshot cadence is device chunks SINCE
     with Profiler(profile_dir):  # the last save (stacked emits unevenly)
         for batch_np, n_raw_lines in source.batches(lines_consumed, batch_size):
+            if batch_np is None:
+                # zero-valid text batch (mostly-v6/unparseable stretch):
+                # account the raw lines and drain staged v6 rows, but skip
+                # the all-invalid v4 device step entirely.  Still ticks
+                # chunks_this_run so max_chunks crash simulation aborts at
+                # the same source-batch boundary it always did.
+                lines_consumed += n_raw_lines
+                meter.tick(n_raw_lines)
+                if step6 is not None:
+                    stage_v6()
+                chunks_this_run += 1
+                if max_chunks is not None and chunks_this_run >= max_chunks:
+                    aborted = True
+                    break
+                continue
             if gbuf is not None:
                 # bucket by ACL; grouped batches emit when a lane fills
                 cols = (
@@ -1333,7 +1599,8 @@ def _run_core_impl(
     if b6fn is not None and step6 is not None and not aborted:
         skip6 = max(0, lines_at_start - source.n4_rows)
         for b6, n_rows6 in b6fn(skip6, batch_size):
-            run_chunk6(mesh_lib.shard_batch(mesh, b6, cfg.mesh_axis))
+            # raw numpy in: run_chunk6 does the single shard_batch itself
+            run_chunk6(b6)
             lines_consumed += n_rows6
             chunks_this_run += 1
             meter.tick(n_rows6)

@@ -54,5 +54,11 @@ if not _needs_reexec():
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8"
         ).strip()
+    # NOTE: do NOT point RA_XLA_CACHE_DIR at a shared cache here to speed
+    # the spawned workers up — on this jaxlib the XLA:CPU persistent
+    # cache reloads executables that compute WRONG register values
+    # (observed: corrupted HLL registers in the distributed wire test).
+    # runtime/compcache.py skips the CPU cache by default for exactly
+    # this class of problem.
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -98,6 +98,64 @@ def test_snapshot_roundtrip(tmp_path):
     assert ckpt.load(str(tmp_path)).lines_consumed == 456
 
 
+def test_epoch_snapshot_roundtrip_on_fake_mesh(corpus, tmp_path):
+    """Elastic epoch schema: a snapshot carrying the world-size-independent
+    cursor manifest (Snapshot.extra) round-trips exactly, old snapshots
+    load with extra=None, and the restored registers place onto the fake
+    8-device mesh bit-identically (the re-formed-cluster restore path)."""
+    import jax
+
+    from ruleset_analysis_tpu.config import AnalysisConfig
+    from ruleset_analysis_tpu.models import pipeline
+    from ruleset_analysis_tpu.parallel import mesh as mesh_lib
+    from ruleset_analysis_tpu.runtime.elastic import manifest_of
+
+    packed, lines = corpus
+    cfg = AnalysisConfig(batch_size=256, sketch=SketchConfig(hll_p=6))
+    state = pipeline.init_state_host(packed.n_keys, cfg)
+    manifest = {
+        "epoch": 2,
+        "world": 3,
+        "shards": ["/logs/a.log", "/logs/b.log", "/logs/c.log"],
+        "cursors": {"0": 400, "1": 2**33 + 7, "2": 0},  # >32-bit cursor too
+        "done": [0],
+    }
+    snap = ckpt.Snapshot(
+        arrays=dict(state._asdict()),
+        lines_consumed=400,
+        n_chunks=6,
+        parsed=400,
+        skipped=0,
+        tracker_tables={1: {10: 5}},
+        fingerprint="fp-elastic",
+        extra={"elastic": manifest},
+    )
+    ckpt.save(str(tmp_path), snap)
+    got = ckpt.load(str(tmp_path))
+    assert got.extra == {"elastic": manifest}
+    shards, cursors, done = manifest_of(got)
+    assert shards == manifest["shards"]
+    assert cursors == {0: 400, 1: 2**33 + 7, 2: 0}
+    assert done == {0}
+    # registers restore onto the fake 8-device mesh bit-identically
+    mesh = mesh_lib.make_mesh()
+    assert mesh.devices.size == 8
+    dev_state = ckpt.state_of(
+        got, lambda v: jax.device_put(v, mesh_lib.replicated(mesh))
+    )
+    for k, v in snap.arrays.items():
+        np.testing.assert_array_equal(np.asarray(getattr(dev_state, k)), v)
+    # a manifest-less snapshot (the pre-elastic schema) loads as extra=None
+    snap2 = ckpt.Snapshot(
+        arrays={"a": np.arange(3, dtype=np.uint32)}, lines_consumed=1,
+        n_chunks=1, parsed=1, skipped=0, tracker_tables={}, fingerprint="x",
+    )
+    ckpt.save(str(tmp_path / "plain"), snap2)
+    plain = ckpt.load(str(tmp_path / "plain"))
+    assert plain.extra is None
+    assert manifest_of(plain) == (None, {}, set())
+
+
 def test_same_chunk_resave_never_deletes_live_snapshot(tmp_path):
     """Re-saving at the same chunk count (e.g. end-of-run save right after
     a periodic one) must not delete the dir LATEST points at — the re-save

@@ -262,6 +262,7 @@ def test_four_process_uneven_splits_including_empty(corpus):
     assert rep["unused"] == rep_ref["unused"]
 
 
+@pytest.mark.slow  # widest fake mesh; 4-process uneven covers multi>2 in tier-1
 def test_eight_process_registers_match_single(corpus):
     """8 processes x 1 fake device each == the SURVEY §5 fake-mesh idiom
     at its widest; registers bit-identical to the single-process run."""
@@ -287,6 +288,8 @@ def test_eight_process_registers_match_single(corpus):
     assert rep["totals"]["lines_total"] == 1200
 
 
+@pytest.mark.slow  # ~100s: jax-level detection needs its full heartbeat
+# window on old jax; the elastic tier tests cover peer death in tier-1
 def test_killed_process_fails_cleanly_not_hangs(corpus):
     """SURVEY §6 failure detection: when a peer dies abruptly mid-job, the
     survivor must abort with an error in bounded time (heartbeat-driven
@@ -325,6 +328,8 @@ def test_killed_process_fails_cleanly_not_hangs(corpus):
     assert serr.strip(), "survivor produced no error output"
 
 
+@pytest.mark.slow  # stacked snapshot barrier also covered by flat crash +
+# single-process stacked tests in tier-1
 def test_two_process_stacked_checkpoint_crash_resume(corpus):
     """VERDICT r3 #4: checkpoint/resume on the stacked distributed path.
     Snapshots are collective flush barriers, so crash+resume registers are
@@ -509,6 +514,7 @@ def test_two_process_v6_bit_identical_and_oracle_exact(corpus6):
     assert rep0["talkers"] == rep1["talkers"]
 
 
+@pytest.mark.slow  # v6 crash/resume is tier-1 single-process (test_stream6)
 def test_two_process_v6_crash_resume(corpus6):
     td, prefix, res = corpus6
     ck = str(td / "ck6")
@@ -562,6 +568,7 @@ def test_two_process_v6_wire_input_matches_text(corpus6, tmp_path):
     assert got_hits == dict(res.hits)
 
 
+@pytest.mark.slow  # stacked+v6 each covered separately in tier-1
 def test_two_process_v6_stacked_bit_identical(corpus6, tmp_path):
     """Stacked layout + v6 side channel across 2 processes == 1 process."""
     td, prefix, res = corpus6

@@ -341,3 +341,32 @@ class TestFuzzParity:
         np.testing.assert_array_equal(ref, got)
         assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
         assert py.skipped > 0  # the corpus really contains corrupt lines
+
+
+def test_pack_lines_refuses_staged_v6_rows():
+    """pack_lines is v4-only: a unified corpus that stages v6 evaluations
+    must raise (mirroring LinePacker.pack_parsed), not silently drop the
+    rows into _staged6 where they leak across calls (ADVICE r5 #2)."""
+    from ruleset_analysis_tpu.errors import AnalysisError
+
+    cfg = synth.synth_config(n_acls=2, rules_per_acl=6, seed=3, v6_fraction=0.5)
+    rs = aclparse.parse_asa_config(cfg, "fw6")
+    packed = pack.pack_rulesets([rs])
+    assert packed.has_v6
+    v6_lines = synth.render_syslog6(
+        packed, synth.synth_tuples6(packed, 4, seed=3), seed=4
+    )
+    v4_lines = synth.render_syslog(
+        packed, synth.synth_tuples(packed, 4, seed=5), seed=6
+    )
+    nat = fastparse.NativePacker(packed)
+    with pytest.raises(AnalysisError, match="pack_lines2"):
+        nat.pack_lines(v6_lines + v4_lines, batch_size=16)
+    # the refused rows were cleared, not left to leak into a later drain
+    assert len(nat.take_v6()) == 0
+    # pure-v4 calls still work on the same packer afterwards
+    out = nat.pack_lines(v4_lines, batch_size=16)
+    assert out.shape == (16, pack.TUPLE_COLS)
+    # the dual-plane API remains the sanctioned route for unified corpora
+    b4, b6 = nat.pack_lines2(v6_lines + v4_lines, batch_size=16)
+    assert int((b6[:, pack.T6_VALID] == 1).sum()) == len(v6_lines)

@@ -491,3 +491,59 @@ def test_106023_src_mid_token_colon_backtracks_like_regex():
     assert (py.parsed, py.skipped) == (nat.parsed, nat.skipped)
     # and the first line really did parse (src = 172.17.70.70)
     assert py.parsed >= 1
+
+
+def test_zero_valid_v4_batches_skip_device_step():
+    """A mostly-IPv6 corpus must not step all-invalid v4 device chunks:
+    _TextSource yields (None, n_raw) for zero-fill batches and the driver
+    accounts the raw lines without a v4 step (ADVICE r5 #3)."""
+    from ruleset_analysis_tpu.runtime.stream import _TextSource
+
+    rs = aclparse.parse_asa_config(CFG, "fw1")
+    packed = pack.pack_rulesets([rs])
+    v6_only = mixed_lines(512, seed=11, v6_share=1.0)
+    src = _TextSource(packed, iter(v6_only))
+    got = list(src.batches(0, 128))
+    # every batch is a zero-fill marker: raw lines accounted, no array
+    assert [n for _b, n in got] == [128, 128, 128, 128]
+    assert all(b is None for b, _n in got)
+    assert src.packer.parsed > 0  # the v6 rows went to the side channel
+    assert len(src.take_v6()) == src.packer.parsed
+
+    # end-to-end: the driver steps ONLY v6 chunks for this corpus and the
+    # report still matches the oracle exactly
+    res = oracle.Oracle([rs]).consume(list(v6_only))
+    cfg128 = run_cfg().replace(batch_size=128)
+    rep = run_stream(packed, iter(v6_only), cfg128, topk=5)
+    assert report_hits(rep) == dict(res.hits)
+    assert rep.totals["lines_total"] == 512
+    assert rep.totals["lines_matched"] == res.lines_matched
+    # chunk count covers the v6 program only — the pre-fix driver stepped
+    # one all-invalid v4 chunk per 128 v6 raw lines on top of these
+    evals = res.lines_matched
+    assert rep.totals["chunks"] == -(-evals // 128)  # ceil: v6 chunks alone
+
+
+def test_convert_python_tier_handles_zero_valid_batches(tmp_path):
+    """The python-tier converter must survive (None, n_raw) zero-v4
+    batches from _TextSource: raw-line/skip accounting still lands in the
+    wire header, and the wire run matches the oracle exactly."""
+    from ruleset_analysis_tpu.hostside import wire
+    from ruleset_analysis_tpu.runtime.stream import run_stream_wire
+
+    rs = aclparse.parse_asa_config(CFG, "fw1")
+    packed = pack.pack_rulesets([rs])
+    v6_only = mixed_lines(300, seed=12, v6_share=1.0)
+    p = tmp_path / "v6.log"
+    p.write_text("\n".join(v6_only) + "\n")
+    out = str(tmp_path / "v6.rawire")
+    stats = wire.convert_logs(
+        packed, [str(p)], out, native=False, batch_size=128, block_rows=128
+    )
+    assert stats["raw_lines"] == 300
+    assert stats["rows"] == 0  # no v4 evaluation rows at all
+    assert stats["rows6"] > 0
+    res = oracle.Oracle([rs]).consume(list(v6_only))
+    rep = run_stream_wire(packed, out, run_cfg(), topk=5)
+    assert report_hits(rep) == dict(res.hits)
+    assert rep.totals["lines_total"] == 300
