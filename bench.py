@@ -350,8 +350,12 @@ def _bench_e2e(packed, cpu_scale: bool, mesh, device_lines_per_sec: float) -> di
     from ruleset_analysis_tpu.hostside import synth
     from ruleset_analysis_tpu.runtime import stream
 
-    n_lines = (1 << 19) if cpu_scale else (1 << 22)
-    batch_size = 1 << 20
+    # batch matches the headline device measurement (per-chip batch x
+    # devices) so the per-stage rates and the overlapped run price the
+    # same chunk geometry; enough chunks that the pipelined ingest
+    # actually overlaps (a single-chunk corpus cannot pipeline).
+    n_lines = (1 << 21) if cpu_scale else (1 << 22)
+    batch_size = (1 << 19) if cpu_scale else (1 << 20)
     try:
         with tempfile.TemporaryDirectory() as td:
             path = os.path.join(td, "bench.log")
@@ -372,12 +376,20 @@ def _bench_e2e(packed, cpu_scale: bool, mesh, device_lines_per_sec: float) -> di
                 sketch=SketchConfig(cms_width=1 << 14, cms_depth=4, hll_p=8),
             )
             # warm the jit cache so the timed run measures steady state,
-            # not compilation (stream builds a fresh jit wrapper per call)
+            # not compilation (the step builders are memoized per
+            # geometry, so the timed run reuses this run's executables);
+            # the driver additionally prices any residual first-dispatch
+            # compile separately in totals.compile_sec
             stream.run_stream_file(packed, path, cfg, mesh=mesh, max_chunks=1)
             t0 = time.perf_counter()
-            stream.run_stream_file(packed, path, cfg, mesh=mesh)
+            rep = stream.run_stream_file(packed, path, cfg, mesh=mesh)
             dt = time.perf_counter() - t0
             overlapped = n_lines / dt
+            # the honest pipelined number: rate with the one-time compile
+            # priced out (reported separately), as the driver measures it
+            sustained = rep.totals.get("sustained_lines_per_sec") or overlapped
+            compile_sec = rep.totals.get("compile_sec", 0.0)
+            ingest = rep.totals.get("ingest")
 
             # --- packed ingest tier (SURVEY §8.2 / VERDICT r3 #2): convert
             # once, then the production wire run — repeated analysis pays
@@ -394,15 +406,19 @@ def _bench_e2e(packed, cpu_scale: bool, mesh, device_lines_per_sec: float) -> di
             t_convert = time.perf_counter() - t0
             stream.run_stream_wire(packed, wire_path, cfg, mesh=mesh, max_chunks=1)
             t0 = time.perf_counter()
-            stream.run_stream_wire(packed, wire_path, cfg, mesh=mesh)
+            rep_w = stream.run_stream_wire(packed, wire_path, cfg, mesh=mesh)
             dt_wire = time.perf_counter() - t0
             wire_lps = n_lines / dt_wire
+            wire_sustained = (
+                rep_w.totals.get("sustained_lines_per_sec") or wire_lps
+            )
 
             rates = {
                 "parse_lines_per_sec": parse["lines_per_sec"],
                 "h2d_lines_per_sec": h2d["lines_per_sec"],
                 "device_lines_per_sec": round(device_lines_per_sec, 1),
                 "overlapped_lines_per_sec": round(overlapped, 1),
+                "overlapped_sustained_lines_per_sec": round(sustained, 1),
                 "wire_ingest_lines_per_sec": round(wire_lps, 1),
             }
             # Real-host H2D projection input: a v5e host moves ≥8 GB/s
@@ -422,18 +438,24 @@ def _bench_e2e(packed, cpu_scale: bool, mesh, device_lines_per_sec: float) -> di
                 "lines": n_lines,
                 "elapsed_sec": round(dt, 3),
                 "lines_per_sec": round(overlapped, 1),
+                # the pipelined-driver sustained rate (one-time compile
+                # priced out below, never silently folded in)
+                "sustained_lines_per_sec": round(sustained, 1),
+                "compile_sec": round(compile_sec, 4),
+                "ingest": ingest,
                 "parser": "native" if _native_available() else "python",
                 "stages": rates,
                 "parse_detail": parse,
                 "h2d_detail": h2d,
                 "wire_ingest": {
                     "lines_per_sec": round(wire_lps, 1),
+                    "sustained_lines_per_sec": round(wire_sustained, 1),
                     "elapsed_sec": round(dt_wire, 3),
                     "convert_sec": round(t_convert, 3),
                     "convert_lines_per_sec": round(n_lines / t_convert, 1),
                     "rows": wstats["rows"],
                     "file_mb": round(wstats["bytes"] / 1e6, 1),
-                    "speedup_vs_text_e2e": round(wire_lps / overlapped, 2),
+                    "speedup_vs_text_e2e": round(wire_sustained / sustained, 2),
                     # without parse, the wire path is bounded by link+device
                     "bottleneck": min(
                         ("h2d_transfer", h2d["lines_per_sec"]),
@@ -462,8 +484,13 @@ def _bench_e2e(packed, cpu_scale: bool, mesh, device_lines_per_sec: float) -> di
                 },
                 "bottleneck": bottleneck,
                 # overlap quality: 1.0 = perfect pipelining to the slowest
-                # stage; the serial bound is what zero overlap would give
-                "pipeline_efficiency": round(overlapped / stage_min, 4),
+                # stage; the serial bound is what zero overlap would give.
+                # Measured on the SUSTAINED rate — the one-time compile is
+                # priced separately above, not laundered into overlap.
+                "pipeline_efficiency": round(sustained / stage_min, 4),
+                "pipeline_efficiency_incl_compile": round(
+                    overlapped / stage_min, 4
+                ),
                 "serial_bound_lines_per_sec": round(
                     1.0
                     / (

@@ -105,10 +105,11 @@ def _cmd_run(args: argparse.Namespace) -> int:
             checkpoint_every_chunks=args.checkpoint_every,
             resume=args.resume,
             report_every_chunks=args.report_every,
-            match_impl=args.match_impl,
+            match_impl=args.experimental_match_impl or args.match_impl,
             counts_impl=args.counts_impl,
             layout=args.layout,
             stacked_lane=args.stacked_lane,
+            prefetch_depth=args.prefetch_depth,
             **({"checkpoint_dir": args.checkpoint_dir} if args.checkpoint_dir else {}),
         )
     except ValueError as e:
@@ -139,8 +140,13 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--packed-input": args.packed_input,
             "--no-exact-counts": not args.exact_counts,
             "--feed-workers": args.feed_workers > 1,
+            "--feed-mode=thread": args.feed_workers > 1 and args.feed_mode != "process",
+            "--experimental-match-impl": bool(args.experimental_match_impl),
             "--elastic": args.elastic,
         }
+        # --prefetch-depth is deliberately NOT rejected: like
+        # --batch-size it is a tpu-path tuning knob the oracle ignores,
+        # and rejecting its off value (0) would be nonsense
         bad = [k for k, v in tpu_only.items() if v]
         if bad:
             print(
@@ -352,6 +358,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 topk=args.topk,
                 profile_dir=args.profile_dir,
                 feed_workers=args.feed_workers,
+                feed_mode=args.feed_mode,
             )
         else:
             rep = run_stream(packed, lines, cfg, topk=args.topk, profile_dir=args.profile_dir)
@@ -641,17 +648,34 @@ def make_parser() -> argparse.ArgumentParser:
                    help="require --logs to be .rawire wire files (see "
                         "`convert`; wire inputs are also auto-detected)")
     p.add_argument("--feed-workers", type=int, default=0, metavar="N",
-                   help="parse with N worker processes over file shards "
+                   help="parse with N workers over file shards "
                         "(multi-core hosts; implies the native parser; 0/1 = off)")
+    p.add_argument("--feed-mode", choices=["process", "thread"],
+                   default="process",
+                   help="worker kind for --feed-workers: separate processes "
+                        "packing into shared memory, or in-process threads "
+                        "around the GIL-releasing native parser")
+    p.add_argument("--prefetch-depth", type=int,
+                   default=AnalysisConfig.prefetch_depth, metavar="K",
+                   help="pipelined ingest: parse/pack/device_put up to K "
+                        "batches ahead of the device step on a background "
+                        "producer (bit-identical reports; 0 = synchronous "
+                        "driver)")
     p.add_argument("--layout", choices=["flat", "stacked"], default="flat",
                    help="rule-match layout: flat scans all rules per line; stacked "
                         "buckets lines by ACL and vmaps over per-ACL rule slabs "
                         "(faster for many firewalls/ACLs)")
     p.add_argument("--stacked-lane", type=int, default=0, metavar="N",
                    help="per-ACL lane width for --layout=stacked (0 = auto)")
-    p.add_argument("--match-impl", choices=["xla", "pallas", "pallas_fused"],
+    p.add_argument("--match-impl", choices=["xla", "pallas"],
                    default="xla",
                    help="first-match kernel (bench_suite.py pallas compares them)")
+    p.add_argument("--experimental-match-impl", choices=["pallas_fused"],
+                   default=None, metavar="IMPL",
+                   help="enable an EXPERIMENTAL kernel, overriding "
+                        "--match-impl (pallas_fused: match + in-VMEM counts "
+                        "in one kernel, measured 0.083x vs xla on TPU — "
+                        "logged loudly at run time; bench/research only)")
     p.add_argument("--counts-impl", choices=["scatter", "matmul", "reduce"],
                    default="scatter",
                    help="exact-counts formulation (bench_suite.py stage "

@@ -140,6 +140,13 @@ class AnalysisConfig:
     #: Per-ACL lane width of a stacked grouped batch; 0 = auto
     #: (~batch_size / n_acls, padded to the mesh).
     stacked_lane: int = 0
+    #: Bounded prefetch depth of the pipelined ingest engine
+    #: (runtime/ingest.py): a background producer parses / packs / issues
+    #: the async device_put for up to this many batches ahead of the
+    #: device step, so host parse and H2D overlap compute.  Reports stay
+    #: bit-identical to the synchronous driver (batches commit in order).
+    #: 0 = synchronous (the pre-pipelined driver); 2 = triple buffering.
+    prefetch_depth: int = 2
 
     def __post_init__(self) -> None:
         if self.batch_size < 1:
@@ -169,6 +176,10 @@ class AnalysisConfig:
             raise ValueError(f"layout must be 'flat' or 'stacked', got {self.layout!r}")
         if self.stacked_lane < 0:
             raise ValueError("stacked_lane must be >= 0")
+        if not 0 <= self.prefetch_depth <= 1024:
+            raise ValueError(
+                f"prefetch_depth must be in 0..1024, got {self.prefetch_depth}"
+            )
         if self.register_memory_budget_bytes < 1:
             raise ValueError("register_memory_budget_bytes must be >= 1")
         if self.layout == "stacked" and self.match_impl != "xla":

@@ -25,3 +25,12 @@ class ResumeInputMismatch(AnalysisError):
 
 class NativeParserUnavailable(AnalysisError):
     """The C++ parser was requested but its library cannot be built/loaded."""
+
+
+class FeedWorkerError(AnalysisError):
+    """A parse feed worker (process or thread) died or reported failure.
+
+    Raised by the multi-worker feed tiers instead of hanging on a
+    completion that will never arrive — a worker killed by the OS (OOM),
+    a crashed parse, or a poisoned descriptor all surface as this typed
+    error within the liveness timeout."""

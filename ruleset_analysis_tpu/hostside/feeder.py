@@ -37,6 +37,7 @@ from multiprocessing import shared_memory
 import numpy as np
 
 from . import fastparse
+from ..errors import FeedWorkerError
 from .pack import PackedRuleset, TUPLE_COLS, TUPLE6_COLS
 
 #: Coordinator read granularity while scanning for batch boundaries.
@@ -184,14 +185,13 @@ class _FeedCounters:
         self.skipped = 0
 
 
-class ParallelFeeder:
-    """Stream-source over files backed by N parse worker processes.
+class _FeederBase:
+    """Shared source-protocol state of the multi-worker feed tiers.
 
-    Drop-in for the stream driver's source protocol: ``.packer`` exposes
-    parsed/skipped counters and ``.batches(skip_lines, batch_size)``
-    yields ``([TUPLE_COLS, rows_cap] uint32, raw_line_count)`` in input
-    order.  ``rows_cap`` is fixed per run (2x batch_size with
-    out-bindings), so one compiled device program serves every chunk.
+    Both tiers commit worker completions in input order: parsed/skipped
+    deltas fold into ``.packer`` and v6 rows stage for ``take_v6`` only
+    when their batch is YIELDED, so checkpoint snapshots stay coherent
+    with consumed input no matter how far workers ran ahead.
     """
 
     def __init__(self, packed: PackedRuleset, paths: list[str], n_workers: int | None = None):
@@ -222,9 +222,30 @@ class ParallelFeeder:
             return chunks[0]
         return np.concatenate(chunks)
 
-    def batches(self, skip_lines: int, batch_size: int):
-        from .pack import T6_SRC, fold_src32_host, limbs_u128
+    def _stage_v6(self, rows6: np.ndarray) -> None:
+        """Commit one batch's v6 rows + talker digests, in input order."""
+        from .pack import T6_SRC, V6_DIGEST_CAP, fold_src32_host, limbs_u128
 
+        dig = self.v6_digests
+        for r in rows6:
+            if len(dig) >= V6_DIGEST_CAP:
+                break
+            src = limbs_u128(*r[T6_SRC:T6_SRC + 4])
+            dig.setdefault(fold_src32_host(src), src)
+        self._v6chunks.append(rows6)
+
+
+class ParallelFeeder(_FeederBase):
+    """Stream-source over files backed by N parse worker processes.
+
+    Drop-in for the stream driver's source protocol: ``.packer`` exposes
+    parsed/skipped counters and ``.batches(skip_lines, batch_size)``
+    yields ``([TUPLE_COLS, rows_cap] uint32, raw_line_count)`` in input
+    order.  ``rows_cap`` is fixed per run (2x batch_size with
+    out-bindings), so one compiled device program serves every chunk.
+    """
+
+    def batches(self, skip_lines: int, batch_size: int):
         self.packer.parsed, self.packer.skipped = self._resume_counts
         rows_cap = (2 if self.packed.bindings_out else 1) * batch_size
         # v6 plane: any line of a batch can be a dual-evaluation v6 line
@@ -283,13 +304,13 @@ class ParallelFeeder:
                     except _queue.Empty:
                         dead = [w.pid for w in workers if not w.is_alive()]
                         if dead:
-                            raise RuntimeError(
+                            raise FeedWorkerError(
                                 f"feeder worker(s) {dead} died without "
                                 "reporting (killed by the OS?)"
                             )
                         continue
                     if msg[0] == "error":
-                        raise RuntimeError(
+                        raise FeedWorkerError(
                             f"feeder worker failed on batch {msg[1]}: {msg[2]}"
                         )
                     idx, slot, lines, dp, ds, n6 = msg
@@ -306,16 +327,7 @@ class ParallelFeeder:
                         buffer=shm.buf,
                         offset=4 * (slot * slot_words + TUPLE_COLS * rows_cap),
                     )
-                    rows6 = np.ascontiguousarray(plane6[:, :n6].T)
-                    dig = self.v6_digests
-                    from .pack import V6_DIGEST_CAP
-
-                    for r in rows6:
-                        if len(dig) >= V6_DIGEST_CAP:
-                            break
-                        src = limbs_u128(*r[T6_SRC:T6_SRC + 4])
-                        dig.setdefault(fold_src32_host(src), src)
-                    self._v6chunks.append(rows6)
+                    self._stage_v6(np.ascontiguousarray(plane6[:, :n6].T))
                 free_slots.append(slot)
                 next_yield += 1
                 self.packer.parsed += dp
@@ -331,3 +343,94 @@ class ParallelFeeder:
                     w.terminate()
             shm.close()
             shm.unlink()
+
+
+class ThreadedFeeder(_FeederBase):
+    """In-process threaded twin of :class:`ParallelFeeder`.
+
+    Worker THREADS parse the same exact-raw-line byte-range descriptors
+    the coordinator scans; the native parser releases the GIL for the
+    parse itself, so threads scale across cores with no spawn cost, no
+    pickling, and no shared-memory plumbing — the tier of choice when
+    the driver process can spare cores (the prefetching ingest engine
+    stacks on top, overlapping whichever tier feeds it with the device
+    step).  Each thread builds ONE NativePacker lazily (the gid tables
+    are per-thread, reused across its descriptors); completions commit
+    strictly in input order with their parsed/skipped deltas and staged
+    v6 rows, so batch boundaries — and the top-K caveat — are identical
+    to the process tier over the same input.
+    """
+
+    def batches(self, skip_lines: int, batch_size: int):
+        import concurrent.futures as cf
+        import threading
+
+        self.packer.parsed, self.packer.skipped = self._resume_counts
+        rows_cap = (2 if self.packed.bindings_out else 1) * batch_size
+        has_v6 = self.packed.has_v6
+        tl = threading.local()
+        # every handle any worker thread opens, for deterministic release
+        # in the finally below (thread-local GC alone would hold fds open
+        # past an early consumer exit — the same discipline _run_core's
+        # close() applies to wire mmaps)
+        files_lock = threading.Lock()
+        opened: list = []
+
+        def work(desc):
+            path_i, offset, nbytes, n_lines = desc
+            pk = getattr(tl, "packer", None)
+            if pk is None:
+                pk = tl.packer = fastparse.NativePacker(self.packed)
+                tl.files = {}
+            f = tl.files.get(path_i)
+            if f is None:
+                f = tl.files[path_i] = open(self.paths[path_i], "rb")
+                with files_lock:
+                    opened.append(f)
+            f.seek(offset)
+            data = f.read(nbytes)
+            p0, s0 = pk.parsed, pk.skipped
+            batch, lines, _used = pk.pack_chunk(
+                data, rows_cap, final=True, max_lines=n_lines, n_threads=1
+            )
+            rows6 = pk.take_v6() if has_v6 else []
+            return batch, lines, pk.parsed - p0, pk.skipped - s0, rows6
+
+        from collections import deque
+
+        desc_it = _scan_batches(self.paths, batch_size, skip_lines)
+        ex = cf.ThreadPoolExecutor(
+            max_workers=self.n_workers, thread_name_prefix="ra-feed"
+        )
+        inflight: deque = deque()
+        max_inflight = 2 * self.n_workers + 2
+        try:
+            def fill() -> None:
+                while len(inflight) < max_inflight:
+                    d = next(desc_it, None)
+                    if d is None:
+                        return
+                    inflight.append(ex.submit(work, d))
+
+            fill()
+            while inflight:
+                fut = inflight.popleft()
+                try:
+                    batch, lines, dp, ds, rows6 = fut.result()
+                except Exception as e:
+                    raise FeedWorkerError(
+                        f"feed worker failed: {type(e).__name__}: {e}"
+                    ) from e
+                self.packer.parsed += dp
+                self.packer.skipped += ds
+                if len(rows6):
+                    self._stage_v6(np.asarray(rows6, dtype=np.uint32))
+                fill()
+                yield batch, lines
+        finally:
+            # wait: a worker mid-descriptor must finish before its file
+            # handles close under it (each task is one bounded parse)
+            ex.shutdown(wait=True, cancel_futures=True)
+            with files_lock:
+                for f in opened:
+                    f.close()

@@ -324,6 +324,67 @@ def _make_step(mesh: Mesh, local, batch_spec):
     return step
 
 
+_LOCALS = {
+    "flat": _local_shard_step,
+    "v6": _local_shard_step6,
+    "stacked": _local_shard_step_stacked,
+}
+
+
+@functools.lru_cache(maxsize=16)
+def _cached_step(
+    kind: str,
+    mesh: Mesh,
+    axis: str,
+    n_keys: int,
+    topk_k: int,
+    exact_counts: bool,
+    rule_block: int,
+    match_impl: str | None,
+    topk_sample_shift: int,
+    counts_impl: str,
+):
+    """Step builders memoized on their full geometry.
+
+    Every driver run builds its step through here, so a second run with
+    the same (mesh, config geometry) in the same process gets the SAME
+    step closure back — and therefore hits the jit executable cache
+    instead of re-tracing and re-compiling.  This is what makes the
+    warm-run-then-measure pattern (bench.py/bench_suite.py) actually
+    measure steady state: a fresh closure per run would recompile even
+    with identical shapes.  Keyed values are all hashable scalars plus
+    the Mesh (hashable by devices + axis names); maxsize bounds the
+    specialized-jit pyramids kept alive.
+    """
+    kwargs = dict(
+        axis=axis,
+        n_keys=n_keys,
+        topk_k=topk_k,
+        exact_counts=exact_counts,
+        rule_block=rule_block,
+        topk_sample_shift=topk_sample_shift,
+        counts_impl=counts_impl,
+    )
+    if match_impl is not None:
+        kwargs["match_impl"] = match_impl
+    local = functools.partial(_LOCALS[kind], **kwargs)
+    spec = P(None, None, axis) if kind == "stacked" else P(None, axis)
+    return _make_step(mesh, local, spec)
+
+
+def _warn_experimental_match(match_impl: str) -> None:
+    if match_impl == "pallas_fused":
+        import sys
+
+        print(
+            "WARNING: EXPERIMENTAL match_impl='pallas_fused' enabled — "
+            "measured 0.083x vs the default XLA step on TPU (VERDICT r5); "
+            "this is a bench/research kernel, not a production path.",
+            file=sys.stderr,
+            flush=True,
+        )
+
+
 def make_parallel_step(
     mesh: Mesh,
     cfg: AnalysisConfig,
@@ -335,19 +396,19 @@ def make_parallel_step(
     state/ruleset replicated, batch sharded on the data axis; the returned
     state and candidates are replicated (identical on every device).
     """
-    axis = cfg.mesh_axis
-    local = functools.partial(
-        _local_shard_step,
-        axis=axis,
-        n_keys=n_keys,
-        topk_k=cfg.sketch.topk_chunk_candidates,
-        exact_counts=cfg.exact_counts,
-        rule_block=rule_block,
-        match_impl=cfg.match_impl,
-        topk_sample_shift=cfg.sketch.topk_sample_shift,
-        counts_impl=cfg.counts_impl,
+    _warn_experimental_match(cfg.match_impl)
+    return _cached_step(
+        "flat",
+        mesh,
+        cfg.mesh_axis,
+        n_keys,
+        cfg.sketch.topk_chunk_candidates,
+        cfg.exact_counts,
+        rule_block,
+        cfg.match_impl,
+        cfg.sketch.topk_sample_shift,
+        cfg.counts_impl,
     )
-    return _make_step(mesh, local, P(None, axis))
 
 
 def make_parallel_step6(
@@ -363,18 +424,18 @@ def make_parallel_step6(
     candidates replicated.  The v6 and v4 steps update ONE shared state,
     so the driver may interleave them freely (mergeable registers).
     """
-    axis = cfg.mesh_axis
-    local = functools.partial(
-        _local_shard_step6,
-        axis=axis,
-        n_keys=n_keys,
-        topk_k=cfg.sketch.topk_chunk_candidates,
-        exact_counts=cfg.exact_counts,
-        rule_block=rule_block,
-        topk_sample_shift=cfg.sketch.topk_sample_shift,
-        counts_impl=cfg.counts_impl,
+    return _cached_step(
+        "v6",
+        mesh,
+        cfg.mesh_axis,
+        n_keys,
+        cfg.sketch.topk_chunk_candidates,
+        cfg.exact_counts,
+        rule_block,
+        None,
+        cfg.sketch.topk_sample_shift,
+        cfg.counts_impl,
     )
-    return _make_step(mesh, local, P(None, axis))
 
 
 def make_parallel_step_stacked(
@@ -391,15 +452,15 @@ def make_parallel_step_stacked(
     rule-side communication and the register merges are the same two
     collectives as the flat path.  ``lane`` must divide by the mesh size.
     """
-    axis = cfg.mesh_axis
-    local = functools.partial(
-        _local_shard_step_stacked,
-        axis=axis,
-        n_keys=n_keys,
-        topk_k=cfg.sketch.topk_chunk_candidates,
-        exact_counts=cfg.exact_counts,
-        rule_block=rule_block,
-        topk_sample_shift=cfg.sketch.topk_sample_shift,
-        counts_impl=cfg.counts_impl,
+    return _cached_step(
+        "stacked",
+        mesh,
+        cfg.mesh_axis,
+        n_keys,
+        cfg.sketch.topk_chunk_candidates,
+        cfg.exact_counts,
+        rule_block,
+        None,
+        cfg.sketch.topk_sample_shift,
+        cfg.counts_impl,
     )
-    return _make_step(mesh, local, P(None, None, axis))

@@ -43,6 +43,42 @@ class ThroughputMeter:
         return time.perf_counter() - self.t0
 
 
+class DispatchTimer:
+    """Prices one-time jit/XLA-compile apart from the sustained rate.
+
+    The first dispatch of each device program blocks on trace + compile;
+    its excess over the SECOND dispatch of the same program is the
+    one-time cost.  (On a backend with synchronous dispatch — XLA:CPU —
+    every dispatch also carries the chunk's execution, so
+    first-minus-second isolates compile where raw first-dispatch time
+    would launder one chunk's work into "compile".)  A program that
+    dispatched only ONCE contributes ZERO: its lone timing conflates
+    compile with a full chunk's execution, and subtracting it whole
+    from the sustained denominator would inflate the sustained rate by
+    10x+ on single-chunk runs — under-attributing compile there is the
+    conservative error.  Shared by the single-process and distributed
+    stream drivers so their ``totals.compile_sec`` mean the same thing.
+    """
+
+    def __init__(self):
+        self._t: dict[str, list[float]] = {}
+
+    def first(self, kind: str, fn, *args):
+        """Run ``fn(*args)``, timing the first two dispatches of ``kind``."""
+        lst = self._t.setdefault(kind, [])
+        if len(lst) >= 2:
+            return fn(*args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        lst.append(time.perf_counter() - t0)
+        return out
+
+    def compile_sec(self) -> float:
+        return sum(
+            max(0.0, t[0] - t[1]) for t in self._t.values() if len(t) > 1
+        )
+
+
 class RecoveryMeter:
     """Recovery-event counters for the elastic supervisor (runtime/elastic.py).
 

@@ -573,6 +573,7 @@ def run_stream_file(
     profile_dir: str | None = None,
     max_chunks: int | None = None,
     feed_workers: int = 0,
+    feed_mode: str = "process",
 ):
     """Analyze syslog file(s), using the native C++ parser when available.
 
@@ -580,21 +581,38 @@ def run_stream_file(
     (building it on first use), else the pure-Python line path.  Results
     are identical either way; only host-side parse throughput differs.
 
-    ``feed_workers > 1`` parses with that many worker PROCESSES over file
-    shards (hostside.feeder) — the multi-core input-split tier.  Chunk
-    boundaries then follow raw-line counts only (a dual-evaluation line
-    never closes a batch early; the grouped batch is 2x wide instead), so
-    per-chunk candidates may differ from the sequential path.  Registers,
-    per-rule counts, and the unused set are identical either way
-    (order-invariant mergeable state); the top-K talker section is the
-    one approximation whose candidate pool is chunk-boundary-sensitive,
-    so borderline talkers can differ between feeder and sequential runs.
+    With ``cfg.prefetch_depth > 0`` (the default) the parse runs on a
+    background producer that keeps a bounded queue of packed,
+    device-ready batches ahead of the device step (runtime/ingest.py) —
+    host parse, H2D transfer, and device compute overlap instead of
+    serializing, with the report bit-identical to the synchronous
+    driver.
+
+    ``feed_workers > 1`` parses with that many workers over file shards
+    — worker PROCESSES packing into shared memory (``feed_mode=
+    "process"``, hostside.feeder.ParallelFeeder) or in-process worker
+    THREADS around the GIL-releasing native parser (``feed_mode=
+    "thread"``, hostside.feeder.ThreadedFeeder) — the multi-core
+    input-split tier.  Chunk boundaries then follow raw-line counts only
+    (a dual-evaluation line never closes a batch early; the grouped
+    batch is 2x wide instead), so per-chunk candidates may differ from
+    the sequential path.  Registers, per-rule counts, and the unused set
+    are identical either way (order-invariant mergeable state); the
+    top-K talker section is the one approximation whose candidate pool
+    is chunk-boundary-sensitive, so borderline talkers can differ
+    between feeder and sequential runs.
     """
     from ..hostside import fastparse
 
     if isinstance(paths, str):
         paths = [paths]
     use_native = native if native is not None else fastparse.available()
+    if feed_mode not in ("process", "thread"):
+        from ..errors import AnalysisError
+
+        raise AnalysisError(
+            f"feed_mode must be 'process' or 'thread', got {feed_mode!r}"
+        )
     if feed_workers and feed_workers > 1:
         if native is False:
             from ..errors import AnalysisError
@@ -602,9 +620,10 @@ def run_stream_file(
             raise AnalysisError(
                 "feed_workers requires the native parser; drop native=False"
             )
-        from ..hostside.feeder import ParallelFeeder
+        from ..hostside.feeder import ParallelFeeder, ThreadedFeeder
 
-        source = ParallelFeeder(packed, paths, n_workers=feed_workers)
+        feeder_cls = ThreadedFeeder if feed_mode == "thread" else ParallelFeeder
+        source = feeder_cls(packed, paths, n_workers=feed_workers)
     elif use_native:
         source = _FileSource(packed, paths)
     else:
@@ -694,6 +713,22 @@ def run_stream_file_distributed(
         source = _FileSource(packed, local_paths) if native else _TextSource(
             packed, _iter_files(local_paths)
         )
+    # Pipelined ingest, collective edition: the producer thread overlaps
+    # THIS process's parse (and, flat text path, the wire bit-pack) with
+    # the collective step rounds.  device_put stays on the consumer side
+    # here — to_global assembles a multi-process global array and is not
+    # produced ahead.  Counters / v6 rows / elastic cursors commit only
+    # as batches are consumed, so epoch snapshots record the last
+    # COMMITTED batch, never one the producer merely prefetched.
+    prepacked = False
+    if cfg.prefetch_depth > 0:
+        from .ingest import PrefetchingSource
+
+        _pack = None
+        if not stacked and not n_wire:
+            _pack = pack_mod.compact_batch
+            prepacked = True
+        source = PrefetchingSource(source, cfg.prefetch_depth, pack=_pack)
     try:
         wire_src = getattr(source, "yields_wire", False)
 
@@ -753,6 +788,13 @@ def run_stream_file_distributed(
         fill6 = 0
         packer = source.packer
         pending: deque[pipeline.ChunkOut] = deque()
+
+        # one-time jit/compile cost of each device program, priced apart
+        # from the sustained rate (shared discipline: metrics.DispatchTimer)
+        from .metrics import DispatchTimer
+
+        _dispatch = DispatchTimer()
+        _first_dispatch = _dispatch.first
 
         from . import checkpoint as ckpt
 
@@ -934,7 +976,7 @@ def run_stream_file_distributed(
                 )
             )
             gb = dist.to_global(mesh, b, P(None, cfg.mesh_axis))
-            state, out = step6(state, rules6_g, gb, n_chunks)
+            state, out = _first_dispatch("v6", step6, state, rules6_g, gb, n_chunks)
             pending.append(out)
             if len(pending) > 2:
                 drain(pending.popleft())
@@ -1041,10 +1083,16 @@ def run_stream_file_distributed(
         it = source.batches(
             0 if elastic is not None else lines_consumed, local_batch
         )
-        empty_cols = pack_mod.WIRE_COLS if wire_src else TUPLE_COLS
-        empty = (
-            None if stacked else np.zeros((empty_cols, local_batch), dtype=np.uint32)
-        )
+        if stacked:
+            empty = None
+        elif prepacked:
+            # padding rounds must match the producer's output layout
+            empty = pack_mod.compact_batch(
+                np.zeros((TUPLE_COLS, local_batch), dtype=np.uint32)
+            )
+        else:
+            empty_cols = pack_mod.WIRE_COLS if wire_src else TUPLE_COLS
+            empty = np.zeros((empty_cols, local_batch), dtype=np.uint32)
         last_snap_chunks = n_chunks
         chunks_this_run = 0
         aborted = False
@@ -1097,7 +1145,7 @@ def run_stream_file_distributed(
             )
             wire = pack_mod.compact_grouped(grouped)
             gbatch = dist.to_global(mesh, wire, P(None, None, cfg.mesh_axis))
-            state, out = step(state, rules, gbatch, n_chunks)
+            state, out = _first_dispatch("v4", step, state, rules, gbatch, n_chunks)
             pending.append(out)
             if len(pending) > 2:
                 drain(pending.popleft())
@@ -1119,9 +1167,13 @@ def run_stream_file_distributed(
                 batch_np, n_raw = nxt if has else (empty, 0)
                 lines_consumed += n_raw
                 meter.tick(n_raw)
-                wire = batch_np if wire_src else pack_mod.compact_batch(batch_np)
+                wire = (
+                    batch_np
+                    if wire_src or prepacked
+                    else pack_mod.compact_batch(batch_np)
+                )
                 gbatch = dist.to_global(mesh, wire, P(None, cfg.mesh_axis))
-                state, out = step(state, rules, gbatch, n_chunks)
+                state, out = _first_dispatch("v4", step, state, rules, gbatch, n_chunks)
                 pending.append(out)
                 if len(pending) > 2:
                     drain(pending.popleft())
@@ -1176,7 +1228,7 @@ def run_stream_file_distributed(
                         (pack_mod.WIRE6_COLS, local_batch), dtype=np.uint32
                     )
                 gb6 = dist.to_global(mesh, b6, P(None, cfg.mesh_axis))
-                state, out = step6(state, rules6_g, gb6, n_chunks)
+                state, out = _first_dispatch("v6", step6, state, rules6_g, gb6, n_chunks)
                 pending.append(out)
                 if len(pending) > 2:
                     drain(pending.popleft())
@@ -1219,13 +1271,24 @@ def run_stream_file_distributed(
             }
         )
         lines_this_run = agg.pop("lines_this_run")
+        compile_sec = _dispatch.compile_sec()
+        sustained = elapsed - compile_sec
         totals = {
             **agg,
             "chunks": n_chunks,
             "processes": nproc,
             "elapsed_sec": round(elapsed, 4),
             "lines_per_sec": round(lines_this_run / elapsed, 1) if elapsed > 0 else 0.0,
+            # one-time jit/XLA-compile cost (this process's first dispatch
+            # of each program), separated from the sustained rate
+            "compile_sec": round(compile_sec, 4),
+            "sustained_lines_per_sec": (
+                round(lines_this_run / sustained, 1) if sustained > 0 else 0.0
+            ),
         }
+        stats_fn = getattr(source, "ingest_stats", None)
+        if stats_fn is not None:
+            totals["ingest"] = stats_fn()
         if elastic is not None:
             # which generation of the elastic cluster produced the report
             totals["elastic_epoch"] = elastic.epoch
@@ -1324,11 +1387,45 @@ def _run_core(
 ):
     """Run the chunk loop, deterministically closing the source after.
 
-    Sources holding OS resources (the wire reader's mmaps) expose
-    ``close()``; releasing them here instead of at GC time keeps repeated
-    wire runs in one process from accumulating file mappings (ADVICE r4).
+    Sources holding OS resources (the wire reader's mmaps, the ingest
+    pipeline's producer threads) expose ``close()``; releasing them here
+    instead of at GC time keeps repeated wire runs in one process from
+    accumulating file mappings (ADVICE r4) and never strands a prefetch
+    producer on a full queue.
+
+    Pipelined ingest (``cfg.prefetch_depth > 0``, runtime/ingest.py)
+    wraps the source HERE, so the ``finally`` below closes the wrapper:
+    a background producer runs the source iterator (parse / feeder /
+    mmap reads) and — for flat layouts — also bit-packs and issues the
+    async sharded ``device_put``, so the queue holds device-ready
+    batches and H2D of chunk N+k overlaps the step of chunk N.  Reports
+    are bit-identical to the synchronous path (batches commit in source
+    order).
     """
+    from ..parallel import mesh as mesh_lib
+
     try:
+        if mesh is None:
+            mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
+        device_ready = False
+        if cfg.prefetch_depth > 0:
+            from ..hostside import pack as _pm
+            from .ingest import PrefetchingSource
+
+            pack = None
+            if cfg.layout != "stacked":
+                axis = cfg.mesh_axis
+                wire_src = getattr(source, "yields_wire", False)
+                if wire_src:
+                    def pack(b):
+                        return mesh_lib.shard_batch(mesh, b, axis)
+                else:
+                    def pack(b):
+                        return mesh_lib.shard_batch(
+                            mesh, _pm.compact_batch(b), axis
+                        )
+                device_ready = True
+            source = PrefetchingSource(source, cfg.prefetch_depth, pack=pack)
         return _run_core_impl(
             packed,
             source,
@@ -1337,6 +1434,7 @@ def _run_core(
             mesh=mesh,
             profile_dir=profile_dir,
             max_chunks=max_chunks,
+            device_ready=device_ready,
         )
     finally:
         close = getattr(source, "close", None)
@@ -1353,14 +1451,15 @@ def _run_core_impl(
     mesh,
     profile_dir: str | None,
     max_chunks: int | None,
+    device_ready: bool = False,
 ):
     from ..parallel import mesh as mesh_lib
     from ..parallel.step import make_parallel_step
     from . import checkpoint as ckpt
     from .metrics import Profiler, ThroughputMeter
 
-    if mesh is None:
-        mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
+    # mesh is always resolved by _run_core (it needs it for the prefetch
+    # pack closures) before this is called
     batch_size = mesh_lib.pad_batch_size(cfg.batch_size, mesh, cfg.mesh_axis)
     if packed.bindings_out and batch_size < 2:
         from ..errors import AnalysisError
@@ -1462,12 +1561,19 @@ def _run_core_impl(
             ),
         )
 
+    # One-time jit/compile + warmup priced SEPARATELY from the sustained
+    # rate (VERDICT r5 Weak #1; measurement discipline in DispatchTimer)
+    from .metrics import DispatchTimer
+
+    _dispatch = DispatchTimer()
+    _first_dispatch = _dispatch.first
+
     def run_chunk(batch_dev) -> None:
         # salt = chunk index: re-randomizes candidate-table slots per
         # chunk (no persistent talker collisions) yet replays exactly on
         # resume since n_chunks is restored from the snapshot
         nonlocal state, n_chunks
-        state, out = step(state, dev_rules, batch_dev, n_chunks)
+        state, out = _first_dispatch("v4", step, state, dev_rules, batch_dev, n_chunks)
         pending.append(out)
         if len(pending) > 2:
             drain(pending.popleft())
@@ -1480,8 +1586,8 @@ def _run_core_impl(
 
     def run_chunk6(batch6_np: np.ndarray) -> None:
         nonlocal state, n_chunks
-        state, out = step6(
-            state, dev_rules6,
+        state, out = _first_dispatch(
+            "v6", step6, state, dev_rules6,
             mesh_lib.shard_batch(mesh, batch6_np, cfg.mesh_axis), n_chunks,
         )
         pending.append(out)
@@ -1558,6 +1664,12 @@ def _run_core_impl(
                 )
                 for grouped in gbuf.add(np.ascontiguousarray(cols.T)):
                     run_grouped(grouped)
+            elif device_ready:
+                # the ingest pipeline already bit-packed the batch and
+                # issued its async sharded device_put in the producer
+                # thread; the H2D transfer has been overlapping earlier
+                # steps since then
+                run_chunk(batch_np)
             else:
                 # ship the bit-packed wire layout: host->device transfer
                 # is the narrowest stage on PCIe-starved links, and the
@@ -1631,6 +1743,8 @@ def _run_core_impl(
     # both an in and an out ACL contributes two); lines_skipped counts
     # raw lines that produced no evaluation.
     lines_this_run = lines_consumed - lines_at_start
+    compile_sec = _dispatch.compile_sec()
+    sustained = elapsed - compile_sec
     totals = {
         "lines_total": lines_consumed,
         "lines_matched": packer.parsed,
@@ -1638,7 +1752,18 @@ def _run_core_impl(
         "chunks": n_chunks,
         "elapsed_sec": round(elapsed, 4),
         "lines_per_sec": round(lines_this_run / elapsed, 1) if elapsed > 0 else 0.0,
+        # one-time jit trace + XLA compile (first dispatch of each device
+        # program), priced separately: two committed e2e artifacts once
+        # disagreed 7.7x purely on how much of the run was compile
+        "compile_sec": round(compile_sec, 4),
+        "sustained_lines_per_sec": (
+            round(lines_this_run / sustained, 1) if sustained > 0 else 0.0
+        ),
     }
+    stats_fn = getattr(source, "ingest_stats", None)
+    if stats_fn is not None:
+        # per-stage overlap accounting: parse-starved vs device-bound
+        totals["ingest"] = stats_fn()
     patch = getattr(source, "totals_patch", None)
     if patch is not None:
         # wire input: restore the converter's raw-line accounting once the

@@ -13,7 +13,11 @@ pytest.importorskip("jax")
 
 from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
 from ruleset_analysis_tpu.hostside import aclparse, fastparse, oracle, pack, synth
-from ruleset_analysis_tpu.hostside.feeder import ParallelFeeder, _scan_batches
+from ruleset_analysis_tpu.hostside.feeder import (
+    ParallelFeeder,
+    ThreadedFeeder,
+    _scan_batches,
+)
 from ruleset_analysis_tpu.runtime.stream import run_stream_file
 
 pytestmark = pytest.mark.skipif(
@@ -129,3 +133,121 @@ def test_killed_worker_detected_not_hung(corpus):
     with pytest.raises(RuntimeError, match="died without reporting"):
         for _ in gen:
             pass
+
+
+# ---------------------------------------------------------------------------
+# threaded tier: same descriptors, same in-order commit, no processes
+# ---------------------------------------------------------------------------
+
+
+def test_threaded_feeder_report_equals_process_tier(corpus):
+    """Thread and process tiers chop identical descriptors, so the FULL
+    report — including chunk-boundary-sensitive top-K candidates — must
+    match between them (not just the order-invariant registers)."""
+    import json
+
+    packed, rs, paths, res = corpus
+    cfg = AnalysisConfig(
+        batch_size=256,
+        sketch=SketchConfig(cms_width=1 << 11, cms_depth=4, hll_p=6),
+    )
+    thr = run_stream_file(packed, paths, cfg, feed_workers=3, feed_mode="thread")
+    prc = run_stream_file(packed, paths, cfg, feed_workers=3, feed_mode="process")
+    jt, jp = json.loads(thr.to_json()), json.loads(prc.to_json())
+    for k in (
+        "elapsed_sec", "lines_per_sec", "compile_sec",
+        "sustained_lines_per_sec", "ingest",
+    ):
+        jt["totals"].pop(k, None)
+        jp["totals"].pop(k, None)
+    assert jt == jp
+    assert thr.totals["lines_matched"] == res.lines_matched
+
+
+def test_threaded_feeder_registers_equal_sequential(corpus):
+    packed, rs, paths, res = corpus
+    cfg = AnalysisConfig(
+        batch_size=256,
+        sketch=SketchConfig(cms_width=1 << 11, cms_depth=4, hll_p=6),
+    )
+    seq = run_stream_file(packed, paths, cfg)
+    thr = run_stream_file(packed, paths, cfg, feed_workers=2, feed_mode="thread")
+    hs = {(e["firewall"], e["acl"], e["index"]): e["hits"] for e in seq.per_rule}
+    ht = {(e["firewall"], e["acl"], e["index"]): e["hits"] for e in thr.per_rule}
+    assert hs == ht
+    assert seq.unused == thr.unused
+    assert thr.totals["lines_total"] == 3000
+    assert thr.totals["lines_matched"] == res.lines_matched
+
+
+# ---------------------------------------------------------------------------
+# v6 plane: both feed tiers carry the SAME evaluation-row streams, byte
+# for byte, as the sequential native parse (VERDICT Missing #4 closure)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def corpus6(tmp_path_factory):
+    td = tmp_path_factory.mktemp("feed6")
+    cfg_text = synth.synth_config(
+        n_acls=2, rules_per_acl=8, seed=77, v6_fraction=0.5
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    n4, n6 = 1600, 1400
+    lines = synth.render_syslog(
+        packed, synth.synth_tuples(packed, n4, seed=78), seed=79
+    )
+    lines += synth.render_syslog6(
+        packed, synth.synth_tuples6(packed, n6, seed=80), seed=81
+    )
+    import random
+
+    random.Random(7).shuffle(lines)
+    p = td / "mixed.log"
+    p.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    assert packed.has_v6
+    return packed, [str(p)]
+
+
+def _row_streams(batches_it, take_v6):
+    """(v4 valid-row stream, v6 row stream) concatenated over all batches."""
+    v4, v6 = [], []
+    for batch, _n in batches_it:
+        v4.append(batch[:, batch[pack.T_VALID] == 1].copy())
+        rows6 = take_v6()
+        if len(rows6):
+            v6.append(np.asarray(rows6, dtype=np.uint32))
+    cat4 = np.concatenate(v4, axis=1) if v4 else np.zeros((pack.TUPLE_COLS, 0))
+    cat6 = (
+        np.concatenate(v6) if v6 else np.zeros((0, pack.TUPLE6_COLS))
+    )
+    return cat4, cat6
+
+
+@pytest.mark.parametrize("tier", ["process", "thread"])
+def test_feeder_v6_plane_byte_identical_to_sequential(corpus6, tier):
+    packed, paths = corpus6
+    packer = fastparse.NativePacker(packed)
+    seq4, seq6 = _row_streams(
+        fastparse.batches_from_files(paths, packer, 256), packer.take_v6
+    )
+    assert seq6.shape[0] > 0  # the corpus genuinely exercises the plane
+    feeder_cls = ParallelFeeder if tier == "process" else ThreadedFeeder
+    feeder = feeder_cls(packed, paths, n_workers=2)
+    par4, par6 = _row_streams(feeder.batches(0, 256), feeder.take_v6)
+    assert np.array_equal(seq4, par4)
+    assert np.array_equal(seq6, par6)
+    # capped digest->address map: same rows in the same stream order ->
+    # identical first-seen winners
+    from ruleset_analysis_tpu.hostside.pack import (
+        T6_SRC, V6_DIGEST_CAP, fold_src32_host, limbs_u128,
+    )
+
+    want: dict[int, int] = {}
+    for r in seq6:
+        if len(want) >= V6_DIGEST_CAP:
+            break
+        src = limbs_u128(*r[T6_SRC:T6_SRC + 4])
+        want.setdefault(fold_src32_host(src), src)
+    assert feeder.v6_digests == want
