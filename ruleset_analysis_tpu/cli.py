@@ -80,6 +80,27 @@ def _cmd_fetch_acls(args: argparse.Namespace) -> int:
     )
 
 
+def _resolve_fault_plan(spec: str | None) -> str:
+    """``--fault-plan`` value: a spec string, or ``@FILE`` naming a file
+    holding one (chaos schedules checked into a repo).  Validated by
+    parsing; the canonical form travels in the config."""
+    if not spec:
+        return ""
+    from .runtime import faults
+
+    if spec.startswith("@"):
+        try:
+            with open(spec[1:], "r", encoding="utf-8") as f:
+                spec = f.read().strip()
+        except OSError as e:
+            # a bad plan FILE is a usage mistake like a bad plan string:
+            # typed so the caller's handler exits 2, never a traceback
+            raise errors.AnalysisError(
+                f"cannot read fault plan file {spec[1:]!r}: {e}"
+            ) from e
+    return faults.FaultPlan.parse(spec).to_str()
+
+
 def _iter_log_lines(paths: list[str]):
     for path in paths:
         if path == "-":
@@ -110,9 +131,13 @@ def _cmd_run(args: argparse.Namespace) -> int:
             layout=args.layout,
             stacked_lane=args.stacked_lane,
             prefetch_depth=args.prefetch_depth,
+            stall_timeout_sec=args.stall_timeout,
+            fault_plan=_resolve_fault_plan(args.fault_plan),
             **({"checkpoint_dir": args.checkpoint_dir} if args.checkpoint_dir else {}),
         )
-    except ValueError as e:
+    except (ValueError, errors.AnalysisError) as e:
+        # AnalysisError here is a malformed --fault-plan: a config
+        # mistake, so the usage exit code — not a runtime failure class
         print(f"error: {e}", file=sys.stderr)
         return 2
     packed = pack.load_packed(args.ruleset)
@@ -143,6 +168,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--feed-mode=thread": args.feed_workers > 1 and args.feed_mode != "process",
             "--experimental-match-impl": bool(args.experimental_match_impl),
             "--elastic": args.elastic,
+            "--fault-plan": bool(args.fault_plan),
         }
         # --prefetch-depth is deliberately NOT rejected: like
         # --batch-size it is a tpu-path tuning knob the oracle ignores,
@@ -661,6 +687,18 @@ def make_parser() -> argparse.ArgumentParser:
                         "batches ahead of the device step on a background "
                         "producer (bit-identical reports; 0 = synchronous "
                         "driver)")
+    p.add_argument("--stall-timeout", type=float,
+                   default=AnalysisConfig.stall_timeout_sec, metavar="SEC",
+                   help="watchdog bound on a pipeline stage making no "
+                        "progress before the run aborts with a typed "
+                        "StallError (exit code 6) instead of hanging; "
+                        "progress resets the window")
+    p.add_argument("--fault-plan", default=None, metavar="SPEC",
+                   help="ARM deterministic fault injection (testing/chaos "
+                        "drills only): 'site@N[,site@N][,seed=S]' fires "
+                        "each named site on its Nth hit, or @FILE holding "
+                        "the spec; see runtime/faults.py SITES and DESIGN "
+                        "§9 for the registered sites")
     p.add_argument("--layout", choices=["flat", "stacked"], default="flat",
                    help="rule-match layout: flat scans all rules per line; stacked "
                         "buckets lines by ACL and vmaps over per-ACL rule slabs "
@@ -768,8 +806,11 @@ def main(argv: list[str] | None = None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
     except errors.AnalysisError as e:
+        # failure-class exit codes (errors.exit_code_for, README "Exit
+        # codes"): supervisors/operators branch on corrupt checkpoint vs
+        # resume mismatch vs feed failure vs stall vs reform budget
         print(f"error: {e}", file=sys.stderr)
-        return 1
+        return errors.exit_code_for(e)
     except ValueError as e:
         # User-reachable library validation (corrupt packed-ruleset files,
         # bad distributed divisibility, malformed wire arrays) surfaces as

@@ -147,6 +147,20 @@ class AnalysisConfig:
     #: bit-identical to the synchronous driver (batches commit in order).
     #: 0 = synchronous (the pre-pipelined driver); 2 = triple buffering.
     prefetch_depth: int = 2
+    #: Watchdog bound (seconds) on a pipeline stage making NO progress:
+    #: the prefetch consumer waiting on an empty queue and the feed
+    #: coordinators waiting on worker completions escalate to a typed
+    #: StallError after this long instead of wedging forever.  Progress
+    #: resets the window, so legitimately slow inputs only need to
+    #: advance once per window (CLI --stall-timeout; env
+    #: RA_STALL_TIMEOUT overrides the default for bare library calls).
+    stall_timeout_sec: float = 300.0
+    #: Serialized fault-injection schedule (runtime/faults.py;
+    #: ``"site@N,site@N,seed=S"``).  Empty = every site disarmed (the
+    #: production state: one None-check per site).  Armed by the drivers
+    #: at run start and exported to RA_FAULT_PLAN so spawned workers
+    #: (feeder processes, elastic generations) inherit the schedule.
+    fault_plan: str = ""
 
     def __post_init__(self) -> None:
         if self.batch_size < 1:
@@ -182,6 +196,10 @@ class AnalysisConfig:
             )
         if self.register_memory_budget_bytes < 1:
             raise ValueError("register_memory_budget_bytes must be >= 1")
+        if self.stall_timeout_sec <= 0:
+            raise ValueError(
+                f"stall_timeout_sec must be > 0, got {self.stall_timeout_sec}"
+            )
         if self.layout == "stacked" and self.match_impl != "xla":
             raise ValueError(
                 f"match_impl={self.match_impl!r} supports layout='flat' only; "

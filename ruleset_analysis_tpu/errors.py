@@ -34,3 +34,89 @@ class FeedWorkerError(AnalysisError):
     completion that will never arrive — a worker killed by the OS (OOM),
     a crashed parse, or a poisoned descriptor all surface as this typed
     error within the liveness timeout."""
+
+
+class IngestError(AnalysisError):
+    """The prefetch producer failed with an untyped exception.
+
+    The pipelined ingest engine re-raises producer-side failures at the
+    consumer's next pull; failures that are not already AnalysisError
+    subclasses are wrapped in this so the chaos invariant — every failed
+    run exits with a TYPED error — holds for arbitrary producer bugs
+    (the original exception rides ``__cause__``)."""
+
+
+class StallError(AnalysisError):
+    """A bounded-progress watchdog fired: a pipeline stage stopped
+    advancing without dying.
+
+    Raised instead of wedging forever when a producer/worker is alive
+    but makes no progress within the stall timeout
+    (``AnalysisConfig.stall_timeout_sec`` / ``RA_STALL_TIMEOUT``) — a
+    hung NFS read, a deadlocked worker, or an injected
+    ``ingest.queue.stall`` fault all surface as this typed abort."""
+
+
+class WireCorrupt(AnalysisError):
+    """A stored wire-format row failed its integrity invariant.
+
+    The converter only ever stores valid evaluation rows, so a stored
+    (non-padding) row with the valid bit clear means the block was
+    damaged after conversion; refusing loudly beats silently skipping
+    rows of a corrupted production input."""
+
+
+class ReformBudgetExhausted(AnalysisError):
+    """The elastic supervisor used up ``--max-reforms`` re-formations."""
+
+
+class InjectedFault(AnalysisError):
+    """A deterministic fault fired by an armed plan (runtime/faults.py).
+
+    Typed as AnalysisError on purpose: chaos schedules assert every
+    faulted run ends in a typed abort or a bit-identical report, and an
+    injected failure crossing an un-wrapping propagation path must not
+    break that invariant by surfacing raw."""
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes: supervisors and operators branch on the failure class.
+# Documented in README "Exit codes"; keep the two tables in sync.
+# ---------------------------------------------------------------------------
+
+EXIT_OK = 0
+#: generic analysis error (parse failure, missing input, uncategorized)
+EXIT_ANALYSIS = 1
+#: bad usage / invalid configuration (argparse-level and ValueError)
+EXIT_USAGE = 2
+#: a checkpoint exists but cannot be trusted (torn write, bit rot, CRC)
+EXIT_CHECKPOINT_CORRUPT = 3
+#: checkpoint/resume identity mismatch (foreign ruleset/geometry/input)
+EXIT_CHECKPOINT_MISMATCH = 4
+#: the feed tier failed (dead worker, corrupt wire block, producer bug)
+EXIT_FEED = 5
+#: a watchdog bounded a hang (stall, formation timeout)
+EXIT_STALL = 6
+#: elastic re-formation budget exhausted (--max-reforms)
+EXIT_REFORM_BUDGET = 7
+
+
+def exit_code_for(exc: BaseException) -> int:
+    """Map a typed runtime error to its documented CLI exit code.
+
+    Ordered most-specific-first; anything unrecognized (including plain
+    AnalysisError) keeps the historical catch-all code 1.
+    """
+    if isinstance(exc, CheckpointCorrupt):
+        return EXIT_CHECKPOINT_CORRUPT
+    if isinstance(exc, (CheckpointMismatch, ResumeInputMismatch)):
+        return EXIT_CHECKPOINT_MISMATCH
+    if isinstance(exc, StallError):
+        return EXIT_STALL
+    if isinstance(exc, ReformBudgetExhausted):
+        return EXIT_REFORM_BUDGET
+    if isinstance(
+        exc, (FeedWorkerError, IngestError, WireCorrupt, NativeParserUnavailable)
+    ):
+        return EXIT_FEED
+    return EXIT_ANALYSIS

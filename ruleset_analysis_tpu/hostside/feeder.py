@@ -32,12 +32,14 @@ from __future__ import annotations
 
 import multiprocessing
 import pickle
+import time
 from multiprocessing import shared_memory
 
 import numpy as np
 
 from . import fastparse
-from ..errors import FeedWorkerError
+from ..errors import FeedWorkerError, StallError
+from ..runtime import faults
 from .pack import PackedRuleset, TUPLE_COLS, TUPLE6_COLS
 
 #: Coordinator read granularity while scanning for batch boundaries.
@@ -137,6 +139,12 @@ def _worker(packed_blob, paths, rows_cap, rows6_cap, shm_name, task_q, done_q):
             task = task_q.get()
             if task is None:
                 return
+            # fault sites (plan arrives via the inherited RA_FAULT_PLAN
+            # env): abrupt death — the OOM-kill the coordinator's
+            # liveness probe must catch — and a wedge the coordinator's
+            # stall watchdog must bound
+            faults.fire("feeder.worker.crash")
+            faults.fire("feeder.worker.stall")
             idx, slot, path_i, offset, nbytes, n_lines = task
             try:
                 f = files.get(path_i)
@@ -194,7 +202,13 @@ class _FeederBase:
     with consumed input no matter how far workers ran ahead.
     """
 
-    def __init__(self, packed: PackedRuleset, paths: list[str], n_workers: int | None = None):
+    def __init__(
+        self,
+        packed: PackedRuleset,
+        paths: list[str],
+        n_workers: int | None = None,
+        stall_timeout: float | None = None,
+    ):
         if not fastparse.available():
             from ..errors import NativeParserUnavailable
 
@@ -202,6 +216,12 @@ class _FeederBase:
         self.packed = packed
         self.paths = list(paths)
         self.n_workers = n_workers or default_feed_workers()
+        #: watchdog bound: workers alive but completing nothing for this
+        #: long is a wedge, escalated to a typed StallError abort
+        self.stall_timeout = (
+            stall_timeout if stall_timeout and stall_timeout > 0
+            else faults.default_stall_timeout()
+        )
         self.packer = _FeedCounters()
         self._resume_counts = (0, 0)
         self._v6chunks: list[np.ndarray] = []  # [n,13] arrays, input order
@@ -294,6 +314,7 @@ class ParallelFeeder(_FeederBase):
             import queue as _queue
 
             submit_until_full()
+            stall_deadline = time.monotonic() + self.stall_timeout
             while next_yield < next_submit:
                 while next_yield not in ready:
                     # timeout + liveness: a worker killed by the OS (OOM)
@@ -308,7 +329,20 @@ class ParallelFeeder(_FeederBase):
                                 f"feeder worker(s) {dead} died without "
                                 "reporting (killed by the OS?)"
                             )
+                        if time.monotonic() > stall_deadline:
+                            # alive but completing nothing: a wedged
+                            # worker (stuck I/O, injected stall) must be
+                            # a bounded typed abort, not a silent hang
+                            raise StallError(
+                                f"feeder workers made no progress in "
+                                f"{self.stall_timeout:.0f}s "
+                                f"({len(workers)} alive); raise "
+                                "--stall-timeout if the input is "
+                                "legitimately this slow"
+                            )
                         continue
+                    # progress: any completion resets the stall window
+                    stall_deadline = time.monotonic() + self.stall_timeout
                     if msg[0] == "error":
                         raise FeedWorkerError(
                             f"feeder worker failed on batch {msg[1]}: {msg[2]}"
@@ -335,12 +369,23 @@ class ParallelFeeder(_FeederBase):
                 submit_until_full()
                 yield out, lines
         finally:
+            # Bounded teardown, also on a consumer-side exception: poison
+            # pills, ONE shared join budget (a wedged worker must not
+            # serialize N x 10s), terminate + reap stragglers, and close
+            # the queues so their feeder threads don't outlive the run.
             for _ in workers:
                 task_q.put(None)
+            deadline = time.monotonic() + 10.0
             for w in workers:
-                w.join(timeout=10)
+                w.join(timeout=max(0.0, deadline - time.monotonic()))
+            for w in workers:
                 if w.is_alive():
                     w.terminate()
+            for w in workers:
+                w.join(timeout=5)
+            for q in (task_q, done_q):
+                q.cancel_join_thread()
+                q.close()
             shm.close()
             shm.unlink()
 
@@ -376,7 +421,12 @@ class ThreadedFeeder(_FeederBase):
         files_lock = threading.Lock()
         opened: list = []
 
+        stop_ev = threading.Event()  # releases injected stalls at teardown
+
         def work(desc):
+            # thread-tier twin of the process worker's fault sites (no
+            # crash site: os._exit here would take the driver down)
+            faults.fire("feeder.worker.stall", stop=stop_ev)
             path_i, offset, nbytes, n_lines = desc
             pk = getattr(tl, "packer", None)
             if pk is None:
@@ -404,6 +454,7 @@ class ThreadedFeeder(_FeederBase):
         )
         inflight: deque = deque()
         max_inflight = 2 * self.n_workers + 2
+        stalled = False
         try:
             def fill() -> None:
                 while len(inflight) < max_inflight:
@@ -416,7 +467,20 @@ class ThreadedFeeder(_FeederBase):
             while inflight:
                 fut = inflight.popleft()
                 try:
-                    batch, lines, dp, ds, rows6 = fut.result()
+                    # stall watchdog: a worker thread that wedges (stuck
+                    # I/O, injected stall) bounds to a typed abort — the
+                    # batches commit in submission order, so waiting on
+                    # THIS future is exactly producer-to-consumer progress
+                    batch, lines, dp, ds, rows6 = fut.result(
+                        timeout=self.stall_timeout
+                    )
+                except cf.TimeoutError:
+                    stalled = True
+                    raise StallError(
+                        f"feed worker made no progress in "
+                        f"{self.stall_timeout:.0f}s; raise --stall-timeout "
+                        "if the input is legitimately this slow"
+                    ) from None
                 except Exception as e:
                     raise FeedWorkerError(
                         f"feed worker failed: {type(e).__name__}: {e}"
@@ -428,9 +492,17 @@ class ThreadedFeeder(_FeederBase):
                 fill()
                 yield batch, lines
         finally:
+            # release injected stalls FIRST so the bounded shutdown below
+            # cannot wedge on a thread parked in a fault site
+            stop_ev.set()
             # wait: a worker mid-descriptor must finish before its file
-            # handles close under it (each task is one bounded parse)
-            ex.shutdown(wait=True, cancel_futures=True)
+            # handles close under it (each task is one bounded parse).
+            # EXCEPT after a stall verdict: a thread wedged in an OS call
+            # cannot be cancelled, and waiting on it would turn the typed
+            # StallError into the very hang the watchdog exists to
+            # prevent — abandon it (the process tier, which CAN terminate
+            # its workers, is the tier of choice for hostile inputs)
+            ex.shutdown(wait=not stalled, cancel_futures=True)
             with files_lock:
                 for f in opened:
                     f.close()

@@ -13,6 +13,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..runtime import faults
+
 
 def make_mesh(devices: list | None = None, axis: str = "data") -> Mesh:
     devs = devices if devices is not None else jax.devices()
@@ -30,11 +32,16 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def shard_batch(mesh: Mesh, batch_np: np.ndarray, axis: str = "data") -> jax.Array:
     """Host [TUPLE_COLS, B] -> device array sharded over the data axis."""
+    # chaos site: H2D transfer failure.  Reached from both the sync chunk
+    # loop and the prefetch producer's pack closure, so one site exercises
+    # both propagation paths (direct raise vs. typed re-raise at consume).
+    faults.fire("stream.device_put.fail")
     return jax.device_put(batch_np, batch_sharding(mesh, axis))
 
 
 def shard_grouped(mesh: Mesh, grouped_np: np.ndarray, axis: str = "data") -> jax.Array:
     """Host [G, TUPLE_COLS, lane] -> device array, lane axis sharded."""
+    faults.fire("stream.device_put.fail")
     return jax.device_put(grouped_np, NamedSharding(mesh, P(None, None, axis)))
 
 

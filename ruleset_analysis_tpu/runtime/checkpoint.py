@@ -26,6 +26,7 @@ import json
 import os
 import tempfile
 import zipfile
+import zlib
 
 import numpy as np
 
@@ -98,14 +99,44 @@ class Snapshot:
     extra: dict | None = None
 
 
+def _file_crc32(path: str) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                return crc & 0xFFFFFFFF
+            crc = zlib.crc32(block, crc)
+
+
+def _manifest_crc32(manifest: dict) -> int:
+    """CRC of the manifest's canonical JSON, excluding the crc field itself.
+
+    Covers every field a bit-flip could silently skew — offsets, packer
+    counters, tracker tables, and the elastic per-shard cursor manifest
+    in ``extra`` (a flipped cursor digit decodes as perfectly valid JSON
+    and would resume from the wrong line without this).
+    """
+    body = {k: v for k, v in manifest.items() if k != "crc32"}
+    return zlib.crc32(
+        json.dumps(body, sort_keys=True, separators=(",", ":")).encode()
+    ) & 0xFFFFFFFF
+
+
 def save(ckpt_dir: str, snap: Snapshot) -> None:
+    from . import faults
+
     os.makedirs(ckpt_dir, exist_ok=True)
     snap_name = f"snap-{snap.n_chunks}"
     tmp_dir = tempfile.mkdtemp(dir=ckpt_dir, prefix=".tmp-")
-    with open(os.path.join(tmp_dir, STATE_FILE), "wb") as f:
+    state_path = os.path.join(tmp_dir, STATE_FILE)
+    with open(state_path, "wb") as f:
         np.savez(f, **snap.arrays)
         f.flush()
         os.fsync(f.fileno())
+    # fault site: crash leaving a half-written register file — the
+    # pointer never moves, so load() must keep serving the prior epoch
+    faults.fire("checkpoint.torn_state", path=state_path)
     manifest = {
         "lines_consumed": snap.lines_consumed,
         "n_chunks": snap.n_chunks,
@@ -115,13 +146,18 @@ def save(ckpt_dir: str, snap: Snapshot) -> None:
         "tracker": [
             [acl, list(table.items())] for acl, table in snap.tracker_tables.items()
         ],
+        # integrity: npz payload CRC + manifest self-CRC, verified on load
+        "state_crc32": _file_crc32(state_path),
     }
     if snap.extra is not None:
         manifest["extra"] = snap.extra
-    with open(os.path.join(tmp_dir, MANIFEST_FILE), "w", encoding="utf-8") as f:
+    manifest["crc32"] = _manifest_crc32(manifest)
+    manifest_path = os.path.join(tmp_dir, MANIFEST_FILE)
+    with open(manifest_path, "w", encoding="utf-8") as f:
         json.dump(manifest, f)
         f.flush()
         os.fsync(f.fileno())
+    faults.fire("checkpoint.torn_manifest", path=manifest_path)
     # Never delete an existing dir (LATEST may point at it): a same-chunk
     # re-save lands under a fresh name and the old one is pruned only
     # after the pointer moves.
@@ -217,6 +253,15 @@ def load(ckpt_dir: str) -> Snapshot | None:
     try:
         with open(manifest_path, "r", encoding="utf-8") as f:
             m = json.load(f)
+        # CRC verification (pre-CRC snapshots carry no fields and load
+        # as before): the manifest self-CRC catches flips that decode as
+        # valid JSON — a skewed offset or elastic cursor — and the state
+        # CRC catches npz damage zipfile's per-member check can miss
+        # (container metadata, whole-member substitution).
+        if "crc32" in m and int(m["crc32"]) != _manifest_crc32(m):
+            raise ValueError("manifest CRC32 mismatch (bit rot?)")
+        if "state_crc32" in m and int(m["state_crc32"]) != _file_crc32(state_path):
+            raise ValueError("register payload CRC32 mismatch (bit rot?)")
         with np.load(state_path) as z:
             arrays = {k: z[k] for k in z.files}
         return Snapshot(

@@ -60,7 +60,8 @@ import tempfile
 import threading
 import time
 
-from ..errors import AnalysisError
+from ..errors import AnalysisError, EXIT_REFORM_BUDGET, StallError, exit_code_for
+from . import faults
 from .metrics import RecoveryMeter
 
 #: seconds between heartbeat-file touches
@@ -85,8 +86,11 @@ JAX_INIT_TIMEOUT_SEC = 60
 DIE_RC = 77
 
 
-class FormationTimeout(AnalysisError):
-    """A generation could not form within the rendezvous timeout."""
+class FormationTimeout(StallError):
+    """A generation could not form within the rendezvous timeout.
+
+    A StallError subclass: formation hanging past its bound is the
+    distributed face of the same watchdog tier (CLI exit code 6)."""
 
 
 # ---------------------------------------------------------------------------
@@ -169,12 +173,22 @@ class _Heartbeat(threading.Thread):
     """Touches ``members/<tag>.hb`` until stopped (daemon: dies with us)."""
 
     def __init__(self, path: str):
-        super().__init__(daemon=True)
+        super().__init__(daemon=True, name="ra-heartbeat")
         self._path = path
         self._stop = threading.Event()
 
     def run(self) -> None:
+        from ..errors import InjectedFault
+
         while not self._stop.is_set():
+            try:
+                # chaos site: this member's heartbeat silently stops
+                # (network partition / node freeze) — the PEERS' staleness
+                # watchdog must re-form without it, and this member must
+                # abort when it finds itself outside the next formation
+                faults.fire("elastic.heartbeat.drop", stop=self._stop)
+            except InjectedFault:
+                return  # stop touching forever: the partition persists
             try:
                 with open(self._path, "a"):
                     os.utime(self._path, None)
@@ -473,7 +487,7 @@ class ElasticSupervisor:
                     world = self._form(gen)
                 except FormationTimeout as e:
                     print(f"elastic: {e}", file=sys.stderr)
-                    return 1, None
+                    return exit_code_for(e), None  # stall class (6)
                 if gen > 0:
                     # the moment the replacement cluster is formed and its
                     # worker is about to run — the recovery is complete
@@ -510,7 +524,9 @@ class ElasticSupervisor:
                         f"{self._gen_dir(gen)}/worker-{self.tag}.log)",
                         file=sys.stderr,
                     )
-                    return 2, None
+                    # documented failure-class exit code (errors.py):
+                    # supervisors branch on 7 = ReformBudgetExhausted
+                    return EXIT_REFORM_BUDGET, None
                 print(
                     f"elastic: generation {gen} failed (worker rc={rc}); "
                     f"re-forming ({self.reforms_used}/{self.max_reforms})",
@@ -539,7 +555,35 @@ class ElasticSupervisor:
 # ---------------------------------------------------------------------------
 
 
+def _start_supervisor_watchdog() -> None:
+    """Abort this worker if its supervisor dies (per-generation liveness).
+
+    The supervisor owns the heartbeat; if it dies, the peers re-form
+    WITHOUT this member while its orphaned worker would keep computing
+    and — worst case — keep writing epoch snapshots over the new
+    generation's.  Reparenting (getppid change) is the cheap, version-
+    proof orphan signal; exit is abrupt on purpose (the collectives this
+    worker holds open must abort, not drain)."""
+    ppid = os.getppid()
+
+    def watch() -> None:
+        while True:
+            if os.getppid() != ppid:
+                print(
+                    "elastic worker: supervisor died (orphaned); aborting",
+                    file=sys.stderr,
+                    flush=True,
+                )
+                os._exit(1)
+            time.sleep(1.0)
+
+    threading.Thread(
+        target=watch, daemon=True, name="ra-supervisor-watchdog"
+    ).start()
+
+
 def _worker_main(elastic_dir: str, tag: int, gen: int) -> int:
+    _start_supervisor_watchdog()
     with open(
         os.path.join(elastic_dir, "members", f"{tag}.job.json"),
         "r",

@@ -46,6 +46,9 @@ import time
 
 import numpy as np
 
+from ..errors import AnalysisError, IngestError, StallError
+from . import faults
+
 _END = ("end", None)
 
 
@@ -119,6 +122,10 @@ class _Pump:
         try:
             while True:
                 t0 = time.perf_counter()
+                # fault sites: a producer bug (typed at the consumer) and
+                # a wedged producer (the consumer's stall watchdog fires)
+                faults.fire("ingest.producer.raise")
+                faults.fire("ingest.queue.stall", stop=self.stop)
                 nxt = next(self._it, None)
                 if nxt is None:
                     break
@@ -141,18 +148,57 @@ class _Pump:
             return
         self._put(_END)
 
+    def _get_bounded(self):
+        """Next queue item, bounded by the stall watchdog.
+
+        Every received item resets the window, so a slow-but-advancing
+        producer never trips it; a producer that is alive yet makes NO
+        progress for ``stall_timeout`` seconds (hung I/O, deadlock, an
+        injected ``ingest.queue.stall``) escalates to a typed StallError
+        instead of wedging the driver forever.  A producer that died
+        without its error/end sentinel (should be impossible — the
+        sentinel put is unconditional) surfaces as IngestError.
+        """
+        timeout = self.owner.stall_timeout
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                return self.q.get(timeout=0.2)
+            except queue.Empty:
+                if not self.thread.is_alive():
+                    raise IngestError(
+                        "ingest producer thread died without reporting"
+                    ) from None
+                if time.monotonic() > deadline:
+                    raise StallError(
+                        f"ingest producer made no progress in {timeout:.0f}s "
+                        "(queue empty, producer alive); raise "
+                        "--stall-timeout if the input is legitimately "
+                        "this slow"
+                    ) from None
+
     def consume(self):
         owner = self.owner
         self.thread.start()
         try:
             while True:
                 t0 = time.perf_counter()
-                tag, payload = self.q.get()
+                tag, payload = self._get_bounded()
                 owner.stats.starved_sec += time.perf_counter() - t0
                 if tag == "end":
                     return
                 if tag == "error":
-                    raise payload
+                    if isinstance(payload, AnalysisError) or not isinstance(
+                        payload, Exception
+                    ):
+                        raise payload
+                    # untyped producer failure: wrap so every failed run
+                    # still exits with a typed AnalysisError (the chaos
+                    # invariant); the original rides __cause__
+                    raise IngestError(
+                        f"ingest producer failed: "
+                        f"{type(payload).__name__}: {payload}"
+                    ) from payload
                 batch, n_raw, parsed, skipped, v6, cur = payload
                 owner.packer.parsed = parsed
                 owner.packer.skipped = skipped
@@ -167,14 +213,28 @@ class _Pump:
 
     def shutdown(self) -> None:
         self.stop.set()
-        # unblock a producer waiting on a full queue
-        try:
-            while True:
-                self.q.get_nowait()
-        except queue.Empty:
-            pass
-        if self.thread.is_alive():
-            self.thread.join(timeout=10.0)
+        deadline = time.monotonic() + 10.0
+        # drain-and-join LOOP, not drain-then-join: a producer that was
+        # mid-_put when we drained can enqueue one more item and block
+        # again on a full depth-1 queue, so keep draining until it exits
+        while self.thread.is_alive() and time.monotonic() < deadline:
+            try:
+                while True:
+                    self.q.get_nowait()
+            except queue.Empty:
+                pass
+            self.thread.join(timeout=0.1)
+        if not self.thread.is_alive():
+            # release the inner iterator's resources (feeder worker
+            # pools, file handles) deterministically — an abandoned
+            # generator would only run its finally at GC time, leaking
+            # threads/processes past the consumer's exception
+            close_it = getattr(self._it, "close", None)
+            if close_it is not None:
+                try:
+                    close_it()
+                except Exception:
+                    pass  # teardown must not mask the consumer's error
 
 
 class PrefetchingSource:
@@ -196,12 +256,17 @@ class PrefetchingSource:
     ``AnalysisConfig.prefetch_depth``, the single user surface).
     """
 
-    def __init__(self, inner, depth: int, pack=None):
+    def __init__(self, inner, depth: int, pack=None, stall_timeout: float | None = None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self._inner = inner
         self.depth = depth
         self._pack = pack
+        #: watchdog bound on producer-to-consumer progress (see _get_bounded)
+        self.stall_timeout = (
+            stall_timeout if stall_timeout and stall_timeout > 0
+            else faults.default_stall_timeout()
+        )
         self.packer = _Counters()
         self.stats = IngestStats()
         self._staged6: list = []

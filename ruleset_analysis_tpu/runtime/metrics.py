@@ -93,6 +93,10 @@ class RecoveryMeter:
     def __init__(self):
         self.events: list[dict] = []
         self._t_detect: float | None = None
+        #: chaos-harness outcomes (record_run): one bool per seeded fault
+        #: schedule — True when the run ended inside the invariant (bit-
+        #: identical report or typed abort), False on any breach
+        self.runs: list[bool] = []
 
     def detect(self, reason: str = "") -> None:
         if self._t_detect is None:  # first detection wins per event
@@ -115,17 +119,41 @@ class RecoveryMeter:
         """Forget an open detection (budget exhausted: no recovery happened)."""
         self._t_detect = None
 
+    def record_run(self, ok: bool) -> None:
+        """One chaos schedule's verdict (pass-rate feeds BENCH artifacts)."""
+        self.runs.append(bool(ok))
+
     def summary(self) -> dict:
-        """Totals patch: {} when the run never re-formed (zero-noise)."""
-        if not self.events:
-            return {}
-        return {
-            "recovery_events": len(self.events),
-            "recovery_total_sec": round(
-                sum(e["time_to_recover_sec"] for e in self.events), 3
-            ),
-            "recoveries": self.events,
-        }
+        """Totals patch: {} when nothing was recorded (zero-noise)."""
+        out: dict = {}
+        if self.events:
+            out.update(
+                {
+                    "recovery_events": len(self.events),
+                    "recovery_total_sec": round(
+                        sum(e["time_to_recover_sec"] for e in self.events), 3
+                    ),
+                    "mean_time_to_recover_sec": round(
+                        sum(e["time_to_recover_sec"] for e in self.events)
+                        / len(self.events),
+                        3,
+                    ),
+                    "recoveries": self.events,
+                }
+            )
+        if self.runs:
+            # robustness the BENCH artifacts can track alongside speed:
+            # how many seeded fault schedules ended inside the
+            # bit-identical-or-typed-abort invariant
+            out.update(
+                {
+                    "chaos_runs": len(self.runs),
+                    "chaos_pass_rate": round(
+                        sum(self.runs) / len(self.runs), 4
+                    ),
+                }
+            )
+        return out
 
 
 class Profiler:

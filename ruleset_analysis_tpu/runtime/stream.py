@@ -27,6 +27,7 @@ from ..hostside.pack import T_VALID, TUPLE_COLS, LinePacker, PackedRuleset
 from ..hostside.syslog import parse_line
 from ..models import pipeline
 from ..ops.topk import TopKTracker
+from . import faults
 
 
 _SENTINEL = object()
@@ -245,8 +246,9 @@ class _WireFileSource:
     ``yields_wire`` tells the chunk loop to skip the host-side
     ``compact_batch`` (rows already crossed the converter in wire layout)
     and feed ``device_put`` straight from the mmap.  Counters come from
-    the stored valid bits, so a corrupted file shows up as skipped rows
-    instead of silently inflating ``lines_matched``.
+    the stored valid bits, and a stored row whose valid bit is clear —
+    impossible from the converter, so necessarily block damage — is a
+    typed ``WireCorrupt`` refusal rather than a silent skip-count.
     """
 
     yields_wire = True
@@ -267,6 +269,26 @@ class _WireFileSource:
     def n4_rows(self) -> int:
         return self.reader.n_rows
 
+    @staticmethod
+    def _corrupt_wire(wire: np.ndarray, rng) -> np.ndarray:
+        """Seeded storage-damage model for the ``stream.wire.corrupt`` site.
+
+        Scrambles whole stored rows including their valid/meta word — the
+        detectable corruption class the strict reader check below exists
+        for.  (Damage confined to the address words of a still-valid row
+        is indistinguishable from legitimate data without payload
+        checksums; DESIGN §9 records that as the format's open item.)
+        """
+        from ..hostside.pack import W_META
+
+        wire = wire.copy()  # never write through the read-only mmap view
+        for _ in range(1 + rng.randrange(3)):
+            j = rng.randrange(wire.shape[1])
+            for w in range(wire.shape[0]):
+                wire[w, j] ^= np.uint32(rng.getrandbits(32))
+            wire[W_META, j] &= np.uint32(~(1 << 23) & 0xFFFFFFFF)
+        return wire
+
     def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
         from ..hostside.wire import sanity_check_valid_bits
 
@@ -286,10 +308,28 @@ class _WireFileSource:
             )
         skip4 = min(skip_lines, self.reader.n_rows)
         for wire, n in self.reader.iter_batches(skip4, batch_size):
+            wire = faults.fire(
+                "stream.wire.corrupt", payload=wire, corrupt=self._corrupt_wire
+            )
             v, inv = sanity_check_valid_bits(wire)
             # padding columns of a short final batch are not stored rows
+            pad = wire.shape[1] - n
+            if inv > pad:
+                # the converter stores ONLY valid evaluation rows, so a
+                # stored row with the valid bit clear is block damage —
+                # refuse loudly rather than silently skip-counting rows
+                # of a corrupted production input (bit-identical-or-
+                # typed-abort invariant, DESIGN §9)
+                from ..errors import WireCorrupt
+
+                raise WireCorrupt(
+                    f"wire batch holds {inv - pad} stored row(s) with the "
+                    "valid bit clear — the block was damaged after "
+                    "conversion; re-run `ruleset-analyze convert` (or "
+                    "repair storage) to proceed"
+                )
             self.packer.parsed += v
-            self.packer.skipped += inv - (wire.shape[1] - n)
+            self.packer.skipped += inv - pad
             yield wire, n
 
     def batches6(self, skip_rows6: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
@@ -372,6 +412,49 @@ def run_stream_wire(
         profile_dir=profile_dir,
         max_chunks=max_chunks,
     )
+
+
+def _needed_v6_digests(tracker, dig: dict[int, int]) -> dict[int, int]:
+    """digest -> address for the sources the tracker tables reference.
+
+    The single definition of "which digests must persist/travel": the
+    per-process snapshots, the elastic epoch snapshot, and the final
+    distributed report gather all need exactly this set — bounded by
+    the top-K capacity, not V6_DIGEST_CAP.
+    """
+    tag = int(pipeline.V6_ACL_TAG)
+    needed = {
+        int(s)
+        for gid, table in tracker.tables().items()
+        if int(gid) & tag
+        for s in table
+    }
+    return {d: dig[d] for d in sorted(needed) if d in dig}
+
+
+def _v6_digest_extra(source, tracker) -> dict | None:
+    """Snapshot payload for the digest->address talker render map.
+
+    The map is collected at PARSE time, so a resumed run only re-sees
+    sources appearing after the crash point — pre-crash talkers would
+    render as opaque ``v6#xxxx`` digests (a silent report divergence the
+    chaos harness caught).
+    """
+    dig = getattr(source, "v6_digests", None)
+    if not dig:
+        return None
+    rows = [[int(d), int(s)] for d, s in _needed_v6_digests(tracker, dig).items()]
+    return {"v6_digests": rows} if rows else None
+
+
+def _restore_v6_digests(source, snap) -> None:
+    """Inverse of :func:`_v6_digest_extra` on resume (pre-PR snapshots
+    carry no entry and restore nothing)."""
+    dig = getattr(source, "v6_digests", None)
+    if dig is None or not snap.extra:
+        return
+    for d, s in snap.extra.get("v6_digests", []):
+        dig.setdefault(int(d), int(s))
 
 
 def _stage_v6_digests(rows, dig: dict[int, int]) -> None:
@@ -496,6 +579,9 @@ class _ShardCursorSource:
                 "elastic sources resume via per-shard cursors, not a "
                 "global skip offset"
             )
+        # deferred: elastic imports this module's driver at call time
+        from .elastic import DIE_RC
+
         for idx, path, start in self._assignments:
             if self._native:
                 from ..hostside import fastparse
@@ -517,11 +603,15 @@ class _ShardCursorSource:
                 self.cursors[idx] += n_raw
                 yield batch, n_raw
                 self._yielded += 1
+                # plan-driven twin of die_after_batches: abrupt node
+                # death mid-collective (DIE_RC tells the supervisor to
+                # propagate it as whole-node death)
+                faults.fire("elastic.worker.die", crash_rc=DIE_RC)
                 if self._die_after is not None and self._yielded >= self._die_after:
                     # crash injection: abrupt, mid-collective (the exit
                     # code is elastic.DIE_RC — the supervisor propagates
                     # it to simulate whole-node death)
-                    os._exit(77)
+                    os._exit(DIE_RC)
             self.done.add(idx)
 
 
@@ -623,7 +713,10 @@ def run_stream_file(
         from ..hostside.feeder import ParallelFeeder, ThreadedFeeder
 
         feeder_cls = ThreadedFeeder if feed_mode == "thread" else ParallelFeeder
-        source = feeder_cls(packed, paths, n_workers=feed_workers)
+        source = feeder_cls(
+            packed, paths, n_workers=feed_workers,
+            stall_timeout=cfg.stall_timeout_sec,
+        )
     elif use_native:
         source = _FileSource(packed, paths)
     else:
@@ -720,6 +813,7 @@ def run_stream_file_distributed(
     # produced ahead.  Counters / v6 rows / elastic cursors commit only
     # as batches are consumed, so epoch snapshots record the last
     # COMMITTED batch, never one the producer merely prefetched.
+    armed_here = faults.arm_spec(cfg.fault_plan)
     prepacked = False
     if cfg.prefetch_depth > 0:
         from .ingest import PrefetchingSource
@@ -728,7 +822,10 @@ def run_stream_file_distributed(
         if not stacked and not n_wire:
             _pack = pack_mod.compact_batch
             prepacked = True
-        source = PrefetchingSource(source, cfg.prefetch_depth, pack=_pack)
+        source = PrefetchingSource(
+            source, cfg.prefetch_depth, pack=_pack,
+            stall_timeout=cfg.stall_timeout_sec,
+        )
     try:
         wire_src = getattr(source, "yields_wire", False)
 
@@ -910,6 +1007,10 @@ def run_stream_file_distributed(
             else:
                 source.set_counts(snap.parsed, snap.skipped)
                 lines_consumed = snap.lines_consumed
+            # every rank re-seeds the talker render map (merged at save
+            # for elastic, per-split otherwise): pre-crash talkers must
+            # not render as opaque digests after a resume
+            _restore_v6_digests(source, snap)
             n_chunks = snap.n_chunks
         else:
             state_host = pipeline.init_state_host(packed.n_keys, cfg)
@@ -1026,8 +1127,24 @@ def run_stream_file_distributed(
                     "skipped": packer.skipped,
                 }
             )
+            # each rank only holds digests for ITS split's sources; the
+            # epoch snapshot needs the union so ANY surviving world can
+            # render every persisted talker candidate (collective: every
+            # rank gathers, rank 0 writes)
+            dig = getattr(source, "v6_digests", None) or {}
+            drows = np.array(
+                [
+                    (d, *pack_mod.u128_limbs(s))
+                    for d, s in _needed_v6_digests(tracker, dig).items()
+                ],
+                dtype=np.uint32,
+            ).reshape(-1, 5)
+            dmerged = dist.allgather_rows(drows)
             if pid != 0:
                 return
+            v6_digest_rows = [
+                [int(r[0]), int(pack_mod.limbs_u128(*r[1:5]))] for r in dmerged
+            ]
             ckpt.save(
                 elastic.epoch_dir,
                 ckpt.snapshot_of(
@@ -1039,6 +1156,11 @@ def run_stream_file_distributed(
                     tracker=tracker,
                     fingerprint=fp,
                     extra={
+                        **(
+                            {"v6_digests": v6_digest_rows}
+                            if v6_digest_rows
+                            else {}
+                        ),
                         "elastic": {
                             "epoch": elastic.epoch,
                             "world": nproc,
@@ -1072,6 +1194,7 @@ def run_stream_file_distributed(
                     skipped=packer.skipped,
                     tracker=tracker,
                     fingerprint=fp,
+                    extra=_v6_digest_extra(source, tracker),
                 ),
             )
 
@@ -1332,6 +1455,10 @@ def run_stream_file_distributed(
         close = getattr(source, "close", None)
         if close is not None:
             close()
+        if armed_here:
+            # a plan this run armed must not leak (env export included)
+            # into a later run in the same process
+            faults.disarm()
 
 
 def _iter_files(paths: list[str]):
@@ -1404,6 +1531,7 @@ def _run_core(
     """
     from ..parallel import mesh as mesh_lib
 
+    armed_here = faults.arm_spec(cfg.fault_plan)
     try:
         if mesh is None:
             mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
@@ -1425,7 +1553,10 @@ def _run_core(
                             mesh, _pm.compact_batch(b), axis
                         )
                 device_ready = True
-            source = PrefetchingSource(source, cfg.prefetch_depth, pack=pack)
+            source = PrefetchingSource(
+                source, cfg.prefetch_depth, pack=pack,
+                stall_timeout=cfg.stall_timeout_sec,
+            )
         return _run_core_impl(
             packed,
             source,
@@ -1440,6 +1571,10 @@ def _run_core(
         close = getattr(source, "close", None)
         if close is not None:
             close()
+        if armed_here:
+            # a plan this run armed must not leak (env export included)
+            # into a later run in the same process
+            faults.disarm()
 
 
 def _run_core_impl(
@@ -1523,6 +1658,7 @@ def _run_core_impl(
         )
         tracker = ckpt.restore_tracker(snap, cfg.sketch.topk_capacity)
         source.set_counts(snap.parsed, snap.skipped)
+        _restore_v6_digests(source, snap)
         lines_consumed = snap.lines_consumed
         n_chunks = snap.n_chunks
     else:
@@ -1558,6 +1694,7 @@ def _run_core_impl(
                 skipped=packer.skipped,
                 tracker=tracker,
                 fingerprint=fp,
+                extra=_v6_digest_extra(source, tracker),
             ),
         )
 

@@ -62,3 +62,43 @@ if not _needs_reexec():
     # this class of problem.
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402  (after the re-exec guard above)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_workers():
+    """Fail any test that leaks our worker threads or child processes.
+
+    Every thread the pipeline spawns carries an ``ra-`` name prefix
+    (ingest producers, feed workers, heartbeats, watchdogs) and every
+    worker process is a ``multiprocessing`` child, so a cheap enumerate
+    catches a shutdown path that stranded one — the audit the chaos
+    harness relies on ("zero leaked threads/processes").  A short grace
+    window absorbs teardown still in flight; the zero-leak case costs
+    one enumerate and no sleep.
+    """
+    yield
+    import multiprocessing
+    import threading
+    import time
+
+    def leaked():
+        ts = [
+            t
+            for t in threading.enumerate()
+            if t.is_alive() and t.name.startswith("ra-")
+        ]
+        return ts, multiprocessing.active_children()
+
+    deadline = time.monotonic() + 5.0
+    ts, procs = leaked()
+    while (ts or procs) and time.monotonic() < deadline:
+        for p in procs:
+            p.join(timeout=0.1)
+        time.sleep(0.05)
+        ts, procs = leaked()
+    assert not ts and not procs, (
+        f"leaked workers after test: threads={[t.name for t in ts]} "
+        f"processes={[p.pid for p in procs]}"
+    )

@@ -233,6 +233,98 @@ def test_save_is_crash_atomic_pairwise(corpus, tmp_path):
     assert after.lines_consumed == before.lines_consumed
 
 
+def test_crc_catches_valid_json_manifest_flip(tmp_path):
+    """A flipped digit in the manifest decodes as perfectly valid JSON —
+    pre-CRC this silently resumed from the wrong cursors.  The manifest
+    self-CRC (which covers the elastic per-shard cursor manifest in
+    ``extra``) must refuse it as CheckpointCorrupt."""
+    snap = ckpt.Snapshot(
+        arrays={"a": np.arange(8, dtype=np.uint32)},
+        lines_consumed=1000,
+        n_chunks=4,
+        parsed=1000,
+        skipped=0,
+        tracker_tables={},
+        fingerprint="fp",
+        extra={"elastic": {"epoch": 1, "world": 4, "shards": ["s0"],
+                           "cursors": {"0": 700}, "done": []}},
+    )
+    ckpt.save(str(tmp_path), snap)
+    name = (tmp_path / "LATEST").read_text().strip()
+    mp = tmp_path / name / "manifest.json"
+    text = mp.read_text(encoding="utf-8")
+    flipped = text.replace('"0": 700', '"0": 300')
+    assert flipped != text
+    mp.write_text(flipped, encoding="utf-8")
+    with pytest.raises(ckpt.CheckpointCorrupt, match="CRC32"):
+        ckpt.load(str(tmp_path))
+    # restore -> loads again (the CRC is over canonical content)
+    mp.write_text(text, encoding="utf-8")
+    assert ckpt.load(str(tmp_path)).extra["elastic"]["cursors"]["0"] == 700
+
+
+def test_crc_catches_state_payload_substitution(tmp_path):
+    """Swapping the register payload for a different VALID npz (storage
+    returning the wrong object) passes zipfile's member CRCs; the
+    manifest's whole-file state CRC must still refuse it."""
+    snap = ckpt.Snapshot(
+        arrays={"a": np.arange(8, dtype=np.uint32)},
+        lines_consumed=10,
+        n_chunks=2,
+        parsed=10,
+        skipped=0,
+        tracker_tables={},
+        fingerprint="fp",
+    )
+    ckpt.save(str(tmp_path), snap)
+    name = (tmp_path / "LATEST").read_text().strip()
+    with open(tmp_path / name / ckpt.STATE_FILE, "wb") as f:
+        np.savez(f, a=np.zeros(8, dtype=np.uint32))  # valid npz, wrong data
+    with pytest.raises(ckpt.CheckpointCorrupt, match="CRC32"):
+        ckpt.load(str(tmp_path))
+
+
+def test_torn_state_write_truncation_refused(corpus, tmp_path):
+    """Truncate the pointed-to snapshot's register file mid-payload: load
+    must refuse with CheckpointCorrupt, never silently start fresh."""
+    import os
+
+    packed, lines = corpus
+    d = tmp_path / "torn"
+    run_stream(packed, iter(lines), make_cfg(d), max_chunks=3)
+    name = (d / "LATEST").read_text().strip()
+    state = d / name / ckpt.STATE_FILE
+    size = os.path.getsize(state)
+    with open(state, "r+b") as f:
+        f.truncate(size // 2)
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        ckpt.load(str(d))
+    with pytest.raises(ckpt.CheckpointCorrupt):
+        run_stream(packed, iter(lines), make_cfg(d, resume=True))
+
+
+def test_torn_save_fault_recovers_from_prior_epoch(corpus, tmp_path):
+    """Armed ``checkpoint.torn_state``: the second save crashes with a
+    half-written register file.  The pointer protocol must keep serving
+    the FIRST epoch, and the resumed run must be bit-identical to an
+    uninterrupted cadence-matched reference."""
+    from ruleset_analysis_tpu.errors import InjectedFault
+    from ruleset_analysis_tpu.runtime import faults
+
+    packed, lines = corpus
+    ref = run_stream(packed, iter(lines), make_cfg(tmp_path / "ref"))
+    d = tmp_path / "ck"
+    with faults.armed(faults.FaultPlan.parse("checkpoint.torn_state@2")):
+        with pytest.raises(InjectedFault):
+            run_stream(packed, iter(lines), make_cfg(d))
+    before = ckpt.load(str(d))  # the prior epoch survived the torn save
+    assert before is not None and before.n_chunks == 2
+    rep = run_stream(packed, iter(lines), make_cfg(d, resume=True))
+    assert hits_of(rep) == hits_of(ref)
+    assert rep.unused == ref.unused
+    assert rep.totals["lines_matched"] == ref.totals["lines_matched"]
+
+
 def test_resume_input_too_short_is_refused(corpus, tmp_path):
     from ruleset_analysis_tpu.errors import ResumeInputMismatch
 

@@ -197,15 +197,19 @@ def test_max_reforms_exhausted_aborts_cleanly(corpus, tmp_path_factory):
         td, prefix, shards, fault="tag=1,after_batches=4", max_reforms=0,
         timeout=300,
     )
+    from ruleset_analysis_tpu.errors import EXIT_REFORM_BUDGET
     from ruleset_analysis_tpu.runtime.elastic import DIE_RC
 
     # normally the injected death (77); if an unrelated generation failure
     # raced ahead, the victim aborts on the exhausted budget instead —
-    # either way it exited, cleanly and bounded
-    assert outs[1][0] in (DIE_RC, 2), outs[1][2][-1500:]
+    # either way it exited, cleanly and bounded, with the documented
+    # failure-class exit code (7 = reform budget exhausted)
+    assert outs[1][0] in (DIE_RC, EXIT_REFORM_BUDGET), outs[1][2][-1500:]
     for pid in (0, 2, 3):
         rc, _out, err = outs[pid]
-        assert rc != 0, f"launcher {pid} claimed success despite dead peer"
+        assert rc == EXIT_REFORM_BUDGET, (
+            f"launcher {pid} rc={rc} (want {EXIT_REFORM_BUDGET})"
+        )
         assert "budget exhausted" in err, err[-1500:]
     # no report: the run never completed
     assert not (td / "rep0.json").exists()
