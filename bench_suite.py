@@ -1252,6 +1252,110 @@ def bench_sustained() -> dict:
     }
 
 
+def bench_obs() -> dict:
+    """Observability-plane guard: disarmed overhead + stage attribution.
+
+    Two measured runs over the same wire corpus through the production
+    CLI at the sustained bench geometry (batch 1<<20, wire mmap ->
+    pipelined ingest -> sharded step):
+
+    - **disarmed** (no --trace-out/--metrics-out): every obs site is one
+      None-check.  The sustained rate here is the <2%-regression guard
+      against the PR 3 baseline (NORTHSTAR_SUSTAINED_1E8_r06_cpu.json),
+      recorded as ``vs_r06_baseline``.
+    - **armed** (--trace-out + --metrics-out): prices the observability
+      tax when ON, and its merged trace feeds
+      ``tools.trace_summary.summarize`` so the artifact records
+      per-stage occupancy — the attribution substrate the ISSUE names.
+
+    ``RA_OBS_LINES`` overrides the corpus size (default 4M lines —
+    enough chunks for a stable sustained separation on CPU).
+    """
+    import os
+    import tempfile
+
+    import jax
+
+    from ruleset_analysis_tpu import cli
+    from ruleset_analysis_tpu.hostside import pack as pack_mod
+    from ruleset_analysis_tpu.hostside import wire as wire_mod
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import trace_summary
+
+    n = int(float(os.environ.get("RA_OBS_LINES", "4e6")))
+    batch = 1 << 20
+    chunks = max(2, (n + batch - 1) // batch)
+    n = chunks * batch
+    packed = _setup()
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "rules")
+        pack_mod.save_packed(packed, prefix)
+        wire_path = os.path.join(d, "obs.rawire")
+        w = wire_mod.WireWriter(
+            wire_path, wire_mod.ruleset_fingerprint(packed), block_rows=batch
+        )
+        with w:
+            for i in range(chunks):
+                t = np.ascontiguousarray(_tuples(packed, batch, seed=i).T)
+                dense = t[:, t[pack_mod.T_VALID] == 1]
+                w.add(pack_mod.compact_batch(dense), batch, batch - dense.shape[1])
+
+        def run_cli(extra: list[str], out: str) -> dict:
+            rc = cli.main([
+                "run", "--ruleset", prefix, "--logs", wire_path,
+                "--batch-size", str(batch), "--json", "--out", out, *extra,
+            ])
+            if rc != 0:
+                raise RuntimeError(f"obs bench CLI run failed rc={rc}")
+            with open(out, "r", encoding="utf-8") as f:
+                return json.load(f)
+
+        # warm: fills the in-process jit caches so both measured runs
+        # carry the same (near-zero) compile residue
+        run_cli([], os.path.join(d, "warm.json"))
+        rep_off = run_cli([], os.path.join(d, "off.json"))
+        trace_dir = os.path.join(d, "trace")
+        metrics_path = os.path.join(d, "metrics.jsonl")
+        rep_on = run_cli(
+            ["--trace-out", trace_dir, "--metrics-out", metrics_path,
+             "--metrics-every", "1"],
+            os.path.join(d, "on.json"),
+        )
+        attribution = trace_summary.summarize(
+            os.path.join(trace_dir, "trace.json")
+        )
+        with open(metrics_path, "r", encoding="utf-8") as f:
+            metrics_records = [json.loads(ln) for ln in f if ln.strip()]
+    off = rep_off["totals"]["sustained_lines_per_sec"]
+    on = rep_on["totals"]["sustained_lines_per_sec"]
+    baseline_r06 = 439_000.0  # NORTHSTAR_SUSTAINED_1E8_r06_cpu.json, 8-dev CPU
+    return {
+        "metric": "obs_disarmed_sustained_lines_per_sec",
+        "value": off,
+        "unit": "lines/sec",
+        "vs_baseline": round(off / baseline_r06, 4),
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "devices": len(jax.devices()),
+            "lines": n,
+            "chunks": chunks,
+            "disarmed_sustained_lines_per_sec": off,
+            "armed_sustained_lines_per_sec": on,
+            "armed_over_disarmed": round(on / off, 4) if off else 0.0,
+            "vs_r06_baseline": round(off / baseline_r06, 4),
+            "r06_baseline_lines_per_sec": baseline_r06,
+            "metrics_records": len(metrics_records),
+            "stage_attribution": {
+                "wall_sec": attribution["wall_sec"],
+                "processes": attribution["processes"],
+                "stages": attribution["stages"],
+                "top_stalls": attribution["top_stalls"],
+            },
+        },
+    }
+
+
 BENCHES = {
     "stage": bench_stage,
     "exact": bench_exact,
@@ -1263,6 +1367,7 @@ BENCHES = {
     "recall": bench_recall,
     "e2e": bench_e2e,
     "sustained": bench_sustained,
+    "obs": bench_obs,
     "convert": bench_convert,
     "v6": bench_v6,
     "v6recall": bench_v6recall,

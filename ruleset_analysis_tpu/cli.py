@@ -159,6 +159,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--resume": args.resume,
             "--report-every": args.report_every,
             "--profile-dir": args.profile_dir,
+            "--trace-out": args.trace_out,
+            "--metrics-out": args.metrics_out,
             "--native-parse": args.native_parse,
             "--checkpoint-dir": args.checkpoint_dir,
             "--layout=stacked": args.layout != "flat",
@@ -259,6 +261,30 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 "and is not available with --distributed", file=sys.stderr,
             )
             return 2
+        if args.trace_out or args.metrics_out:
+            # Arm the observability plane (runtime/obs.py) for the whole
+            # run: span shards land in --trace-out (exported via
+            # RA_TRACE_DIR so spawned feeder/elastic workers write
+            # sibling shards) and the metrics snapshotter appends JSONL
+            # to --metrics-out.  main()'s finally merges/stops them even
+            # when the run ends in a typed abort — that trace is exactly
+            # the one worth keeping.
+            from .runtime import obs
+
+            try:
+                if args.trace_out:
+                    obs.start_trace(args.trace_out, role="main")
+                if args.metrics_out:
+                    obs.start_metrics(args.metrics_out, args.metrics_every)
+            except OSError as e:
+                # an unwritable trace dir / metrics file is a usage
+                # mistake, reported like every other bad-path flag —
+                # not a raw traceback
+                print(
+                    f"error: cannot open --trace-out/--metrics-out "
+                    f"target: {e}", file=sys.stderr,
+                )
+                return 2
         if args.elastic:
             # Elastic tier: this process becomes a recovery SUPERVISOR
             # (runtime/elastic.py) — --logs is the FULL shard list, the
@@ -720,6 +746,21 @@ def make_parser() -> argparse.ArgumentParser:
                         "prices them; all bit-identical)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here (TensorBoard profile)")
+    p.add_argument("--trace-out", default=None, metavar="DIR",
+                   help="record pipeline spans (parse/pack/H2D/step/"
+                        "checkpoint/elastic) + fault-site instants to "
+                        "per-process shards in DIR, merged into DIR/"
+                        "trace.json at exit — loads in Perfetto / "
+                        "chrome://tracing; spawned feeder/elastic workers "
+                        "inherit the directory via RA_TRACE_DIR (disarmed "
+                        "cost: one None-check per site)")
+    p.add_argument("--metrics-out", default=None, metavar="FILE",
+                   help="append machine-readable run telemetry (JSON "
+                        "lines: lines/s, prefetch queue depth + wait "
+                        "times, feeder occupancy, checkpoint bytes/"
+                        "latency, recovery events, RSS) to FILE")
+    p.add_argument("--metrics-every", type=float, default=10.0, metavar="SEC",
+                   help="snapshot cadence of --metrics-out (default 10s)")
     p.add_argument("--distributed", action="store_true",
                    help="join a jax.distributed multi-process job; --logs are "
                         "THIS process's input split (rank 0 prints the report)")
@@ -798,6 +839,28 @@ def make_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def _finalize_obs() -> None:
+    """Stop the metrics thread + merge trace shards, typed aborts included.
+
+    Runs from ``main``'s finally so a run that dies with an
+    AnalysisError still leaves ONE merged timeline — a disarmed run
+    exits through two None-checks.
+    """
+    from .runtime import obs
+
+    try:
+        merged = obs.shutdown()
+    except Exception as e:  # a broken merge must not mask the run's rc
+        print(f"warning: trace merge failed: {e}", file=sys.stderr)
+        return
+    if merged:
+        print(
+            f"trace: {merged} (open in Perfetto or chrome://tracing; "
+            "summarize with tools/trace_summary.py)",
+            file=sys.stderr,
+        )
+
+
 def main(argv: list[str] | None = None) -> int:
     args = make_parser().parse_args(argv)
     try:
@@ -833,6 +896,8 @@ def main(argv: list[str] | None = None) -> int:
 
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
         return 0
+    finally:
+        _finalize_obs()
 
 
 if __name__ == "__main__":

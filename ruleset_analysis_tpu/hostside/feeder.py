@@ -39,7 +39,7 @@ import numpy as np
 
 from . import fastparse
 from ..errors import FeedWorkerError, StallError
-from ..runtime import faults
+from ..runtime import faults, obs
 from .pack import PackedRuleset, TUPLE_COLS, TUPLE6_COLS
 
 #: Coordinator read granularity while scanning for batch boundaries.
@@ -129,6 +129,10 @@ def _scan_batches(paths: list[str], batch_size: int, skip_lines: int):
 
 
 def _worker(packed_blob, paths, rows_cap, rows6_cap, shm_name, task_q, done_q):
+    # span shards arm lazily from the inherited RA_TRACE_DIR (the same
+    # env channel the fault plan rides); the label makes this process's
+    # track readable in the merged timeline
+    obs.note_role("feeder-worker")
     packed = pickle.loads(packed_blob)
     packer = fastparse.NativePacker(packed)
     shm = shared_memory.SharedMemory(name=shm_name)
@@ -139,6 +143,7 @@ def _worker(packed_blob, paths, rows_cap, rows6_cap, shm_name, task_q, done_q):
             task = task_q.get()
             if task is None:
                 return
+            t0_span = time.perf_counter()
             # fault sites (plan arrives via the inherited RA_FAULT_PLAN
             # env): abrupt death — the OOM-kill the coordinator's
             # liveness probe must catch — and a wedge the coordinator's
@@ -178,6 +183,10 @@ def _worker(packed_blob, paths, rows_cap, rows6_cap, shm_name, task_q, done_q):
             except Exception as e:  # forward instead of dying silently
                 done_q.put(("error", idx, f"{type(e).__name__}: {e}"))
                 return
+            obs.complete(
+                "feeder.parse", t0_span, time.perf_counter(), cat="feeder",
+                args={"batch": idx, "lines": lines},
+            )
             done_q.put(
                 (idx, slot, lines, packer.parsed - p0, packer.skipped - s0, n6)
             )
@@ -313,6 +322,19 @@ class ParallelFeeder(_FeederBase):
 
             import queue as _queue
 
+            def _occupancy() -> dict:
+                # pool gauges for the metrics snapshotter: how many
+                # descriptors are in flight vs workers still alive
+                return {
+                    "mode": "process",
+                    "workers": len(workers),
+                    "alive": sum(1 for w in workers if w.is_alive()),
+                    "inflight": next_submit - next_yield,
+                    "ready": len(ready),
+                    "free_slots": len(free_slots),
+                }
+
+            obs.register_sampler("feeder", _occupancy)
             submit_until_full()
             stall_deadline = time.monotonic() + self.stall_timeout
             while next_yield < next_submit:
@@ -369,6 +391,7 @@ class ParallelFeeder(_FeederBase):
                 submit_until_full()
                 yield out, lines
         finally:
+            obs.unregister_sampler("feeder")
             # Bounded teardown, also on a consumer-side exception: poison
             # pills, ONE shared join budget (a wedged worker must not
             # serialize N x 10s), terminate + reap stragglers, and close
@@ -424,6 +447,7 @@ class ThreadedFeeder(_FeederBase):
         stop_ev = threading.Event()  # releases injected stalls at teardown
 
         def work(desc):
+            t0_span = time.perf_counter()
             # thread-tier twin of the process worker's fault sites (no
             # crash site: os._exit here would take the driver down)
             faults.fire("feeder.worker.stall", stop=stop_ev)
@@ -444,6 +468,10 @@ class ThreadedFeeder(_FeederBase):
                 data, rows_cap, final=True, max_lines=n_lines, n_threads=1
             )
             rows6 = pk.take_v6() if has_v6 else []
+            obs.complete(
+                "feeder.parse", t0_span, time.perf_counter(), cat="feeder",
+                args={"lines": lines},
+            )
             return batch, lines, pk.parsed - p0, pk.skipped - s0, rows6
 
         from collections import deque
@@ -456,6 +484,15 @@ class ThreadedFeeder(_FeederBase):
         max_inflight = 2 * self.n_workers + 2
         stalled = False
         try:
+            obs.register_sampler(
+                "feeder",
+                lambda: {
+                    "mode": "thread",
+                    "workers": self.n_workers,
+                    "inflight": len(inflight),
+                },
+            )
+
             def fill() -> None:
                 while len(inflight) < max_inflight:
                     d = next(desc_it, None)
@@ -492,6 +529,7 @@ class ThreadedFeeder(_FeederBase):
                 fill()
                 yield batch, lines
         finally:
+            obs.unregister_sampler("feeder")
             # release injected stalls FIRST so the bounded shutdown below
             # cannot wedge on a thread parked in a fault site
             stop_ev.set()

@@ -25,6 +25,7 @@ import hashlib
 import json
 import os
 import tempfile
+import time
 import zipfile
 import zlib
 
@@ -124,8 +125,9 @@ def _manifest_crc32(manifest: dict) -> int:
 
 
 def save(ckpt_dir: str, snap: Snapshot) -> None:
-    from . import faults
+    from . import faults, obs
 
+    t_save0 = time.perf_counter()
     os.makedirs(ckpt_dir, exist_ok=True)
     snap_name = f"snap-{snap.n_chunks}"
     tmp_dir = tempfile.mkdtemp(dir=ckpt_dir, prefix=".tmp-")
@@ -181,6 +183,25 @@ def save(ckpt_dir: str, snap: Snapshot) -> None:
         os.fsync(f.fileno())
     os.replace(tmp, os.path.join(ckpt_dir, POINTER_FILE))
     _fsync_dir(ckpt_dir)
+    # the pointer rename above IS the commit point; mark it on the
+    # timeline and push bytes/latency to the metrics JSONL (the size
+    # stat only when a plane is armed — disarmed saves stay syscall-free
+    # past their None-checks)
+    if obs.active_tracer() is not None or obs.metrics_active():
+        t_save1 = time.perf_counter()
+        state_bytes = os.path.getsize(os.path.join(snap_dir, STATE_FILE))
+        obs.complete(
+            "checkpoint.save", t_save0, t_save1, cat="checkpoint",
+            args={"n_chunks": snap.n_chunks, "bytes": int(state_bytes)},
+        )
+        obs.instant("checkpoint.commit", args={"snap": snap_name})
+        obs.metric_event(
+            "checkpoint",
+            n_chunks=snap.n_chunks,
+            lines_consumed=snap.lines_consumed,
+            bytes=int(state_bytes),
+            save_sec=round(t_save1 - t_save0, 4),
+        )
     # Prune everything the new pointer does not reference — superseded
     # snapshots, orphans from a crash between snapshot rename and pointer
     # commit, and stale tmp dirs/files — only after the pointer is durable.
@@ -231,6 +252,13 @@ def _rmtree(path: str) -> None:
 
 
 def load(ckpt_dir: str) -> Snapshot | None:
+    from . import obs
+
+    with obs.span("checkpoint.load", dir=ckpt_dir):
+        return _load(ckpt_dir)
+
+
+def _load(ckpt_dir: str) -> Snapshot | None:
     name = _read_pointer(ckpt_dir)
     if name is None:
         return None  # no pointer file at all: genuinely no checkpoint

@@ -61,7 +61,7 @@ import threading
 import time
 
 from ..errors import AnalysisError, EXIT_REFORM_BUDGET, StallError, exit_code_for
-from . import faults
+from . import faults, obs
 from .metrics import RecoveryMeter
 
 #: seconds between heartbeat-file touches
@@ -348,6 +348,7 @@ class ElasticSupervisor:
         lowest surviving tag is the leader: it allocates the coordinator
         port and publishes the plan; everyone else polls for it.
         """
+        t_form0 = time.perf_counter()
         self._join(gen)
         deadline = time.monotonic() + FORM_TIMEOUT_SEC
         plan_path = self._plan_path(gen)
@@ -385,6 +386,11 @@ class ElasticSupervisor:
             time.sleep(0.1)
         with open(plan_path, "r", encoding="utf-8") as f:
             plan = json.load(f)
+        # the join-to-plan window of THIS member, on the merged timeline
+        obs.complete(
+            "elastic.form", t_form0, time.perf_counter(), cat="elastic",
+            args={"gen": gen, "world": list(plan["world"])},
+        )
         if self.tag not in plan["world"]:
             # our heartbeat was stale when the plan was cut; aborting THIS
             # member is the safe outcome (the formed world runs without us)
@@ -480,6 +486,14 @@ class ElasticSupervisor:
         )
         self._hb = _Heartbeat(self._hb_path(self.tag))
         self._hb.start()
+        # recovery totals ride every metrics snapshot while supervising
+        # (satellite of the RecoveryMeter summary — an operator tailing
+        # --metrics-out sees reforms_used move without waiting for the
+        # final report)
+        obs.register_sampler(
+            "recovery",
+            lambda: {"reforms_used": self.reforms_used, **self.meter.summary()},
+        )
         try:
             gen = 0
             while True:
@@ -534,6 +548,7 @@ class ElasticSupervisor:
                 )
                 gen += 1
         finally:
+            obs.unregister_sampler("recovery")
             if self._hb is not None:
                 self._hb.stop()
 
@@ -583,6 +598,9 @@ def _start_supervisor_watchdog() -> None:
 
 
 def _worker_main(elastic_dir: str, tag: int, gen: int) -> int:
+    # trace shard arming is inherited via RA_TRACE_DIR (supervisor env);
+    # the label names this generation worker's track in the merged view
+    obs.note_role(f"elastic-worker-{tag}-gen{gen}")
     _start_supervisor_watchdog()
     with open(
         os.path.join(elastic_dir, "members", f"{tag}.job.json"),

@@ -346,6 +346,13 @@ def fire(
     if n != spec.at:
         return payload
     action = spec.action
+    # mark the firing on the trace timeline BEFORE acting: the per-event
+    # flush means even a `crash` (os._exit) or `torn` site leaves its
+    # instant in this process's shard, so a merged chaos trace shows
+    # exactly where every injected failure landed
+    from . import obs
+
+    obs.instant(f"fault.{site}", args={"action": action, "hit": n})
     if action == "raise":
         raise InjectedFault(f"injected fault: {site} (hit {n})")
     if action == "stall":

@@ -47,7 +47,7 @@ import time
 import numpy as np
 
 from ..errors import AnalysisError, IngestError, StallError
-from . import faults
+from . import faults, obs
 
 _END = ("end", None)
 
@@ -107,7 +107,12 @@ class _Pump:
         while not self.stop.is_set():
             try:
                 self.q.put(item, timeout=0.1)
-                self.owner.stats.backpressure_sec += time.perf_counter() - t0
+                t1 = time.perf_counter()
+                self.owner.stats.backpressure_sec += t1 - t0
+                if t1 - t0 >= obs.STALL_SPAN_MIN_SEC:
+                    # producer blocked on a full queue: the device is
+                    # the bottleneck for this interval
+                    obs.complete("ingest.backpressure", t0, t1, cat="ingest")
                 return True
             except queue.Full:
                 continue
@@ -127,6 +132,7 @@ class _Pump:
                 faults.fire("ingest.producer.raise")
                 faults.fire("ingest.queue.stall", stop=self.stop)
                 nxt = next(self._it, None)
+                t_parsed = time.perf_counter()
                 if nxt is None:
                     break
                 batch, n_raw = nxt
@@ -136,8 +142,17 @@ class _Pump:
                 parsed = inner.packer.parsed
                 skipped = inner.packer.skipped
                 cur = cursor_rows() if cursor_rows is not None else None
+                obs.complete(
+                    "ingest.produce", t0, t_parsed, cat="ingest",
+                    args={"n_raw": n_raw},
+                )
                 if pack is not None and batch is not None:
                     batch = pack(batch)
+                    # bit-pack + async sharded device_put (H2D issue)
+                    obs.complete(
+                        "ingest.pack", t_parsed, time.perf_counter(),
+                        cat="ingest",
+                    )
                 owner.stats.produce_sec += time.perf_counter() - t0
                 if not self._put(
                     ("item", (batch, n_raw, parsed, skipped, v6, cur))
@@ -184,7 +199,12 @@ class _Pump:
             while True:
                 t0 = time.perf_counter()
                 tag, payload = self._get_bounded()
-                owner.stats.starved_sec += time.perf_counter() - t0
+                t1 = time.perf_counter()
+                owner.stats.starved_sec += t1 - t0
+                if t1 - t0 >= obs.STALL_SPAN_MIN_SEC:
+                    # consumer blocked on an empty queue: the parse is
+                    # the bottleneck for this interval
+                    obs.complete("ingest.starved", t0, t1, cat="ingest")
                 if tag == "end":
                     return
                 if tag == "error":
@@ -285,6 +305,9 @@ class PrefetchingSource:
             self.cursor_rows = self._committed_cursor_rows
         if hasattr(inner, "totals_patch"):
             self.totals_patch = inner.totals_patch
+        # live queue gauges for the metrics snapshotter (one None-check
+        # when --metrics-out is unset); unregistered on close
+        obs.register_sampler("ingest", self._sample_metrics)
 
     # -- delegated attributes -------------------------------------------
     @property
@@ -344,7 +367,19 @@ class PrefetchingSource:
     def ingest_stats(self) -> dict:
         return {"prefetch_depth": self.depth, **self.stats.to_dict()}
 
+    def _sample_metrics(self) -> dict:
+        """Live snapshot of the bounded queue + overlap accounting."""
+        return {
+            "prefetch_depth": self.depth,
+            "queue_depth": sum(p.q.qsize() for p in self._pumps),
+            "batches": self.stats.batches,
+            "produce_sec": round(self.stats.produce_sec, 3),
+            "backpressure_sec": round(self.stats.backpressure_sec, 3),
+            "starved_sec": round(self.stats.starved_sec, 3),
+        }
+
     def close(self) -> None:
+        obs.unregister_sampler("ingest")
         for pump in self._pumps:
             pump.shutdown()
         inner_close = getattr(self._inner, "close", None)

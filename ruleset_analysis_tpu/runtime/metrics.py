@@ -1,9 +1,14 @@
-"""Observability: throughput metering and optional device profiling.
+"""Observability meters: throughput, dispatch timing, recovery, profiling.
 
 The reference's only visibility is Hadoop's job counters and stdout
-(SURVEY.md §6).  Here: a periodic stderr throughput line (lines/sec,
-instantaneous and cumulative) and an opt-in ``jax.profiler`` trace whose
+(SURVEY.md §6).  Here: a periodic throughput line (lines/sec,
+instantaneous and cumulative), the compile-vs-sustained dispatch timer,
+recovery-event accounting, and an opt-in ``jax.profiler`` trace whose
 output loads in TensorBoard's profile plugin for per-op device timing.
+Every meter also feeds the unified tracing + metrics plane
+(``runtime/obs.py``) when it is armed — spans for device dispatches and
+elastic re-formations, line counters and throughput events for the
+metrics JSONL — at a disarmed cost of one None-check per site.
 """
 
 from __future__ import annotations
@@ -11,9 +16,20 @@ from __future__ import annotations
 import sys
 import time
 
+from . import obs
+
 
 class ThroughputMeter:
-    """Periodic lines/sec reporting without per-chunk host/device syncs."""
+    """Periodic lines/sec reporting without per-chunk host/device syncs.
+
+    Every tick also feeds the metrics plane's cumulative line counter
+    (one None-check when ``--metrics-out`` is unset), and the periodic
+    report line lands in the metrics JSONL as a ``throughput`` event in
+    addition to stderr — a sustained run is watchable by tailing the
+    metrics file instead of scraping stderr.  :meth:`summary` folds the
+    final cumulative numbers into the report totals so downstream
+    artifacts stop re-deriving them.
+    """
 
     def __init__(self, report_every_chunks: int = 0, out=sys.stderr):
         self.every = report_every_chunks
@@ -27,6 +43,7 @@ class ThroughputMeter:
     def tick(self, n_lines: int) -> None:
         self.lines += n_lines
         self.chunks += 1
+        obs.add_lines(n_lines)
         if self.every and self.chunks % self.every == 0:
             now = time.perf_counter()
             inst = (self.lines - self.lines_last) / max(now - self.t_last, 1e-9)
@@ -37,10 +54,29 @@ class ThroughputMeter:
                 file=self.out,
                 flush=True,
             )
+            obs.metric_event(
+                "throughput",
+                chunk=self.chunks,
+                lines=self.lines,
+                lines_per_sec_inst=round(inst, 1),
+                lines_per_sec_cum=round(cum, 1),
+            )
             self.t_last, self.lines_last = now, self.lines
 
     def elapsed(self) -> float:
         return time.perf_counter() - self.t0
+
+    def summary(self) -> dict:
+        """Final cumulative numbers for the report totals (``throughput``)."""
+        elapsed = self.elapsed()
+        return {
+            "chunks_ticked": self.chunks,
+            "lines": self.lines,
+            "elapsed_sec": round(elapsed, 4),
+            "lines_per_sec_cum": (
+                round(self.lines / elapsed, 1) if elapsed > 0 else 0.0
+            ),
+        }
 
 
 class DispatchTimer:
@@ -64,13 +100,27 @@ class DispatchTimer:
         self._t: dict[str, list[float]] = {}
 
     def first(self, kind: str, fn, *args):
-        """Run ``fn(*args)``, timing the first two dispatches of ``kind``."""
+        """Run ``fn(*args)``, timing the first two dispatches of ``kind``.
+
+        Every dispatch also records a ``step.dispatch`` trace span when
+        the observability plane is armed — this method already wraps
+        every device dispatch of both stream drivers, so one hook here
+        covers the whole step taxonomy.  Disarmed cost past the first
+        two dispatches: one None-check.
+        """
         lst = self._t.setdefault(kind, [])
-        if len(lst) >= 2:
+        tr = obs.active_tracer()
+        if len(lst) >= 2 and tr is None:
             return fn(*args)
         t0 = time.perf_counter()
         out = fn(*args)
-        lst.append(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        if len(lst) < 2:
+            lst.append(t1 - t0)
+        if tr is not None:
+            tr.complete(
+                "step.dispatch", t0, t1, cat="step", args={"kind": kind}
+            )
         return out
 
     def compile_sec(self) -> float:
@@ -93,6 +143,10 @@ class RecoveryMeter:
     def __init__(self):
         self.events: list[dict] = []
         self._t_detect: float | None = None
+        #: detection reason for the OPEN event; initialized here so an
+        #: out-of-order recovered() (no prior detect()) reads a defined
+        #: value instead of depending on attribute-existence luck
+        self._reason: str = ""
         #: chaos-harness outcomes (record_run): one bool per seeded fault
         #: schedule — True when the run ended inside the invariant (bit-
         #: identical report or typed abort), False on any breach
@@ -102,17 +156,22 @@ class RecoveryMeter:
         if self._t_detect is None:  # first detection wins per event
             self._t_detect = time.perf_counter()
             self._reason = reason
+            obs.instant("elastic.detect", args={"reason": reason})
 
     def recovered(self, *, world: int) -> None:
         t = time.perf_counter()
         t0 = self._t_detect if self._t_detect is not None else t
-        self.events.append(
-            {
-                "time_to_recover_sec": round(t - t0, 3),
-                "world": world,
-                "reason": self._reason if self._t_detect is not None else "",
-            }
-        )
+        event = {
+            "time_to_recover_sec": round(t - t0, 3),
+            "world": world,
+            "reason": self._reason if self._t_detect is not None else "",
+        }
+        self.events.append(event)
+        # the detect..recovered window IS the re-formation span; pushed
+        # to both planes so a 10s recovery is visible on the timeline
+        # and in the metrics JSONL without waiting for the final report
+        obs.complete("elastic.reform", t0, t, cat="elastic", args=event)
+        obs.metric_event("recovery", **event)
         self._t_detect = None
 
     def abandon(self) -> None:
@@ -157,21 +216,56 @@ class RecoveryMeter:
 
 
 class Profiler:
-    """Context manager around jax.profiler tracing (no-op when dir is None)."""
+    """Context manager around jax.profiler tracing (no-op when dir is None).
 
-    def __init__(self, trace_dir: str | None):
+    Hardened: entering twice is a typed error (jax's second start_trace
+    would otherwise fail deep inside the profiler with an opaque
+    message), the trace ALWAYS stops when the body raises (a stop_trace
+    failure during exception unwind is swallowed so it cannot mask the
+    run's real error), and a successful exit prints the trace path with
+    the TensorBoard hint so operators do not have to know the plugin
+    incantation.
+    """
+
+    def __init__(self, trace_dir: str | None, out=sys.stderr):
         self.trace_dir = trace_dir
+        self.out = out
+        self._active = False
 
     def __enter__(self):
+        if self._active:
+            from ..errors import AnalysisError
+
+            raise AnalysisError(
+                "Profiler already started; nest runs, not profiler scopes"
+            )
         if self.trace_dir:
             import jax
 
             jax.profiler.start_trace(self.trace_dir)
+            self._active = True
         return self
 
-    def __exit__(self, *exc):
-        if self.trace_dir:
-            import jax
+    def __exit__(self, exc_type, exc, tb):
+        if not self._active:
+            return False
+        self._active = False
+        import jax
 
+        try:
             jax.profiler.stop_trace()
+        except Exception:
+            # unwinding with the body's exception: the profiler's own
+            # teardown failure must not mask it.  A clean-exit failure
+            # is real and propagates.
+            if exc_type is None:
+                raise
+        else:
+            if exc_type is None:
+                print(
+                    f"profiler trace: {self.trace_dir} (open with "
+                    "`tensorboard --logdir` -> Profile tab)",
+                    file=self.out,
+                    flush=True,
+                )
         return False

@@ -27,7 +27,7 @@ from ..hostside.pack import T_VALID, TUPLE_COLS, LinePacker, PackedRuleset
 from ..hostside.syslog import parse_line
 from ..models import pipeline
 from ..ops.topk import TopKTracker
-from . import faults
+from . import faults, obs
 
 
 _SENTINEL = object()
@@ -1266,8 +1266,9 @@ def run_stream_file_distributed(
                     (max(packed.n_acls, 1), TUPLE_COLS, local_lane), dtype=np.uint32
                 )
             )
-            wire = pack_mod.compact_grouped(grouped)
-            gbatch = dist.to_global(mesh, wire, P(None, None, cfg.mesh_axis))
+            with obs.span("ingest.pack"):
+                wire = pack_mod.compact_grouped(grouped)
+                gbatch = dist.to_global(mesh, wire, P(None, None, cfg.mesh_axis))
             state, out = _first_dispatch("v4", step, state, rules, gbatch, n_chunks)
             pending.append(out)
             if len(pending) > 2:
@@ -1290,12 +1291,13 @@ def run_stream_file_distributed(
                 batch_np, n_raw = nxt if has else (empty, 0)
                 lines_consumed += n_raw
                 meter.tick(n_raw)
-                wire = (
-                    batch_np
-                    if wire_src or prepacked
-                    else pack_mod.compact_batch(batch_np)
-                )
-                gbatch = dist.to_global(mesh, wire, P(None, cfg.mesh_axis))
+                with obs.span("ingest.pack"):
+                    wire = (
+                        batch_np
+                        if wire_src or prepacked
+                        else pack_mod.compact_batch(batch_np)
+                    )
+                    gbatch = dist.to_global(mesh, wire, P(None, cfg.mesh_axis))
                 state, out = _first_dispatch("v4", step, state, rules, gbatch, n_chunks)
                 pending.append(out)
                 if len(pending) > 2:
@@ -1408,6 +1410,9 @@ def run_stream_file_distributed(
             "sustained_lines_per_sec": (
                 round(lines_this_run / sustained, 1) if sustained > 0 else 0.0
             ),
+            # the meter's own cumulative numbers (THIS process's split),
+            # folded in so artifacts stop re-deriving them from stderr
+            "throughput": meter.summary(),
         }
         stats_fn = getattr(source, "ingest_stats", None)
         if stats_fn is not None:
@@ -1718,8 +1723,10 @@ def _run_core_impl(
 
     def run_grouped(grouped_np: np.ndarray) -> None:
         # grouped batches also cross the wire bit-packed (16 B/line)
-        wire = pack_mod.compact_grouped(grouped_np)
-        run_chunk(mesh_lib.shard_grouped(mesh, wire, cfg.mesh_axis))
+        with obs.span("ingest.pack"):
+            wire = pack_mod.compact_grouped(grouped_np)
+            batch_dev = mesh_lib.shard_grouped(mesh, wire, cfg.mesh_axis)
+        run_chunk(batch_dev)
 
     def run_chunk6(batch6_np: np.ndarray) -> None:
         nonlocal state, n_chunks
@@ -1811,8 +1818,13 @@ def _run_core_impl(
                 # ship the bit-packed wire layout: host->device transfer
                 # is the narrowest stage on PCIe-starved links, and the
                 # device unpack is three VPU shifts (pipeline.batch_cols)
-                wire = batch_np if wire_src else pack_mod.compact_batch(batch_np)
-                run_chunk(mesh_lib.shard_batch(mesh, wire, cfg.mesh_axis))
+                with obs.span("ingest.pack"):
+                    wire = (
+                        batch_np if wire_src
+                        else pack_mod.compact_batch(batch_np)
+                    )
+                    batch_dev = mesh_lib.shard_batch(mesh, wire, cfg.mesh_axis)
+                run_chunk(batch_dev)
             if step6 is not None:
                 stage_v6()
             lines_consumed += n_raw_lines
@@ -1896,6 +1908,9 @@ def _run_core_impl(
         "sustained_lines_per_sec": (
             round(lines_this_run / sustained, 1) if sustained > 0 else 0.0
         ),
+        # the meter's own cumulative numbers, folded into the report so
+        # downstream artifacts stop re-deriving them from stderr lines
+        "throughput": meter.summary(),
     }
     stats_fn = getattr(source, "ingest_stats", None)
     if stats_fn is not None:

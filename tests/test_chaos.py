@@ -54,6 +54,7 @@ VOLATILE = (
     "compile_sec",
     "sustained_lines_per_sec",
     "ingest",
+    "throughput",
 )
 
 CFG6 = """\

@@ -156,7 +156,7 @@ def test_threaded_feeder_report_equals_process_tier(corpus):
     jt, jp = json.loads(thr.to_json()), json.loads(prc.to_json())
     for k in (
         "elapsed_sec", "lines_per_sec", "compile_sec",
-        "sustained_lines_per_sec", "ingest",
+        "sustained_lines_per_sec", "ingest", "throughput",
     ):
         jt["totals"].pop(k, None)
         jp["totals"].pop(k, None)

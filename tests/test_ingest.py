@@ -38,6 +38,7 @@ VOLATILE = (
     "compile_sec",
     "sustained_lines_per_sec",
     "ingest",
+    "throughput",
 )
 
 

@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""Per-stage occupancy + top stalls from a merged pipeline trace.
+
+Usage:
+    python tools/trace_summary.py TRACE.json [--json] [--top N]
+
+Reads the merged Chrome trace the observability plane writes
+(``run --trace-out DIR`` -> ``DIR/trace.json``; runtime/obs.py) and
+answers the attribution question directly from the timeline:
+
+- **occupancy** — for each span name (``ingest.produce``, ``ingest.pack``,
+  ``step.dispatch``, ``feeder.parse``, ``checkpoint.save``, ...), total
+  busy time as a percentage of the trace wall window, with event counts
+  and mean durations.  Parallel tracks (producer thread, feeder worker
+  processes) each contribute their own busy time, so totals over 100%
+  mean real overlap — exactly what the pipelined ingest engine exists
+  to produce.
+- **top stalls** — the longest ``ingest.starved`` (parse-bound) and
+  ``ingest.backpressure`` (device-bound) intervals, with their offsets
+  into the run, so "where did the pipeline wait" has a concrete answer.
+- **instants** — fault-site firings, checkpoint commits, elastic
+  detections, counted by name.
+
+``bench_suite.py obs`` imports :func:`summarize` to record stage
+attribution in its artifact; tests assert the merged traces of chaos
+runs stay summarizable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import gzip
+import json
+import sys
+
+#: span names whose duration IS waiting, reported as stalls not work
+STALL_NAMES = ("ingest.starved", "ingest.backpressure")
+
+
+def _load_events(path: str) -> list[dict]:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt", encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        return data.get("traceEvents", [])
+    return data  # bare event-array form is also valid Chrome JSON
+
+
+def summarize(path: str, top: int = 5) -> dict:
+    """Machine-readable attribution for one merged trace file."""
+    events = _load_events(path)
+    spans = [e for e in events if e.get("ph") == "X" and "ts" in e]
+    instants = collections.Counter(
+        e.get("name", "?") for e in events if e.get("ph") == "i"
+    )
+    pids = {e.get("pid") for e in events if "pid" in e}
+    tracks = {(e.get("pid"), e.get("tid")) for e in spans}
+    if not spans:
+        return {
+            "path": path,
+            "events": len(events),
+            "processes": len(pids),
+            "tracks": 0,
+            "wall_sec": 0.0,
+            "stages": {},
+            "top_stalls": [],
+            "instants": dict(instants),
+        }
+    t_min = min(e["ts"] for e in spans)
+    t_max = max(e["ts"] + e.get("dur", 0) for e in spans)
+    wall_us = max(1, t_max - t_min)
+    by_stage: dict[str, dict] = {}
+    for e in spans:
+        s = by_stage.setdefault(e["name"], {"busy_us": 0, "count": 0})
+        s["busy_us"] += e.get("dur", 0)
+        s["count"] += 1
+    stages = {
+        name: {
+            "occupancy_pct": round(100.0 * s["busy_us"] / wall_us, 2),
+            "busy_sec": round(s["busy_us"] / 1e6, 4),
+            "count": s["count"],
+            "mean_ms": round(s["busy_us"] / s["count"] / 1e3, 3),
+        }
+        for name, s in sorted(
+            by_stage.items(), key=lambda kv: -kv[1]["busy_us"]
+        )
+    }
+    stalls = sorted(
+        (e for e in spans if e["name"] in STALL_NAMES),
+        key=lambda e: -e.get("dur", 0),
+    )[:top]
+    return {
+        "path": path,
+        "events": len(events),
+        "processes": len(pids),
+        "tracks": len(tracks),
+        "wall_sec": round(wall_us / 1e6, 4),
+        "stages": stages,
+        "top_stalls": [
+            {
+                "kind": e["name"],
+                "at_sec": round((e["ts"] - t_min) / 1e6, 4),
+                "dur_ms": round(e.get("dur", 0) / 1e3, 3),
+                "pid": e.get("pid"),
+            }
+            for e in stalls
+        ],
+        "instants": dict(instants),
+    }
+
+
+def render(s: dict) -> str:
+    out = [
+        f"== {s['path']} ==",
+        f"  {s['events']} events, {s['processes']} process(es), "
+        f"{s['tracks']} span track(s), wall {s['wall_sec']:.3f}s",
+        "  stage occupancy (busy / wall; >100% total = overlap):",
+    ]
+    for name, st in s["stages"].items():
+        out.append(
+            f"    {st['occupancy_pct']:6.2f}%  {st['busy_sec']:9.3f}s  "
+            f"x{st['count']:<6} mean {st['mean_ms']:8.3f} ms  {name}"
+        )
+    if s["top_stalls"]:
+        out.append("  top stall intervals:")
+        for st in s["top_stalls"]:
+            out.append(
+                f"    +{st['at_sec']:9.3f}s  {st['dur_ms']:9.3f} ms  "
+                f"[pid {st['pid']}] {st['kind']}"
+            )
+    if s["instants"]:
+        marks = ", ".join(f"{k} x{v}" for k, v in sorted(s["instants"].items()))
+        out.append(f"  instants: {marks}")
+    return "\n".join(out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-stage occupancy + top stalls from a merged "
+        "--trace-out trace"
+    )
+    ap.add_argument("traces", nargs="+", help="merged trace.json file(s)")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    ap.add_argument("--top", type=int, default=5, help="stall intervals to list")
+    args = ap.parse_args(argv)
+    rc = 0
+    results = []
+    for path in args.traces:
+        try:
+            results.append(summarize(path, top=args.top))
+        except (OSError, ValueError) as e:
+            print(f"error: unreadable trace {path!r}: {e}", file=sys.stderr)
+            rc = 1
+    if not results:
+        return rc or 1
+    if args.json:
+        print(json.dumps(results if len(results) > 1 else results[0], indent=2))
+    else:
+        for s in results:
+            print(render(s))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
