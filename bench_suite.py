@@ -1356,6 +1356,141 @@ def bench_obs() -> dict:
     }
 
 
+def bench_coalesce() -> dict:
+    """Flow-coalescing guard (ISSUE 5): skewed speedup + uniform overhead.
+
+    Three wire corpora through the production CLI at the sustained
+    geometry (batch 1<<20, wire mmap -> pipelined ingest -> sharded
+    step), sweeping traffic skew:
+
+    - **uniform** — independent lines (compaction ratio ~1).  Guards the
+      overhead: ``--coalesce auto`` must sample, disable itself, and
+      land within ~3% of the off baseline; ``on`` prices the always-on
+      hash pass.
+    - **zipf s=1.0 / s=1.2** — Zipf flow repetition from a bounded pool
+      (synth.zipf_weights; the heavy-hitter regime of real firewall
+      logs).  Guards the win: ``--coalesce on`` vs ``off`` sustained
+      speedup, expected >= 1.3x (the step is scatter/device-bound, so
+      shrinking device rows by the compaction ratio dominates).
+
+    ``RA_COALESCE_LINES`` overrides the per-corpus size (default ~6M).
+    """
+    import os
+    import tempfile
+
+    import jax
+
+    from ruleset_analysis_tpu import cli
+    from ruleset_analysis_tpu.hostside import pack as pack_mod
+    from ruleset_analysis_tpu.hostside import synth as synth_mod
+    from ruleset_analysis_tpu.hostside import wire as wire_mod
+
+    n = int(float(os.environ.get("RA_COALESCE_LINES", "6e6")))
+    batch = 1 << 20
+    chunks = max(3, (n + batch - 1) // batch)
+    n = chunks * batch
+    pool_flows = 1 << 18
+    packed = _setup()
+    pool = synth_mod.flow_pool(packed, pool_flows, seed=7)
+    sweeps = {}
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "rules")
+        pack_mod.save_packed(packed, prefix)
+
+        def write_corpus(path: str, skew: float | None) -> None:
+            w = wire_mod.WireWriter(
+                path, wire_mod.ruleset_fingerprint(packed), block_rows=batch
+            )
+            p = (
+                synth_mod.zipf_weights(pool.shape[0], skew)
+                if skew is not None
+                else None
+            )
+            with w:
+                for i in range(chunks):
+                    if skew is None:
+                        t = _tuples(packed, batch, seed=100 + i)
+                    else:
+                        rng = np.random.default_rng(1000 + i)
+                        t = pool[rng.choice(pool.shape[0], size=batch, p=p)]
+                    t = np.ascontiguousarray(t.T)
+                    dense = t[:, t[pack_mod.T_VALID] == 1]
+                    w.add(
+                        pack_mod.compact_batch(dense), batch,
+                        batch - dense.shape[1],
+                    )
+
+        def run_cli(wire_path: str, coalesce: str, out: str) -> dict:
+            rc = cli.main([
+                "run", "--ruleset", prefix, "--logs", wire_path,
+                "--batch-size", str(batch), "--coalesce", coalesce,
+                "--json", "--out", out,
+            ])
+            if rc != 0:
+                raise RuntimeError(f"coalesce bench CLI run failed rc={rc}")
+            with open(out, "r", encoding="utf-8") as f:
+                return json.load(f)
+
+        for name, skew in [("uniform", None), ("zipf_1.0", 1.0), ("zipf_1.2", 1.2)]:
+            wp = os.path.join(d, f"{name}.rawire")
+            write_corpus(wp, skew)
+            # warm fills the jit caches (off-path shapes); the coalesced
+            # bucket shapes compile inside their own measured run's
+            # compile_sec, which the sustained rate already excludes
+            run_cli(wp, "off", os.path.join(d, "warm.json"))
+            rep_off = run_cli(wp, "off", os.path.join(d, f"{name}-off.json"))
+            rep_on = run_cli(wp, "on", os.path.join(d, f"{name}-on.json"))
+            off = rep_off["totals"]["sustained_lines_per_sec"]
+            on = rep_on["totals"]["sustained_lines_per_sec"]
+            entry = {
+                "skew": skew if skew is not None else "uniform",
+                "lines": n,
+                "off_sustained_lines_per_sec": off,
+                "on_sustained_lines_per_sec": on,
+                "on_speedup": round(on / off, 4) if off else 0.0,
+                "compaction_ratio": rep_on["totals"]["coalesce"][
+                    "compaction_ratio"
+                ],
+            }
+            if skew is None:
+                # production setting for unknown traffic: auto samples a
+                # few batches and turns itself off — the overhead guard
+                rep_auto = run_cli(
+                    wp, "auto", os.path.join(d, f"{name}-auto.json")
+                )
+                auto = rep_auto["totals"]["sustained_lines_per_sec"]
+                entry["auto_sustained_lines_per_sec"] = auto
+                entry["auto_over_off"] = round(auto / off, 4) if off else 0.0
+                entry["auto_disabled"] = (
+                    rep_auto["totals"]["coalesce"]["active"] is False
+                )
+            sweeps[name] = entry
+
+    headline = sweeps["zipf_1.0"]["on_speedup"]
+    return {
+        "metric": "coalesce_sustained_speedup_zipf1",
+        "value": headline,
+        "unit": "x vs coalesce=off",
+        "vs_baseline": headline,
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "devices": len(jax.devices()),
+            "batch": batch,
+            "chunks": chunks,
+            "pool_flows": pool_flows,
+            "guards": {
+                "skewed_speedup_min": 1.3,
+                "uniform_auto_overhead_max": 0.03,
+                "skewed_speedup_ok": sweeps["zipf_1.0"]["on_speedup"] >= 1.3,
+                "uniform_overhead_ok": sweeps["uniform"].get(
+                    "auto_over_off", 0.0
+                ) >= 0.97,
+            },
+            "sweeps": sweeps,
+        },
+    }
+
+
 BENCHES = {
     "stage": bench_stage,
     "exact": bench_exact,
@@ -1368,6 +1503,7 @@ BENCHES = {
     "e2e": bench_e2e,
     "sustained": bench_sustained,
     "obs": bench_obs,
+    "coalesce": bench_coalesce,
     "convert": bench_convert,
     "v6": bench_v6,
     "v6recall": bench_v6recall,

@@ -132,6 +132,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             stacked_lane=args.stacked_lane,
             prefetch_depth=args.prefetch_depth,
             stall_timeout_sec=args.stall_timeout,
+            coalesce=args.coalesce,
             fault_plan=_resolve_fault_plan(args.fault_plan),
             **({"checkpoint_dir": args.checkpoint_dir} if args.checkpoint_dir else {}),
         )
@@ -171,6 +172,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--experimental-match-impl": bool(args.experimental_match_impl),
             "--elastic": args.elastic,
             "--fault-plan": bool(args.fault_plan),
+            "--coalesce": args.coalesce != "off",
         }
         # --prefetch-depth is deliberately NOT rejected: like
         # --batch-size it is a tpu-path tuning knob the oracle ignores,
@@ -376,6 +378,13 @@ def _cmd_run(args: argparse.Namespace) -> int:
             if not file_input:
                 print("--distributed requires file inputs (not '-')", file=sys.stderr)
                 return 2
+            if args.coalesce != "off":
+                print(
+                    "--coalesce applies to single-process runs only; for "
+                    "distributed jobs pre-coalesce the input with "
+                    "`ruleset-analyze convert --coalesce`", file=sys.stderr,
+                )
+                return 2
             import jax
 
             from .parallel.distributed import init_distributed
@@ -457,10 +466,20 @@ def _cmd_convert(args: argparse.Namespace) -> int:
         native=args.native_parse,
         block_rows=args.block_rows,
         feed_workers=args.feed_workers,
+        coalesce=args.coalesce,
     )
     mb = stats["bytes"] / 1e6
+    if stats.get("weighted"):
+        stored = stats["rows"] + stats["rows6"]
+        ratio = stats["evals"] / max(stored, 1)
+        shape = (
+            f"{stored} weighted rows for {stats['evals']} evaluations "
+            f"(compaction {ratio:.2f}x)"
+        )
+    else:
+        shape = f"{stats['evals']} evaluation rows"
     print(
-        f"wrote {args.out}: {stats['evals']} evaluation rows"
+        f"wrote {args.out}: {shape}"
         f"{' (' + str(stats['rows6']) + ' v6)' if stats.get('rows6') else ''} from "
         f"{stats['raw_lines']} lines ({stats['skipped']} skipped), "
         f"{mb:.1f} MB, parser={stats['parser']}",
@@ -498,7 +517,11 @@ def _cmd_wire_info(args: argparse.Namespace) -> int:
             "raw_lines": r.raw_lines,
             "skipped_lines": r.n_skipped,
             "block_rows": r.block_rows,
-            "bytes_per_row": wire.ROW_BYTES,
+            "bytes_per_row": wire.ROWW_BYTES if r.weighted else wire.ROW_BYTES,
+            "weighted": r.weighted,
+            # weighted (coalesced) files: true evaluation count behind
+            # the stored unique rows
+            **({"evals": r.n_evals} if r.weighted else {}),
             # null = no ruleset given, nothing was checked; a real
             # mismatch surfaces as ok=false with the fingerprint error
             "ruleset_match": True if fp is not None else None,
@@ -509,8 +532,13 @@ def _cmd_wire_info(args: argparse.Namespace) -> int:
     else:
         for e in rows:
             if e["ok"]:
+                w = (
+                    f" weighted rows ({e['evals']} evaluations)"
+                    if e.get("weighted")
+                    else " rows"
+                )
                 print(
-                    f"{e['file']}: {e['rows']} rows"
+                    f"{e['file']}: {e['rows']}{w}"
                     f"{' + ' + str(e['rows6']) + ' v6 rows' if e.get('rows6') else ''}"
                     f" from {e['raw_lines']} lines "
                     f"({e['skipped_lines']} skipped), block={e['block_rows']}"
@@ -617,7 +645,15 @@ def _cmd_synth(args: argparse.Namespace) -> int:
     rs = aclparse.parse_asa_config(cfg_text, args.hostname)
     packed = pack.pack_rulesets([rs])
     n6 = int(args.lines * args.v6_fraction) if packed.has_v6 else 0
-    tuples = synth.synth_tuples(packed, args.lines - n6, seed=args.seed)
+    if args.flows > 0:
+        # flow-repetition tier: Zipf(--skew) draws from a bounded flow
+        # pool, the feedstock the coalescing ingest tier compacts
+        tuples = synth.synth_flow_tuples(
+            packed, args.lines - n6, args.flows, skew=args.skew,
+            seed=args.seed,
+        )
+    else:
+        tuples = synth.synth_tuples(packed, args.lines - n6, seed=args.seed)
     log_lines = synth.render_syslog(packed, tuples, seed=args.seed)
     if n6:
         import random as _random
@@ -707,6 +743,15 @@ def make_parser() -> argparse.ArgumentParser:
                    help="worker kind for --feed-workers: separate processes "
                         "packing into shared memory, or in-process threads "
                         "around the GIL-releasing native parser")
+    p.add_argument("--coalesce", choices=["off", "on", "auto"], default="off",
+                   help="pre-aggregate each batch's duplicate flow tuples "
+                        "into (unique row, weight) pairs before the device "
+                        "step — shrinks the scatter-bound step, H2D bytes "
+                        "and device rows by the traffic's repetition ratio "
+                        "with a bit-identical report; 'auto' samples the "
+                        "first batches and turns itself off below the "
+                        "break-even ratio (single-process runs; for "
+                        "--distributed use `convert --coalesce`)")
     p.add_argument("--prefetch-depth", type=int,
                    default=AnalysisConfig.prefetch_depth, metavar="K",
                    help="pipelined ingest: parse/pack/device_put up to K "
@@ -801,6 +846,12 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--feed-workers", type=int, default=0, metavar="N",
                    help="parse with N worker processes (multi-core one-time "
                         "conversion; output is byte-identical; 0/1 = off)")
+    p.add_argument("--coalesce", action="store_true",
+                   help="write the weighted v3 format: per-batch duplicate "
+                        "flow tuples store once with a repetition count "
+                        "(20 B/row + weights; bit-identical reports, file "
+                        "and every later device step shrink by the "
+                        "corpus's compaction ratio)")
     p.set_defaults(fn=_cmd_convert)
 
     p = sub.add_parser(
@@ -835,6 +886,13 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--v6-fraction", type=float, default=0.0,
                    help="fraction of ACEs (and log lines) spelled IPv6 — "
                         "generates a unified v4+v6 config and mixed corpus")
+    p.add_argument("--flows", type=int, default=0, metavar="M",
+                   help="draw lines from a pool of M distinct flows with "
+                        "Zipf(--skew) repetition (the coalescing tier's "
+                        "feedstock; 0 = independent lines as before)")
+    p.add_argument("--skew", type=float, default=1.0, metavar="S",
+                   help="Zipf exponent for --flows (0 = uniform; larger "
+                        "concentrates traffic on head flows; default 1.0)")
     p.set_defaults(fn=_cmd_synth)
     return ap
 

@@ -155,6 +155,19 @@ class AnalysisConfig:
     #: advance once per window (CLI --stall-timeout; env
     #: RA_STALL_TIMEOUT overrides the default for bare library calls).
     stall_timeout_sec: float = 300.0
+    #: Flow coalescing (runtime/coalesce.py): pre-aggregate each batch's
+    #: duplicate evaluation tuples into (unique row, weight) pairs before
+    #: the device step — the MapReduce-combiner move applied to a
+    #: scatter-bound step.  Registers update weight-linearly (or
+    #: idempotently, HLL), so reports are bit-identical to the
+    #: uncoalesced path while device rows, scatters, and H2D bytes
+    #: shrink by the traffic's compaction ratio.  "off" = never (the
+    #: historical path, zero added work), "on" = always, "auto" =
+    #: sample the first batches and disable below the break-even ratio.
+    #: Applies to the single-process stream drivers; the distributed
+    #: driver rejects it (per-process unique counts diverge, and the
+    #: collective batch assembly needs one global shape).
+    coalesce: str = "off"
     #: Serialized fault-injection schedule (runtime/faults.py;
     #: ``"site@N,site@N,seed=S"``).  Empty = every site disarmed (the
     #: production state: one None-check per site).  Armed by the drivers
@@ -204,6 +217,35 @@ class AnalysisConfig:
             raise ValueError(
                 f"match_impl={self.match_impl!r} supports layout='flat' only; "
                 "the stacked path always uses the XLA vmapped kernel"
+            )
+        if self.coalesce not in ("off", "on", "auto"):
+            raise ValueError(
+                f"coalesce must be 'off', 'on', or 'auto', got {self.coalesce!r}"
+            )
+        if self.coalesce != "off" and self.match_impl == "pallas_fused":
+            # the fused kernel's in-VMEM histogram counts each valid line
+            # as ONE — it is not weight-linear, so a coalesced batch would
+            # silently undercount by the compaction ratio.  (The stream
+            # drivers enforce the same refusal for weighted .rawire
+            # inputs, which this config-time check cannot see.)
+            raise ValueError(
+                "coalesce is incompatible with the experimental "
+                "pallas_fused kernel (its in-kernel count histogram is "
+                "not weight-linear); use the default match_impl"
+            )
+        if (
+            self.coalesce != "off"
+            and self.counts_impl == "matmul"
+            and self.batch_size >= 1 << 24
+        ):
+            # the matmul counts formulation is exact while per-key
+            # per-chunk sums stay < 2^24 (f32 integer range); a coalesced
+            # chunk's summed weights are bounded by the RAW batch size,
+            # which this geometry lets exceed that — its shape guard only
+            # sees the (smaller) unique-row count, so refuse up front
+            raise ValueError(
+                "coalesce with counts_impl='matmul' needs batch_size < "
+                f"2^24 to keep the f32 formulation exact; got {self.batch_size}"
             )
 
     def replace(self, **kw) -> "AnalysisConfig":

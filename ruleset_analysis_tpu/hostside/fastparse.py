@@ -168,11 +168,51 @@ def _bind(lib: ctypes.CDLL) -> None:
         lib.asa_count_lines.restype = ctypes.c_int64
         lib.asa_count_nl.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.asa_count_nl.restype = ctypes.c_int64
+        # flow coalescing (ISSUE 5): open-addressing batch compaction
+        lib.asa_coalesce.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_int64, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.asa_coalesce.restype = ctypes.c_int64
 
 
 def available() -> bool:
     """True if the native parser library is loadable (building if needed)."""
     return _load() is not None
+
+
+def native_coalesce(
+    mat: np.ndarray, want_first: bool = False
+) -> tuple[np.ndarray, np.ndarray | None] | None:
+    """Native batch compaction, or None when the library is unavailable.
+
+    ``mat`` is a C-contiguous ``[rows, B]`` uint32 plane whose LAST row
+    is the weight/valid plane (see ``pack.coalesce_cols``, which owns the
+    numpy fallback and the output contract — first-occurrence order,
+    summed weights).  The hash pass releases the GIL (ctypes), so under
+    the pipelined ingest producer it overlaps the device step.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    rows, b = mat.shape
+    if not mat.flags.c_contiguous:
+        mat = np.ascontiguousarray(mat)
+    scratch = np.empty((rows, b), dtype=np.uint32)
+    first = np.empty(b, dtype=np.int64) if want_first else None
+    u = int(
+        lib.asa_coalesce(
+            mat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            rows,
+            b,
+            scratch.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            first.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+            if first is not None
+            else None,
+        )
+    )
+    out = np.ascontiguousarray(scratch[:, :u])
+    return out, (first[:u].copy() if first is not None else None)
 
 
 class NativePacker:

@@ -55,9 +55,15 @@ from .pack import (
     T_VALID,
     TUPLE_COLS,
     W_META,
+    W_WEIGHT,
+    W6_WEIGHT,
     WIRE_COLS,
     WIRE6_COLS,
+    WIRE6W_COLS,
+    WIREW_COLS,
     PackedRuleset,
+    coalesce_wire,
+    coalesce_wire6,
     compact_batch,
     compact_batch6,
 )
@@ -68,6 +74,16 @@ MAGIC = b"RAWIREv1"
 #: upgrades to v2 when a v6 row was actually written, so all-v4 corpora
 #: keep producing byte-identical v1 files; readers sniff by magic.
 MAGIC6 = b"RAWIREv2"
+#: Wire format v3 (ISSUE 5): COALESCED rows — every stored row is a
+#: distinct evaluation tuple carrying a uint32 weights plane (20 B/row
+#: v4, 44 B/row v6; pack.WIREW_COLS/WIRE6W_COLS).  ``convert --coalesce``
+#: writes it; the run path feeds the weighted rows straight to the
+#: device, which reads the weights row as its valid plane.  v1/v2 files
+#: are untouched (implicit weight = 1), and the header's ``n_evals``
+#: keeps the TRUE evaluation count (summed weights) so reports state
+#: original-input totals.  v3 always uses the 72-byte v2 header layout
+#: (the v6 section row count is simply 0 for all-v4 corpora).
+MAGIC_W = b"RAWIREv3"
 #: Placeholder magic while a convert is in flight; only a successful
 #: ``WireWriter.close()`` upgrades it to MAGIC, so a crashed or aborted
 #: convert leaves a file every reader refuses ("not a wire file") instead
@@ -84,6 +100,8 @@ DEFAULT_BLOCK_ROWS = 1 << 16
 
 ROW_BYTES = WIRE_COLS * 4  # 16 B/line
 ROW6_BYTES = 40  # WIRE6_COLS * 4
+ROWW_BYTES = WIREW_COLS * 4  # 20 B/row (weighted v4)
+ROW6W_BYTES = WIRE6W_COLS * 4  # 44 B/row (weighted v6)
 
 
 def ruleset_fingerprint(packed: PackedRuleset) -> bytes:
@@ -129,6 +147,7 @@ class WireWriter:
         path: str,
         fp: bytes,
         block_rows: int = DEFAULT_BLOCK_ROWS,
+        weighted: bool = False,
     ):
         if block_rows <= 0:
             raise ValueError("block_rows must be positive")
@@ -136,11 +155,17 @@ class WireWriter:
         self._f = open(path, "wb")
         self._fp = fp
         self.block_rows = block_rows
+        #: v3 coalesced format: rows carry a weights plane; ``n_evals``
+        #: then tracks SUMMED weights (true evaluations), not stored rows
+        self.weighted = weighted
+        self._cols4 = WIREW_COLS if weighted else WIRE_COLS
+        self._cols6 = WIRE6W_COLS if weighted else WIRE6_COLS
+        self._evals = 0
         self.n_rows = 0
         self.n6_rows = 0
         self.raw_lines = 0
         self.n_skipped = 0
-        self._buf = np.empty((WIRE_COLS, block_rows), dtype=np.uint32)
+        self._buf = np.empty((self._cols4, block_rows), dtype=np.uint32)
         self._fill = 0
         #: v6 rows spill to a sibling temp file while v4 blocks stream to
         #: the main file (the v6 section must FOLLOW every v4 block); a
@@ -161,7 +186,8 @@ class WireWriter:
         # choice must be made BEFORE the first v4 block lands; a ruleset
         # without v6 rows never calls add6, so the caller passes
         # has_v6 via begin6() before any add when v6 is possible.
-        self._payload_at = HEADER_BYTES
+        # (The weighted v3 format always reserves the 72-byte header.)
+        self._payload_at = HEADER6_BYTES if weighted else HEADER_BYTES
         self._f.write(self._header(final=False))
 
     def begin6(self) -> None:
@@ -172,6 +198,8 @@ class WireWriter:
         handle n6_rows == 0, and all-v4 corpora (no begin6) keep their
         exact v1 bytes.
         """
+        if self._payload_at == HEADER6_BYTES:
+            return  # weighted files (or repeated calls) already reserved it
         if self.n_rows or self._fill or self.n6_rows:
             raise RuntimeError("begin6() must precede the first add")
         self._payload_at = HEADER6_BYTES
@@ -181,15 +209,21 @@ class WireWriter:
 
     def _header(self, final: bool = True) -> bytes:
         if self._payload_at == HEADER6_BYTES:
+            if self.weighted:
+                magic = MAGIC_W if final else MAGIC_PARTIAL
+                evals = self._evals  # summed weights = true evaluations
+            else:
+                magic = MAGIC6 if final else MAGIC_PARTIAL
+                evals = self.n_rows + self.n6_rows  # n_evals == stored rows
             return struct.pack(
                 _HEADER6_FMT,
-                MAGIC6 if final else MAGIC_PARTIAL,
+                magic,
                 self.block_rows,
                 0,
                 self.n_rows,
                 self.n6_rows,
                 self.raw_lines,
-                self.n_rows + self.n6_rows,  # n_evals == stored rows
+                evals,
                 self.n_skipped,
                 self._fp,
             )
@@ -206,9 +240,17 @@ class WireWriter:
         )
 
     def add(self, wire: np.ndarray, raw_lines: int, skipped: int) -> None:
-        """Append ``wire[:, :k]`` rows covering ``raw_lines`` text lines."""
-        if wire.dtype != np.uint32 or wire.shape[0] != WIRE_COLS:
-            raise ValueError(f"expected [WIRE_COLS, k] uint32, got {wire.shape} {wire.dtype}")
+        """Append ``wire[:, :k]`` rows covering ``raw_lines`` text lines.
+
+        Weighted writers take ``[WIREW_COLS, k]`` planes (weights row
+        included) and fold the summed weights into ``n_evals``.
+        """
+        if wire.dtype != np.uint32 or wire.shape[0] != self._cols4:
+            raise ValueError(
+                f"expected [{self._cols4}, k] uint32, got {wire.shape} {wire.dtype}"
+            )
+        if self.weighted:
+            self._evals += int(wire[W_WEIGHT].sum())
         self.raw_lines += raw_lines
         self.n_skipped += skipped
         pos = 0
@@ -224,19 +266,23 @@ class WireWriter:
                 self._fill = 0
 
     def add6(self, wire6: np.ndarray, raw_lines: int, skipped: int) -> None:
-        """Append v6 rows (``[WIRE6_COLS, k]``) to the spill section.
+        """Append v6 rows (``[WIRE6_COLS, k]``; weighted: +weights row)
+        to the spill section.
 
-        Requires :meth:`begin6` to have reserved the v2 header.
+        Requires :meth:`begin6` to have reserved the v2 header (weighted
+        files reserve it at construction).
         """
         if self._payload_at != HEADER6_BYTES:
             raise RuntimeError("call begin6() before the first add to write v6 rows")
-        if wire6.dtype != np.uint32 or wire6.shape[0] != WIRE6_COLS:
+        if wire6.dtype != np.uint32 or wire6.shape[0] != self._cols6:
             raise ValueError(
-                f"expected [WIRE6_COLS, k] uint32, got {wire6.shape} {wire6.dtype}"
+                f"expected [{self._cols6}, k] uint32, got {wire6.shape} {wire6.dtype}"
             )
+        if self.weighted:
+            self._evals += int(wire6[W6_WEIGHT].sum())
         if self._f6 is None:
             self._f6 = open(self._path + ".spill6", "wb")
-            self._buf6 = np.empty((WIRE6_COLS, self.block_rows), dtype=np.uint32)
+            self._buf6 = np.empty((self._cols6, self.block_rows), dtype=np.uint32)
         self.raw_lines += raw_lines
         self.n_skipped += skipped
         pos = 0
@@ -316,7 +362,7 @@ def is_wire_file(path: str) -> bool:
     try:
         with open(path, "rb") as f:
             head = f.read(len(MAGIC))
-            return head in (MAGIC, MAGIC6, MAGIC_PARTIAL)
+            return head in (MAGIC, MAGIC6, MAGIC_W, MAGIC_PARTIAL)
     except OSError:
         return False
 
@@ -334,7 +380,8 @@ class _WireFile:
                     f"{path!r} is an incomplete wire file (the convert that "
                     "wrote it crashed or was aborted); re-run the convert"
                 )
-            if head.startswith(MAGIC6):
+            self.weighted = head.startswith(MAGIC_W)
+            if head.startswith(MAGIC6) or self.weighted:
                 if len(head) < HEADER6_BYTES:
                     raise WireFormatError(
                         f"{path!r} is not a wire file (bad magic/header)"
@@ -366,8 +413,12 @@ class _WireFile:
                     "(fingerprint mismatch); re-run `ruleset-analyze convert` "
                     "with the current packed ruleset"
                 )
-            self._v6_at = self._payload_at + self.n_rows * ROW_BYTES
-            need = self._v6_at + self.n6_rows * ROW6_BYTES
+            self.cols4 = WIREW_COLS if self.weighted else WIRE_COLS
+            self.cols6 = WIRE6W_COLS if self.weighted else WIRE6_COLS
+            self._row_bytes = ROWW_BYTES if self.weighted else ROW_BYTES
+            self._row6_bytes = ROW6W_BYTES if self.weighted else ROW6_BYTES
+            self._v6_at = self._payload_at + self.n_rows * self._row_bytes
+            need = self._v6_at + self.n6_rows * self._row6_bytes
             size = os.fstat(f.fileno()).st_size
             if size < need:
                 raise WireFormatError(
@@ -397,22 +448,23 @@ class _WireFile:
             self._mm = None
 
     def block(self, b: int) -> np.ndarray:
-        """Read-only [WIRE_COLS, r] view of payload block ``b``."""
+        """Read-only [cols4, r] view of payload block ``b`` (cols4 is
+        WIRE_COLS, or WIREW_COLS for weighted v3 files)."""
         start = b * self.block_rows
         r = min(self.block_rows, self.n_rows - start)
-        off = self._payload_at + start * ROW_BYTES
-        arr = np.frombuffer(self._mm, dtype=np.uint32, count=WIRE_COLS * r, offset=off)
-        return arr.reshape(WIRE_COLS, r)
+        off = self._payload_at + start * self._row_bytes
+        arr = np.frombuffer(self._mm, dtype=np.uint32, count=self.cols4 * r, offset=off)
+        return arr.reshape(self.cols4, r)
 
     def block6(self, b: int) -> np.ndarray:
-        """Read-only [WIRE6_COLS, r] view of v6-section block ``b``."""
+        """Read-only [cols6, r] view of v6-section block ``b``."""
         start = b * self.block_rows
         r = min(self.block_rows, self.n6_rows - start)
-        off = self._v6_at + start * ROW6_BYTES
+        off = self._v6_at + start * self._row6_bytes
         arr = np.frombuffer(
-            self._mm, dtype=np.uint32, count=WIRE6_COLS * r, offset=off
+            self._mm, dtype=np.uint32, count=self.cols6 * r, offset=off
         )
-        return arr.reshape(WIRE6_COLS, r)
+        return arr.reshape(self.cols6, r)
 
     @property
     def n_blocks(self) -> int:
@@ -450,6 +502,18 @@ class WireReader:
         if fp is None and packed is not None:
             fp = ruleset_fingerprint(packed)
         self._files = [_WireFile(p, fp) for p in paths]
+        kinds = {f.weighted for f in self._files}
+        if len(kinds) > 1:
+            for f in self._files:
+                f.close()
+            raise WireFormatError(
+                "cannot mix weighted (RAWIREv3) and plain wire files in "
+                "one input list; re-convert for a uniform set"
+            )
+        #: True when every file stores coalesced (weighted) rows
+        self.weighted = bool(kinds.pop()) if kinds else False
+        self._cols4 = WIREW_COLS if self.weighted else WIRE_COLS
+        self._cols6 = WIRE6W_COLS if self.weighted else WIRE6_COLS
         blocks = {f.block_rows for f in self._files}
         #: Common payload block size, or 0 when the files disagree (the
         #: reader handles mixed blocks fine; only the aggregate is
@@ -507,7 +571,7 @@ class WireReader:
                     continue
                 while pos < n:
                     if pend is None:
-                        pend = np.zeros((WIRE_COLS, batch_size), dtype=np.uint32)
+                        pend = np.zeros((self._cols4, batch_size), dtype=np.uint32)
                     m = min(batch_size - fill, n - pos)
                     pend[:, fill : fill + m] = blk[:, pos : pos + m]
                     fill += m
@@ -561,7 +625,7 @@ class WireReader:
                     continue
                 while pos < n:
                     if pend is None:
-                        pend = np.zeros((WIRE6_COLS, batch_size), dtype=np.uint32)
+                        pend = np.zeros((self._cols6, batch_size), dtype=np.uint32)
                     m = min(batch_size - fill, n - pos)
                     pend[:, fill:fill + m] = blk[:, pos:pos + m]
                     fill += m
@@ -583,6 +647,7 @@ def convert_logs(
     batch_size: int = DEFAULT_BLOCK_ROWS,
     block_rows: int = DEFAULT_BLOCK_ROWS,
     feed_workers: int = 0,
+    coalesce: bool = False,
 ) -> dict:
     """Parse text syslog once and write a ``.rawire`` file; return stats.
 
@@ -592,6 +657,13 @@ def convert_logs(
     sequence a text run would feed the device — the output file is
     byte-identical across all three parse tiers (chunk boundaries differ
     between tiers, but the file stores only the row stream).
+
+    ``coalesce=True`` writes the weighted v3 format: each per-batch run
+    of duplicate evaluation tuples stores ONCE with its repetition count
+    (ISSUE 5).  Registers from a weighted run are bit-identical to the
+    plain file's (weight-linear/idempotent updates); the file shrinks by
+    ~the corpus's compaction ratio at 20 B/row, and so does every
+    downstream device step.
     """
     from . import fastparse
 
@@ -623,7 +695,9 @@ def convert_logs(
         parser_name = "native" if use_native else "python"
 
     last_skipped = 0
-    with WireWriter(out_path, ruleset_fingerprint(packed), block_rows) as w:
+    with WireWriter(
+        out_path, ruleset_fingerprint(packed), block_rows, weighted=coalesce
+    ) as w:
         if packed.has_v6:
             w.begin6()
         for batch, n_raw in batches:
@@ -639,21 +713,28 @@ def convert_logs(
                 if batch is None
                 else batch[:, batch[T_VALID] == 1]
             )
-            w.add(compact_batch(valid), n_raw, skipped - last_skipped)
+            wire4 = compact_batch(valid)
+            if coalesce:
+                wire4 = coalesce_wire(wire4)
+            w.add(wire4, n_raw, skipped - last_skipped)
             last_skipped = skipped
             if take_v6 is not None:
                 rows6 = take_v6()
                 if len(rows6):
                     t6 = np.asarray(rows6, dtype=np.uint32).T
-                    w.add6(compact_batch6(t6), 0, 0)
+                    wire6 = compact_batch6(t6)
+                    if coalesce:
+                        wire6 = coalesce_wire6(wire6)
+                    w.add6(wire6, 0, 0)
     return {
         "rows": w.n_rows,
         "rows6": w.n6_rows,
         "raw_lines": w.raw_lines,
-        "evals": w.n_rows + w.n6_rows,
+        "evals": w._evals if coalesce else w.n_rows + w.n6_rows,
         "skipped": w.n_skipped,
         "bytes": os.path.getsize(out_path),
         "parser": parser_name,
+        "weighted": coalesce,
     }
 
 

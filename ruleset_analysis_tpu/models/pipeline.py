@@ -28,8 +28,8 @@ from ..hostside.pack import (
     PackedRuleset,
     T_ACL, T_DPORT, T_DST, T_PROTO, T_SPORT, T_SRC, T_VALID,
     T6_ACL, T6_DPORT, T6_DST, T6_PROTO, T6_SPORT, T6_SRC, T6_VALID,
-    TUPLE_COLS, TUPLE6_COLS, W_DST, W_META, W_PORTS, W_SRC, WIRE_COLS,
-    WIRE_MAX_ACLS,
+    TUPLE_COLS, TUPLE6_COLS, W_DST, W_META, W_PORTS, W_SRC, W_WEIGHT,
+    WIRE_COLS, WIRE_MAX_ACLS, WIREW_COLS,
 )
 from ..ops import cms as cms_ops
 from ..ops import counts as count_ops
@@ -88,17 +88,20 @@ class ChunkOut(NamedTuple):
 
 
 def batch_cols(batch: jax.Array) -> tuple[dict, jax.Array]:
-    """Field columns + valid mask from a batch in EITHER layout.
+    """Field columns + valid/weight plane from a batch in ANY layout.
 
     Accepts the working layout ``[TUPLE_COLS, B]`` (one uint32 lane per
-    field) or the wire layout ``[WIRE_COLS, B]`` (bit-packed, 16 B/line —
-    what the stream driver ships over PCIe; see pack.compact_batch).  The
-    layout is static shape information, so under jit this is a free
-    Python branch; the wire unpack is three shifts and three ands on the
-    VPU — noise next to the match itself.
+    field), the wire layout ``[WIRE_COLS, B]`` (bit-packed, 16 B/line —
+    what the stream driver ships over PCIe; see pack.compact_batch), or
+    the WEIGHTED wire layout ``[WIREW_COLS, B]`` (a coalesced batch: the
+    extra row carries each unique row's repetition count, which becomes
+    the valid plane — every register update is weight-linear in it or
+    idempotent, see DESIGN §11).  The layout is static shape information,
+    so under jit this is a free Python branch; the wire unpack is three
+    shifts and three ands on the VPU — noise next to the match itself.
     """
     u32 = jnp.uint32
-    if batch.shape[-2] == WIRE_COLS:
+    if batch.shape[-2] in (WIRE_COLS, WIREW_COLS):
         meta = batch[..., W_META, :]
         ports = batch[..., W_PORTS, :]
         cols = {
@@ -109,6 +112,8 @@ def batch_cols(batch: jax.Array) -> tuple[dict, jax.Array]:
             "dst": batch[..., W_DST, :],
             "dport": ports & u32(0xFFFF),
         }
+        if batch.shape[-2] == WIREW_COLS:
+            return cols, batch[..., W_WEIGHT, :]
         return cols, (meta >> u32(23)) & u32(1)
     if batch.shape[-2] == TUPLE_COLS:
         cols = {
@@ -135,11 +140,12 @@ def batch_cols6(batch: jax.Array) -> tuple[dict, jax.Array]:
     shifts).  Address limbs surface as src0..src3 / dst0..dst3.
     """
     from ..hostside.pack import (
-        W6_DST, W6_META, W6_PORTS, W6_SRC, WIRE6_COLS,
+        W6_DST, W6_META, W6_PORTS, W6_SRC, W6_WEIGHT, WIRE6_COLS,
+        WIRE6W_COLS,
     )
 
     u32 = jnp.uint32
-    if batch.shape[-2] == WIRE6_COLS:
+    if batch.shape[-2] in (WIRE6_COLS, WIRE6W_COLS):
         meta = batch[..., W6_META, :]
         ports = batch[..., W6_PORTS, :]
         cols = {
@@ -151,6 +157,8 @@ def batch_cols6(batch: jax.Array) -> tuple[dict, jax.Array]:
         for i in range(4):
             cols[f"src{i}"] = batch[..., W6_SRC + i, :]
             cols[f"dst{i}"] = batch[..., W6_DST + i, :]
+        if batch.shape[-2] == WIRE6W_COLS:
+            return cols, batch[..., W6_WEIGHT, :]
         return cols, (meta >> u32(23)) & u32(1)
     if batch.shape[-2] != TUPLE6_COLS:
         raise ValueError(
@@ -320,7 +328,7 @@ def ship_ruleset_host(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> De
 def _update_registers(
     state: AnalysisState,
     keys: jax.Array,  # [B] u32 count keys (matched rule / implicit deny)
-    valid: jax.Array,  # [B] u32 mask
+    valid: jax.Array,  # [B] u32 weight plane (0 = invalid, w = w raw lines)
     src: jax.Array,  # [B] u32 source IPs
     acl: jax.Array,  # [B] u32 ACL gids
     *,

@@ -1212,4 +1212,57 @@ int64_t asa_count_lines(const char* buf, int64_t len, int final_,
     return lines;
 }
 
+// Flow coalescing (ISSUE 5): compact a column-major [rows, b] uint32
+// plane into (unique column, summed weight) pairs in FIRST-OCCURRENCE
+// order.  The LAST row is the weight/valid plane — zero-weight columns
+// drop, the rest group by the remaining rows' values.  One linear pass
+// with an open-addressing (linear-probe) table sized to the next power
+// of two >= 2b; `out` must have capacity rows*b (laid out [rows, b] —
+// the caller slices [:, :U]); `first_idx` (optional) receives each
+// unique column's first source index.  Returns U.  ASA flow logs repeat
+// the same 5-tuple across 106100/302013 lines, so U << b on real
+// traffic — the MapReduce-combiner move applied to the device batch.
+int64_t asa_coalesce(const uint32_t* in, int64_t rows, int64_t b,
+                     uint32_t* out, int64_t* first_idx) {
+    if (rows < 2 || b <= 0) return 0;
+    const int64_t krows = rows - 1;
+    const uint32_t* wrow = in + krows * b;
+    int64_t nslots = 1;
+    while (nslots < 2 * b) nslots <<= 1;
+    std::vector<int64_t> table((size_t)nslots, -1);
+    int64_t u = 0;
+    for (int64_t j = 0; j < b; ++j) {
+        uint32_t w = wrow[j];
+        if (!w) continue;
+        uint64_t h = 1469598103934665603ull;  // FNV-1a over the key rows
+        for (int64_t r = 0; r < krows; ++r) {
+            h ^= in[r * b + j];
+            h *= 1099511628211ull;
+        }
+        h ^= h >> 32;  // fold: the table mask only sees the low bits
+        int64_t s = (int64_t)(h & (uint64_t)(nslots - 1));
+        for (;;) {
+            int64_t p = table[(size_t)s];
+            if (p < 0) {
+                table[(size_t)s] = u;
+                for (int64_t r = 0; r < krows; ++r) out[r * b + u] = in[r * b + j];
+                out[krows * b + u] = w;
+                if (first_idx) first_idx[u] = j;
+                ++u;
+                break;
+            }
+            bool eq = true;
+            for (int64_t r = 0; r < krows; ++r) {
+                if (out[r * b + p] != in[r * b + j]) { eq = false; break; }
+            }
+            if (eq) {
+                out[krows * b + p] += w;
+                break;
+            }
+            s = (s + 1) & (nslots - 1);
+        }
+    }
+    return u;
+}
+
 }  // extern "C"

@@ -34,13 +34,20 @@ def hll_init(n_keys: int, p: int) -> jnp.ndarray:
 def hll_update(
     hll: jnp.ndarray, keys: jnp.ndarray, values: jnp.ndarray, valid: jnp.ndarray
 ) -> jnp.ndarray:
-    """Fold ``values`` (e.g. src IPs) into each line's key's registers."""
+    """Fold ``values`` (e.g. src IPs) into each line's key's registers.
+
+    ``valid`` is a uint32 *weight* plane: 0 masks the line out, any
+    nonzero value counts it — the gate is boolean (``valid > 0``), never
+    multiplicative, because HLL is idempotent in repetitions of the same
+    (key, value): a coalesced row carrying weight w must update exactly
+    as w identical raw lines would (DESIGN §11).
+    """
     p = int(hll.shape[1]).bit_length() - 1
     h_idx = fmix32(values, seed=_HLL_SEED_IDX)
     h_rank = fmix32(values, seed=_HLL_SEED_RANK)
     reg = h_idx >> _U32(32 - p)  # high p bits -> register index
     rank = clz32(h_rank) + _U32(1)  # 1..33
-    rank = rank * valid.astype(_U32)  # invalid -> 0 == identity for max
+    rank = rank * (valid > 0).astype(_U32)  # invalid -> 0 == identity for max
     return hll.at[keys, reg].max(rank, mode="drop")
 
 

@@ -64,7 +64,9 @@ def _shard_map(f, *, mesh, in_specs, out_specs):
 def _merge_tail(
     state: AnalysisState,
     keys: jax.Array,  # [b] u32 count keys, local shard
-    valid: jax.Array,  # [b] u32
+    valid: jax.Array,  # [b] u32 WEIGHT plane (0 = invalid; a coalesced
+    #                    row's w counts as w raw lines — every update
+    #                    below is weight-linear or idempotent, DESIGN §11)
     src: jax.Array,  # [b] u32
     acl: jax.Array,  # [b] u32
     salt: jax.Array,

@@ -1,0 +1,209 @@
+"""Flow-coalescing policy for the stream drivers (ISSUE 5 tentpole).
+
+ASA flow logs are massively repetitive — the same 5-tuple logs
+106100/302013/302015 lines over and over — so every batch compacts into
+(unique row, weight) pairs on the host before it crosses the wire
+(``hostside.pack.coalesce_*``).  The device step is SCATTER-BOUND
+(DESIGN §8: ~77% of the step is batch-sized register scatters), and
+every register update is weight-linear or idempotent, so shrinking the
+batch to its distinct rows shrinks the dominant scatters, the H2D
+bytes, and the device rows near-linearly with traffic skew while the
+final report stays bit-identical (DESIGN §11).
+
+This module owns the *policy* around the compactors:
+
+- **Bucket ladder.**  jit compiles one executable per static batch
+  shape, so a coalesced batch of U unique rows pads up to the smallest
+  bucket of a fixed geometric ladder (batch, batch/2, ... down to a
+  floor that keeps mesh divisibility).  At most ``_LADDER_STEPS``
+  distinct shapes ever compile; padding columns carry weight 0 and are
+  masked on device like any invalid row.
+
+- **auto mode.**  Compaction costs one O(B) host hash pass per batch;
+  it pays for itself only when the compaction ratio r = raw/unique
+  makes the device-step savings (~(1 - 1/r) x the scatter-bound share)
+  exceed that pass.  ``auto`` coalesces the first
+  ``AUTO_SAMPLE_BATCHES`` batches, and disables itself for the rest of
+  the run when the observed ratio is below ``AUTO_MIN_RATIO`` — a
+  uniform (ratio~1) corpus then pays only the sampling window.
+
+- **Accounting.**  Raw-vs-unique row counters feed an
+  ``ingest.coalesce`` trace span per batch, a metrics-snapshotter
+  sampler, and the report's ``totals.coalesce`` block.  Committed line
+  counters and elastic cursors are untouched: batch boundaries stay
+  raw-line-based (coalescing happens strictly downstream of the batch
+  iterator), so checkpoints and resume offsets are unchanged.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from ..config import AnalysisConfig
+from ..hostside import pack as pack_mod
+from . import faults, obs
+
+#: ``auto`` samples this many batches before deciding...
+AUTO_SAMPLE_BATCHES = 4
+#: ...or this many raw rows, whichever comes first — a 1M-row-batch run
+#: must not spend 4M rows deciding what half a million already show.
+AUTO_SAMPLE_ROWS = 1 << 19
+#: Minimum sampled compaction ratio (raw rows / unique rows) for
+#: ``auto`` to keep coalescing.  Below it the host hash pass buys less
+#: device-step shrink than it costs (DESIGN §11 threshold model).
+AUTO_MIN_RATIO = 1.25
+#: Maximum distinct coalesced batch shapes per family (compile bound).
+_LADDER_STEPS = 6
+
+
+def _ladder(batch_size: int, n_dev: int) -> list[int]:
+    """Descending bucket sizes: halve while mesh-divisible, bounded."""
+    out = [batch_size]
+    while (
+        len(out) < _LADDER_STEPS
+        and out[-1] % 2 == 0
+        and out[-1] // 2 >= n_dev
+        and (out[-1] // 2) % n_dev == 0
+    ):
+        out.append(out[-1] // 2)
+    return out
+
+
+class Coalescer:
+    """Per-run coalescing state shared by every driver hook.
+
+    Thread-safe: under pipelined ingest the v4 hooks run on the producer
+    thread while the v6 staging hooks run on the consumer, so the
+    counters and the auto decision take a small lock (one uncontended
+    acquire per *batch*, not per row).
+    """
+
+    def __init__(self, mode: str, batch_size: int, n_dev: int):
+        if mode not in ("on", "auto"):
+            raise ValueError(f"coalesce mode must be 'on' or 'auto', got {mode!r}")
+        self.mode = mode
+        self._enabled = True
+        self._decided = mode == "on"
+        self._lock = threading.Lock()
+        self._ladder = _ladder(batch_size, max(n_dev, 1))
+        self.batches = 0
+        self.raw_rows = 0
+        self.unique_rows = 0
+        self._t0: float | None = None
+
+    # -- policy ---------------------------------------------------------
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def _bucket(self, u: int) -> int:
+        for size in reversed(self._ladder):  # ascending
+            if size >= u:
+                return size
+        return self._ladder[0]
+
+    def _account(self, raw: int, unique: int, t0: float, t1: float) -> None:
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = t0
+            self.batches += 1
+            self.raw_rows += raw
+            self.unique_rows += unique
+            if not self._decided and (
+                self.batches >= AUTO_SAMPLE_BATCHES
+                or self.raw_rows >= AUTO_SAMPLE_ROWS
+            ):
+                self._decided = True
+                if self.raw_rows < AUTO_MIN_RATIO * max(self.unique_rows, 1):
+                    # uniform-ish traffic: the hash pass costs more than
+                    # the device shrink buys — stop coalescing (later
+                    # batches pass through exactly as with --coalesce off)
+                    self._enabled = False
+        obs.complete(
+            "ingest.coalesce", t0, t1, cat="ingest",
+            args={"raw": raw, "unique": unique},
+        )
+
+    def _compact(self, mat: np.ndarray, fn, pad: bool) -> np.ndarray:
+        # a failing compactor must abort typed, never emit a half-built
+        # weighted batch (the chaos invariant; site registered in faults)
+        faults.fire("ingest.coalesce.fail")
+        t0 = time.perf_counter()
+        raw = int(mat[-1].sum())
+        out = fn(mat)
+        u = out.shape[-1]
+        if pad:
+            out = pack_mod.pad_weighted(out, self._bucket(u))
+        self._account(raw, u, t0, time.perf_counter())
+        return out
+
+    # -- family/layout hooks -------------------------------------------
+    def tuple4(self, batch: np.ndarray, pad: bool = True) -> np.ndarray:
+        """``[TUPLE_COLS, B]`` -> weighted ``[TUPLE_COLS, bucket]``."""
+        return self._compact(batch, pack_mod.coalesce_batch, pad)
+
+    def tuple6(self, batch6: np.ndarray, pad: bool = True) -> np.ndarray:
+        return self._compact(batch6, pack_mod.coalesce_batch6, pad)
+
+    def wire4(self, wire: np.ndarray, pad: bool = True) -> np.ndarray:
+        """``[WIRE_COLS(+1), B]`` -> weighted ``[WIREW_COLS, bucket]``."""
+        view = pack_mod._wire_weighted_view(
+            wire, pack_mod.WIRE_COLS, pack_mod.W_META
+        )
+        return self._compact(view, pack_mod.coalesce_wire, pad)
+
+    def wire6(self, wire6: np.ndarray, pad: bool = True) -> np.ndarray:
+        view = pack_mod._wire_weighted_view(
+            wire6, pack_mod.WIRE6_COLS, pack_mod.W6_META
+        )
+        return self._compact(view, pack_mod.coalesce_wire6, pad)
+
+    # -- reporting ------------------------------------------------------
+    def ratio(self) -> float:
+        return self.raw_rows / max(self.unique_rows, 1)
+
+    def summary(self) -> dict:
+        """Report-totals block (``totals.coalesce``)."""
+        return {
+            "mode": self.mode,
+            "active": self._enabled,
+            "batches": self.batches,
+            "raw_rows": self.raw_rows,
+            "unique_rows": self.unique_rows,
+            "compaction_ratio": round(self.ratio(), 4),
+        }
+
+    def sample_metrics(self) -> dict:
+        """Live gauge for the metrics snapshotter (raw vs unique rows/s)."""
+        elapsed = (
+            time.perf_counter() - self._t0 if self._t0 is not None else 0.0
+        )
+        return {
+            "mode": self.mode,
+            "active": self._enabled,
+            "batches": self.batches,
+            "raw_rows": self.raw_rows,
+            "unique_rows": self.unique_rows,
+            "compaction_ratio": round(self.ratio(), 4),
+            "raw_rows_per_sec": (
+                round(self.raw_rows / elapsed, 1) if elapsed > 0 else 0.0
+            ),
+            "unique_rows_per_sec": (
+                round(self.unique_rows / elapsed, 1) if elapsed > 0 else 0.0
+            ),
+        }
+
+
+def make_coalescer(
+    cfg: AnalysisConfig, batch_size: int, n_dev: int
+) -> Coalescer | None:
+    """One Coalescer per run, or None when ``cfg.coalesce`` is off.
+
+    ``None`` keeps the off path at literally zero added work — the
+    drivers' hooks are one ``is not None`` check per batch.
+    """
+    if cfg.coalesce == "off":
+        return None
+    return Coalescer(cfg.coalesce, batch_size, n_dev)

@@ -89,6 +89,10 @@ SITES: dict[str, tuple[str, str]] = {
         "raise", "the prefetch producer thread fails mid-batch"),
     "ingest.queue.stall": (
         "stall", "the prefetch producer wedges; the bounded queue runs dry"),
+    "ingest.coalesce.fail": (
+        "raise", "the flow-coalescing compactor fails mid-batch (host "
+        "OOM / native-library fault analog); a half-built weighted batch "
+        "must never reach the device"),
     "checkpoint.torn_state": (
         "torn", "crash mid-save after a partial register-file write"),
     "checkpoint.torn_manifest": (

@@ -8,9 +8,11 @@ stage times instead of their max (BENCH r5 measured pipeline_efficiency
 (SURVEY.md §2 L2): a background producer thread runs the source's batch
 iterator (the parse stage — the native parser releases the GIL and fans
 one batch across cores itself), optionally applies a ``pack`` transform
-(wire bit-packing and the async sharded ``device_put``, so the queue
-holds device-ready batches and H2D of chunk N+k overlaps the step of
-chunk N), and feeds a bounded queue the driver's chunk loop consumes.
+(flow coalescing when ``--coalesce`` is armed — the O(B) unique-row
+hash pass, runtime/coalesce.py — then wire bit-packing and the async
+sharded ``device_put``, so the queue holds device-ready batches and H2D
+of chunk N+k overlaps the step of chunk N), and feeds a bounded queue
+the driver's chunk loop consumes.
 
 Correctness contract — COMMIT AT CONSUME, not at produce:
 
@@ -292,6 +294,13 @@ class PrefetchingSource:
         self._staged6: list = []
         self._pumps: list[_Pump] = []
         self.yields_wire = getattr(inner, "yields_wire", False)
+        #: weighted (coalesced) wire input: drivers key the fingerprint
+        #: unit, padding shapes, grouped compaction, and the
+        #: non-weight-linear-impl refusals off this — it must survive
+        #: the wrap exactly like yields_wire
+        self.yields_wire_weighted = getattr(
+            inner, "yields_wire_weighted", False
+        )
         self._cursor_rows = None
         # expose optional protocol members only when the inner source has
         # them: the drivers feature-detect with hasattr (e.g. a v6 step

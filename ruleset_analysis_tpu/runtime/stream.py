@@ -257,6 +257,12 @@ class _WireFileSource:
         from ..hostside.wire import WireReader
 
         self.reader = WireReader(paths, packed)
+        #: weighted (RAWIREv3) input: stored rows are coalesced unique
+        #: tuples with a weights plane; parsed counters then count summed
+        #: weights (true evaluations) while resume offsets stay in the
+        #: stored-row unit this file defines
+        self.weighted = self.reader.weighted
+        self.yields_wire_weighted = self.weighted
         self.packer = _PackedCounters()
         #: fold digest -> 128-bit source (populated by batches6; report
         #: rendering of v6 talkers, same contract as _TextSource)
@@ -268,6 +274,27 @@ class _WireFileSource:
     @property
     def n4_rows(self) -> int:
         return self.reader.n_rows
+
+    @staticmethod
+    def _check_chunk_weight(ws: int) -> None:
+        """Refuse weighted chunks whose summed weights reach 2^32.
+
+        The exact-counts accumulator's carry detection (counts.add64)
+        assumes per-chunk deltas < 2^32; a plain chunk satisfies it by
+        shape, but a weighted chunk's delta is the ORIGINAL line count
+        behind its rows — an extraordinarily repetitive corpus could
+        overflow the uint32 scatter undetected.  Loud refusal with a
+        concrete fix beats a silently wrapped register.
+        """
+        if ws >= 1 << 32:
+            from ..errors import AnalysisError
+
+            raise AnalysisError(
+                f"weighted wire chunk carries {ws} original lines, which "
+                "overflows the per-chunk uint32 count delta; re-convert "
+                "with a smaller --block-rows (or run with a smaller "
+                "--batch-size) so each chunk stays under 2^32 lines"
+            )
 
     @staticmethod
     def _corrupt_wire(wire: np.ndarray, rng) -> np.ndarray:
@@ -328,7 +355,15 @@ class _WireFileSource:
                     "conversion; re-run `ruleset-analyze convert` (or "
                     "repair storage) to proceed"
                 )
-            self.packer.parsed += v
+            if self.weighted:
+                # each stored row stands for `weight` original evaluations
+                from ..hostside.pack import W_WEIGHT
+
+                ws = int(wire[W_WEIGHT].sum())
+                self._check_chunk_weight(ws)
+                self.packer.parsed += ws
+            else:
+                self.packer.parsed += v
             self.packer.skipped += inv - pad
             yield wire, n
 
@@ -343,7 +378,14 @@ class _WireFileSource:
         cap = _TextSource.V6_DIGEST_CAP
         for w6, n in self.reader.iter_batches6(skip_rows6, batch_size):
             v = int(_np.count_nonzero(w6[W6_META] & _np.uint32(1 << 23)))
-            self.packer.parsed += v
+            if self.weighted:
+                from ..hostside.pack import W6_WEIGHT
+
+                ws6 = int(w6[W6_WEIGHT].sum())
+                self._check_chunk_weight(ws6)
+                self.packer.parsed += ws6
+            else:
+                self.packer.parsed += v
             self.packer.skipped += (w6.shape[1] - v) - (w6.shape[1] - n)
             if len(self.v6_digests) < cap and n:
                 # digest -> address map for talker rendering: vectorized
@@ -376,11 +418,17 @@ class _WireFileSource:
         """
         if not complete:
             return {"wire_rows_only": True}
-        return {
+        out = {
             "lines_total": self.reader.raw_lines,
             "lines_skipped": self.reader.n_skipped + self.packer.skipped,
             "wire_rows": self.reader.n_rows + self.reader.n6_rows,
         }
+        if self.weighted:
+            # stored rows are coalesced: state the true evaluation count
+            # and the file's compaction ratio alongside
+            out["wire_evals"] = self.reader.n_evals
+            out["wire_weighted"] = True
+        return out
 
 
 def run_stream_wire(
@@ -780,6 +828,16 @@ def run_stream_file_distributed(
     from ..errors import AnalysisError
 
     stacked = cfg.layout == "stacked"
+    if cfg.coalesce != "off":
+        # per-process unique-row counts diverge, and to_global assembles
+        # ONE global array per round — every process would need the same
+        # post-compaction shape.  Weighted .rawire inputs (converted with
+        # `convert --coalesce`) are the distributed way to the same win.
+        raise AnalysisError(
+            "coalesce applies to the single-process stream drivers only; "
+            "for distributed runs convert the input with "
+            "`ruleset-analyze convert --coalesce` instead"
+        )
     if isinstance(local_paths, str):
         local_paths = [local_paths]
     from ..hostside.wire import is_wire_file
@@ -828,6 +886,9 @@ def run_stream_file_distributed(
         )
     try:
         wire_src = getattr(source, "yields_wire", False)
+        wire_weighted = getattr(source, "yields_wire_weighted", False)
+        if wire_weighted:
+            _check_weighted_input_config(cfg)
 
         mesh = dist.make_global_mesh(cfg.mesh_axis)
         pid, nproc = jax.process_index(), jax.process_count()
@@ -913,7 +974,7 @@ def run_stream_file_distributed(
                     packed, cfg, mesh.shape[cfg.mesh_axis], local_lane if stacked else 0
                 )
                 + f"-dist{pid}of{nproc}"
-                + ("-wire" if wire_src else "")
+                + (("-wirew" if wire_weighted else "-wire") if wire_src else "")
             )
         lines_consumed = 0
         n_chunks = 0
@@ -1214,7 +1275,12 @@ def run_stream_file_distributed(
                 np.zeros((TUPLE_COLS, local_batch), dtype=np.uint32)
             )
         else:
-            empty_cols = pack_mod.WIRE_COLS if wire_src else TUPLE_COLS
+            if wire_src:
+                empty_cols = (
+                    pack_mod.WIREW_COLS if wire_weighted else pack_mod.WIRE_COLS
+                )
+            else:
+                empty_cols = TUPLE_COLS
             empty = np.zeros((empty_cols, local_batch), dtype=np.uint32)
         last_snap_chunks = n_chunks
         chunks_this_run = 0
@@ -1267,7 +1333,13 @@ def run_stream_file_distributed(
                 )
             )
             with obs.span("ingest.pack"):
-                wire = pack_mod.compact_grouped(grouped)
+                # a weighted wire input's rows carry weights in T_VALID
+                # (expand_batch); the 1-bit compactor would crush them
+                wire = (
+                    pack_mod.compact_grouped_w(grouped)
+                    if wire_weighted
+                    else pack_mod.compact_grouped(grouped)
+                )
                 gbatch = dist.to_global(mesh, wire, P(None, None, cfg.mesh_axis))
             state, out = _first_dispatch("v4", step, state, rules, gbatch, n_chunks)
             pending.append(out)
@@ -1350,7 +1422,13 @@ def run_stream_file_distributed(
                     meter.tick(n_rows6)
                 else:
                     b6 = np.zeros(
-                        (pack_mod.WIRE6_COLS, local_batch), dtype=np.uint32
+                        (
+                            pack_mod.WIRE6W_COLS
+                            if wire_weighted
+                            else pack_mod.WIRE6_COLS,
+                            local_batch,
+                        ),
+                        dtype=np.uint32,
                     )
                 gb6 = dist.to_global(mesh, b6, P(None, cfg.mesh_axis))
                 state, out = _first_dispatch("v6", step6, state, rules6_g, gb6, n_chunks)
@@ -1466,6 +1544,37 @@ def run_stream_file_distributed(
             faults.disarm()
 
 
+def _check_weighted_input_config(cfg: AnalysisConfig) -> None:
+    """Refuse device formulations that are not weight-linear/exact.
+
+    A weighted (RAWIREv3) input reaches the step with weights the config
+    validator never saw, so the two combinations the on-the-fly
+    coalescer refuses at config time must also be refused here:
+
+    - ``pallas_fused``: its in-VMEM count histogram adds ONE per valid
+      line — a weight-w row would silently count as one line.
+    - ``matmul`` counts: exact only while per-key per-chunk sums stay
+      < 2^24 (f32 integer range); a weighted chunk's summed weights are
+      bounded by the ORIGINAL corpus's lines behind it, not by the
+      stored batch size the formulation's shape guard sees.
+    """
+    from ..errors import AnalysisError
+
+    if cfg.match_impl == "pallas_fused":
+        raise AnalysisError(
+            "weighted (coalesced) wire inputs are incompatible with the "
+            "experimental pallas_fused kernel (its in-kernel count "
+            "histogram is not weight-linear); use the default match_impl"
+        )
+    if cfg.counts_impl == "matmul":
+        raise AnalysisError(
+            "weighted (coalesced) wire inputs are incompatible with "
+            "counts_impl='matmul' (per-key per-chunk sums can exceed the "
+            "f32-exact range the formulation's shape guard assumes); use "
+            "'scatter' or 'reduce'"
+        )
+
+
 def _iter_files(paths: list[str]):
     for path in paths:
         with open(path, "r", encoding="utf-8", errors="replace") as f:
@@ -1535,11 +1644,25 @@ def _run_core(
     order).
     """
     from ..parallel import mesh as mesh_lib
+    from . import coalesce as coalesce_mod
 
     armed_here = faults.arm_spec(cfg.fault_plan)
+    coal = None
     try:
         if mesh is None:
             mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
+        # Flow coalescing (ISSUE 5): compact duplicate evaluation tuples
+        # into (unique row, weight) pairs before the device step.  The
+        # compactor runs inside the pack stage, so under pipelined ingest
+        # the O(B) host hash pass runs on the producer thread and
+        # overlaps device compute exactly like the wire bit-pack does.
+        coal = coalesce_mod.make_coalescer(
+            cfg,
+            mesh_lib.pad_batch_size(cfg.batch_size, mesh, cfg.mesh_axis),
+            mesh.shape[cfg.mesh_axis],
+        )
+        if coal is not None:
+            obs.register_sampler("coalesce", coal.sample_metrics)
         device_ready = False
         if cfg.prefetch_depth > 0:
             from ..hostside import pack as _pm
@@ -1551,12 +1674,16 @@ def _run_core(
                 wire_src = getattr(source, "yields_wire", False)
                 if wire_src:
                     def pack(b):
+                        if coal is not None and coal.enabled():
+                            b = coal.wire4(b)
                         return mesh_lib.shard_batch(mesh, b, axis)
                 else:
                     def pack(b):
-                        return mesh_lib.shard_batch(
-                            mesh, _pm.compact_batch(b), axis
-                        )
+                        if coal is not None and coal.enabled():
+                            wire = _pm.compact_batch_w(coal.tuple4(b))
+                        else:
+                            wire = _pm.compact_batch(b)
+                        return mesh_lib.shard_batch(mesh, wire, axis)
                 device_ready = True
             source = PrefetchingSource(
                 source, cfg.prefetch_depth, pack=pack,
@@ -1571,8 +1698,11 @@ def _run_core(
             profile_dir=profile_dir,
             max_chunks=max_chunks,
             device_ready=device_ready,
+            coal=coal,
         )
     finally:
+        if coal is not None:
+            obs.unregister_sampler("coalesce")
         close = getattr(source, "close", None)
         if close is not None:
             close()
@@ -1592,6 +1722,7 @@ def _run_core_impl(
     profile_dir: str | None,
     max_chunks: int | None,
     device_ready: bool = False,
+    coal=None,
 ):
     from ..parallel import mesh as mesh_lib
     from ..parallel.step import make_parallel_step
@@ -1642,10 +1773,21 @@ def _run_core_impl(
     fill6 = 0
     packer = source.packer
     wire_src = getattr(source, "yields_wire", False)
+    #: input rows already carry weights (a coalesced .rawire file): the
+    #: grouped compactor must preserve them, and resume offsets count
+    #: STORED (unique) rows — a distinct unit from a plain wire file's.
+    wire_weighted = getattr(source, "yields_wire_weighted", False)
+    #: rows fed to the group buffer may carry weights > 1 (the coalescer
+    #: was created — even auto-disabled runs buffered weighted rows
+    #: during the sampling window — or the input file is weighted)
+    weighted_rows = coal is not None or wire_weighted
+    if wire_weighted:
+        _check_weighted_input_config(cfg)
     # wire offsets count evaluation rows, text offsets count raw lines —
-    # the same snapshot must not resume across input kinds
+    # the same snapshot must not resume across input kinds (nor may a
+    # weighted wire file's stored-row offsets resume a plain file's)
     fp = ckpt.fingerprint(packed, cfg, mesh.shape[cfg.mesh_axis], lane) + (
-        "-wire" if wire_src else ""
+        ("-wirew" if wire_weighted else "-wire") if wire_src else ""
     )
     lines_consumed = 0
     n_chunks = 0
@@ -1722,14 +1864,29 @@ def _run_core_impl(
         n_chunks += 1
 
     def run_grouped(grouped_np: np.ndarray) -> None:
-        # grouped batches also cross the wire bit-packed (16 B/line)
+        # grouped batches also cross the wire bit-packed (16 B/line; the
+        # weighted variant adds the 4-byte weights row — rows that may
+        # carry weights MUST take it, or compact_grouped's 1-bit valid
+        # would silently crush a weight-w row down to one line)
         with obs.span("ingest.pack"):
-            wire = pack_mod.compact_grouped(grouped_np)
+            wire = (
+                pack_mod.compact_grouped_w(grouped_np)
+                if weighted_rows
+                else pack_mod.compact_grouped(grouped_np)
+            )
             batch_dev = mesh_lib.shard_grouped(mesh, wire, cfg.mesh_axis)
         run_chunk(batch_dev)
 
     def run_chunk6(batch6_np: np.ndarray) -> None:
         nonlocal state, n_chunks
+        if coal is not None and coal.enabled():
+            # v6 chunks coalesce at step time: tuple batches carry the
+            # weights in T6_VALID (no layout change), wire-v2 sections
+            # grow the weights row (WIRE6W_COLS)
+            if batch6_np.shape[0] == pack_mod.TUPLE6_COLS:
+                batch6_np = coal.tuple6(batch6_np)
+            else:
+                batch6_np = coal.wire6(batch6_np)
         state, out = _first_dispatch(
             "v6", step6, state, dev_rules6,
             mesh_lib.shard_batch(mesh, batch6_np, cfg.mesh_axis), n_chunks,
@@ -1802,10 +1959,19 @@ def _run_core_impl(
                     break
                 continue
             if gbuf is not None:
-                # bucket by ACL; grouped batches emit when a lane fills
+                # bucket by ACL; grouped batches emit when a lane fills.
+                # Coalescing compacts the batch BEFORE bucketing, so
+                # lanes fill at the unique-row rate — more raw lines per
+                # grouped device chunk.  (Emission cadence therefore
+                # shifts vs the uncoalesced run; registers are cadence-
+                # invariant, and the single-emission regime — lane >=
+                # per-ACL rows — keeps even candidates identical,
+                # DESIGN §11.)
                 cols = (
                     pack_mod.expand_batch(batch_np) if wire_src else batch_np
                 )
+                if coal is not None and coal.enabled():
+                    cols = coal.tuple4(cols, pad=False)
                 for grouped in gbuf.add(np.ascontiguousarray(cols.T)):
                     run_grouped(grouped)
             elif device_ready:
@@ -1819,10 +1985,17 @@ def _run_core_impl(
                 # is the narrowest stage on PCIe-starved links, and the
                 # device unpack is three VPU shifts (pipeline.batch_cols)
                 with obs.span("ingest.pack"):
-                    wire = (
-                        batch_np if wire_src
-                        else pack_mod.compact_batch(batch_np)
-                    )
+                    if coal is not None and coal.enabled():
+                        wire = (
+                            coal.wire4(batch_np)
+                            if wire_src
+                            else pack_mod.compact_batch_w(coal.tuple4(batch_np))
+                        )
+                    else:
+                        wire = (
+                            batch_np if wire_src
+                            else pack_mod.compact_batch(batch_np)
+                        )
                     batch_dev = mesh_lib.shard_batch(mesh, wire, cfg.mesh_axis)
                 run_chunk(batch_dev)
             if step6 is not None:
@@ -1916,6 +2089,10 @@ def _run_core_impl(
     if stats_fn is not None:
         # per-stage overlap accounting: parse-starved vs device-bound
         totals["ingest"] = stats_fn()
+    if coal is not None:
+        # raw-vs-unique accounting + the auto decision, in the report so
+        # artifacts can state the compaction ratio a run actually saw
+        totals["coalesce"] = coal.summary()
     patch = getattr(source, "totals_patch", None)
     if patch is not None:
         # wire input: restore the converter's raw-line accounting once the

@@ -55,6 +55,7 @@ VOLATILE = (
     "sustained_lines_per_sec",
     "ingest",
     "throughput",
+    "coalesce",  # raw/unique accounting differs from the off baseline
 )
 
 CFG6 = """\
@@ -138,7 +139,8 @@ def baselines(chaos_corpus, tmp_path_factory):
     return get
 
 
-def _cfg(depth: int, layout: str, cadence: int, ckpt_dir: str, resume=False):
+def _cfg(depth: int, layout: str, cadence: int, ckpt_dir: str, resume=False,
+         coalesce="off"):
     return AnalysisConfig(
         batch_size=512,
         sketch=SketchConfig(cms_width=1 << 10, cms_depth=2, hll_p=6),
@@ -148,6 +150,7 @@ def _cfg(depth: int, layout: str, cadence: int, ckpt_dir: str, resume=False):
         checkpoint_dir=ckpt_dir,
         resume=resume,
         stall_timeout_sec=STALL_SEC,
+        coalesce=coalesce,
     )
 
 
@@ -161,16 +164,23 @@ def schedule_for(seed: int):
     layout = rng.choice(["flat", "stacked"])
     inp = rng.choice(["text", "wire"])
     depth = rng.choice([0, 2])
+    # the coalesced path joins the matrix on flat layouts, where its
+    # reports are unconditionally bit-identical to the off baseline
+    # (stacked emission cadence shifts candidate pools — DESIGN §11;
+    # its identity regime is pinned separately in test_coalesce.py)
+    coalesce = rng.choice(["off", "on"]) if layout == "flat" else "off"
     sites = ["stream.device_put.fail", "checkpoint.torn_state",
              "checkpoint.torn_manifest"]
     if depth:
         sites += ["ingest.producer.raise", "ingest.queue.stall"]
     if inp == "wire":
         sites += ["stream.wire.corrupt"]
+    if coalesce != "off":
+        sites += ["ingest.coalesce.fail"]
     site = rng.choice(sites)
     cadence = 2 if site.startswith("checkpoint.") else rng.choice([0, 2])
     plan = faults.FaultPlan([faults.FaultSpec(site, rng.randint(1, 4))], seed=seed)
-    return layout, inp, depth, cadence, plan
+    return layout, inp, depth, cadence, coalesce, plan
 
 
 def run_schedule(seed, chaos_corpus, baseline_of, tmp_path) -> bool:
@@ -180,9 +190,9 @@ def run_schedule(seed, chaos_corpus, baseline_of, tmp_path) -> bool:
     aggregates verdicts into the chaos pass-rate artifact).
     """
     packed, text, wirep = chaos_corpus
-    layout, inp, depth, cadence, plan = schedule_for(seed)
+    layout, inp, depth, cadence, coalesce, plan = schedule_for(seed)
     ck = str(tmp_path / f"ck-{seed}")
-    cfg = _cfg(depth, layout, cadence, ck)
+    cfg = _cfg(depth, layout, cadence, ck, coalesce=coalesce)
 
     def run(c):
         return (
@@ -191,6 +201,9 @@ def run_schedule(seed, chaos_corpus, baseline_of, tmp_path) -> bool:
             else run_stream_file(packed, text, c, topk=5)
         )
 
+    # the baseline is always the coalesce-OFF fault-free run: a coalesced
+    # schedule asserts BOTH halves at once — fault invariant and the
+    # tentpole's bit-identical-report claim
     base = baseline_of(layout, inp, cadence)
     aborted = False
     with faults.armed(plan):
@@ -207,7 +220,9 @@ def run_schedule(seed, chaos_corpus, baseline_of, tmp_path) -> bool:
         # recovery half: whatever the fault tore mid-save, the pointer
         # protocol + CRCs must serve a consistent prior epoch and the
         # resumed run must land bit-identical to the fault-free baseline
-        resumed = run(_cfg(depth, layout, cadence, ck, resume=True))
+        resumed = run(
+            _cfg(depth, layout, cadence, ck, resume=True, coalesce=coalesce)
+        )
         assert report_image(resumed) == base, f"seed {seed} bad recovery"
         leftovers = [
             e for e in os.listdir(ck)

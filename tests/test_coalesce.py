@@ -1,0 +1,473 @@
+"""Flow-coalescing ingest (ISSUE 5): weighted batch compaction.
+
+The tentpole invariant: a coalesced run's FINAL REPORT is bit-identical
+to the uncoalesced run's — per-rule hits, unused set, unique-source
+estimates, top-K talker representatives, totals — because every register
+update is weight-linear (counts/CMS/talker scatter-adds) or idempotent
+(HLL max), and unique rows are emitted in first-occurrence order so the
+candidate table's representative selection is preserved.  Pinned here
+across flat x text/wire x v4/v6 x sync/prefetch, plus the stacked
+layout's identity regime (single-emission: lane >= per-ACL rows), the
+weighted .rawire v3 format, crash-at-K resume, the auto threshold, and
+the compactor units (native vs numpy bit-identity, composition).
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+from ruleset_analysis_tpu.errors import AnalysisError, InjectedFault
+from ruleset_analysis_tpu.hostside import aclparse, fastparse, pack, synth
+from ruleset_analysis_tpu.hostside import wire as wire_mod
+from ruleset_analysis_tpu.runtime import faults
+from ruleset_analysis_tpu.runtime.coalesce import Coalescer, _ladder
+from ruleset_analysis_tpu.runtime.stream import (
+    run_stream_file,
+    run_stream_wire,
+)
+
+VOLATILE = (
+    "elapsed_sec",
+    "lines_per_sec",
+    "compile_sec",
+    "sustained_lines_per_sec",
+    "ingest",
+    "throughput",
+    "coalesce",
+)
+
+
+def report_image(rep) -> dict:
+    j = json.loads(rep.to_json())
+    for k in VOLATILE:
+        j["totals"].pop(k, None)
+    return j
+
+
+CFG6 = """\
+hostname fw1
+access-list A extended permit tcp any host 10.0.0.5 eq 443
+access-list A extended permit tcp any6 2001:db8:1::/48 eq 443
+access-list A extended permit udp 2001:db8:2::/64 any6 eq 53
+access-list A extended deny tcp any6 host 2001:db8::bad
+access-list A extended permit ip any any
+access-list B extended permit tcp any6 any6 range 8000 8100
+access-group A in interface outside
+"""
+
+
+def _mixed_lines(n, seed=0, v6_share=0.35):
+    """Mixed-family corpus with bounded field pools, so flows REPEAT."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        acl = "A" if rng.random() < 0.8 else "B"
+        if rng.random() < v6_share:
+            src = f"2001:db8:2::{rng.randrange(1, 7):x}"
+            dst = f"2001:db8:1:1::{rng.randrange(1, 5):x}"
+            proto = rng.choice(["tcp", "udp"])
+        else:
+            src = f"10.1.0.{rng.randrange(1, 7)}"
+            dst = "10.0.0.5" if rng.random() < 0.5 else "10.9.9.9"
+            proto = "tcp"
+        out.append(
+            f"Jul 29 07:48:{i % 60:02d} fw1 : %ASA-6-106100: access-list {acl} "
+            f"permitted {proto} inside/{src}({rng.randrange(1024, 1028)}) -> "
+            f"outside/{dst}({rng.choice([443, 53])}) "
+            f"hit-cnt 1 first hit [0x0, 0x0]"
+        )
+    return out
+
+
+@pytest.fixture(scope="module")
+def corpus4(tmp_path_factory):
+    """v4 corpus of 4000 lines over ~120 distinct flows (ratio >> 1)."""
+    td = tmp_path_factory.mktemp("coal4")
+    cfg_text = synth.synth_config(n_acls=3, rules_per_acl=8, seed=41)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    tuples = synth.synth_flow_tuples(packed, 4000, 120, skew=1.0, seed=5)
+    lines = synth.render_syslog(packed, tuples, seed=6)
+    p = td / "v4.log"
+    p.write_text("\n".join(lines) + "\n", encoding="utf-8")
+    wp = td / "v4.rawire"
+    wire_mod.convert_logs(packed, [str(p)], str(wp), batch_size=512, block_rows=512)
+    return packed, str(p), str(wp)
+
+
+@pytest.fixture(scope="module")
+def corpus6(tmp_path_factory):
+    """Mixed v4+v6 repetitive corpus against a unified ruleset."""
+    td = tmp_path_factory.mktemp("coal6")
+    rs = aclparse.parse_asa_config(CFG6, "fw1")
+    packed = pack.pack_rulesets([rs])
+    p = td / "v6.log"
+    p.write_text("\n".join(_mixed_lines(3000, seed=7)) + "\n", encoding="utf-8")
+    wp = td / "v6.rawire"
+    wire_mod.convert_logs(packed, [str(p)], str(wp), batch_size=512, block_rows=512)
+    return packed, str(p), str(wp)
+
+
+def _cfg(coalesce, depth=2, layout="flat", **kw):
+    return AnalysisConfig(
+        batch_size=512,
+        sketch=SketchConfig(cms_width=1 << 10, cms_depth=2, hll_p=6),
+        prefetch_depth=depth,
+        layout=layout,
+        coalesce=coalesce,
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Compactor units
+# ---------------------------------------------------------------------------
+
+
+def _repetitive_batch(n=4000, pool=150, seed=0):
+    rng = np.random.default_rng(seed)
+    p = np.zeros((6, pool), dtype=np.uint32)
+    p[0] = rng.integers(0, 40, pool)
+    p[1] = rng.integers(0, 256, pool)
+    p[2] = rng.integers(0, 2**32, pool, dtype=np.uint32)
+    p[3] = rng.integers(0, 2**16, pool)
+    p[4] = rng.integers(0, 2**32, pool, dtype=np.uint32)
+    p[5] = rng.integers(0, 2**16, pool)
+    batch = np.zeros((pack.TUPLE_COLS, n), dtype=np.uint32)
+    batch[:6] = p[:, rng.integers(0, pool, size=n)]
+    batch[pack.T_VALID] = (rng.random(n) < 0.9).astype(np.uint32)
+    return batch
+
+
+def test_numpy_and_native_compactors_bit_identical():
+    batch = np.ascontiguousarray(_repetitive_batch())
+    out_np, fi_np = pack._np_coalesce(batch, True)
+    if not fastparse.available():
+        pytest.skip("native library not buildable here")
+    out_nat, fi_nat = fastparse.native_coalesce(batch, True)
+    assert np.array_equal(out_np, out_nat)
+    assert np.array_equal(fi_np, fi_nat)
+
+
+def test_coalesce_weights_order_and_composition():
+    batch = _repetitive_batch()
+    out, first = pack.coalesce_cols(np.ascontiguousarray(batch), True)
+    # weights conserve the raw valid count
+    assert int(out[-1].sum()) == int(batch[pack.T_VALID].sum())
+    # first-occurrence order: source indices strictly increase
+    assert np.all(np.diff(first) > 0)
+    # every unique row's fields match its first occurrence
+    assert np.array_equal(out[:-1], batch[:-1, first])
+    # composition: re-coalescing a coalesced plane is a fixed point
+    again, _ = pack.coalesce_cols(out)
+    assert np.array_equal(again, out)
+    # all-invalid input -> zero columns
+    dead = np.zeros((pack.TUPLE_COLS, 32), dtype=np.uint32)
+    empty, _ = pack.coalesce_cols(dead)
+    assert empty.shape == (pack.TUPLE_COLS, 0)
+
+
+def test_wire_and_tuple_compaction_agree():
+    batch = _repetitive_batch(seed=3)
+    ww = pack.coalesce_wire(pack.compact_batch(batch))
+    assert ww.shape[0] == pack.WIREW_COLS
+    tb = pack.coalesce_batch(batch)
+    assert np.array_equal(pack.expand_batch(ww), tb)
+    # weighted meta keeps the valid bit set on every stored row
+    assert np.all((ww[pack.W_META] >> 23) & 1 == (ww[pack.W_WEIGHT] > 0))
+
+
+def test_pad_weighted_and_bucket_ladder():
+    assert _ladder(512, 8) == [512, 256, 128, 64, 32, 16]
+    assert _ladder(48, 8) == [48, 24]  # 12 not divisible by 8
+    c = Coalescer("on", 512, 8)
+    b = _repetitive_batch(600, pool=100)[:, :512]
+    out = c.tuple4(b)
+    assert out.shape[1] in (128, 256)  # ~100 uniques pad to a bucket
+    assert int(out[pack.T_VALID].sum()) == int(b[pack.T_VALID].sum())
+
+
+# ---------------------------------------------------------------------------
+# Zipf flow-repetition generator (satellite): requested ratio within ±10%
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("skew", [0.0, 1.0, 1.2])
+def test_synth_flow_tuples_hit_expected_unique(skew):
+    cfg_text = synth.synth_config(n_acls=3, rules_per_acl=8, seed=41)
+    packed = pack.pack_rulesets([aclparse.parse_asa_config(cfg_text, "fw1")])
+    n, n_flows = 20000, 2000
+    t = synth.synth_flow_tuples(packed, n, n_flows, skew=skew, seed=11)
+    assert t.shape == (n, pack.TUPLE_COLS)
+    pool = synth.flow_pool(packed, n_flows, seed=11)
+    view = np.ascontiguousarray(t).view(
+        [("", np.uint32)] * t.shape[1]
+    ).ravel()
+    uniq = np.unique(view).size
+    want = synth.expected_unique(n, pool.shape[0], skew)
+    assert abs(uniq - want) <= 0.10 * want, (skew, uniq, want)
+    # deterministic in seed
+    t2 = synth.synth_flow_tuples(packed, n, n_flows, skew=skew, seed=11)
+    assert np.array_equal(t, t2)
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity matrix: flat x text/wire x v4/v6 x sync/prefetch
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family", ["v4", "v6"])
+@pytest.mark.parametrize("inp", ["text", "wire"])
+@pytest.mark.parametrize("depth", [0, 2])
+def test_flat_coalesced_bit_identical(corpus4, corpus6, family, inp, depth):
+    packed, text, wirep = corpus4 if family == "v4" else corpus6
+
+    def run(cfg):
+        return (
+            run_stream_wire(packed, wirep, cfg, topk=5)
+            if inp == "wire"
+            else run_stream_file(packed, text, cfg, topk=5)
+        )
+
+    base = run(_cfg("off", depth))
+    rep = run(_cfg("on", depth))
+    co = rep.totals["coalesce"]
+    assert co["active"] and co["compaction_ratio"] > 1.5, co
+    assert report_image(rep) == report_image(base)
+
+
+@pytest.mark.parametrize("family", ["v4", "v6"])
+def test_stacked_coalesced_bit_identical_single_emission(
+    corpus4, corpus6, family
+):
+    """Stacked identity regime: lane >= per-ACL rows (single emission).
+
+    Multi-emission cadence shifts the candidate pool between runs (the
+    same caveat as the feeder tier, DESIGN §11); registers and the
+    unused-rule report are cadence-invariant either way.
+    """
+    packed, text, _ = corpus4 if family == "v4" else corpus6
+    kw = dict(layout="stacked", stacked_lane=8192)
+    base = run_stream_file(packed, text, _cfg("off", 2, **kw), topk=5)
+    rep = run_stream_file(packed, text, _cfg("on", 2, **kw), topk=5)
+    assert report_image(rep) == report_image(base)
+
+
+def test_crash_at_chunk_k_resume_coalesced(corpus6, tmp_path):
+    """Crash simulation + resume with coalesce on == sync off baseline."""
+    packed, text, _ = corpus6
+    ref = run_stream_file(
+        packed,
+        text,
+        _cfg("off", 0).replace(
+            checkpoint_every_chunks=2, checkpoint_dir=str(tmp_path / "ref")
+        ),
+        topk=5,
+    )
+    ck = str(tmp_path / "ck")
+    cfg = _cfg("on", 3).replace(checkpoint_every_chunks=2, checkpoint_dir=ck)
+    crashed = run_stream_file(packed, text, cfg, topk=5, max_chunks=3)
+    assert crashed.totals["lines_total"] < ref.totals["lines_total"]
+    resumed = run_stream_file(packed, text, cfg.replace(resume=True), topk=5)
+    assert report_image(resumed) == report_image(ref)
+
+
+# ---------------------------------------------------------------------------
+# auto mode
+# ---------------------------------------------------------------------------
+
+
+def test_auto_disables_on_uniform_and_stays_on_skewed(corpus4, tmp_path):
+    packed, text, _ = corpus4
+    # skewed corpus: auto stays on
+    rep = run_stream_file(packed, text, _cfg("auto"), topk=5)
+    assert rep.totals["coalesce"]["active"] is True
+    # uniform corpus (independent draws): auto turns itself off and the
+    # report still matches the off baseline bit for bit
+    tuples = synth.synth_tuples(packed, 3000, seed=9)
+    p = tmp_path / "uniform.log"
+    p.write_text(
+        "\n".join(synth.render_syslog(packed, tuples, seed=10)) + "\n",
+        encoding="utf-8",
+    )
+    base = run_stream_file(packed, str(p), _cfg("off"), topk=5)
+    auto = run_stream_file(packed, str(p), _cfg("auto"), topk=5)
+    co = auto.totals["coalesce"]
+    assert co["active"] is False and co["compaction_ratio"] < 1.25, co
+    assert report_image(auto) == report_image(base)
+
+
+# ---------------------------------------------------------------------------
+# Weighted wire files (RAWIREv3)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family", ["v4", "v6"])
+def test_weighted_wire_file_report_content(
+    corpus4, corpus6, family, tmp_path
+):
+    packed, text, wirep = corpus4 if family == "v4" else corpus6
+    wpw = str(tmp_path / "w.rawire")
+    stats = wire_mod.convert_logs(
+        packed, [text], wpw, batch_size=512, block_rows=512, coalesce=True
+    )
+    assert stats["weighted"] and stats["rows"] < stats["evals"]
+    base = run_stream_wire(packed, wirep, _cfg("off"), topk=5)
+    rep = run_stream_wire(packed, wpw, _cfg("off"), topk=5)
+    assert rep.totals["wire_weighted"] is True
+    assert rep.totals["wire_evals"] == stats["evals"]
+    # totals state ORIGINAL input accounting; stored-row-derived keys
+    # (chunks, wire_rows) legitimately differ from the plain file's
+    iw, ib = report_image(rep), report_image(base)
+    for k in ("chunks", "wire_rows", "wire_evals", "wire_weighted"):
+        iw["totals"].pop(k, None)
+        ib["totals"].pop(k, None)
+    assert iw == ib
+
+
+def test_weighted_wire_file_resume_and_recoalesce(corpus4, tmp_path):
+    """Crash/resume on a weighted file, and --coalesce on top composes."""
+    packed, text, _ = corpus4
+    wpw = str(tmp_path / "w.rawire")
+    wire_mod.convert_logs(
+        packed, [text], wpw, batch_size=512, block_rows=512, coalesce=True
+    )
+    ref = run_stream_wire(
+        packed,
+        wpw,
+        _cfg("off", 0).replace(
+            checkpoint_every_chunks=2, checkpoint_dir=str(tmp_path / "ref")
+        ),
+        topk=5,
+    )
+    ck = str(tmp_path / "ck")
+    cfg = _cfg("off", 2).replace(checkpoint_every_chunks=2, checkpoint_dir=ck)
+    run_stream_wire(packed, wpw, cfg, topk=5, max_chunks=2)
+    resumed = run_stream_wire(packed, wpw, cfg.replace(resume=True), topk=5)
+    assert report_image(resumed) == report_image(ref)
+    # run-time coalescing on top of an already-weighted file merges
+    # cross-batch duplicates (weights compose additively)
+    rep = run_stream_wire(packed, wpw, _cfg("on"), topk=5)
+    assert report_image(rep) == report_image(ref)
+
+
+def test_weighted_wire_file_stacked_under_prefetch(corpus4, tmp_path):
+    """Stacked layout over a weighted file, THROUGH the prefetch wrapper:
+    the weighted flag must survive the wrap (a crushed weights plane
+    here would silently divide every count by the compaction ratio)."""
+    packed, text, wirep = corpus4
+    wpw = str(tmp_path / "w.rawire")
+    wire_mod.convert_logs(
+        packed, [text], wpw, batch_size=512, block_rows=512, coalesce=True
+    )
+    kw = dict(layout="stacked", stacked_lane=8192)
+    base = run_stream_wire(packed, wirep, _cfg("off", 2, **kw), topk=5)
+    rep = run_stream_wire(packed, wpw, _cfg("off", 2, **kw), topk=5)
+    iw, ib = report_image(rep), report_image(base)
+    for k in ("chunks", "wire_rows", "wire_evals", "wire_weighted"):
+        iw["totals"].pop(k, None)
+        ib["totals"].pop(k, None)
+    assert iw == ib
+
+
+def test_weighted_and_plain_wire_files_refuse_to_mix(corpus4, tmp_path):
+    packed, text, wirep = corpus4
+    wpw = str(tmp_path / "w.rawire")
+    wire_mod.convert_logs(
+        packed, [text], wpw, batch_size=512, block_rows=512, coalesce=True
+    )
+    with pytest.raises(wire_mod.WireFormatError, match="mix weighted"):
+        wire_mod.WireReader([wirep, wpw], packed)
+
+
+def test_wire_info_reports_weighted_fields(corpus4, tmp_path, capsys):
+    from ruleset_analysis_tpu.cli import main
+
+    packed, text, _ = corpus4
+    wpw = str(tmp_path / "w.rawire")
+    stats = wire_mod.convert_logs(
+        packed, [text], wpw, batch_size=512, block_rows=512, coalesce=True
+    )
+    assert main(["wire-info", wpw, "--json"]) == 0
+    info = json.loads(capsys.readouterr().out)[0]
+    assert info["weighted"] is True
+    assert info["evals"] == stats["evals"]
+    assert info["rows"] == stats["rows"]
+    assert info["bytes_per_row"] == wire_mod.ROWW_BYTES
+
+
+# ---------------------------------------------------------------------------
+# Guard rails
+# ---------------------------------------------------------------------------
+
+
+def test_coalesce_fault_site_aborts_typed(corpus4):
+    packed, text, _ = corpus4
+    with faults.armed(faults.FaultPlan.parse("ingest.coalesce.fail@2")):
+        with pytest.raises(AnalysisError):
+            run_stream_file(packed, text, _cfg("on", 0), topk=5)
+    # sync path raises the InjectedFault subclass directly
+    with faults.armed(faults.FaultPlan.parse("ingest.coalesce.fail@1")):
+        with pytest.raises(InjectedFault):
+            run_stream_file(packed, text, _cfg("on", 0), topk=5)
+
+
+def test_distributed_rejects_runtime_coalesce(corpus4):
+    from ruleset_analysis_tpu.runtime.stream import (
+        run_stream_file_distributed,
+    )
+
+    packed, text, _ = corpus4
+    with pytest.raises(AnalysisError, match="convert --coalesce"):
+        run_stream_file_distributed(packed, text, _cfg("on"))
+
+
+def test_config_validates_coalesce():
+    with pytest.raises(ValueError, match="coalesce"):
+        AnalysisConfig(coalesce="sometimes")
+    with pytest.raises(ValueError, match="matmul"):
+        AnalysisConfig(
+            coalesce="on", counts_impl="matmul", batch_size=1 << 24
+        )
+    # the fused kernel's in-VMEM histogram is not weight-linear
+    with pytest.raises(ValueError, match="pallas_fused"):
+        AnalysisConfig(coalesce="on", match_impl="pallas_fused")
+    cfg = AnalysisConfig(coalesce="auto")
+    assert AnalysisConfig.from_dict(cfg.to_dict()) == cfg
+
+
+def test_weighted_wire_input_refuses_non_weight_linear_impls(
+    corpus4, tmp_path
+):
+    """A weighted file reaches the step with weights the config validator
+    never saw: the drivers must refuse pallas_fused (histogram adds one
+    per line) and matmul counts (f32-exact bound assumes raw rows)."""
+    packed, text, _ = corpus4
+    wpw = str(tmp_path / "w.rawire")
+    wire_mod.convert_logs(
+        packed, [text], wpw, batch_size=512, block_rows=512, coalesce=True
+    )
+    with pytest.raises(AnalysisError, match="pallas_fused"):
+        run_stream_wire(
+            packed, wpw, _cfg("off").replace(match_impl="pallas_fused"),
+            topk=5,
+        )
+    with pytest.raises(AnalysisError, match="matmul"):
+        run_stream_wire(
+            packed, wpw, _cfg("off").replace(counts_impl="matmul"), topk=5
+        )
+
+
+def test_weighted_chunk_overflow_refused():
+    """Summed weights >= 2^32 in one chunk would wrap the uint32 count
+    scatter undetected — the source refuses loudly instead."""
+    from ruleset_analysis_tpu.runtime.stream import _WireFileSource
+
+    with pytest.raises(AnalysisError, match="2\\^32"):
+        _WireFileSource._check_chunk_weight(1 << 32)
+    _WireFileSource._check_chunk_weight((1 << 32) - 1)  # boundary ok

@@ -39,6 +39,7 @@ VOLATILE = (
     "sustained_lines_per_sec",
     "ingest",
     "throughput",
+    "coalesce",  # raw/unique accounting absent from the off baseline
 )
 
 
@@ -138,6 +139,17 @@ def test_wire_prefetch_bit_identical(
     sync = run_stream_wire(packed, wp, _cfg(0, layout), topk=5)
     pre = run_stream_wire(packed, wp, _cfg(2, layout), topk=5)
     assert report_image(sync) == report_image(pre)
+
+
+@pytest.mark.parametrize("family", ["v4", "v6"])
+def test_text_prefetch_coalesced_bit_identical(corpus4, corpus6, family):
+    """Prefetch + flow coalescing still commits in source order: the
+    coalesced pack stage runs on the producer thread, and the report is
+    bit-identical to the synchronous UNcoalesced driver (ISSUE 5)."""
+    packed, path = corpus4 if family == "v4" else corpus6
+    sync = run_stream_file(packed, path, _cfg(0), topk=5)
+    co = run_stream_file(packed, path, _cfg(3, coalesce="on"), topk=5)
+    assert report_image(sync) == report_image(co)
 
 
 def test_python_parser_prefetch_bit_identical(corpus4):

@@ -120,3 +120,32 @@ def test_counts_total_is_exact_sync(setup):
             topk_k=cfg.sketch.topk_chunk_candidates,
         )
     assert pipeline.counts_total(state) == 3 * int(batch[pack.T_VALID].sum())
+
+
+def test_weighted_wire_layout_round_trip_and_step(setup):
+    """The WEIGHTED wire layout (ISSUE 5): expand round-trips weights,
+    and the device step over [WIREW_COLS, U] coalesced rows produces
+    registers bit-identical to the raw batch's."""
+    packed, cfg, batch = setup
+    # force repetition so coalescing actually merges rows
+    rep = np.ascontiguousarray(np.tile(batch[:, :256], (1, 4)))
+    cb = pack.coalesce_batch(rep)
+    ww = pack.compact_batch_w(cb)
+    assert ww.shape == (pack.WIREW_COLS, cb.shape[1])
+    np.testing.assert_array_equal(pack.expand_batch(ww), cb)
+    kw = dict(
+        n_keys=packed.n_keys,
+        topk_k=cfg.sketch.topk_chunk_candidates,
+        exact_counts=True,
+    )
+    s_raw, _ = pipeline.analysis_step(
+        pipeline.init_state(packed.n_keys, cfg), pipeline.ship_ruleset(packed),
+        pack.compact_batch(rep), **kw
+    )
+    s_w, _ = pipeline.analysis_step(
+        pipeline.init_state(packed.n_keys, cfg), pipeline.ship_ruleset(packed),
+        ww, **kw
+    )
+    for a, b in zip(s_raw, s_w):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert pipeline.counts_total(s_w) == int(rep[pack.T_VALID].sum())

@@ -90,6 +90,22 @@ def summarize(path: str, top: int = 5) -> dict:
         (e for e in spans if e["name"] in STALL_NAMES),
         key=lambda e: -e.get("dur", 0),
     )[:top]
+    # flow-coalescing attribution: each ingest.coalesce span carries the
+    # batch's raw/unique row counts in its args, so the trace alone
+    # answers "what compaction ratio did this run actually see"
+    coalesce = None
+    raw = unique = 0
+    for e in spans:
+        if e["name"] == "ingest.coalesce":
+            a = e.get("args") or {}
+            raw += int(a.get("raw", 0))
+            unique += int(a.get("unique", 0))
+    if raw or unique:
+        coalesce = {
+            "raw_rows": raw,
+            "unique_rows": unique,
+            "compaction_ratio": round(raw / max(unique, 1), 4),
+        }
     return {
         "path": path,
         "events": len(events),
@@ -107,6 +123,7 @@ def summarize(path: str, top: int = 5) -> dict:
             for e in stalls
         ],
         "instants": dict(instants),
+        **({"coalesce": coalesce} if coalesce else {}),
     }
 
 
@@ -129,6 +146,12 @@ def render(s: dict) -> str:
                 f"    +{st['at_sec']:9.3f}s  {st['dur_ms']:9.3f} ms  "
                 f"[pid {st['pid']}] {st['kind']}"
             )
+    if s.get("coalesce"):
+        c = s["coalesce"]
+        out.append(
+            f"  coalesce: {c['raw_rows']} raw -> {c['unique_rows']} unique "
+            f"rows ({c['compaction_ratio']:.2f}x compaction)"
+        )
     if s["instants"]:
         marks = ", ".join(f"{k} x{v}" for k, v in sorted(s["instants"].items()))
         out.append(f"  instants: {marks}")
