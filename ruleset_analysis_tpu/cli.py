@@ -436,6 +436,87 @@ def _cmd_run(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_serve(args: argparse.Namespace) -> int:
+    """Always-on service mode: live listeners -> windowed reports.
+
+    Runs until --max-windows/--stop-after (or SIGINT); the window ring,
+    report publication, reload semantics, and endpoint paths live in
+    runtime/serve.py (DESIGN §12).
+    """
+    from .config import ServeConfig
+
+    try:
+        cfg = AnalysisConfig(
+            backend="tpu",
+            batch_size=args.batch_size,
+            sketch=SketchConfig(
+                cms_width=args.cms_width,
+                cms_depth=args.cms_depth,
+                hll_p=args.hll_p,
+            ),
+            register_memory_budget_bytes=args.register_budget_mb << 20,
+            resume=args.resume,
+            stall_timeout_sec=args.stall_timeout,
+            fault_plan=_resolve_fault_plan(args.fault_plan),
+        )
+        mode, length = report_mod.parse_window_spec(args.window)
+        scfg = ServeConfig(
+            listen=tuple(args.listen),
+            window_lines=int(length) if mode == "lines" else 0,
+            window_sec=length if mode == "sec" else 0.0,
+            ring=args.ring,
+            views=tuple(args.view),
+            queue_lines=args.queue_lines,
+            http=args.http,
+            serve_dir=args.serve_dir,
+            checkpoint_every_windows=args.checkpoint_every_windows,
+            checkpoint_dir=args.checkpoint_dir or "",
+            reload_watch=args.reload_watch,
+            reload_poll_sec=args.reload_poll,
+            max_windows=args.max_windows,
+            stop_after_sec=args.stop_after,
+        )
+    except (ValueError, errors.AnalysisError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        from .runtime.serve import ServeDriver  # deferred: imports JAX
+    except ImportError as e:
+        print(f"error: tpu backend unavailable ({e})", file=sys.stderr)
+        return 1
+    if args.trace_out or args.metrics_out:
+        from .runtime import obs
+
+        try:
+            if args.trace_out:
+                obs.start_trace(args.trace_out, role="serve")
+            if args.metrics_out:
+                obs.start_metrics(args.metrics_out, args.metrics_every)
+        except OSError as e:
+            print(
+                f"error: cannot open --trace-out/--metrics-out target: {e}",
+                file=sys.stderr,
+            )
+            return 2
+    try:
+        # construction binds the listener sockets: a privileged port or
+        # an address in use must be the documented clean error, not a
+        # traceback
+        driver = ServeDriver(args.ruleset, cfg, scfg, topk=args.topk)
+    except OSError as e:
+        print(f"error: cannot bind --listen/--http: {e}", file=sys.stderr)
+        return 2
+    try:
+        summary = driver.run()
+    except OSError as e:
+        print(f"error: serve I/O failure: {e}", file=sys.stderr)
+        return 1
+    import json as json_mod
+
+    print(json_mod.dumps(summary, indent=2))
+    return 0
+
+
 def _cmd_convert(args: argparse.Namespace) -> int:
     """Text syslog -> pre-tokenized .rawire wire file (SURVEY.md §8.2).
 
@@ -566,68 +647,47 @@ def _cmd_diff_reports(args: argparse.Namespace) -> int:
 
     def load(path):
         with open(path, "r", encoding="utf-8") as f:
-            rep = json_mod.load(f)
-        hits = {
-            tuple((e["firewall"], e["acl"], e["index"])): e["hits"]
-            for e in rep.get("per_rule", [])
-        }
-        unused = {tuple(k) for k in rep.get("unused", [])}
-        return hits, unused
+            return json_mod.load(f)
 
     try:
-        hits_a, unused_a = load(args.old)
-        hits_b, unused_b = load(args.new)
+        rep_a, rep_b = load(args.old), load(args.new)
+        if args.expect_window:
+            # typed refusal: a 24h window diffed against a 7d window is a
+            # misleading answer, not a smaller one (main() maps the code)
+            report_mod.check_window_compat(rep_a, rep_b, args.expect_window)
+        out = report_mod.diff_report_objs(rep_a, rep_b, top=args.top)
+    except errors.AnalysisError:
+        raise
     except (OSError, ValueError, KeyError, TypeError) as e:
         print(f"error: unreadable report: {e}", file=sys.stderr)
         return 2
 
-    key_str = lambda k: f"{k[0]} {k[1]} {k[2]}"  # noqa: E731
-    # Compare only rules PRESENT in both reports: a rule deleted between
-    # runs must not masquerade as "newly used", nor a rule added between
-    # runs as "newly unused" — ruleset churn is reported separately.
-    common = set(hits_a) & set(hits_b)
-    rules_removed = sorted(set(hits_a) - common)
-    rules_added = sorted(set(hits_b) - common)
-    stable_unused = sorted(unused_a & unused_b & common)
-    newly_unused = sorted((unused_b - unused_a) & common)
-    newly_used = sorted((unused_a - unused_b) & common)
-    movers = sorted(
-        ((abs(hits_b[k] - hits_a[k]), k) for k in common),
-        reverse=True,
-    )[: args.top]
-    out = {
-        "stable_unused": [key_str(k) for k in stable_unused],
-        "newly_unused": [key_str(k) for k in newly_unused],
-        "newly_used": [key_str(k) for k in newly_used],
-        "rules_added": [key_str(k) for k in rules_added],
-        "rules_removed": [key_str(k) for k in rules_removed],
-        "top_hit_movers": [
-            {"rule": key_str(k), "old": hits_a[k], "new": hits_b[k]}
-            for d, k in movers
-            if d > 0
-        ],
-    }
     if args.json:
         print(json_mod.dumps(out, indent=2))
         return 0
-    print(f"# stable unused (deletion candidates): {len(stable_unused)}")
-    for k in stable_unused:
-        print(f"  {key_str(k)}")
-    print(f"# newly unused (quiet this run): {len(newly_unused)}")
-    for k in newly_unused:
-        print(f"  {key_str(k)}")
-    print(f"# newly used (were unused before): {len(newly_used)}")
-    for k in newly_used:
-        print(f"  {key_str(k)}")
-    if rules_added or rules_removed:
+    print(f"# stable unused (deletion candidates): {len(out['stable_unused'])}")
+    for k in out["stable_unused"]:
+        print(f"  {k}")
+    print(f"# newly unused (quiet this run): {len(out['newly_unused'])}")
+    for k in out["newly_unused"]:
+        print(f"  {k}")
+    print(f"# newly used (were unused before): {len(out['newly_used'])}")
+    for k in out["newly_used"]:
+        print(f"  {k}")
+    if out["rules_added"] or out["rules_removed"]:
         print(
-            f"# ruleset churn: {len(rules_added)} added, "
-            f"{len(rules_removed)} removed between reports"
+            f"# ruleset churn: {len(out['rules_added'])} added, "
+            f"{len(out['rules_removed'])} removed between reports"
         )
     if out["top_hit_movers"]:
         print("# top hit movers:")
         for m in out["top_hit_movers"]:
             print(f"  {m['rule']}: {m['old']} -> {m['new']}")
+    if out.get("window_incomplete"):
+        print(
+            f"# WARNING: incomplete window(s): {', '.join(out['window_incomplete'])}"
+            " — churn there may be drop artifacts, not traffic"
+        )
     return 0
 
 
@@ -831,6 +891,80 @@ def make_parser() -> argparse.ArgumentParser:
     p.set_defaults(fn=_cmd_run)
 
     p = sub.add_parser(
+        "serve",
+        help="always-on service mode: live syslog listeners feed "
+             "time-windowed registers; windowed/cumulative reports "
+             "publish on every rotation to --serve-dir and a loopback "
+             "JSON endpoint; SIGHUP (or a watched ruleset-file change) "
+             "hot-reloads the rule tensor with counter migration",
+    )
+    p.add_argument("--ruleset", required=True, help="packed ruleset path prefix "
+                   "(re-read on reload)")
+    p.add_argument("--listen", action="append", default=[], metavar="SPEC",
+                   help="ingress (repeatable): udp:HOST:PORT, "
+                        "tcp:HOST:PORT (newline-framed), or tail:PATH "
+                        "(rotating-file tailer)")
+    p.add_argument("--window", required=True, metavar="W",
+                   help="rotation cadence: a duration (900s, 15m, 24h) or "
+                        "lines:N (deterministic line-count windows)")
+    p.add_argument("--ring", type=int, default=8, metavar="N",
+                   help="window epochs retained for merged views (default 8)")
+    p.add_argument("--view", action="append", type=int, default=[],
+                   metavar="K",
+                   help="also publish a merged view of the last K windows "
+                        "at every rotation (repeatable; e.g. --view 24 "
+                        "--view 168 for 24h/7d at a 1h window)")
+    p.add_argument("--serve-dir", required=True,
+                   help="reports/endpoint/checkpoint directory")
+    p.add_argument("--http", default="127.0.0.1:0", metavar="HOST:PORT",
+                   help="JSON endpoint bind (port 0 = ephemeral, recorded "
+                        "in serve-dir/endpoint.json; 'off' disables). "
+                        "Paths: /report /report/cumulative "
+                        "/report/window/<id> /report/merged/<k> /diff "
+                        "/health /metrics")
+    p.add_argument("--queue-lines", type=int, default=1 << 16, metavar="N",
+                   help="listener queue capacity; lines past it DROP with "
+                        "an explicit count and the window is published "
+                        "with a WindowIncomplete marker (default 65536)")
+    p.add_argument("--checkpoint-every-windows", type=int, default=1,
+                   metavar="N",
+                   help="checkpoint the window ring every N rotations "
+                        "(0 = never; a restarted serve --resume keeps its "
+                        "history)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="default: SERVE_DIR/ckpt")
+    p.add_argument("--resume", action="store_true",
+                   help="restore the window ring from --checkpoint-dir")
+    p.add_argument("--reload-watch", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="poll the ruleset files and hot-reload on change "
+                        "(SIGHUP reloads regardless)")
+    p.add_argument("--reload-poll", type=float, default=2.0, metavar="SEC")
+    p.add_argument("--max-windows", type=int, default=0, metavar="N",
+                   help="stop after N rotations (0 = run forever)")
+    p.add_argument("--stop-after", type=float, default=0.0, metavar="SEC",
+                   help="soft wall-clock deadline (0 = none)")
+    p.add_argument("--batch-size", type=int, default=1 << 16)
+    p.add_argument("--cms-width", type=int, default=1 << 14)
+    p.add_argument("--cms-depth", type=int, default=4)
+    p.add_argument("--hll-p", type=int, default=8)
+    p.add_argument("--register-budget-mb", type=int, default=4096, metavar="MB")
+    p.add_argument("--topk", type=int, default=10)
+    p.add_argument("--stall-timeout", type=float,
+                   default=AnalysisConfig.stall_timeout_sec, metavar="SEC")
+    p.add_argument("--fault-plan", default=None, metavar="SPEC",
+                   help="chaos drills: see `run --fault-plan` (adds the "
+                        "listener.drop/listener.stall/reload.midbatch sites)")
+    p.add_argument("--trace-out", default=None, metavar="DIR",
+                   help="record listener/rotation/reload spans (see "
+                        "`run --trace-out`)")
+    p.add_argument("--metrics-out", default=None, metavar="FILE",
+                   help="append queue/drop gauges + window events as JSON "
+                        "lines")
+    p.add_argument("--metrics-every", type=float, default=10.0, metavar="SEC")
+    p.set_defaults(fn=_cmd_serve)
+
+    p = sub.add_parser(
         "convert",
         help="pre-tokenize text syslog into a .rawire wire file "
              "(16 B/line; `run` feeds it to the device with no host parse)",
@@ -873,6 +1007,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("old", help="earlier report (run --json output)")
     p.add_argument("new", help="later report")
     p.add_argument("--top", type=int, default=10, help="hit movers to show")
+    p.add_argument("--expect-window", default=None, metavar="W",
+                   help="require BOTH reports to be serve-mode window "
+                        "reports of exactly this window (lines:N or a "
+                        "duration like 24h); a mismatch is a typed "
+                        "refusal, not a misleading diff")
     p.add_argument("--json", action="store_true")
     p.set_defaults(fn=_cmd_diff_reports)
 

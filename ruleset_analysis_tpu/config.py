@@ -98,6 +98,73 @@ class SketchConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Configuration of the always-on ``serve`` mode (runtime/serve.py).
+
+    Exactly one of ``window_lines`` / ``window_sec`` must be positive:
+    line-count windows are deterministic and replayable (tests, soak
+    benches — the same traffic always cuts at the same boundary),
+    wall-clock windows are the production cadence ("unused in the last
+    24h" = merge the last ``86400/window_sec`` ring epochs).
+    """
+
+    #: listener specs: ``udp:HOST:PORT``, ``tcp:HOST:PORT``, ``tail:PATH``
+    listen: tuple[str, ...] = ()
+    window_lines: int = 0  # rotate after N received lines (deterministic)
+    window_sec: float = 0.0  # rotate on a wall-clock cadence (production)
+    ring: int = 8  # window epochs retained for merged views
+    #: merged views (in windows) re-published at every rotation, e.g.
+    #: (24, 168) for 24h/7d at a 1h window
+    views: tuple[int, ...] = ()
+    queue_lines: int = 1 << 16  # listener queue capacity (drops counted past it)
+    http: str = "127.0.0.1:0"  # JSON endpoint bind; "off" disables
+    serve_dir: str = os.path.join(OUTPUT_DIR, "serve")
+    #: ring checkpoint cadence in windows (0 = never); dir defaults to
+    #: ``serve_dir/ckpt`` when empty
+    checkpoint_every_windows: int = 1
+    checkpoint_dir: str = ""
+    reload_watch: bool = True  # poll the ruleset files; SIGHUP always works
+    reload_poll_sec: float = 2.0
+    max_windows: int = 0  # stop after N rotations (0 = run forever)
+    stop_after_sec: float = 0.0  # soft wall deadline (0 = none); bounds tests
+
+    def __post_init__(self) -> None:
+        if (self.window_lines > 0) == (self.window_sec > 0):
+            raise ValueError(
+                "exactly one of window_lines/window_sec must be positive "
+                f"(got lines={self.window_lines}, sec={self.window_sec})"
+            )
+        if self.window_lines < 0 or self.window_sec < 0:
+            raise ValueError("window length must be positive")
+        if self.ring < 1:
+            raise ValueError(f"ring must be >= 1, got {self.ring}")
+        if self.queue_lines < 1:
+            raise ValueError(f"queue_lines must be >= 1, got {self.queue_lines}")
+        if any(v < 1 for v in self.views):
+            raise ValueError("views must be >= 1 window each")
+        if any(v > self.ring for v in self.views):
+            # a merged-24 view over an 8-epoch ring would claim 24
+            # windows of evidence while holding 8 — refuse, don't shrink
+            raise ValueError(
+                f"views {tuple(v for v in self.views if v > self.ring)} "
+                f"exceed the ring ({self.ring} windows retained); raise "
+                "--ring or lower --view"
+            )
+        if self.checkpoint_every_windows < 0:
+            raise ValueError("checkpoint_every_windows must be >= 0")
+        if self.reload_poll_sec <= 0:
+            raise ValueError("reload_poll_sec must be > 0")
+        if self.max_windows < 0 or self.stop_after_sec < 0:
+            raise ValueError("max_windows/stop_after_sec must be >= 0")
+        if self.http != "off":
+            host, _, port = self.http.rpartition(":")
+            if not host or not port.isdigit():
+                raise ValueError(
+                    f"http must be HOST:PORT or 'off', got {self.http!r}"
+                )
+
+
+@dataclasses.dataclass(frozen=True)
 class AnalysisConfig:
     """Everything the runtime needs to run one analysis job."""
 

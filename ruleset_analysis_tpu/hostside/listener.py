@@ -1,0 +1,512 @@
+"""Live syslog listener tier for the always-on ``serve`` mode.
+
+The batch tiers read finished files; a *service* has to take the traffic
+as the network delivers it.  This module is the ingress edge of
+``runtime/serve.py``: socket listeners (UDP datagrams and newline-framed
+TCP — the two shapes real syslog relays speak) plus a rotating-file
+tailer, all pushing decoded lines into one bounded :class:`LineQueue`.
+
+Drop accounting is the load-bearing invariant (ROADMAP item 1): the
+queue is bounded so a slow consumer exerts backpressure on *us*, never
+unbounded memory — but a line that cannot be queued is **counted**, per
+ingress, and the serve loop stamps every analysis window that overlaps a
+drop (or a dead listener) with an explicit ``WindowIncomplete`` marker.
+A dropped-line window is therefore never silently reported as zero-hit;
+the report says "this window is missing N lines" instead (DESIGN §12).
+
+Fault sites (runtime/faults.py): ``listener.drop`` forces one received
+line to drop (exercising exactly that accounting), ``listener.stall``
+wedges a listener thread mid-receive — the serve loop's liveness checks
+and ``--stop-after`` bound must turn either into an explicit marker or a
+typed abort, never a hang or a silent zero-hit window (tests/test_chaos).
+
+Threads carry the ``ra-`` name prefix so the test harness's leak audit
+covers them like every other pipeline thread.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from collections import deque
+
+from ..errors import AnalysisError
+from ..runtime import faults, obs
+
+
+class LineQueue:
+    """Bounded line queue with explicit, per-cause drop accounting.
+
+    ``put`` never blocks the ingress thread: when the queue is full the
+    line is dropped and counted (``dropped``).  Silently blocking a UDP
+    receiver would just move the loss into the kernel socket buffer where
+    nobody can count it — an explicit host-side counter is the only place
+    the "never silently zero-hit" invariant can be enforced from.
+    """
+
+    def __init__(self, capacity: int):
+        if capacity < 1:
+            raise AnalysisError(f"listener queue capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._q: deque[str] = deque()
+        self._lock = threading.Lock()
+        self._ready = threading.Condition(self._lock)
+        self.received = 0  # lines handed to put() (drops included)
+        self.dropped = 0  # lines put() could not queue
+        self.forced_drops = 0  # listener.drop fault firings (subset of dropped)
+
+    def put(self, line: str) -> bool:
+        with self._lock:
+            self.received += 1
+            if len(self._q) >= self.capacity:
+                self.dropped += 1
+                return False
+            self._q.append(line)
+            self._ready.notify()
+            return True
+
+    def note_forced_drop(self) -> None:
+        """Account a line the ``listener.drop`` fault site discarded."""
+        with self._lock:
+            self.received += 1
+            self.dropped += 1
+            self.forced_drops += 1
+
+    def note_discarded(self, n: int = 1) -> None:
+        """Account ``n`` lines discarded before they could be queued
+        (oversized unterminated frames)."""
+        with self._lock:
+            self.received += n
+            self.dropped += n
+
+    def discard_remaining(self) -> int:
+        """Drop-and-count every queued line (bounded shutdown).
+
+        A stop request must not analyze an unbounded backlog, but it
+        must never pretend the backlog did not exist: the lines count as
+        explicit drops so the final window carries the incomplete marker
+        and ``summary.drops`` reports the loss.
+        """
+        with self._lock:
+            n = len(self._q)
+            self._q.clear()
+            self.dropped += n
+            return n
+
+    def pop(self, timeout: float = 0.2) -> str | None:
+        with self._ready:
+            if not self._q:
+                self._ready.wait(timeout)
+            if not self._q:
+                return None
+            return self._q.popleft()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self.capacity,
+                "depth": len(self._q),
+                "received": self.received,
+                "dropped": self.dropped,
+                "forced_drops": self.forced_drops,
+            }
+
+
+# longest unterminated line a stream listener will buffer before
+# discarding it as a counted drop: the bounded LineQueue is the module's
+# memory guarantee, and a peer that never sends a newline must not be
+# able to grow a side buffer past it (real syslog lines are < 8 KiB)
+MAX_LINE_BYTES = 1 << 20
+
+
+class BaseListener(threading.Thread):
+    """One ingress thread feeding the shared queue.
+
+    Lifecycle: ``start()`` -> receive loop -> ``close()`` (idempotent).
+    A listener that dies on an unexpected error records it in ``.error``
+    and sets ``.dead`` — the serve loop reads both and decides between
+    "mark windows incomplete" and a typed abort.  An injected
+    ``listener.stall`` parks the thread until shutdown (or the fault
+    plan's disarm) releases it, then terminates it loudly — exactly a
+    wedged receiver whose traffic is silently lost upstream.
+    """
+
+    kind = "base"
+
+    def __init__(self, q: LineQueue, label: str):
+        super().__init__(name=f"ra-listener-{label}", daemon=True)
+        self.q = q
+        self.label = label
+        self.stop_event = threading.Event()
+        self.dead = False
+        self.error: BaseException | None = None
+        #: liveness heartbeat: every receive-loop iteration (idle ones
+        #: included) refreshes it, so a thread parked mid-push (injected
+        #: listener.stall, frozen socket) is DETECTABLE — the serve loop
+        #: compares beat age against the stall timeout instead of
+        #: trusting is_alive(), which a wedged thread still satisfies
+        self.beat = time.monotonic()
+
+    # -- subclass surface ------------------------------------------------
+    def _serve(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _teardown(self) -> None:
+        pass
+
+    # -- shared line path ------------------------------------------------
+    def _push(self, line: str) -> None:
+        """Fault-instrumented push: the ONLY way lines enter the queue."""
+        faults.fire("listener.stall", stop=self.stop_event)
+        line = faults.fire(
+            "listener.drop", payload=line, corrupt=lambda _p, _rng: None
+        )
+        if line is None:
+            # the site ate the line: account it as an explicit drop so the
+            # window it belonged to reports incomplete, never zero-hit
+            self.q.note_forced_drop()
+            obs.instant("listener.drop", args={"listener": self.label})
+            return
+        if not self.q.put(line):
+            obs.instant("listener.drop", args={"listener": self.label})
+
+    def run(self) -> None:
+        try:
+            self._serve()
+        except BaseException as e:  # recorded, surfaced by the serve loop
+            if not self.stop_event.is_set():
+                self.error = e
+        finally:
+            self.dead = True
+            self._teardown()
+
+    def close(self) -> None:
+        self.stop_event.set()
+        self._teardown()
+        if self.ident is not None:  # join() on a never-started thread raises
+            self.join(timeout=10.0)
+
+
+class UdpSyslogListener(BaseListener):
+    """RFC3164-style UDP syslog: one datagram = one log line."""
+
+    kind = "udp"
+
+    def __init__(self, q: LineQueue, host: str, port: int):
+        super().__init__(q, f"udp-{host}-{port}")
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.settimeout(0.2)
+        self.address = self._sock.getsockname()
+
+    def _serve(self) -> None:
+        while not self.stop_event.is_set():
+            self.beat = time.monotonic()
+            try:
+                data, _addr = self._sock.recvfrom(1 << 16)
+            except socket.timeout:
+                continue
+            except OSError:
+                if self.stop_event.is_set():
+                    return
+                raise
+            # one datagram, one message (trailing newline tolerated)
+            self._push(data.decode("utf-8", errors="replace").rstrip("\r\n"))
+
+    def _teardown(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class TcpSyslogListener(BaseListener):
+    """Newline-framed TCP syslog (the reliable-transport relay shape).
+
+    Single accept loop with short socket timeouts — syslog relays hold
+    few long-lived connections, so a select fleet would be overkill; a
+    dead peer is detected at the next read.
+    """
+
+    kind = "tcp"
+
+    def __init__(self, q: LineQueue, host: str, port: int):
+        super().__init__(q, f"tcp-{host}-{port}")
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(8)
+        self._sock.settimeout(0.2)
+        self.address = self._sock.getsockname()
+        self._conns: list[socket.socket] = []
+
+    def _serve(self) -> None:
+        import selectors
+
+        sel = selectors.DefaultSelector()
+        sel.register(self._sock, selectors.EVENT_READ, ("accept", None))
+        bufs: dict[socket.socket, bytes] = {}
+        skipping: set[socket.socket] = set()
+        try:
+            while not self.stop_event.is_set():
+                self.beat = time.monotonic()
+                for key, _ev in sel.select(timeout=0.2):
+                    tag, _ = key.data
+                    if tag == "accept":
+                        try:
+                            conn, _addr = self._sock.accept()
+                        except OSError:
+                            continue
+                        conn.setblocking(False)
+                        self._conns.append(conn)
+                        bufs[conn] = b""
+                        sel.register(conn, selectors.EVENT_READ, ("conn", None))
+                        continue
+                    conn = key.fileobj
+                    try:
+                        data = conn.recv(1 << 16)
+                    except (BlockingIOError, InterruptedError):
+                        continue
+                    except OSError:
+                        data = b""
+                    if not data:
+                        sel.unregister(conn)
+                        skipping.discard(conn)
+                        tail = bufs.pop(conn, b"")
+                        if tail:  # unterminated final line still counts
+                            self._push(tail.decode("utf-8", errors="replace"))
+                        try:
+                            conn.close()
+                        except OSError:
+                            pass
+                        if conn in self._conns:
+                            self._conns.remove(conn)
+                        continue
+                    if conn in skipping:
+                        # inside an oversized (already-dropped) line:
+                        # discard until its terminating newline arrives
+                        if b"\n" not in data:
+                            continue
+                        _, data = data.split(b"\n", 1)
+                        skipping.discard(conn)
+                    buf = bufs[conn] + data
+                    *lines, rest = buf.split(b"\n")
+                    if len(rest) > MAX_LINE_BYTES:
+                        self.q.note_discarded()
+                        obs.instant(
+                            "listener.drop",
+                            args={"listener": self.label, "cause": "oversize"},
+                        )
+                        rest = b""
+                        skipping.add(conn)
+                    bufs[conn] = rest
+                    for raw in lines:
+                        self._push(
+                            raw.decode("utf-8", errors="replace").rstrip("\r")
+                        )
+        finally:
+            sel.close()
+
+    def _teardown(self) -> None:
+        # snapshot: close() runs this on the caller's thread while the
+        # receive loop may still be appending/removing connections
+        for conn in list(self._conns):
+            try:
+                conn.close()
+            except OSError:
+                pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class FileTailer(BaseListener):
+    """Rotating-file tailer: ``tail -F`` semantics for relay spool files.
+
+    Follows ``path`` from its current end (or the start, for a file that
+    appears later), detects rotation by inode change or truncation, and
+    re-opens the new file from offset 0 so no post-rotation line is
+    missed.  Partial trailing lines wait for their newline.
+    """
+
+    kind = "tail"
+
+    def __init__(
+        self, q: LineQueue, path: str, poll_sec: float = 0.1,
+        from_start: bool = False,
+    ):
+        super().__init__(q, f"tail-{os.path.basename(path)}")
+        self.path = path
+        self.poll_sec = poll_sec
+        self._from_start = from_start
+
+    @staticmethod
+    def _ino(f) -> int:
+        try:
+            return os.fstat(f.fileno()).st_ino
+        except OSError:
+            return -1
+
+    def _open(self):
+        return open(self.path, "r", encoding="utf-8", errors="replace")
+
+    def _serve(self) -> None:
+        f = None
+        buf = ""
+        skipping = False  # inside an oversized, already-dropped line
+        while not self.stop_event.is_set():
+            self.beat = time.monotonic()
+            if f is None:
+                try:
+                    f = self._open()
+                except OSError:
+                    # a file that appears later is NEW traffic: read it
+                    # fully (only an already-present spool skips its past)
+                    self._from_start = True
+                    self.stop_event.wait(self.poll_sec)
+                    continue
+                if not self._from_start:
+                    f.seek(0, os.SEEK_END)
+                self._from_start = True  # rotated successors read fully
+            chunk = f.read(1 << 16)
+            if chunk:
+                if skipping:
+                    if "\n" not in chunk:
+                        continue
+                    chunk = chunk.split("\n", 1)[1]
+                    skipping = False
+                buf += chunk
+                *lines, buf = buf.split("\n")
+                for line in lines:
+                    self._push(line.rstrip("\r"))
+                if len(buf) > MAX_LINE_BYTES:
+                    self.q.note_discarded()
+                    obs.instant(
+                        "listener.drop",
+                        args={"listener": self.label, "cause": "oversize"},
+                    )
+                    buf = ""
+                    skipping = True
+                continue
+            # no new data: rotation (new inode) or truncation (shrunk)?
+            try:
+                st = os.stat(self.path)
+                rotated = st.st_ino != self._ino(f) or st.st_size < f.tell()
+            except OSError:
+                rotated = True  # the old file was removed; wait for a new one
+            if rotated:
+                if buf:  # final unterminated line of the rotated-out file
+                    self._push(buf)
+                    buf = ""
+                f.close()
+                f = None
+                continue
+            self.stop_event.wait(self.poll_sec)
+        if f is not None:
+            f.close()
+
+
+def parse_listen_spec(spec: str) -> tuple[str, str, int | str]:
+    """``udp:HOST:PORT`` / ``tcp:HOST:PORT`` / ``tail:PATH`` -> parts.
+
+    Typed errors (AnalysisError) so the CLI reports a bad ``--listen``
+    as usage, not a traceback.
+    """
+    kind, _, rest = spec.partition(":")
+    if kind in ("tail", "tail0"):
+        # tail = `tail -F` (skip a pre-existing file's past); tail0 =
+        # read a pre-existing file from offset 0, then follow — replays
+        # an already-written spool without racing the listener start
+        if not rest:
+            raise AnalysisError(f"bad --listen {spec!r}: {kind} needs a path")
+        return (kind, "", rest)
+    if kind in ("udp", "tcp"):
+        host, _, port = rest.rpartition(":")
+        if not host or not port:
+            raise AnalysisError(
+                f"bad --listen {spec!r}: want {kind}:HOST:PORT"
+            )
+        try:
+            return (kind, host, int(port))
+        except ValueError as e:
+            raise AnalysisError(f"bad --listen port in {spec!r}") from e
+    raise AnalysisError(
+        f"bad --listen {spec!r}: kind must be udp, tcp, tail, or tail0"
+    )
+
+
+def make_listener(q: LineQueue, spec: str) -> BaseListener:
+    kind, host, arg = parse_listen_spec(spec)
+    if kind == "udp":
+        return UdpSyslogListener(q, host, arg)
+    if kind == "tcp":
+        return TcpSyslogListener(q, host, arg)
+    return FileTailer(q, str(arg), from_start=(kind == "tail0"))
+
+
+class ListenerSet:
+    """The ingress fleet: one queue, N listeners, liveness + gauges."""
+
+    def __init__(self, q: LineQueue, specs: list[str]):
+        self.q = q
+        self.listeners: list[BaseListener] = []
+        try:
+            for s in specs:
+                self.listeners.append(make_listener(q, s))
+        except BaseException:
+            # a failing Nth spec must not orphan the N-1 already-bound
+            # sockets (the threads never start, so nothing else closes
+            # them); close() on an unstarted listener is safe
+            self.close()
+            raise
+
+    def start(self) -> None:
+        for ln in self.listeners:
+            ln.start()
+
+    def close(self) -> None:
+        for ln in self.listeners:
+            ln.close()
+
+    def alive(self) -> int:
+        return sum(1 for ln in self.listeners if ln.is_alive() and not ln.dead)
+
+    def stalled(self, age_sec: float) -> list[BaseListener]:
+        """Live listeners whose heartbeat is older than ``age_sec``.
+
+        A wedged receiver is worse than a dead one: it still looks alive
+        while its traffic silently backs up and drops upstream.  The
+        serve loop stamps overlapping windows incomplete and, when EVERY
+        live listener is wedged with nothing queued, aborts typed
+        (StallError) instead of idling forever.
+        """
+        now = time.monotonic()
+        return [
+            ln for ln in self.listeners
+            if ln.is_alive() and not ln.dead and now - ln.beat > age_sec
+        ]
+
+    def first_error(self) -> BaseException | None:
+        for ln in self.listeners:
+            if ln.error is not None:
+                return ln.error
+        return None
+
+    def addresses(self) -> dict[str, list[int | str]]:
+        out: dict[str, list] = {}
+        for ln in self.listeners:
+            addr = getattr(ln, "address", None)
+            out[ln.label] = list(addr) if addr else [getattr(ln, "path", "")]
+        return out
+
+    def sample_metrics(self) -> dict:
+        """Queue/drop gauges for the metrics snapshotter (obs sampler)."""
+        return {**self.q.snapshot(), "alive": self.alive(), "n": len(self.listeners)}

@@ -105,6 +105,17 @@ SITES: dict[str, tuple[str, str]] = {
         "corrupt", "a wire-format block arrives bit-flipped from storage"),
     "stream.device_put.fail": (
         "raise", "host->device transfer fails (XLA runtime error analog)"),
+    "listener.drop": (
+        "corrupt", "the serve listener tier loses one received line "
+        "(kernel buffer overrun analog); MUST surface as an explicit "
+        "drop count + WindowIncomplete marker, never a silent zero-hit "
+        "window"),
+    "listener.stall": (
+        "stall", "a serve listener thread wedges mid-receive and stops "
+        "delivering lines (frozen relay/socket analog)"),
+    "reload.midbatch": (
+        "raise", "a live ruleset reload fails mid-swap; the old rule "
+        "tensor and counters must stay intact (atomic reload)"),
 }
 
 

@@ -15,6 +15,7 @@ import dataclasses
 import json
 from typing import Any
 
+from ..errors import AnalysisError
 from ..hostside.oracle import RuleKey
 from ..hostside.pack import PackedRuleset
 
@@ -56,14 +57,36 @@ class Report:
         by_acl: dict[tuple[str, str], list[dict]] = {}
         for e in self.per_rule:
             by_acl.setdefault((e["firewall"], e["acl"]), []).append(e)
+        # HLL error band (VERDICT Weak #6): every "unique sources" figure
+        # is a sketch estimate; print its p90 band right next to it so a
+        # deletion decision is never made on an uncaveated approximation
+        hll = t.get("hll") or {}
+        band = hll.get("rel_err_p90")
+        band_txt = f" (±{100.0 * band:.1f}% p90)" if band else ""
         for (fw, acl), entries in by_acl.items():
             out.append(f"\n== {fw} / {acl} ==")
             for e in entries:
                 tag = "implicit-deny" if e["index"] == 0 else f"rule {e['index']}"
                 extra = ""
                 if "unique_sources" in e:
-                    extra = f"  uniq_src~{e['unique_sources']}"
+                    extra = f"  uniq_src~{e['unique_sources']}{band_txt}"
                 out.append(f"  {tag:>14}: {e['hits']:>12}{extra}  | {e['text']}")
+        if hll.get("hint"):
+            out.append(f"\n# hint: {hll['hint']}")
+        win = t.get("window") or {}
+        if win.get("incomplete"):
+            inc = win["incomplete"]
+            out.append(
+                f"\n# WINDOW INCOMPLETE: {inc.get('drops', 0)} line(s) "
+                f"dropped ({', '.join(inc.get('reasons', []))}) — zero-hit "
+                "rules in this window are NOT deletion evidence"
+            )
+        if t.get("quarantine"):
+            q = t["quarantine"]
+            out.append(
+                f"\n# quarantined (rules removed by a live reload, counters "
+                f"preserved): {q['hits']} hits across {len(q['rules'])} rule(s)"
+            )
         out.append(f"\n# unused rules: {len(self.unused)}")
         for fw, acl, idx in self.unused:
             out.append(f"  UNUSED {fw} {acl} rule {idx}")
@@ -117,3 +140,125 @@ def build_report(
         # wasn't fully parsed (those rules were never analyzable)
         t["config_entries_skipped"] = len(packed.parse_skips)
     return Report(per_rule=per_rule, unused=unused, totals=t, talkers=talk)
+
+
+# ---------------------------------------------------------------------------
+# Report diffing — the operator's delete-decision view, shared by the
+# ``diff-reports`` CLI and the serve mode's window-over-window publication.
+# ---------------------------------------------------------------------------
+
+
+def diff_report_objs(old: dict, new: dict, top: int = 10) -> dict:
+    """Diff two report JSON objects (``run --json`` / serve window shape).
+
+    Rules unused in BOTH reports are the stable deletion candidates;
+    newly-unused / newly-used rules are the churn to investigate.  Only
+    rules present in both reports compare — ruleset churn is reported
+    separately so a deleted rule never masquerades as "newly used".
+    """
+
+    def load(rep: dict):
+        hits = {
+            (e["firewall"], e["acl"], e["index"]): e["hits"]
+            for e in rep.get("per_rule", [])
+        }
+        unused = {tuple(k) for k in rep.get("unused", [])}
+        return hits, unused
+
+    hits_a, unused_a = load(old)
+    hits_b, unused_b = load(new)
+    key_str = lambda k: f"{k[0]} {k[1]} {k[2]}"  # noqa: E731
+    common = set(hits_a) & set(hits_b)
+    rules_removed = sorted(set(hits_a) - common)
+    rules_added = sorted(set(hits_b) - common)
+    movers = sorted(
+        ((abs(hits_b[k] - hits_a[k]), k) for k in common), reverse=True
+    )[:top]
+    out = {
+        "stable_unused": [key_str(k) for k in sorted(unused_a & unused_b & common)],
+        "newly_unused": [key_str(k) for k in sorted((unused_b - unused_a) & common)],
+        "newly_used": [key_str(k) for k in sorted((unused_a - unused_b) & common)],
+        "rules_added": [key_str(k) for k in rules_added],
+        "rules_removed": [key_str(k) for k in rules_removed],
+        "top_hit_movers": [
+            {"rule": key_str(k), "old": hits_a[k], "new": hits_b[k]}
+            for d, k in movers
+            if d > 0
+        ],
+    }
+    # serve-mode reports: surface incompleteness so a diff over a lossy
+    # window is never mistaken for clean churn evidence
+    inc = [
+        label
+        for label, rep in (("old", old), ("new", new))
+        if (rep.get("totals", {}).get("window") or {}).get("incomplete")
+    ]
+    if inc:
+        out["window_incomplete"] = inc
+    return out
+
+
+def window_of(rep: dict) -> tuple[str, float] | None:
+    """``(mode, length)`` of a report's analysis window, or None.
+
+    Batch reports carry no window; serve window reports carry
+    ``totals.window.mode/length``; merged/cumulative serve views carry a
+    window block without a single length and return None too (they are
+    not same-window-comparable as-is).
+    """
+    win = rep.get("totals", {}).get("window") or {}
+    if "mode" in win and "length" in win and "id" in win:
+        return (str(win["mode"]), float(win["length"]))
+    return None
+
+
+def parse_window_spec(spec: str) -> tuple[str, float]:
+    """``lines:N`` / ``900s`` / ``15m`` / ``24h`` / ``7d`` -> (mode, length)."""
+    s = spec.strip().lower()
+    if s.startswith("lines:"):
+        try:
+            n = int(s[len("lines:"):])
+        except ValueError as e:
+            raise AnalysisError(f"bad window spec {spec!r}") from e
+        if n < 1:
+            raise AnalysisError(f"window line count must be >= 1, got {n}")
+        return ("lines", float(n))
+    mult = 1.0
+    if s and s[-1] in "smhd":
+        mult = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}[s[-1]]
+        s = s[:-1]
+    try:
+        sec = float(s) * mult
+    except ValueError as e:
+        raise AnalysisError(
+            f"bad window spec {spec!r} (want lines:N or a duration like "
+            "900s / 15m / 24h)"
+        ) from e
+    if sec <= 0:
+        raise AnalysisError(f"window duration must be > 0, got {spec!r}")
+    return ("sec", sec)
+
+
+def check_window_compat(old: dict, new: dict, expect: str) -> None:
+    """Typed refusal when two reports' windows don't match ``expect``.
+
+    Comparing a 24h window against a 7d window produces a *misleading*
+    diff — every quiet-in-24h rule reads as newly-unused — so
+    ``diff-reports --expect-window`` turns that mistake into an error
+    instead of an answer.
+    """
+    want = parse_window_spec(expect)
+    for label, rep in (("old", old), ("new", new)):
+        got = window_of(rep)
+        if got is None:
+            raise AnalysisError(
+                f"--expect-window {expect}: the {label} report carries no "
+                "per-window metadata (not a serve window report, or a "
+                "merged/cumulative view)"
+            )
+        if got != want:
+            raise AnalysisError(
+                f"--expect-window {expect}: the {label} report's window is "
+                f"{got[0]}:{got[1]:g}, expected {want[0]}:{want[1]:g} — "
+                "reports from different window lengths are not comparable"
+            )

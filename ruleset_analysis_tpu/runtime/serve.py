@@ -1,0 +1,1516 @@
+"""Always-on streaming service mode: live ingest, windowed registers,
+hot ruleset reload.
+
+The batch drivers answer "was this rule used *ever* in this corpus"; a
+production deletion decision needs "was it used in the last 24h/7d" on
+*live* traffic (ROADMAP item 1).  This module turns the pipeline into a
+long-running service with three pillars:
+
+1. **Listener tier** (hostside/listener.py): UDP/TCP syslog sockets and
+   a rotating-file tailer feed a bounded queue with explicit drop
+   accounting.  The serve loop forms batches with the batch drivers'
+   exact boundary rules (stream.LineBatcher) and steps them through the
+   same jitted device programs.
+
+2. **Windowed registers.**  Time is cut into windows (wall-clock cadence
+   or a deterministic line count); each window accumulates into a FRESH
+   register state, and at rotation the window's registers are pulled to
+   host and pushed into a ring of N mergeable epochs.  Because every
+   register obeys the merge laws the collective step already relies on
+   (``parallel/step.py::_merge_tail``: psum = add for counts/CMS, pmax =
+   max for HLL), merging K epochs is bit-identical to a single replay
+   over the concatenated traffic — so "unused in the last K windows" is
+   one cheap host-side merge, not a re-run (tests/test_serve.py pins the
+   law).  The ring — epochs, counters, per-window trackers, quarantine —
+   rides the existing checkpoint plane (CRC'd manifests, atomic pointer
+   publish), so a restarted service resumes with its history intact.
+
+3. **Publication + hot reload.**  Every rotation publishes the window
+   report, the cumulative report, and a ``diff-reports``-machinery diff
+   against the previous window to the serve directory and a minimal
+   loopback HTTP JSON endpoint (/report, /health, /metrics).  A SIGHUP
+   or a watched ruleset-file change re-packs the rule tensor mid-stream:
+   a key-space **migration map** (rule identity = firewall/ACL/text, so
+   counters survive renumbering) rewrites the live state AND every ring
+   epoch into the new key space; keys with hits that map nowhere land in
+   an explicit **quarantine bucket** — reported, never dropped.  A
+   reload that fails at any point (including the ``reload.midbatch``
+   fault site) leaves the old tensor and counters untouched.
+
+Drop invariant: any window that overlaps a dropped line (queue overflow,
+forced ``listener.drop`` fault, dead listener) is stamped with a typed
+``WindowIncomplete`` marker (``totals.window.incomplete``) in every
+report that includes it — never silently reported as zero-hit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..config import AnalysisConfig, ServeConfig
+from ..errors import AnalysisError, FeedWorkerError, StallError
+from ..hostside import pack as pack_mod
+from ..hostside.listener import LineQueue, ListenerSet
+from ..models import pipeline
+from ..ops.topk import TopKTracker
+from . import checkpoint as ckpt
+from . import faults, obs
+from .report import diff_report_objs
+
+def merge_register_arrays(items: list[dict[str, np.ndarray]]) -> dict[str, np.ndarray]:
+    """Merge K window register images under the _merge_tail laws.
+
+    Bit-identical to accumulating the concatenated traffic into one
+    state: 64-bit counts add exactly (the device's add64 carries), CMS
+    planes add mod 2^32 (psum wraps identically), HLL takes the
+    elementwise max (pmax).  Associative + commutative, so ring merges
+    compose in any grouping.
+    """
+    if not items:
+        raise AnalysisError("merge_register_arrays needs at least one epoch")
+    first = items[0]
+    u64 = np.uint64
+    lo = first["counts_lo"].astype(u64)
+    total = lo + (first["counts_hi"].astype(u64) << u64(32))
+    cms = first["cms"].copy()
+    hll = first["hll"].copy()
+    talk = first["talk_cms"].copy()
+    for it in items[1:]:
+        total = total + (
+            it["counts_lo"].astype(u64) + (it["counts_hi"].astype(u64) << u64(32))
+        )
+        cms = (cms + it["cms"]).astype(np.uint32)
+        np.maximum(hll, it["hll"], out=hll)
+        talk = (talk + it["talk_cms"]).astype(np.uint32)
+    return {
+        "counts_lo": (total & u64(0xFFFFFFFF)).astype(np.uint32),
+        "counts_hi": (total >> u64(32)).astype(np.uint32),
+        "cms": cms,
+        "hll": hll,
+        "talk_cms": talk,
+    }
+
+
+def zero_arrays(n_keys: int, cfg: AnalysisConfig) -> dict[str, np.ndarray]:
+    return dict(pipeline.state_to_host(pipeline.init_state_host(n_keys, cfg)))
+
+
+# ---------------------------------------------------------------------------
+# Key-space migration: old packed ruleset -> new packed ruleset.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class MigrationMap:
+    """How the old key/gid spaces map into a re-packed ruleset.
+
+    Rule identity is ``(firewall, acl, rule text)`` — the index is
+    exactly what renumbering changes, so it cannot be the identity.
+    Duplicate identical texts within one ACL pair up in config order.
+    Implicit-deny keys match by ACL identity.  ``key_map[old] == -1``
+    means the old key has no home in the new space (rule deleted or
+    rewritten): its counters go to the quarantine bucket.
+    """
+
+    key_map: np.ndarray  # [old n_keys] int64 -> new key id or -1
+    gid_map: dict[int, int | None]  # old acl gid -> new gid (None = gone)
+    old_n_keys: int
+    new_n_keys: int
+
+    @property
+    def identity(self) -> bool:
+        return (
+            self.old_n_keys == self.new_n_keys
+            and bool((self.key_map == np.arange(self.old_n_keys)).all())
+            and all(v == k for k, v in self.gid_map.items())
+        )
+
+
+def build_migration(old: pack_mod.PackedRuleset, new: pack_mod.PackedRuleset) -> MigrationMap:
+    from collections import defaultdict, deque as _dq
+
+    def ident(m):
+        if m.implicit_deny:
+            return (m.firewall, m.acl, None)
+        return (m.firewall, m.acl, m.text)
+
+    cand: dict[tuple, _dq] = defaultdict(_dq)
+    for kid, m in enumerate(new.key_meta):
+        cand[ident(m)].append(kid)
+    key_map = np.full(old.n_keys, -1, dtype=np.int64)
+    for kid, m in enumerate(old.key_meta):
+        q = cand.get(ident(m))
+        if q:
+            key_map[kid] = q.popleft()
+    gid_map = {
+        gid: new.acl_gid.get(name) for name, gid in old.acl_gid.items()
+    }
+    return MigrationMap(key_map, gid_map, old.n_keys, new.n_keys)
+
+
+def migrate_arrays(
+    arrays: dict[str, np.ndarray],
+    mig: MigrationMap,
+    old: pack_mod.PackedRuleset,
+    cfg: AnalysisConfig,
+) -> tuple[dict[str, np.ndarray], dict[tuple, int]]:
+    """Rewrite one register image into the new key space.
+
+    Exact counts scatter through the (injective) key map — 64-bit, so
+    quarantine accounting is exact to the line.  Per-key HLL rows travel
+    with their key.  The two hashed sketches (per-key CMS, talker CMS)
+    key by *hashed position*, which a renumbering invalidates wholesale:
+    they reset to zero on a non-identity migration (they are estimate
+    planes; the exact counters and the report's unused set never depend
+    on them while ``exact_counts`` is on).  Returns the new image plus
+    ``{(firewall, acl, index, text): hits}`` for every unmappable key
+    with a nonzero count — the quarantine bucket.
+    """
+    if mig.identity:
+        return {k: v.copy() for k, v in arrays.items()}, {}
+    u64 = np.uint64
+    old_tot = arrays["counts_lo"].astype(u64) + (
+        arrays["counts_hi"].astype(u64) << u64(32)
+    )
+    s = cfg.sketch
+    new_tot = np.zeros(mig.new_n_keys, dtype=u64)
+    new_hll = np.zeros((mig.new_n_keys, s.hll_m), dtype=np.uint32)
+    # the key map is injective (build_migration pops each new key at
+    # most once), so a fancy-index assignment IS the scatter — the
+    # reload pause stays O(n_keys) in numpy, not interpreter, time
+    # (this runs once per ring epoch, partly under the publish lock)
+    mapped = mig.key_map >= 0
+    targets = mig.key_map[mapped]
+    new_tot[targets] = old_tot[mapped]
+    new_hll[targets] = arrays["hll"][mapped]
+    quarantine: dict[tuple, int] = {}
+    for kid in np.nonzero(~mapped & (old_tot > 0))[0]:
+        m = old.key_meta[int(kid)]
+        quarantine[(m.firewall, m.acl, m.index, m.text)] = int(old_tot[kid])
+    return (
+        {
+            "counts_lo": (new_tot & u64(0xFFFFFFFF)).astype(np.uint32),
+            "counts_hi": (new_tot >> u64(32)).astype(np.uint32),
+            "cms": np.zeros((s.cms_depth, s.cms_width), dtype=np.uint32),
+            "hll": new_hll,
+            "talk_cms": np.zeros((s.talk_cms_depth, s.cms_width), dtype=np.uint32),
+        },
+        quarantine,
+    )
+
+
+def migrate_tracker_tables(
+    tables: dict[int, dict[int, int]], mig: MigrationMap
+) -> tuple[dict[int, dict[int, int]], int]:
+    """Re-gid the talker summaries; returns (new tables, entries dropped)."""
+    tag = int(pipeline.V6_ACL_TAG)
+    out: dict[int, dict[int, int]] = {}
+    dropped = 0
+    for gid, table in tables.items():
+        base = int(gid) & ~tag
+        ng = mig.gid_map.get(base)
+        if ng is None:
+            dropped += len(table)
+            continue
+        dst = out.setdefault(ng | (int(gid) & tag), {})
+        for src, est in table.items():
+            dst[src] = max(dst.get(src, 0), est)
+    return out, dropped
+
+
+def _quarantine_totals(q: dict[tuple, int]) -> dict | None:
+    """Report-facing image of a quarantine bucket (None when empty)."""
+    if not q:
+        return None
+    return {
+        "hits": int(sum(q.values())),
+        "rules": [
+            {"rule": f"{fw} {acl} {idx}", "text": text, "hits": int(h)}
+            for (fw, acl, idx, text), h in sorted(q.items())
+        ],
+    }
+
+
+def _merge_quarantine(dst: dict[tuple, int], src: dict[tuple, int]) -> None:
+    for k, v in src.items():
+        dst[k] = dst.get(k, 0) + v
+
+
+# ---------------------------------------------------------------------------
+# Window epochs + ring.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class WindowEpoch:
+    """One rotated window: register image + accounting + talker summary."""
+
+    arrays: dict[str, np.ndarray]
+    meta: dict  # id, lines, parsed, skipped, chunks, drops, incomplete...
+    tracker_tables: dict[int, dict[int, int]]
+    quarantine: dict[tuple, int] = dataclasses.field(default_factory=dict)
+
+
+class WindowRing:
+    """Ring of the last N window epochs (oldest evicted first)."""
+
+    def __init__(self, size: int):
+        if size < 1:
+            raise AnalysisError(f"window ring size must be >= 1, got {size}")
+        self.size = size
+        self.epochs: deque[WindowEpoch] = deque(maxlen=size)
+
+    def push(self, ep: WindowEpoch) -> None:
+        self.epochs.append(ep)
+
+    def last(self, k: int) -> list[WindowEpoch]:
+        eps = list(self.epochs)
+        return eps[-k:] if k > 0 else eps
+
+    def window_ids(self) -> list[int]:
+        return [ep.meta["id"] for ep in self.epochs]
+
+
+# ---------------------------------------------------------------------------
+# The serve driver.
+# ---------------------------------------------------------------------------
+
+
+class _ReloadFlushError(Exception):
+    """Carrier: a device-step failure inside a reload's in-flight flush.
+
+    NOT an atomic reload failure — the batcher tail was already consumed
+    when the step raised, so treating it as a recoverable reload_error
+    would publish a window missing delivered lines with no incomplete
+    marker.  The reload path unwraps it and propagates the original
+    typed error as a serve abort, exactly like the same step failure in
+    the normal serve loop.
+    """
+
+
+class ServeDriver:
+    """The long-running analysis service (one process, one mesh).
+
+    Construction loads the packed ruleset and validates the config; the
+    blocking :meth:`run` owns the device loop.  Tests drive it from a
+    thread and talk to it over the loopback listeners / HTTP endpoint;
+    the CLI ``serve`` subcommand runs it in the foreground with SIGHUP
+    reload wired up.
+    """
+
+    def __init__(
+        self,
+        ruleset_prefix: str,
+        cfg: AnalysisConfig,
+        scfg: ServeConfig,
+        *,
+        topk: int = 10,
+        mesh=None,
+    ):
+        if cfg.layout != "flat":
+            raise AnalysisError(
+                "serve supports layout='flat' only (the stacked group "
+                "buffer's data-dependent emission cadence has no window "
+                "boundary semantics yet)"
+            )
+        if cfg.coalesce != "off":
+            raise AnalysisError(
+                "serve does not support --coalesce yet; windowed batches "
+                "are formed line-at-a-time at the listener edge"
+            )
+        if not scfg.listen:
+            raise AnalysisError(
+                "serve needs at least one --listen spec "
+                "(udp:HOST:PORT, tcp:HOST:PORT, or tail:PATH)"
+            )
+        self.prefix = ruleset_prefix
+        self.cfg = cfg
+        self.scfg = scfg
+        self.topk = topk
+        self._mesh_arg = mesh
+        try:
+            self.packed = pack_mod.load_packed(ruleset_prefix)
+        except OSError as e:
+            # typed so the CLI's bind-failure handler (except OSError
+            # around construction) never misreports a bad --ruleset
+            # prefix as "cannot bind --listen/--http"
+            raise AnalysisError(
+                f"cannot read packed ruleset {ruleset_prefix!r}: {e}"
+            ) from e
+        self.queue = LineQueue(scfg.queue_lines)
+        self.listeners = ListenerSet(self.queue, list(scfg.listen))
+        self.ring = WindowRing(scfg.ring)
+        self._reload_req = threading.Event()
+        self._stop_req = threading.Event()
+        self._pub_lock = threading.Lock()
+        self._published: dict[str, dict] = {}  # name -> report JSON obj
+        self._window_reports: dict[int, dict] = {}
+        # bind the HTTP endpoint here, like the listener sockets: a bad
+        # --http port must be the documented clean bind error (exit 2,
+        # before any listener thread starts), not a mid-run "serve I/O
+        # failure" after traffic is already flowing
+        self._http = None
+        if scfg.http != "off":
+            host, _, port = scfg.http.rpartition(":")
+            try:
+                self._http = _make_http_server((host, int(port)), self)
+            except BaseException:
+                # the listener sockets bound above have no owner yet —
+                # a failed construction must release them
+                self.listeners.close()
+                raise
+        self._http_thread = None
+        self._watch_thread = None
+        self._old_signals: dict = {}
+        # service counters (cumulative across windows and reloads)
+        self.windows_published = 0
+        self.reloads = 0
+        self.reload_errors = 0
+        self.last_reload_error = ""
+        self.total_lines = 0
+        self.total_parsed = 0
+        self.total_skipped = 0
+        self.total_chunks = 0
+        self.cum_quarantine: dict[tuple, int] = {}
+        self.talker_entries_dropped = 0
+        self.drops_restored = 0  # drops from checkpointed history (--resume)
+        # cumulative incompleteness: EVERY reason a window was marked
+        # (dead/stalled listeners included), not just queue drops — the
+        # cumulative "unused ever" view must carry the marker whenever
+        # any of its windows lost traffic
+        self.cum_incomplete_reasons: list[str] = []
+        self.cum_incomplete_windows: list[int] = []
+        self._t0 = time.time()
+
+    # -- public control surface -----------------------------------------
+    def request_reload(self) -> None:
+        self._reload_req.set()
+
+    def stop(self) -> None:
+        self._stop_req.set()
+
+    @property
+    def http_address(self) -> tuple[str, int] | None:
+        srv = self._http
+        return tuple(srv.server_address[:2]) if srv is not None else None
+
+    # -- health / metrics ------------------------------------------------
+    def health(self) -> dict:
+        q = self.queue.snapshot()
+        stalled = len(self.listeners.stalled(self.cfg.stall_timeout_sec))
+        with self._pub_lock:
+            # both mutate under this lock (reload + rotation on the serve
+            # thread); an unlocked sum() here can die mid-iteration
+            quarantine_hits = int(sum(self.cum_quarantine.values()))
+            ring_windows = self.ring.window_ids()
+        degraded = (
+            q["dropped"] > 0
+            or self.reload_errors > 0
+            or stalled > 0
+            or self.listeners.alive() < len(self.listeners.listeners)
+        )
+        return {
+            "status": "degraded" if degraded else "ok",
+            "uptime_sec": round(time.time() - self._t0, 3),
+            "windows_published": self.windows_published,
+            "lines_total": self.total_lines,
+            "queue": q,
+            "listeners": {
+                "n": len(self.listeners.listeners),
+                "alive": self.listeners.alive(),
+                "stalled": stalled,
+                "addresses": self.listeners.addresses(),
+            },
+            "reloads": self.reloads,
+            "reload_errors": self.reload_errors,
+            **(
+                {"last_reload_error": self.last_reload_error}
+                if self.last_reload_error
+                else {}
+            ),
+            "ruleset": {
+                "n_rules": self.packed.n_rules,
+                "n_acls": self.packed.n_acls,
+                "n_keys": self.packed.n_keys,
+            },
+            "current_window": {
+                "id": getattr(self, "win_id", 0),
+                "pushed": getattr(self, "win_pushed", 0),
+            },
+            "window": {
+                "mode": "lines" if self.scfg.window_lines else "sec",
+                "length": self.scfg.window_lines or self.scfg.window_sec,
+                "ring": self.scfg.ring,
+                # under the publish lock: the serve thread pushes epochs
+                # while HTTP handler threads read here
+                "ring_windows": ring_windows,
+            },
+            "quarantine_hits": quarantine_hits,
+        }
+
+    def _sample_metrics(self) -> dict:
+        return {
+            **self.listeners.sample_metrics(),
+            "windows_published": self.windows_published,
+            "reloads": self.reloads,
+            "lines_total": self.total_lines,
+        }
+
+    # -- report access (HTTP + tests) ------------------------------------
+    def published(self, name: str) -> dict | None:
+        with self._pub_lock:
+            return self._published.get(name)
+
+    def window_report(self, wid: int) -> dict | None:
+        with self._pub_lock:
+            return self._window_reports.get(wid)
+
+    def merged_report_obj(self, k: int) -> dict | None:
+        """Merge the last ``k`` ring epochs into one report (on demand).
+
+        Snapshots the epochs AND the ruleset under the publish lock,
+        then renders outside it: the (possibly slow) merge + finalize
+        must not block the serve loop's rotation publish, and a reload
+        swapping the key space mid-render must not mix old arrays with
+        the new ruleset.  Shallow refs suffice — a reload REBINDS epoch
+        arrays/tables, never mutates them in place — except quarantine,
+        which is merged in place and therefore copied.
+        """
+        with self._pub_lock:
+            eps = [
+                WindowEpoch(
+                    arrays=ep.arrays,
+                    meta=dict(ep.meta),
+                    tracker_tables=ep.tracker_tables,
+                    quarantine=dict(ep.quarantine),
+                )
+                for ep in self.ring.last(k)
+            ]
+            packed = self.packed
+        if not eps:
+            return None
+        return json.loads(self._render_merged(eps, packed).to_json())
+
+    # -- internals -------------------------------------------------------
+    def _render_merged(self, eps: list[WindowEpoch], packed):
+        arrays = merge_register_arrays([ep.arrays for ep in eps])
+        tracker = TopKTracker(self.cfg.sketch.topk_capacity)
+        for ep in eps:
+            for acl, table in ep.tracker_tables.items():
+                for src, est in table.items():
+                    tracker.offer(int(acl), int(src), int(est))
+        drops = sum(ep.meta.get("drops", 0) for ep in eps)
+        incomplete = [
+            ep.meta["id"] for ep in eps if ep.meta.get("incomplete")
+        ]
+        q: dict[tuple, int] = {}
+        for ep in eps:
+            _merge_quarantine(q, ep.quarantine)
+        totals = {
+            "lines_total": int(sum(ep.meta["lines"] for ep in eps)),
+            "lines_matched": int(sum(ep.meta["parsed"] for ep in eps)),
+            "lines_skipped": int(sum(ep.meta["skipped"] for ep in eps)),
+            "chunks": int(sum(ep.meta["chunks"] for ep in eps)),
+            "window": {
+                "merged_windows": [ep.meta["id"] for ep in eps],
+                "mode": "lines" if self.scfg.window_lines else "sec",
+                "length": self.scfg.window_lines or self.scfg.window_sec,
+                "drops": int(drops),
+                **(
+                    {"incomplete": {"windows": incomplete, "drops": int(drops)}}
+                    if incomplete
+                    else {}
+                ),
+            },
+        }
+        qt = _quarantine_totals(q)
+        if qt:
+            totals["quarantine"] = qt
+        return pipeline.finalize(
+            pipeline.AnalysisState(**arrays), packed, self.cfg, tracker,
+            topk=self.topk, totals=totals, v6_digests=self._v6_digests,
+        )
+
+    def _write_json(self, name: str, obj: dict) -> None:
+        path = os.path.join(self.scfg.serve_dir, name)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(obj, f, indent=2)
+        os.replace(tmp, path)
+
+    # -- the run loop ----------------------------------------------------
+    def run(self) -> dict:
+        """Serve until stopped; returns a summary dict (also written to
+        ``serve_dir/summary.json``)."""
+        import jax  # deferred: keep construction backend-free
+
+        from ..parallel import mesh as mesh_lib
+        from ..parallel.step import make_parallel_step, make_parallel_step6
+        from .metrics import DispatchTimer
+
+        scfg = self.scfg
+        os.makedirs(scfg.serve_dir, exist_ok=True)
+        armed_here = faults.arm_spec(self.cfg.fault_plan)
+        aborted: BaseException | None = None
+        try:
+            # EVERYTHING after arming is inside the try: a setup failure
+            # (mesh, batch geometry, CheckpointMismatch from --resume)
+            # must still disarm the fault plan and close the pre-bound
+            # listener/HTTP sockets, exactly like a mid-run abort
+            self._mesh_lib = mesh_lib
+            mesh = self._mesh_arg or mesh_lib.make_mesh(axis=self.cfg.mesh_axis)
+            self.mesh = mesh
+            self.batch_size = mesh_lib.pad_batch_size(
+                self.cfg.batch_size, mesh, self.cfg.mesh_axis
+            )
+            if self.packed.bindings_out and self.batch_size < 2:
+                raise AnalysisError(
+                    "batch_size must be >= 2 when out-direction "
+                    "access-groups are bound"
+                )
+            self._make_step = lambda p: make_parallel_step(mesh, self.cfg, p.n_keys)
+            self._make_step6 = lambda p: make_parallel_step6(mesh, self.cfg, p.n_keys)
+            self._dispatch = DispatchTimer()
+            self._install_ruleset(self.packed)
+            self._v6_digests: dict[int, int] = {}
+            self._v6rows: list = []
+            self._fp = self._fingerprint(self.packed)
+
+            # fresh window scaffolding (possibly replaced by resume below)
+            self.win_id = 0
+            self.cum_arrays = zero_arrays(self.packed.n_keys, self.cfg)
+            self.cum_tracker = TopKTracker(self.cfg.sketch.topk_capacity)
+            if self.cfg.resume:
+                self._restore_ring()
+
+            obs.register_sampler("listener", self._sample_metrics)
+            self.listeners.start()
+            self._begin_window()
+            self._start_http()
+            self._start_watcher()
+            self._install_signals()
+            self._write_json("endpoint.json", {
+                "pid": os.getpid(),
+                "http": list(self.http_address) if self.http_address else None,
+                "listeners": self.listeners.addresses(),
+                "serve_dir": os.path.abspath(scfg.serve_dir),
+            })
+            self._loop()
+        except BaseException as e:
+            aborted = e
+            raise
+        finally:
+            try:
+                self._teardown(aborted)
+            finally:
+                # disarm on abort paths too: a plan this run armed must
+                # not leak into later runs in the same process
+                if armed_here:
+                    faults.disarm()
+        summary = {
+            "windows_published": self.windows_published,
+            "lines_total": self.total_lines,
+            "drops": self.queue.snapshot()["dropped"],
+            "reloads": self.reloads,
+            "reload_errors": self.reload_errors,
+            "quarantine_hits": int(sum(self.cum_quarantine.values())),
+            "serve_dir": os.path.abspath(scfg.serve_dir),
+        }
+        self._write_json("summary.json", summary)
+        return summary
+
+    def _fingerprint(self, packed) -> str:
+        return (
+            ckpt.fingerprint(
+                packed, self.cfg, self.mesh.shape[self.cfg.mesh_axis], 0
+            )
+            + "-serve"
+        )
+
+    def _install_ruleset(self, packed) -> None:
+        """Ship (or re-ship) the rule tensor + step programs."""
+        self.packed = packed
+        self.dev_rules = pipeline.ship_ruleset(
+            packed, match_impl=self.cfg.match_impl
+        )
+        self.step = self._make_step(packed)
+        self.step6 = None
+        self.dev_rules6 = None
+        if packed.has_v6:
+            self.dev_rules6 = pipeline.ship_ruleset6(packed)
+            self.step6 = self._make_step6(packed)
+
+    # -- window lifecycle ------------------------------------------------
+    def _begin_window(self) -> None:
+        from .stream import LineBatcher
+
+        self.state = pipeline.init_state(self.packed.n_keys, self.cfg)
+        self.tracker = TopKTracker(self.cfg.sketch.topk_capacity)
+        self.pending: deque[pipeline.ChunkOut] = deque()
+        packer = pack_mod.LinePacker(self.packed)
+        self.batcher = LineBatcher(
+            packer, self.packed.has_v6, self._v6rows, self._v6_digests,
+            self.batch_size,
+        )
+        self.n_chunks = 0  # window-local: the candidate-table salt, reset
+        # so a window replays exactly like an offline run over its lines
+        self.win_lines = 0  # lines committed to emitted batches
+        self.win_pushed = 0  # lines handed to the batcher
+        self.win_reloads = 0
+        self.win_quarantine: dict[tuple, int] = {}
+        self._buf6 = None
+        self._fill6 = 0
+        self._win_t0 = time.time()
+        # the drop baseline carries over from the previous window's close
+        # (when there is one) so a drop landing DURING rotation/publish
+        # still charges to exactly one window, never the gap between two
+        base = getattr(self, "_next_drops_base", None)
+        self._drops_at_start = (
+            base if base is not None else self.queue.snapshot()["dropped"]
+        )
+        self._listeners_ok_at_start = (
+            self.listeners.alive() == len(self.listeners.listeners)
+        )
+        self._win_saw_stall = False
+
+    def _drain(self, out: pipeline.ChunkOut) -> None:
+        self.tracker.offer_chunk(
+            np.asarray(out.cand_acl),
+            np.asarray(out.cand_src),
+            np.asarray(out.cand_est),
+        )
+
+    def _run_chunk(self, batch_np: np.ndarray) -> None:
+        wire = pack_mod.compact_batch(batch_np)
+        dev = self._mesh_lib.shard_batch(self.mesh, wire, self.cfg.mesh_axis)
+        self.state, out = self._dispatch.first(
+            "v4", self.step, self.state, self.dev_rules, dev, self.n_chunks
+        )
+        self.pending.append(out)
+        if len(self.pending) > 2:
+            self._drain(self.pending.popleft())
+        self.n_chunks += 1
+
+    def _run_chunk6(self, batch6_np: np.ndarray) -> None:
+        dev = self._mesh_lib.shard_batch(self.mesh, batch6_np, self.cfg.mesh_axis)
+        self.state, out = self._dispatch.first(
+            "v6", self.step6, self.state, self.dev_rules6, dev, self.n_chunks
+        )
+        self.pending.append(out)
+        if len(self.pending) > 2:
+            self._drain(self.pending.popleft())
+        self.n_chunks += 1
+
+    def _stage_v6(self) -> None:
+        # mirror of _run_core_impl.stage_v6: drain staged rows, step full
+        # v6 chunks; partial chunks wait for flush
+        if self.step6 is None:
+            return
+        if not self._v6rows:
+            return
+        # drain in place: the batcher holds a reference to this list
+        rows = self._v6rows[:]
+        del self._v6rows[:]
+        i = 0
+        while i < len(rows):
+            if self._buf6 is None:
+                self._buf6 = np.zeros(
+                    (pack_mod.TUPLE6_COLS, self.batch_size), dtype=np.uint32
+                )
+            take = min(self.batch_size - self._fill6, len(rows) - i)
+            self._buf6[:, self._fill6:self._fill6 + take] = np.asarray(
+                rows[i:i + take], dtype=np.uint32
+            ).T
+            self._fill6 += take
+            i += take
+            if self._fill6 == self.batch_size:
+                self._run_chunk6(self._buf6)
+                self._buf6 = None
+                self._fill6 = 0
+
+    def _flush_v6(self) -> None:
+        if self.step6 is None:
+            return
+        self._stage_v6()
+        if self._fill6:
+            self._run_chunk6(self._buf6)
+            self._buf6 = None
+            self._fill6 = 0
+
+    def _consume_event(self, ev: tuple[np.ndarray | None, int]) -> None:
+        batch_np, n_raw = ev
+        if batch_np is None:
+            self.win_lines += n_raw
+            obs.add_lines(n_raw)
+            self._stage_v6()
+            return
+        self._run_chunk(batch_np)
+        self._stage_v6()
+        self.win_lines += n_raw
+        obs.add_lines(n_raw)
+
+    def _flush_inflight(self) -> None:
+        """Step everything consumed so far (rotation/reload barrier)."""
+        tail = self.batcher.flush()
+        if tail is not None:
+            self._consume_event(tail)
+        self._flush_v6()
+        pipeline.sync_state(self.state)
+        while self.pending:
+            self._drain(self.pending.popleft())
+
+    # -- rotation + publication ------------------------------------------
+    def _window_meta(self, *, partial: bool) -> dict:
+        drops = self.queue.snapshot()["dropped"] - self._drops_at_start
+        self._next_drops_base = self._drops_at_start + drops
+        listeners_ok = (
+            self.listeners.alive() == len(self.listeners.listeners)
+        )
+        reasons = []
+        if drops > 0:
+            reasons.append("dropped_lines")
+        if self._listeners_ok_at_start and not listeners_ok:
+            reasons.append("listener_died")
+        if not self._listeners_ok_at_start:
+            reasons.append("listener_down")
+        if self._win_saw_stall or self.listeners.stalled(
+            self.cfg.stall_timeout_sec
+        ):
+            reasons.append("listener_stalled")
+        packer = self.batcher.packer
+        meta = {
+            "id": self.win_id,
+            "mode": "lines" if self.scfg.window_lines else "sec",
+            "length": self.scfg.window_lines or self.scfg.window_sec,
+            "lines": self.win_lines,
+            "parsed": packer.parsed,
+            "skipped": packer.skipped,
+            "chunks": self.n_chunks,
+            "drops": int(drops),
+            "reloads": self.win_reloads,
+            "started_unix": round(self._win_t0, 3),
+            "ended_unix": round(time.time(), 3),
+        }
+        if partial:
+            meta["partial"] = True
+        if reasons:
+            # the typed WindowIncomplete marker: this window's traffic is
+            # known-incomplete, so "0 hits" here must not read as unused
+            meta["incomplete"] = {"drops": int(drops), "reasons": reasons}
+        return meta
+
+    def _window_totals(self, meta: dict, quarantine: dict[tuple, int]) -> dict:
+        elapsed = max(meta["ended_unix"] - meta["started_unix"], 0.0)
+        totals = {
+            "lines_total": meta["lines"],
+            "lines_matched": meta["parsed"],
+            "lines_skipped": meta["skipped"],
+            "chunks": meta["chunks"],
+            "elapsed_sec": round(elapsed, 4),
+            "lines_per_sec": (
+                round(meta["lines"] / elapsed, 1) if elapsed > 0 else 0.0
+            ),
+            "window": meta,
+        }
+        qt = _quarantine_totals(quarantine)
+        if qt:
+            totals["quarantine"] = qt
+        return totals
+
+    def _render_window_obj(self, ep: WindowEpoch) -> dict:
+        """Re-render one epoch's window report (resume repopulation)."""
+        tracker = TopKTracker(self.cfg.sketch.topk_capacity)
+        for acl, table in ep.tracker_tables.items():
+            for src, est in table.items():
+                tracker.offer(int(acl), int(src), int(est))
+        rep = pipeline.finalize(
+            pipeline.AnalysisState(**ep.arrays), self.packed, self.cfg,
+            tracker, topk=self.topk,
+            totals=self._window_totals(ep.meta, ep.quarantine),
+            v6_digests=self._v6_digests,
+        )
+        return json.loads(rep.to_json())
+
+    def _rotate(self, *, partial: bool = False) -> None:
+        with obs.span("serve.rotate", window=self.win_id):
+            self._flush_inflight()
+            meta = self._window_meta(partial=partial)
+            arrays = pipeline.state_to_host(self.state)
+            ep = WindowEpoch(
+                arrays=arrays,
+                meta=meta,
+                tracker_tables=self.tracker.tables(),
+                quarantine=dict(self.win_quarantine),
+            )
+            rep = pipeline.finalize(
+                pipeline.AnalysisState(**arrays), self.packed, self.cfg,
+                self.tracker, topk=self.topk,
+                totals=self._window_totals(meta, self.win_quarantine),
+                v6_digests=self._v6_digests,
+            )
+            rep_obj = json.loads(rep.to_json())
+            if meta.get("incomplete"):
+                self.cum_incomplete_windows.append(meta["id"])
+                for r in meta["incomplete"]["reasons"]:
+                    if r not in self.cum_incomplete_reasons:
+                        self.cum_incomplete_reasons.append(r)
+            with self._pub_lock:
+                self.ring.push(ep)
+                prev = self._published.get("report")
+                # quarantine merges under the lock: /health sums this
+                # dict from HTTP handler threads
+                _merge_quarantine(self.cum_quarantine, self.win_quarantine)
+            # cumulative accounting
+            self.cum_arrays = merge_register_arrays([self.cum_arrays, arrays])
+            for acl, table in ep.tracker_tables.items():
+                for src, est in table.items():
+                    self.cum_tracker.offer(int(acl), int(src), int(est))
+            self.total_lines += meta["lines"]
+            self.total_parsed += meta["parsed"]
+            self.total_skipped += meta["skipped"]
+            self.total_chunks += meta["chunks"]
+            # the NEXT window opens here, BEFORE the (potentially slow)
+            # publish + ring-checkpoint phase: a /health poll or reload
+            # request arriving mid-rotation sees the new window id with
+            # zero pushed lines, never the closed window's stale counters
+            self.win_id += 1
+            self._begin_window()
+            self.windows_published += 1
+            obs.metric_event(
+                "serve.window", id=meta["id"], lines=meta["lines"],
+                chunks=meta["chunks"], drops=meta["drops"],
+            )
+            self._publish(rep_obj, prev, meta)
+            if (
+                self.scfg.checkpoint_every_windows
+                and self.windows_published % self.scfg.checkpoint_every_windows == 0
+            ):
+                self._save_ring_ckpt()
+
+    def _publish(self, rep_obj: dict, prev: dict | None, meta: dict) -> None:
+        with obs.span("serve.publish", window=meta["id"]):
+            cum_obj = json.loads(self._render_cumulative().to_json())
+            diff_obj = None
+            if prev is not None:
+                # window-over-window churn via the diff-reports machinery
+                diff_obj = diff_report_objs(prev, rep_obj, top=self.topk)
+                diff_obj["windows"] = [
+                    prev["totals"].get("window", {}).get("id"),
+                    meta["id"],
+                ]
+            with self._pub_lock:
+                self._published["report"] = rep_obj
+                self._published["cumulative"] = cum_obj
+                if diff_obj is not None:
+                    self._published["diff"] = diff_obj
+                self._window_reports[meta["id"]] = rep_obj
+                # keep the in-memory per-window map bounded by the ring
+                live = set(self.ring.window_ids())
+                evicted = [w for w in self._window_reports if w not in live]
+                for wid in evicted:
+                    del self._window_reports[wid]
+            # the ring is the retention policy on disk too: an always-on
+            # service must not grow serve_dir one window file per
+            # rotation forever (latest/cumulative/merged keep the
+            # aggregate view; archive externally for longer history)
+            for wid in evicted:
+                for name in (f"window-{wid:06d}.json", f"diff-{wid:06d}.json"):
+                    try:
+                        os.remove(os.path.join(self.scfg.serve_dir, name))
+                    except OSError:
+                        pass
+            self._write_json(f"window-{meta['id']:06d}.json", rep_obj)
+            self._write_json("latest.json", rep_obj)
+            self._write_json("cumulative.json", cum_obj)
+            if diff_obj is not None:
+                self._write_json(f"diff-{meta['id']:06d}.json", diff_obj)
+            for k in self.scfg.views:
+                eps = self.ring.last(k)
+                if eps:
+                    # serve-thread render: the serve thread is the only
+                    # mutator of ring + packed, so no snapshot needed
+                    self._write_json(
+                        f"merged-{k}.json",
+                        json.loads(self._render_merged(eps, self.packed).to_json()),
+                    )
+
+    def _render_cumulative(self):
+        # rendered only from _publish, AFTER _rotate merged the window's
+        # quarantine into the cumulative bucket — no re-merge here
+        q = self.cum_quarantine
+        totals = {
+            "lines_total": self.total_lines,
+            "lines_matched": self.total_parsed,
+            "lines_skipped": self.total_skipped,
+            "chunks": self.total_chunks,
+            "window": {
+                "cumulative": True,
+                "windows": self.windows_published,
+                # restored history's drops + this process's: a resumed
+                # service must not reset the loss magnitude its own
+                # incomplete markers refer to
+                "drops": self.drops_restored
+                + int(self.queue.snapshot()["dropped"]),
+            },
+        }
+        drops = self.drops_restored + int(self.queue.snapshot()["dropped"])
+        reasons = list(self.cum_incomplete_reasons)
+        if drops and "dropped_lines" not in reasons:
+            reasons.append("dropped_lines")
+        if drops or reasons:
+            # any window lost traffic (drops, dead or stalled listener):
+            # the cumulative view says so — its zero-hit rules are not
+            # deletion evidence either
+            totals["window"]["incomplete"] = {
+                "drops": drops,
+                "reasons": reasons,
+                "windows": list(self.cum_incomplete_windows),
+            }
+        qt = _quarantine_totals(q)
+        if qt:
+            totals["quarantine"] = qt
+        return pipeline.finalize(
+            pipeline.AnalysisState(**self.cum_arrays), self.packed, self.cfg,
+            self.cum_tracker, topk=self.topk, totals=totals,
+            v6_digests=self._v6_digests,
+        )
+
+    # -- ring checkpointing ----------------------------------------------
+    def _save_ring_ckpt(self) -> None:
+        arrays: dict[str, np.ndarray] = {}
+        wmeta = []
+        for ep in self.ring.epochs:
+            pfx = f"w{ep.meta['id']:06d}__"
+            for k, v in ep.arrays.items():
+                arrays[pfx + k] = v
+            wmeta.append({
+                "meta": ep.meta,
+                "tracker": [
+                    [int(acl), [[int(s), int(e)] for s, e in t.items()]]
+                    for acl, t in ep.tracker_tables.items()
+                ],
+                "quarantine": [
+                    [fw, acl, idx, text, int(h)]
+                    for (fw, acl, idx, text), h in sorted(ep.quarantine.items())
+                ],
+            })
+        for k, v in self.cum_arrays.items():
+            arrays["cum__" + k] = v
+        snap = ckpt.Snapshot(
+            arrays=arrays,
+            lines_consumed=self.total_lines,
+            n_chunks=self.total_chunks,
+            parsed=self.total_parsed,
+            skipped=self.total_skipped,
+            tracker_tables=self.cum_tracker.tables(),
+            fingerprint=self._fp,
+            extra={
+                "serve": {
+                    # win_id is the already-open in-progress window (the
+                    # rotation opened it before checkpointing); its
+                    # partial lines are not in this snapshot, so a resume
+                    # restarts it from empty under the same id
+                    "next_window": self.win_id,
+                    "windows_published": self.windows_published,
+                    "windows": wmeta,
+                    "reloads": self.reloads,
+                    "quarantine": [
+                        [fw, acl, idx, text, int(h)]
+                        for (fw, acl, idx, text), h in sorted(
+                            self.cum_quarantine.items()
+                        )
+                    ],
+                    "v6_digests": [
+                        [int(d), int(s)] for d, s in self._v6_digests.items()
+                    ],
+                    "incomplete_reasons": list(self.cum_incomplete_reasons),
+                    "incomplete_windows": list(self.cum_incomplete_windows),
+                    "drops": self.drops_restored
+                    + int(self.queue.snapshot()["dropped"]),
+                }
+            },
+        )
+        ckpt.save(self.scfg.checkpoint_dir or self._default_ckpt_dir(), snap)
+
+    def _default_ckpt_dir(self) -> str:
+        return os.path.join(self.scfg.serve_dir, "ckpt")
+
+    def _restore_ring(self) -> None:
+        snap = ckpt.load(self.scfg.checkpoint_dir or self._default_ckpt_dir())
+        if snap is None:
+            return
+        if snap.fingerprint != self._fp:
+            raise ckpt.CheckpointMismatch(
+                "serve checkpoint was taken with a different ruleset, "
+                "sketch geometry, or mesh; refusing to resume the window "
+                "ring (delete the serve checkpoint dir to start fresh)"
+            )
+        sv = (snap.extra or {}).get("serve")
+        if not sv:
+            raise ckpt.CheckpointCorrupt(
+                "serve checkpoint manifest lacks the serve extra block"
+            )
+        self.total_lines = snap.lines_consumed
+        self.total_chunks = snap.n_chunks
+        self.total_parsed = snap.parsed
+        self.total_skipped = snap.skipped
+        self.cum_tracker = ckpt.restore_tracker(
+            snap, self.cfg.sketch.topk_capacity
+        )
+        self.cum_arrays = {
+            k[len("cum__"):]: v
+            for k, v in snap.arrays.items()
+            if k.startswith("cum__")
+        }
+        self.win_id = int(sv["next_window"])
+        self.windows_published = int(sv.get("windows_published", 0))
+        self.reloads = int(sv.get("reloads", 0))
+        self.cum_quarantine = {
+            (fw, acl, int(idx), text): int(h)
+            for fw, acl, idx, text, h in sv.get("quarantine", [])
+        }
+        self._v6_digests.update(
+            {int(d): int(s) for d, s in sv.get("v6_digests", [])}
+        )
+        self.cum_incomplete_reasons = list(sv.get("incomplete_reasons", []))
+        self.cum_incomplete_windows = [
+            int(w) for w in sv.get("incomplete_windows", [])
+        ]
+        self.drops_restored = int(sv.get("drops", 0))
+        for w in sv.get("windows", []):
+            meta = w["meta"]
+            pfx = f"w{meta['id']:06d}__"
+            ep = WindowEpoch(
+                arrays={
+                    k[len(pfx):]: v
+                    for k, v in snap.arrays.items()
+                    if k.startswith(pfx)
+                },
+                meta=meta,
+                tracker_tables={
+                    int(acl): {int(s): int(e) for s, e in t}
+                    for acl, t in w.get("tracker", [])
+                },
+                quarantine={
+                    (fw, acl, int(idx), text): int(h)
+                    for fw, acl, idx, text, h in w.get("quarantine", [])
+                },
+            )
+            self.ring.push(ep)
+        # repopulate the publication surface from the restored ring:
+        # /report and /report/window/<id> must serve the checkpointed
+        # history immediately, not 404 until the next rotation (and the
+        # first post-resume diff runs against the pre-restart window)
+        for ep in self.ring.epochs:
+            self._window_reports[ep.meta["id"]] = self._render_window_obj(ep)
+        if self.ring.epochs:
+            self._published["report"] = self._window_reports[
+                self.ring.epochs[-1].meta["id"]
+            ]
+            self._published["cumulative"] = json.loads(
+                self._render_cumulative().to_json()
+            )
+
+    # -- hot reload -------------------------------------------------------
+    def _maybe_reload(self) -> None:
+        if not self._reload_req.is_set():
+            return
+        self._reload_req.clear()
+        with obs.span("serve.reload"):
+            try:
+                self._do_reload()
+            except _ReloadFlushError as e:
+                raise e.__cause__  # step failure, not a reload failure
+            except (AnalysisError, ValueError, OSError) as e:
+                # atomic failure: nothing was swapped, the old tensor and
+                # counters keep serving; the error is visible in /health
+                self.reload_errors += 1
+                self.last_reload_error = str(e)
+                obs.instant("serve.reload.failed", args={"error": str(e)[:200]})
+
+    def _do_reload(self) -> None:
+        old_packed = self.packed
+        new_packed = pack_mod.load_packed(self.prefix)
+        # fault site FIRST: a reload that dies mid-swap must leave the
+        # old tensor, registers, and in-flight batch completely intact
+        faults.fire("reload.midbatch")
+        mig = build_migration(old_packed, new_packed)
+        # step everything parsed under the OLD ruleset through the OLD
+        # programs — gids/keys in flight belong to the old space
+        try:
+            self._flush_inflight()
+        except Exception as e:
+            raise _ReloadFlushError() from e
+        # build everything the swap needs OFF the publish lock (device
+        # shipping and jit lookup are the slow parts)
+        dev_rules = pipeline.ship_ruleset(
+            new_packed, match_impl=self.cfg.match_impl
+        )
+        step = self._make_step(new_packed)
+        dev_rules6 = step6 = None
+        if new_packed.has_v6:
+            dev_rules6 = pipeline.ship_ruleset6(new_packed)
+            step6 = self._make_step6(new_packed)
+        from .stream import LineBatcher
+
+        old_packer = self.batcher.packer
+        packer = pack_mod.LinePacker(new_packed)
+        packer.parsed, packer.skipped = old_packer.parsed, old_packer.skipped
+        batcher = LineBatcher(
+            packer, new_packed.has_v6, self._v6rows, self._v6_digests,
+            self.batch_size,
+        )
+        new_state = None
+        q: dict[tuple, int] = {}
+        if not mig.identity:
+            arrays = pipeline.state_to_host(self.state)
+            new_arrays, q = migrate_arrays(arrays, mig, old_packed, self.cfg)
+            import jax
+
+            new_state = pipeline.AnalysisState(**{
+                k: jax.device_put(v, self._mesh_lib.replicated(self.mesh))
+                for k, v in new_arrays.items()
+            })
+        # ONE publish-locked swap: ring epochs, cumulative image, live
+        # state, rule tensor, programs, and batcher move to the new key
+        # space together — an HTTP render can never pair migrated arrays
+        # with the old ruleset (or old arrays with the new one)
+        with self._pub_lock:
+            if not mig.identity:
+                _merge_quarantine(self.win_quarantine, q)
+                for ep in self.ring.epochs:
+                    ep_arrays, ep_q = migrate_arrays(
+                        ep.arrays, mig, old_packed, self.cfg
+                    )
+                    ep.arrays = ep_arrays
+                    _merge_quarantine(ep.quarantine, ep_q)
+                    ep.meta["migrated"] = ep.meta.get("migrated", 0) + 1
+                    new_tables, dropped = migrate_tracker_tables(
+                        ep.tracker_tables, mig
+                    )
+                    ep.tracker_tables = new_tables
+                    self.talker_entries_dropped += dropped
+                self.cum_arrays, cq = migrate_arrays(
+                    self.cum_arrays, mig, old_packed, self.cfg
+                )
+                _merge_quarantine(self.cum_quarantine, cq)
+                cum_tables, cdrop = migrate_tracker_tables(
+                    self.cum_tracker.tables(), mig
+                )
+                self.talker_entries_dropped += cdrop
+                self.cum_tracker = TopKTracker(self.cfg.sketch.topk_capacity)
+                for acl, table in cum_tables.items():
+                    for src, est in table.items():
+                        self.cum_tracker.offer(acl, src, est)
+                win_tables, wdrop = migrate_tracker_tables(
+                    self.tracker.tables(), mig
+                )
+                self.talker_entries_dropped += wdrop
+                self.tracker = TopKTracker(self.cfg.sketch.topk_capacity)
+                for acl, table in win_tables.items():
+                    for src, est in table.items():
+                        self.tracker.offer(acl, src, est)
+                self.state = new_state
+            self.packed = new_packed
+            self.dev_rules = dev_rules
+            self.step = step
+            self.dev_rules6 = dev_rules6
+            self.step6 = step6
+            self.batcher = batcher
+        self._fp = self._fingerprint(new_packed)
+        self.reloads += 1
+        self.win_reloads += 1
+        obs.instant("serve.reload.ok", args={
+            "n_keys": new_packed.n_keys,
+            "migrated": not mig.identity,
+        })
+
+    # -- service plumbing -------------------------------------------------
+    def _start_http(self) -> None:
+        if self._http is None:  # bound in __init__; "off" leaves it None
+            return
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, name="ra-serve-http", daemon=True
+        )
+        self._http_thread.start()
+
+    def _start_watcher(self) -> None:
+        if not self.scfg.reload_watch:
+            return
+
+        def watch():
+            # debounced: save_packed writes TWO files (.npz + .json)
+            # whose mtimes settle at different polls — fire ONE reload
+            # once the pair has been stable for a full poll interval,
+            # never per-file (a double reload is a wasted re-pack and a
+            # half-written pair is a load failure)
+            last = self._ruleset_mtimes()
+            pending = None
+            while not self._stop_req.wait(self.scfg.reload_poll_sec):
+                cur = self._ruleset_mtimes()
+                if cur == last:
+                    pending = None
+                    continue
+                if any(m is None for m in cur):
+                    continue  # file mid-replace; wait for the pair
+                if cur == pending:  # stable across a whole poll: fire
+                    last = cur
+                    pending = None
+                    self._reload_req.set()
+                else:
+                    pending = cur
+
+        self._watch_thread = threading.Thread(
+            target=watch, name="ra-serve-reload-watch", daemon=True
+        )
+        self._watch_thread.start()
+
+    def _ruleset_mtimes(self) -> tuple:
+        out = []
+        for suffix in (".npz", ".json"):
+            try:
+                st = os.stat(self.prefix + suffix)
+                out.append((st.st_mtime_ns, st.st_size))
+            except OSError:
+                out.append(None)
+        return tuple(out)
+
+    def _install_signals(self) -> None:
+        import signal
+
+        if threading.current_thread() is not threading.main_thread():
+            return
+        # SIGINT/SIGTERM request a GRACEFUL stop (the only way to stop a
+        # --max-windows 0 service): the loop exits at its next check,
+        # publishes the final partial window, and writes summary.json —
+        # the default KeyboardInterrupt would skip both and lose the
+        # open window's delivered lines from every report
+        wanted = {
+            getattr(signal, "SIGHUP", None): lambda *_: self._reload_req.set(),
+            signal.SIGINT: lambda *_: self._stop_req.set(),
+            signal.SIGTERM: lambda *_: self._stop_req.set(),
+        }
+        for sig, handler in wanted.items():
+            if sig is None:
+                continue
+            try:
+                self._old_signals[sig] = signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
+
+    def _teardown(self, aborted: BaseException | None) -> None:
+        import signal
+
+        self._stop_req.set()
+        for sig, old in self._old_signals.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old_signals = {}
+        if self._http is not None:
+            if self._http_thread is not None:
+                # shutdown() handshakes with serve_forever — calling it
+                # when the serving thread never started blocks forever
+                self._http.shutdown()
+                self._http.server_close()
+                self._http_thread.join(timeout=5.0)
+            else:
+                self._http.server_close()
+        self.listeners.close()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5.0)
+        obs.unregister_sampler("listener")
+
+    def _loop(self) -> None:
+        scfg = self.scfg
+        t0 = time.monotonic()
+        next_rotation = (
+            t0 + scfg.window_sec if scfg.window_sec else None
+        )
+        while True:
+            if self._stop_req.is_set():
+                break
+            if scfg.stop_after_sec and time.monotonic() - t0 >= scfg.stop_after_sec:
+                break
+            self._maybe_reload()
+            # wall-clock rotation fires under load too, not just when idle
+            if next_rotation is not None and time.monotonic() >= next_rotation:
+                self._rotate()
+                # skip cadence slots the rotation itself overran (the
+                # fsync-bound ring checkpoint can take seconds): firing
+                # them back-to-back would publish a burst of empty
+                # windows that evicts every real epoch from the ring
+                next_rotation += scfg.window_sec
+                now = time.monotonic()
+                while next_rotation <= now:
+                    next_rotation += scfg.window_sec
+                if scfg.max_windows and self.windows_published >= scfg.max_windows:
+                    break
+                continue
+            line = self.queue.pop(timeout=0.1)
+            if line is not None:
+                for ev in self.batcher.push(line):
+                    self._consume_event(ev)
+                self.win_pushed += 1
+                # lines-mode rotation: deterministic, replayable windows
+                if scfg.window_lines and self.win_pushed >= scfg.window_lines:
+                    self._rotate()
+                    if scfg.max_windows and self.windows_published >= scfg.max_windows:
+                        break
+                continue
+            # idle tick: listener liveness
+            if self.listeners.alive() == 0 and len(self.queue) == 0:
+                err = self.listeners.first_error()
+                if err is not None:
+                    raise FeedWorkerError(
+                        f"every serve listener died; first error: "
+                        f"{type(err).__name__}: {err}"
+                    ) from err
+                break  # all ingress closed cleanly and drained: done
+            # wedged-listener watchdog: a parked receive thread still
+            # says is_alive(), but its heartbeat stops — overlapping
+            # windows get the incomplete marker, and once EVERY live
+            # listener is wedged with nothing queued the service aborts
+            # typed instead of idling forever on traffic it cannot see
+            stalled = self.listeners.stalled(self.cfg.stall_timeout_sec)
+            if stalled:
+                self._win_saw_stall = True
+                if len(stalled) == self.listeners.alive() and len(self.queue) == 0:
+                    names = ", ".join(ln.label for ln in stalled)
+                    raise StallError(
+                        f"every live serve listener stalled (no heartbeat "
+                        f"for {self.cfg.stall_timeout_sec:g}s): {names}"
+                    )
+        # bounded shutdown: stop ingress FIRST, then account every line
+        # still queued as an explicit drop — a stop request must not
+        # analyze an unbounded backlog, and must never pretend the
+        # backlog did not exist (the final window carries the incomplete
+        # marker; summary.drops reports the loss)
+        self.listeners.close()
+        undelivered = self.queue.discard_remaining()
+        # final partial window: publish (marked partial) rather than drop
+        # consumed lines on the floor — unless it is empty
+        if (
+            self.win_pushed
+            or self.batcher.raw
+            or self._fill6
+            or self.pending
+            or self.win_lines
+            or undelivered
+        ):
+            self._rotate(partial=True)
+
+
+# ---------------------------------------------------------------------------
+# Minimal loopback HTTP JSON endpoint.
+# ---------------------------------------------------------------------------
+
+
+def _make_http_handler():
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "ra-serve/1"
+
+        def log_message(self, *a):  # silence per-request stderr noise
+            pass
+
+        def _send(self, code: int, obj) -> None:
+            body = json.dumps(obj, indent=2).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            drv: ServeDriver = self.server.driver
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            try:
+                if path == "/health":
+                    return self._send(200, drv.health())
+                if path == "/metrics":
+                    return self._send(200, drv._sample_metrics())
+                if path == "/report":
+                    obj = drv.published("report")
+                    return self._send(200, obj) if obj else self._send(
+                        404, {"error": "no window published yet"}
+                    )
+                if path == "/report/cumulative":
+                    obj = drv.published("cumulative")
+                    return self._send(200, obj) if obj else self._send(
+                        404, {"error": "no window published yet"}
+                    )
+                if path == "/diff":
+                    obj = drv.published("diff")
+                    return self._send(200, obj) if obj else self._send(
+                        404, {"error": "fewer than two windows published"}
+                    )
+                if path.startswith("/report/window/"):
+                    try:
+                        wid = int(path.rsplit("/", 1)[1])
+                    except ValueError:
+                        return self._send(400, {"error": "bad window id"})
+                    obj = drv.window_report(wid)
+                    return self._send(200, obj) if obj else self._send(
+                        404, {"error": f"window {wid} not in the ring"}
+                    )
+                if path.startswith("/report/merged/"):
+                    try:
+                        k = int(path.rsplit("/", 1)[1])
+                    except ValueError:
+                        return self._send(400, {"error": "bad window count"})
+                    if not 1 <= k <= drv.scfg.ring:
+                        # the refuse-don't-shrink rule ServeConfig
+                        # applies to --view: a merged-24 answer from an
+                        # 8-epoch ring would claim 24 windows of
+                        # evidence while holding 8
+                        return self._send(400, {
+                            "error": (
+                                f"merged window count must be in "
+                                f"1..{drv.scfg.ring} (the ring size), "
+                                f"got {k}; raise --ring to retain more"
+                            ),
+                        })
+                    obj = drv.merged_report_obj(k)
+                    return self._send(200, obj) if obj else self._send(
+                        404, {"error": "no windows in the ring"}
+                    )
+                return self._send(404, {
+                    "error": "unknown path",
+                    "endpoints": [
+                        "/health", "/metrics", "/report",
+                        "/report/cumulative", "/report/window/<id>",
+                        "/report/merged/<k>", "/diff",
+                    ],
+                })
+            except BrokenPipeError:
+                pass
+
+    return Handler
+
+
+def _make_http_server(addr, driver):
+    from http.server import ThreadingHTTPServer
+
+    srv = ThreadingHTTPServer(addr, _make_http_handler())
+    srv.daemon_threads = True
+    srv.driver = driver
+    return srv
+
+
+def window_incomplete(report_obj: dict) -> dict | None:
+    """The typed WindowIncomplete marker of a serve report, or None.
+
+    Consumers (operators, tests, downstream diff tooling) use this to
+    refuse treating an incomplete window's zero-hit rules as unused.
+    """
+    return (report_obj.get("totals", {}).get("window") or {}).get("incomplete")

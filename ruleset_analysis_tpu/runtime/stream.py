@@ -44,6 +44,93 @@ def chunked(it: Iterable[str], size: int) -> Iterator[list[str]]:
         yield buf
 
 
+class LineBatcher:
+    """Push-based core of the text batching rules.
+
+    Extracted from :class:`_TextSource` so the always-on serve loop
+    (runtime/serve.py) forms batches under EXACTLY the batch drivers'
+    boundary rules — the same early close when a dual-evaluation line
+    would overflow, the same ``(None, n_raw)`` zero-valid batches, the
+    same v6 side channel and capped digest map.  Identical boundaries
+    are what make a per-window serve report bit-identical to an offline
+    ``run_stream`` over the same window's lines (talker candidates are
+    the one chunk-boundary-sensitive statistic; registers never are).
+
+    ``push`` returns the ``(batch, n_raw)`` events the line completed
+    (possibly empty); ``flush`` closes the partial batch at a window
+    rotation or end of stream.
+    """
+
+    def __init__(
+        self,
+        packer: LinePacker,
+        has_v6: bool,
+        v6rows: list,
+        v6_digests: dict[int, int],
+        batch_size: int,
+    ):
+        self.packer = packer
+        self._has_v6 = has_v6
+        self._v6rows = v6rows
+        self._digests = v6_digests
+        self._batch = batch_size
+        self._out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
+        self._fill = 0
+        self.raw = 0  # raw lines assigned to the open batch
+
+    def _emit(self) -> tuple[np.ndarray | None, int]:
+        ev = ((self._out if self._fill else None), self.raw)
+        self._out = np.zeros((TUPLE_COLS, self._batch), dtype=np.uint32)
+        self._fill = 0
+        self.raw = 0
+        return ev
+
+    def push(self, line: str) -> list[tuple[np.ndarray | None, int]]:
+        events: list[tuple[np.ndarray | None, int]] = []
+        packer = self.packer
+        p = parse_line(line)
+        gids = [] if p is None else packer.resolve_gids(p)
+        if gids and p.family == 6:
+            if not self._has_v6:
+                # v6 traffic vs a pure-v4 ruleset: counted skip
+                gids = []
+            else:
+                s = pack_mod.u128_limbs(p.src)
+                d = pack_mod.u128_limbs(p.dst)
+                for gid in gids:
+                    self._v6rows.append(
+                        (gid, p.proto, *s, p.sport, *d, p.dport, 1)
+                    )
+                dig = self._digests
+                if len(dig) < pack_mod.V6_DIGEST_CAP:
+                    dig.setdefault(pack_mod.fold_src32_host(p.src), p.src)
+                packer.parsed += len(gids)
+                self.raw += 1
+                if self.raw == self._batch:
+                    events.append(self._emit())
+                return events
+        if gids and self._fill + len(gids) > self._batch:
+            events.append(self._emit())
+        for gid in gids:
+            self._out[:, self._fill] = (
+                gid, p.proto, p.src, p.sport, p.dst, p.dport, 1
+            )
+            self._fill += 1
+        packer.parsed += len(gids)
+        if not gids:
+            packer.skipped += 1
+        self.raw += 1
+        if self.raw == self._batch:
+            events.append(self._emit())
+        return events
+
+    def flush(self) -> tuple[np.ndarray | None, int] | None:
+        """Close the open partial batch (rotation / end of stream)."""
+        if self.raw:
+            return self._emit()
+        return None
+
+
 class _TextSource:
     """Batch source over an iterable of decoded lines (pure-Python parse).
 
@@ -76,9 +163,14 @@ class _TextSource:
         self.packer.parsed, self.packer.skipped = parsed, skipped
 
     def take_v6(self) -> list[tuple]:
-        """Drain v6 tuple rows staged since the last call (driver-pulled)."""
-        out = self._v6rows
-        self._v6rows = []
+        """Drain v6 tuple rows staged since the last call (driver-pulled).
+
+        Drains IN PLACE: the LineBatcher holds a reference to this list,
+        so rebinding the attribute would orphan its staging target and
+        silently lose every later v6 row.
+        """
+        out = self._v6rows[:]
+        del self._v6rows[:]
         return out
 
     def batches(self, skip_lines: int, batch_size: int) -> Iterator[tuple[np.ndarray, int]]:
@@ -95,58 +187,18 @@ class _TextSource:
                 f"snapshot consumed {skip_lines} lines but the input "
                 f"stream has only {skipped_ok}; wrong or truncated log input"
             )
-        packer = self.packer
-        out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
-        fill = 0  # tuple rows used
-        raw = 0  # raw lines assigned to this batch
+        # v6 evaluations ride a side channel the driver pulls via take_v6
+        # and steps through the v6 device program; they never consume v4
+        # batch capacity (LineBatcher stages them into self._v6rows)
+        b = LineBatcher(
+            self.packer, self._has_v6, self._v6rows, self.v6_digests,
+            batch_size,
+        )
         for line in it:
-            p = parse_line(line)
-            gids = [] if p is None else packer.resolve_gids(p)
-            if gids and p.family == 6:
-                if not self._has_v6:
-                    # v6 traffic vs a pure-v4 ruleset: counted skip (the
-                    # device path has no v6 rows to evaluate against)
-                    gids = []
-                else:
-                    # v6 evaluations ride a side channel the driver pulls
-                    # via take_v6 and steps through the v6 device program;
-                    # they never consume v4 batch capacity
-                    s = pack_mod.u128_limbs(p.src)
-                    d = pack_mod.u128_limbs(p.dst)
-                    for gid in gids:
-                        self._v6rows.append(
-                            (gid, p.proto, *s, p.sport, *d, p.dport, 1)
-                        )
-                    dig = self.v6_digests
-                    if len(dig) < self.V6_DIGEST_CAP:
-                        dig.setdefault(pack_mod.fold_src32_host(p.src), p.src)
-                    packer.parsed += len(gids)
-                    raw += 1
-                    if raw == batch_size:
-                        yield (out if fill else None), raw
-                        out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
-                        fill = 0
-                        raw = 0
-                    continue
-            if gids and fill + len(gids) > batch_size:
-                yield out, raw
-                out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
-                fill = 0
-                raw = 0
-            for gid in gids:
-                out[:, fill] = (gid, p.proto, p.src, p.sport, p.dst, p.dport, 1)
-                fill += 1
-            packer.parsed += len(gids)
-            if not gids:
-                packer.skipped += 1
-            raw += 1
-            if raw == batch_size:
-                yield (out if fill else None), raw
-                out = np.zeros((TUPLE_COLS, batch_size), dtype=np.uint32)
-                fill = 0
-                raw = 0
-        if raw:
-            yield (out if fill else None), raw
+            yield from b.push(line)
+        tail = b.flush()
+        if tail is not None:
+            yield tail
 
 
 class _PackedCounters:

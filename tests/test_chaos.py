@@ -74,7 +74,8 @@ STALL_SEC = 3.0
 
 
 def report_image(rep) -> dict:
-    j = json.loads(rep.to_json())
+    j = rep if isinstance(rep, dict) else json.loads(rep.to_json())
+    j = json.loads(json.dumps(j))
     for k in VOLATILE:
         j["totals"].pop(k, None)
     return j
@@ -385,6 +386,166 @@ def test_disarmed_sites_cost_nothing_and_change_nothing(chaos_corpus):
     arr = np.arange(4, dtype=np.uint32)
     assert faults.fire("stream.wire.corrupt", payload=arr) is arr
     assert faults.fire("ingest.producer.raise") is None
+
+
+# ---------------------------------------------------------------------------
+# Serve-mode chaos (ISSUE 6): seeded schedules over the listener/reload
+# tier.  The windowed invariant: every published window report is either
+# bit-identical to an offline replay over exactly the lines that were
+# DELIVERED to it, or carries an explicit WindowIncomplete marker with
+# exact drop accounting — and a run under any schedule ends in a report
+# or a typed abort, never a hang and never a silent zero-hit window.
+# ---------------------------------------------------------------------------
+
+SERVE_W = 100  # lines per window (deterministic rotation)
+SERVE_LINES = 310  # 3 full windows + a tail that must never publish dirty
+
+
+def serve_schedule(seed: int):
+    """Seeded serve schedule: site from the seed, hit count from its rng.
+
+    The site cycles so 12 seeds cover each of the four failure classes
+    three times; hit counts above SERVE_LINES are deliberate never-fire
+    schedules (the clean-run branch of the invariant).
+    """
+    sites = ["listener.drop", "listener.stall", "reload.midbatch",
+             "stream.device_put.fail"]
+    rng = random.Random(seed)
+    site = sites[seed % len(sites)]
+    if site == "listener.drop":
+        at = rng.choice([5, 150, 205, 1000])
+    elif site == "listener.stall":
+        at = rng.choice([50, 1000])
+    elif site == "reload.midbatch":
+        at = 1
+    else:  # stream.device_put.fail: lands in the first windows' chunks
+        at = rng.randint(1, 4)
+    return site, at, faults.FaultPlan([faults.FaultSpec(site, at)], seed=seed)
+
+
+@pytest.fixture(scope="module")
+def serve_chaos_corpus(chaos_corpus, tmp_path_factory):
+    packed, _text, _wirep = chaos_corpus
+    td = tmp_path_factory.mktemp("chaos_serve")
+    prefix = str(td / "rules")
+    pack.save_packed(packed, prefix)
+    return packed, prefix, _mixed_lines(SERVE_LINES, seed=77)
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_chaos_serve_schedule(seed, serve_chaos_corpus, tmp_path):
+    """One seeded listener/reload schedule against a live serve loop."""
+    import socket
+    import threading
+
+    from ruleset_analysis_tpu.config import ServeConfig
+    from ruleset_analysis_tpu.runtime.serve import ServeDriver, window_incomplete
+    from ruleset_analysis_tpu.runtime.stream import run_stream
+
+    packed, prefix, lines = serve_chaos_corpus
+    site, at, plan = serve_schedule(seed)
+    cfg = _cfg(0, "flat", 0, str(tmp_path / "ck"))
+    scfg = ServeConfig(
+        listen=("tcp:127.0.0.1:0",), window_lines=SERVE_W, ring=4,
+        serve_dir=str(tmp_path / "serve"), max_windows=3,
+        stop_after_sec=60, reload_watch=False,
+        checkpoint_every_windows=0, http="off", queue_lines=10_000,
+    )
+    out: dict = {}
+    with faults.armed(plan):
+        drv = ServeDriver(prefix, cfg, scfg, topk=5)
+
+        def runner():
+            try:
+                out["summary"] = drv.run()
+            except BaseException as e:
+                out["error"] = e
+
+        th = threading.Thread(target=runner)
+        th.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not (
+            "error" in out or drv.listeners.alive()
+        ):
+            time.sleep(0.05)
+        if site == "reload.midbatch":
+            # the ruleset on disk is unchanged; the fault site fires
+            # before the (identity) migration even starts
+            drv.request_reload()
+        if "error" not in out and drv.listeners.alive():
+            s = socket.create_connection(drv.listeners.listeners[0].address)
+            s.sendall(("\n".join(lines) + "\n").encode())
+            s.close()
+        th.join(timeout=120)
+        assert not th.is_alive(), f"seed {seed} ({site}@{at}): serve HUNG"
+    if "error" in out:
+        # the typed-abort branch (injected device failure, or the
+        # wedged-listener watchdog escalating a stalled ingress)
+        assert isinstance(out["error"], AnalysisError), (
+            f"seed {seed} ({site}@{at}): untyped abort {out['error']!r}"
+        )
+        return
+
+    summary = out["summary"]
+    dropped_idx = (
+        at - 1 if site == "listener.drop" and at <= SERVE_LINES else None
+    )
+    delivered = [ln for i, ln in enumerate(lines) if i != dropped_idx]
+    n_full = min(3, len(delivered) // SERVE_W)
+    # the bounded stop (max_windows) discards the queued backlog as
+    # COUNTED drops and publishes one final marked partial window for
+    # it — never a silent discard
+    backlog = len(delivered) - n_full * SERVE_W
+    n_win = summary["windows_published"]
+    assert n_win == n_full + (1 if backlog else 0), f"seed {seed} ({site}@{at})"
+    marked = []
+    for i in range(n_full):
+        with open(
+            os.path.join(scfg.serve_dir, f"window-{i:06d}.json"),
+            encoding="utf-8",
+        ) as f:
+            rep = json.load(f)
+        # registers answer for exactly the delivered lines — true with
+        # or without the incompleteness marker (the marker is about the
+        # lines that never arrived, not the ones analyzed)
+        seg = delivered[i * SERVE_W:(i + 1) * SERVE_W]
+        got = report_image(rep)
+        want = report_image(run_stream(packed, iter(seg), cfg, topk=5))
+        got["totals"].pop("window", None)
+        want["totals"].pop("window", None)
+        assert got == want, f"seed {seed} ({site}@{at}): window {i} diverged"
+        inc = window_incomplete(rep)
+        if inc:
+            marked.append((i, inc))
+    if backlog:
+        with open(
+            os.path.join(scfg.serve_dir, f"window-{n_full:06d}.json"),
+            encoding="utf-8",
+        ) as f:
+            prep = json.load(f)
+        inc = window_incomplete(prep)
+        assert prep["totals"]["lines_total"] == 0, (
+            f"seed {seed}: backlog window analyzed lines it should not have"
+        )
+        assert inc and inc["drops"] == backlog, (
+            f"seed {seed}: shutdown backlog not marked ({inc})"
+        )
+    forced = 1 if dropped_idx is not None else 0
+    assert summary["drops"] == forced + backlog, (
+        f"seed {seed} ({site}@{at}): drop accounting off"
+    )
+    if dropped_idx is not None:
+        # the drop is accounted exactly once, on exactly one full
+        # window — never silently absorbed into a zero-hit report
+        assert len(marked) == 1 and marked[0][1]["drops"] == 1, (
+            f"seed {seed}: dropped line not marked ({marked})"
+        )
+    else:
+        assert marked == [], f"seed {seed} ({site}@{at})"
+    if site == "reload.midbatch":
+        # atomic failed reload: nothing swapped, nothing quarantined
+        assert summary["reload_errors"] == 1 and summary["reloads"] == 0
+        assert summary["quarantine_hits"] == 0
 
 
 # ---------------------------------------------------------------------------

@@ -20,6 +20,9 @@ answers the attribution question directly from the timeline:
   into the run, so "where did the pipeline wait" has a concrete answer.
 - **instants** — fault-site firings, checkpoint commits, elastic
   detections, counted by name.
+- **serve** — for traces from the always-on ``serve`` mode: window
+  rotation count + latency (``serve.rotate``), reload pauses
+  (``serve.reload``), and ``listener.drop`` instants.
 
 ``bench_suite.py obs`` imports :func:`summarize` to record stage
 attribution in its artifact; tests assert the merged traces of chaos
@@ -106,6 +109,37 @@ def summarize(path: str, top: int = 5) -> dict:
             "unique_rows": unique,
             "compaction_ratio": round(raw / max(unique, 1), 4),
         }
+    # serve-mode attribution: rotation latency (serve.rotate covers the
+    # flush + ring push + publish of one window) and the reload pause
+    # (serve.reload = how long live analysis stood still for the swap);
+    # listener.drop instants are the trace's copy of the drop counter
+    serve = None
+    rotations = [e for e in spans if e["name"] == "serve.rotate"]
+    reloads = [e for e in spans if e["name"] == "serve.reload"]
+    if rotations or reloads:
+        durs = [e.get("dur", 0) for e in rotations]
+        serve = {
+            "rotations": len(rotations),
+            **(
+                {
+                    "rotation_mean_ms": round(sum(durs) / len(durs) / 1e3, 3),
+                    "rotation_max_ms": round(max(durs) / 1e3, 3),
+                }
+                if durs
+                else {}
+            ),
+            "reloads": len(reloads),
+            **(
+                {
+                    "reload_pause_ms": [
+                        round(e.get("dur", 0) / 1e3, 3) for e in reloads
+                    ]
+                }
+                if reloads
+                else {}
+            ),
+            "listener_drops": instants.get("listener.drop", 0),
+        }
     return {
         "path": path,
         "events": len(events),
@@ -124,6 +158,7 @@ def summarize(path: str, top: int = 5) -> dict:
         ],
         "instants": dict(instants),
         **({"coalesce": coalesce} if coalesce else {}),
+        **({"serve": serve} if serve else {}),
     }
 
 
@@ -152,6 +187,19 @@ def render(s: dict) -> str:
             f"  coalesce: {c['raw_rows']} raw -> {c['unique_rows']} unique "
             f"rows ({c['compaction_ratio']:.2f}x compaction)"
         )
+    if s.get("serve"):
+        sv = s["serve"]
+        line = f"  serve: {sv['rotations']} rotation(s)"
+        if "rotation_mean_ms" in sv:
+            line += (
+                f" (mean {sv['rotation_mean_ms']:.1f} ms, "
+                f"max {sv['rotation_max_ms']:.1f} ms)"
+            )
+        line += f", {sv['reloads']} reload(s)"
+        if sv.get("reload_pause_ms"):
+            line += f" (pause {', '.join(f'{p:.1f}' for p in sv['reload_pause_ms'])} ms)"
+        line += f", {sv['listener_drops']} listener drop(s)"
+        out.append(line)
     if s["instants"]:
         marks = ", ".join(f"{k} x{v}" for k, v in sorted(s["instants"].items()))
         out.append(f"  instants: {marks}")
