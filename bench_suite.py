@@ -1697,6 +1697,265 @@ def bench_servesoak() -> dict:
     }
 
 
+def bench_autoscale() -> dict:
+    """Metrics-driven autoscaling under a square-wave offered load (ISSUE 7).
+
+    Drives the production ``serve --autoscale`` CLI with bursts of
+    loopback traffic separated by idle gaps longer than the flap-damping
+    window: each burst must scale the device mesh OUT (sustained queue
+    pressure), each idle must scale it back IN (sustained starvation)
+    after the cooldown, with ZERO flaps and ZERO drops across the whole
+    soak.  The bench measures the load->decision response latency from
+    the outside (polling the same ``/metrics`` gauges the policy reads)
+    and folds in the trace plane's decision evidence + time-to-effect.
+
+    ``RA_AS_BURSTS`` (default 3) and ``RA_AS_BURST_LINES`` (default 100k)
+    size the square wave.
+    """
+    import os
+    import socket
+    import tempfile
+    import threading
+    import urllib.request
+
+    import jax
+
+    from ruleset_analysis_tpu import cli
+    from ruleset_analysis_tpu.hostside import aclparse
+    from ruleset_analysis_tpu.hostside import pack as pack_mod
+    from ruleset_analysis_tpu.hostside import synth
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import trace_summary
+
+    bursts = int(os.environ.get("RA_AS_BURSTS", "3"))
+    w_lines = int(float(os.environ.get("RA_AS_BURST_LINES", "100000")))
+    total = bursts * w_lines
+    BATCH = 2048
+    QUEUE = 1 << 15
+    # policy knobs of the soak: damping window = 2*(cooldown+sustain) = 3s;
+    # the idle gaps below hold longer than that, so zero flaps is the
+    # CORRECT outcome, not a lucky one
+    SUSTAIN, COOLDOWN = 0.5, 1.0
+    MIN_W, MAX_W = 2, 4
+
+    cfg_text = synth.synth_config(n_acls=2, rules_per_acl=12, seed=0)
+    packed = pack_mod.pack_rulesets([aclparse.parse_asa_config(cfg_text, "fw1")])
+    t = _tuples(packed, total, seed=5)
+    lines = synth.render_syslog(packed, t, seed=5)
+
+    def wait_for(pred, timeout, what):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        raise RuntimeError(f"autoscale soak: timed out waiting for {what}")
+
+    def read_json(path):
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+    def http_json(addr, path):
+        import urllib.error
+
+        for attempt in range(3):
+            try:
+                with urllib.request.urlopen(
+                    f"http://{addr[0]}:{addr[1]}{path}", timeout=10
+                ) as r:
+                    return json.load(r)
+            except (urllib.error.URLError, OSError):
+                if attempt == 2:
+                    raise
+                time.sleep(0.2)
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "rules")
+        pack_mod.save_packed(packed, prefix)
+        serve_dir = os.path.join(d, "serve")
+        trace_dir = os.path.join(d, "trace")
+
+        # pre-warm the jit caches for EVERY world rung the ladder can
+        # visit (a production deploy compiles its geometries up front;
+        # the step builders memoize on mesh identity and the serve
+        # driver's fixed max-world batch padding makes the geometry
+        # identical across rungs)
+        from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+        from ruleset_analysis_tpu.parallel import mesh as mesh_lib
+        from ruleset_analysis_tpu.runtime.stream import run_stream
+
+        warm_cfg = AnalysisConfig(
+            backend="tpu", batch_size=BATCH, prefetch_depth=0,
+            sketch=SketchConfig(cms_width=1 << 14, cms_depth=4, hll_p=8),
+        )
+        devs = list(jax.devices())
+        for k in (MIN_W, MAX_W):
+            run_stream(
+                packed, iter(lines[:64]), warm_cfg,
+                mesh=mesh_lib.make_mesh(devs[:k], axis=warm_cfg.mesh_axis),
+            )
+
+        rc: dict = {}
+        th = threading.Thread(target=lambda: rc.update(rc=cli.main([
+            "serve", "--ruleset", prefix,
+            "--listen", "tcp:127.0.0.1:0",
+            "--window", f"lines:{w_lines}",
+            "--serve-dir", serve_dir,
+            "--max-windows", str(bursts),
+            "--stop-after", "600",
+            "--batch-size", str(BATCH),
+            "--http", "127.0.0.1:0",
+            "--no-reload-watch",
+            "--queue-lines", str(QUEUE),
+            "--autoscale",
+            "--autoscale-min", str(MIN_W),
+            "--autoscale-max", str(MAX_W),
+            "--autoscale-initial", str(MIN_W),
+            "--autoscale-out-threshold", "0.25",
+            "--autoscale-in-threshold", "0.8",
+            "--autoscale-sustain", str(SUSTAIN),
+            "--autoscale-cooldown", str(COOLDOWN),
+            "--autoscale-budget", str(2 * bursts + 2),
+            "--autoscale-poll", "0.1",
+            "--trace-out", trace_dir,
+        ])))
+        th.start()
+        ep_path = os.path.join(serve_dir, "endpoint.json")
+        wait_for(lambda: os.path.exists(ep_path), 60, "serve endpoint")
+        ep = read_json(ep_path)
+        http = tuple(ep["http"])
+        (tcp_addr,) = [a for a in ep["listeners"].values()]
+
+        def gauge(name):
+            return http_json(http, "/metrics").get(name, 0)
+
+        damping = 2 * (COOLDOWN + SUSTAIN)
+        wall_start = time.time()
+        response_out, response_in = [], []
+        s = socket.create_connection(tuple(tcp_addr))
+        for i in range(bursts):
+            seen_out = gauge("autoscale_scale_out_total")
+            t0 = time.perf_counter()
+            # high phase: offer the window's whole line budget as fast
+            # as the queue absorbs it, throttling just under the drop
+            # line — a closed-loop overload, so the queue-occupancy
+            # pressure signal sustains on ANY host regardless of its
+            # absolute device rate, and drops stay zero by construction
+            seg = lines[i * w_lines:(i + 1) * w_lines]
+            fired = False
+            # ONE gauge fetch per 4096-line chunk: the serve loop answers
+            # HTTP between lines, so a chatty sender would throttle
+            # itself below the service's drain rate and never build the
+            # very pressure the bench exists to create
+            for j in range(0, len(seg), 4096):
+                s.sendall(("\n".join(seg[j:j + 4096]) + "\n").encode())
+                try:
+                    g = http_json(http, "/metrics")
+                    if not fired and g.get("autoscale_scale_out_total", 0) > seen_out:
+                        response_out.append(round(time.perf_counter() - t0, 3))
+                        fired = True
+                    throttle_deadline = time.monotonic() + 120
+                    while g.get("queue_depth", 0) > 0.55 * QUEUE:
+                        if time.monotonic() > throttle_deadline:
+                            raise RuntimeError(
+                                "autoscale soak: queue never drained "
+                                "below the throttle line (service wedged?)"
+                            )
+                        time.sleep(0.05)  # hold below the drop line
+                        g = http_json(http, "/metrics")
+                except OSError:
+                    if i != bursts - 1 or j + 4096 < len(seg):
+                        raise  # only the final rotation may take it down
+                    break
+            if i == bursts - 1:
+                # the service stops itself at the final rotation (its
+                # /metrics endpoint goes down with it); the summary's
+                # decision log verifies this burst's scale-out below
+                if not fired:
+                    deadline = time.monotonic() + 120
+                    while time.monotonic() < deadline and th.is_alive():
+                        try:
+                            if gauge("autoscale_scale_out_total") > seen_out:
+                                response_out.append(
+                                    round(time.perf_counter() - t0, 3)
+                                )
+                                break
+                        except OSError:
+                            break  # endpoint gone: the service finished
+                        time.sleep(0.1)
+                break
+            if not fired:
+                wait_for(
+                    lambda: gauge("autoscale_scale_out_total") > seen_out,
+                    120, f"scale-out on burst {i}",
+                )
+                response_out.append(round(time.perf_counter() - t0, 3))
+            # low phase: wait for the scale-in, then hold the idle past
+            # the damping window so the NEXT burst's out is a load
+            # response, not a flap
+            seen_in = gauge("autoscale_scale_in_total")
+            t1 = time.perf_counter()
+            wait_for(
+                lambda: gauge("autoscale_scale_in_total") > seen_in,
+                180, f"scale-in after burst {i}",
+            )
+            response_in.append(round(time.perf_counter() - t1, 3))
+            time.sleep(damping)
+        s.close()
+        th.join(timeout=300)
+        if th.is_alive() or rc.get("rc") != 0:
+            raise RuntimeError(f"autoscale soak: serve CLI failed rc={rc.get('rc')}")
+        elapsed = max(time.time() - wall_start, 1e-3)
+        summary = read_json(os.path.join(serve_dir, "summary.json"))
+        attribution = trace_summary.summarize(os.path.join(trace_dir, "trace.json"))
+    asum = summary["autoscale"]
+    tr = attribution.get("autoscale", {})
+    mean_out = round(sum(response_out) / max(len(response_out), 1), 3)
+    return {
+        "metric": "autoscale_scale_out_response_sec",
+        "value": mean_out,
+        "unit": "sec (burst start -> scale-out observed at /metrics)",
+        "vs_baseline": round(mean_out / max(SUSTAIN, 1e-9), 3),  # x sustain floor
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "devices": len(jax.devices()),
+            "lines": total,
+            "bursts": bursts,
+            "burst_lines": w_lines,
+            "square_wave_idle_sec": damping,
+            "world_ladder": [MIN_W, MAX_W],
+            "queue_lines": QUEUE,
+            "policy": {
+                "out_threshold": 0.25, "in_threshold": 0.8,
+                "sustain_sec": SUSTAIN, "cooldown_sec": COOLDOWN,
+                "damping_window_sec": damping,
+            },
+            "scale_out_events": asum["scale_out"],
+            "scale_in_events": asum["scale_in"],
+            "flaps": asum["flaps"],
+            "budget_left": asum["budget_left"],
+            "final_world": summary["world"],
+            "decisions": asum["decisions"],
+            "response_out_sec": response_out,
+            "response_in_sec": response_in,
+            "time_to_effect_mean_ms": tr.get("time_to_effect_mean_ms"),
+            "time_to_effect_max_ms": tr.get("time_to_effect_max_ms"),
+            "trace_flaps": tr.get("flaps"),
+            "drops": summary["drops"],
+            "windows_published": summary["windows_published"],
+            "elapsed_sec": round(elapsed, 1),
+            "guards": {
+                "scale_out_on_every_burst": asum["scale_out"] >= bursts,
+                "scale_in_after_every_idle": asum["scale_in"] >= bursts - 1,
+                "zero_flaps": asum["flaps"] == 0 and tr.get("flaps", 0) == 0,
+                "zero_drops": summary["drops"] == 0,
+                "all_windows_published": summary["windows_published"] == bursts,
+            },
+        },
+    }
+
+
 BENCHES = {
     "stage": bench_stage,
     "exact": bench_exact,
@@ -1709,6 +1968,7 @@ BENCHES = {
     "e2e": bench_e2e,
     "sustained": bench_sustained,
     "servesoak": bench_servesoak,
+    "autoscale": bench_autoscale,
     "obs": bench_obs,
     "coalesce": bench_coalesce,
     "convert": bench_convert,
@@ -1718,9 +1978,11 @@ BENCHES = {
 
 
 #: a bare `python bench_suite.py` runs these; `sustained` (≥1e8 lines —
-#: minutes of wall time by design) and `servesoak` (a paced live-service
-#: soak with sockets + threads) are explicit-only
-DEFAULT_BENCHES = [n for n in BENCHES if n not in ("sustained", "servesoak")]
+#: minutes of wall time by design), `servesoak` and `autoscale` (paced
+#: live-service soaks with sockets + threads) are explicit-only
+DEFAULT_BENCHES = [
+    n for n in BENCHES if n not in ("sustained", "servesoak", "autoscale")
+]
 
 
 def main(argv: list[str]) -> int:

@@ -101,6 +101,98 @@ def _resolve_fault_plan(spec: str | None) -> str:
     return faults.FaultPlan.parse(spec).to_str()
 
 
+#: --autoscale-X flag name -> AutoscaleConfig field.  The dataclass
+#: field defaults are the ONE source of truth for flag defaults (both
+#: the argparse defaults and the requires---autoscale check read them).
+_AUTOSCALE_FIELDS = {
+    "autoscale_min": "min_world",
+    "autoscale_max": "max_world",
+    "autoscale_initial": "initial_world",
+    "autoscale_out_threshold": "out_threshold",
+    "autoscale_in_threshold": "in_threshold",
+    "autoscale_sustain": "sustain_sec",
+    "autoscale_cooldown": "cooldown_sec",
+    "autoscale_budget": "reform_budget",
+    "autoscale_poll": "poll_sec",
+    "autoscale_plan": "plan",
+}
+
+
+def _autoscale_defaults() -> dict:
+    import dataclasses
+
+    from .config import AutoscaleConfig
+
+    by_field = {f.name: f.default for f in dataclasses.fields(AutoscaleConfig)}
+    return {flag: by_field[field] for flag, field in _AUTOSCALE_FIELDS.items()}
+
+
+def _autoscale_config(args):
+    """``--autoscale`` flag family -> AutoscaleConfig (None when off)."""
+    if not args.autoscale:
+        for flag, dflt in _autoscale_defaults().items():
+            if getattr(args, flag) != dflt:
+                raise errors.AnalysisError(
+                    f"--{flag.replace('_', '-')} requires --autoscale"
+                )
+        return None
+    from .config import AutoscaleConfig
+
+    return AutoscaleConfig(
+        **{
+            field: getattr(args, flag)
+            for flag, field in _AUTOSCALE_FIELDS.items()
+        }
+    )
+
+
+def _add_autoscale_flags(p) -> None:
+    d = _autoscale_defaults()
+    p.add_argument("--autoscale", action="store_true",
+                   help="arm the metrics-driven elastic autoscaler "
+                        "(DESIGN §13): sustained producer-backpressure "
+                        "scales device workers OUT, sustained starvation "
+                        "scales IN, via planned re-formations from the "
+                        "epoch checkpoints — decisions carry their "
+                        "evidence in the trace/metrics planes")
+    p.add_argument("--autoscale-min", type=int, default=d["autoscale_min"], metavar="W",
+                   help="smallest world the policy may scale in to")
+    p.add_argument("--autoscale-max", type=int, default=d["autoscale_max"], metavar="W",
+                   help="largest world (0 = everything provisioned: all "
+                        "devices for serve, the launcher pool for "
+                        "--elastic)")
+    p.add_argument("--autoscale-initial", type=int, default=d["autoscale_initial"], metavar="W",
+                   help="starting world (0 = the smallest allowed)")
+    p.add_argument("--autoscale-out-threshold", type=float,
+                   default=d["autoscale_out_threshold"],
+                   metavar="F",
+                   help="scale OUT when the pressure signal holds >= F "
+                        "over the sustain window (fraction of wall time "
+                        "producer-backpressured / queue-saturated)")
+    p.add_argument("--autoscale-in-threshold", type=float,
+                   default=d["autoscale_in_threshold"],
+                   metavar="F",
+                   help="scale IN when the starvation signal holds >= F "
+                        "over the sustain window")
+    p.add_argument("--autoscale-sustain", type=float, default=d["autoscale_sustain"],
+                   metavar="SEC",
+                   help="a signal must hold this long before a decision")
+    p.add_argument("--autoscale-cooldown", type=float, default=d["autoscale_cooldown"],
+                   metavar="SEC",
+                   help="dead time after every decision (flap damping)")
+    p.add_argument("--autoscale-budget", type=int, default=d["autoscale_budget"], metavar="N",
+                   help="scale re-formations allowed per run (0 = "
+                        "observe-only: decisions with evidence, no "
+                        "actuation); separate from --max-reforms, which "
+                        "stays the FAILURE budget")
+    p.add_argument("--autoscale-poll", type=float, default=d["autoscale_poll"], metavar="SEC",
+                   help="metrics sampling cadence of the policy engine")
+    p.add_argument("--autoscale-plan", default=d["autoscale_plan"], metavar="SPEC",
+                   help="scripted decisions for drills/tests "
+                        "('out@T,in@T': fire at T seconds, in order), "
+                        "bypassing the signal thresholds")
+
+
 def _iter_log_lines(paths: list[str]):
     for path in paths:
         if path == "-":
@@ -133,9 +225,12 @@ def _cmd_run(args: argparse.Namespace) -> int:
             prefetch_depth=args.prefetch_depth,
             stall_timeout_sec=args.stall_timeout,
             coalesce=args.coalesce,
+            mesh_shape=args.mesh,
+            mesh_dcn=args.mesh_dcn,
             fault_plan=_resolve_fault_plan(args.fault_plan),
             **({"checkpoint_dir": args.checkpoint_dir} if args.checkpoint_dir else {}),
         )
+        autoscale = _autoscale_config(args)
     except (ValueError, errors.AnalysisError) as e:
         # AnalysisError here is a malformed --fault-plan: a config
         # mistake, so the usage exit code — not a runtime failure class
@@ -173,6 +268,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--elastic": args.elastic,
             "--fault-plan": bool(args.fault_plan),
             "--coalesce": args.coalesce != "off",
+            "--mesh=hybrid": args.mesh != "flat",
+            "--autoscale": args.autoscale,
         }
         # --prefetch-depth is deliberately NOT rejected: like
         # --batch-size it is a tpu-path tuning knob the oracle ignores,
@@ -287,6 +384,13 @@ def _cmd_run(args: argparse.Namespace) -> int:
                     f"target: {e}", file=sys.stderr,
                 )
                 return 2
+        if args.autoscale and not args.elastic:
+            print(
+                "--autoscale applies to `serve` and to `run --elastic` "
+                "(the supervised tier that can re-form the world); a "
+                "fixed-membership run has nothing to scale", file=sys.stderr,
+            )
+            return 2
         if args.elastic:
             # Elastic tier: this process becomes a recovery SUPERVISOR
             # (runtime/elastic.py) — --logs is the FULL shard list, the
@@ -331,6 +435,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             import os as os_mod
 
             from .errors import AnalysisError as _AErr
+            from .runtime import faults
             from .runtime.elastic import ElasticSupervisor
 
             fault = None
@@ -355,11 +460,26 @@ def _cmd_run(args: argparse.Namespace) -> int:
                         args.elastic_dir, "result"
                     ),
                     fault=fault,
+                    autoscale=autoscale,
                 )
             except _AErr as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 2
-            rc, result_path = sup.run()
+            # the supervisor process hosts fault sites of its own (the
+            # autoscale decide/actuate seam); workers re-arm the same
+            # spec idempotently from the job config
+            armed_here = faults.arm_spec(cfg.fault_plan)
+            try:
+                rc, result_path = sup.run()
+            except _AErr as e:
+                # a typed runtime abort (e.g. an injected autoscale
+                # fault at the decide/actuate seam) exits with its
+                # documented failure-class code, never a traceback
+                print(f"error: {e}", file=sys.stderr)
+                return errors.exit_code_for(e)
+            finally:
+                if armed_here:
+                    faults.disarm()
             if rc != 0 or result_path is None:
                 return rc
             with open(result_path, "r", encoding="utf-8") as f:
@@ -459,6 +579,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             stall_timeout_sec=args.stall_timeout,
             fault_plan=_resolve_fault_plan(args.fault_plan),
         )
+        ascfg = _autoscale_config(args)
         mode, length = report_mod.parse_window_spec(args.window)
         scfg = ServeConfig(
             listen=tuple(args.listen),
@@ -502,7 +623,7 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         # construction binds the listener sockets: a privileged port or
         # an address in use must be the documented clean error, not a
         # traceback
-        driver = ServeDriver(args.ruleset, cfg, scfg, topk=args.topk)
+        driver = ServeDriver(args.ruleset, cfg, scfg, topk=args.topk, ascfg=ascfg)
     except OSError as e:
         print(f"error: cannot bind --listen/--http: {e}", file=sys.stderr)
         return 2
@@ -830,6 +951,16 @@ def make_parser() -> argparse.ArgumentParser:
                         "each named site on its Nth hit, or @FILE holding "
                         "the spec; see runtime/faults.py SITES and DESIGN "
                         "§9 for the registered sites")
+    p.add_argument("--mesh", choices=["flat", "hybrid"], default="flat",
+                   help="device mesh topology: flat = one data axis over "
+                        "every device; hybrid = the two-level DCN x ICI "
+                        "mesh (an outer between-host axis times an inner "
+                        "ICI axis, the create_hybrid_device_mesh idiom) — "
+                        "batches shard and registers merge over BOTH "
+                        "axes, reports bit-identical to flat (DESIGN §13)")
+    p.add_argument("--mesh-dcn", type=int, default=0, metavar="N",
+                   help="outer (DCN) extent of --mesh hybrid; 0 = auto "
+                        "(process count when multi-host, else 2)")
     p.add_argument("--layout", choices=["flat", "stacked"], default="flat",
                    help="rule-match layout: flat scans all rules per line; stacked "
                         "buckets lines by ACL and vmaps over per-ACL rule slabs "
@@ -886,6 +1017,7 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-reforms", type=int, default=2, metavar="N",
                    help="abort after N automatic cluster re-formations "
                         "(the Hadoop max-task-retries analog; default 2)")
+    _add_autoscale_flags(p)
     p.add_argument("--json", action="store_true")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_run)
@@ -952,9 +1084,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--topk", type=int, default=10)
     p.add_argument("--stall-timeout", type=float,
                    default=AnalysisConfig.stall_timeout_sec, metavar="SEC")
+    _add_autoscale_flags(p)
     p.add_argument("--fault-plan", default=None, metavar="SPEC",
                    help="chaos drills: see `run --fault-plan` (adds the "
-                        "listener.drop/listener.stall/reload.midbatch sites)")
+                        "listener.drop/listener.stall/reload.midbatch and "
+                        "autoscale.decide/autoscale.spawn sites)")
     p.add_argument("--trace-out", default=None, metavar="DIR",
                    help="record listener/rotation/reload spans (see "
                         "`run --trace-out`)")

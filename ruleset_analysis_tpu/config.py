@@ -98,6 +98,99 @@ class SketchConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """Policy knobs of the metrics-driven elastic autoscaler
+    (runtime/autoscale.py).
+
+    The policy reads two canonical signals sampled from the live metrics
+    plane — **pressure** (fraction of recent wall time the pipeline was
+    producer-backpressured / queue-saturated: the device tier cannot keep
+    up, scale OUT) and **starvation** (fraction of recent wall time the
+    device tier sat idle waiting for input: capacity is excess, scale
+    IN) — and turns them into planned scale events only when a signal
+    holds over a full ``sustain_sec`` window.  Flap damping is threefold:
+    the sustain window itself, a ``cooldown_sec`` dead time after every
+    decision, and the hysteresis gap between the two thresholds (both
+    signals cannot be sustained simultaneously).  ``reform_budget``
+    bounds the scale re-formations of one run the way ``--max-reforms``
+    bounds failure re-formations; 0 = observe-only (decisions are
+    logged with evidence but never actuated).
+    """
+
+    min_world: int = 1
+    max_world: int = 0  # 0 = everything provisioned (devices / launcher pool)
+    initial_world: int = 0  # 0 = the smallest allowed world
+    out_threshold: float = 0.5  # sustained pressure >= this => scale out
+    in_threshold: float = 0.8  # sustained starvation >= this => scale in
+    sustain_sec: float = 3.0  # a signal must hold this long to count
+    cooldown_sec: float = 10.0  # dead time after every decision
+    reform_budget: int = 4  # scale re-formations allowed (0 = observe-only)
+    poll_sec: float = 0.5  # metrics sampling cadence
+    #: scripted decision schedule for drills/tests ("out@T,in@T": fire
+    #: each entry T seconds after the policy engine starts observing,
+    #: in order); empty = decide from the live signals
+    plan: str = ""
+
+    def __post_init__(self) -> None:
+        if self.min_world < 1:
+            raise ValueError(f"min_world must be >= 1, got {self.min_world}")
+        if self.max_world < 0 or (
+            self.max_world and self.max_world < self.min_world
+        ):
+            raise ValueError(
+                f"max_world must be 0 (= provisioned) or >= min_world, got "
+                f"{self.max_world} (min_world {self.min_world})"
+            )
+        if self.initial_world < 0 or (
+            self.initial_world
+            and not (
+                self.min_world
+                <= self.initial_world
+                <= (self.max_world or self.initial_world)
+            )
+        ):
+            raise ValueError(
+                f"initial_world must be 0 or within "
+                f"[{self.min_world}, {self.max_world or 'max'}], got "
+                f"{self.initial_world}"
+            )
+        for name in ("out_threshold", "in_threshold"):
+            v = getattr(self, name)
+            if not 0.0 < v <= 1.0:
+                raise ValueError(f"{name} must be in (0, 1], got {v}")
+        if self.sustain_sec <= 0 or self.poll_sec <= 0:
+            raise ValueError("sustain_sec and poll_sec must be > 0")
+        if self.cooldown_sec < 0:
+            raise ValueError("cooldown_sec must be >= 0")
+        if self.reform_budget < 0:
+            raise ValueError("reform_budget must be >= 0")
+        # validate the scripted plan eagerly (bad specs fail at config
+        # time like every other knob), without importing the engine
+        for part in filter(None, (p.strip() for p in self.plan.split(","))):
+            d, _, t = part.partition("@")
+            if d not in ("out", "in"):
+                raise ValueError(
+                    f"autoscale plan entry {part!r}: direction must be "
+                    "'out' or 'in'"
+                )
+            try:
+                if float(t) < 0:
+                    raise ValueError
+            except ValueError:
+                raise ValueError(
+                    f"autoscale plan entry {part!r}: want DIRECTION@SECONDS"
+                ) from None
+
+    def to_dict(self) -> dict:
+        """JSON-serializable image (elastic supervisor -> worker handoff)."""
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "AutoscaleConfig":
+        return AutoscaleConfig(**d)
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Configuration of the always-on ``serve`` mode (runtime/serve.py).
 
@@ -179,6 +272,17 @@ class AnalysisConfig:
     #: smaller ``hll_p``, instead of silently OOMing the chip.
     register_memory_budget_bytes: int = 4 << 30
     mesh_axis: str = "data"
+    #: Mesh topology: "flat" = one data axis over every device (the
+    #: historical shape); "hybrid" = the two-level DCN x ICI idiom
+    #: (SNIPPETS.md [2] ``create_hybrid_device_mesh``): an outer "dcn"
+    #: axis of ``mesh_dcn`` groups (hosts, once world size grows past
+    #: one) times an inner ICI axis.  Batches shard over BOTH axes and
+    #: every register merge reduces over both, so reports are
+    #: bit-identical to the flat mesh — pinned on CPU as 2x4 vs flat 8.
+    mesh_shape: str = "flat"
+    #: Outer (DCN) extent of the hybrid mesh; 0 = auto (the process
+    #: count when multi-process, else 2 — the CPU exercise geometry).
+    mesh_dcn: int = 0
     checkpoint_every_chunks: int = 0  # 0 = no checkpointing
     checkpoint_dir: str = os.path.join(OUTPUT_DIR, "ckpt")
     resume: bool = False  # resume from checkpoint_dir if a snapshot exists
@@ -268,6 +372,16 @@ class AnalysisConfig:
             )
         if self.layout not in ("flat", "stacked"):
             raise ValueError(f"layout must be 'flat' or 'stacked', got {self.layout!r}")
+        if self.mesh_shape not in ("flat", "hybrid"):
+            raise ValueError(
+                f"mesh_shape must be 'flat' or 'hybrid', got {self.mesh_shape!r}"
+            )
+        if self.mesh_dcn < 0:
+            raise ValueError(f"mesh_dcn must be >= 0, got {self.mesh_dcn}")
+        if self.mesh_dcn and self.mesh_shape != "hybrid":
+            raise ValueError(
+                "mesh_dcn only applies to mesh_shape='hybrid'"
+            )
         if self.stacked_lane < 0:
             raise ValueError("stacked_lane must be >= 0")
         if not 0 <= self.prefetch_depth <= 1024:

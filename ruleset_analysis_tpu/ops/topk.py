@@ -162,7 +162,11 @@ class TopKTracker:
 
     def top(self, acl: int, k: int) -> list[tuple[int, int]]:
         t = self._tables.get(acl, {})
-        return sorted(t.items(), key=lambda kv: -kv[1])[:k]
+        # canonical tie order (estimate desc, then source asc): candidate
+        # ARRIVAL order varies with the mesh world size (per-device top-k
+        # slices), and reports must render identically across scale
+        # events for the autoscale bit-identity law
+        return sorted(t.items(), key=lambda kv: (-kv[1], kv[0]))[:k]
 
     def acls(self) -> list[int]:
         return list(self._tables)

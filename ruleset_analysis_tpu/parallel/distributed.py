@@ -75,17 +75,32 @@ def init_distributed(
     )
 
 
-def make_global_mesh(axis: str = "data") -> Mesh:
-    """One flat data axis over every device of every host.
+def make_global_mesh(
+    axis: str = "data", *, topology: str = "flat", dcn: int = 0
+) -> Mesh:
+    """The global mesh over every device of every host.
 
-    A flat axis is correct here because all collectives are small register
-    reductions: XLA decomposes the global psum/pmax into an ICI reduction
-    per pod slice plus a DCN exchange between hosts on its own.  (Jobs
-    whose batches must stay host-local would use a ("dcn", "data") 2-axis
-    mesh via jax.experimental.mesh_utils.create_hybrid_device_mesh; not
-    needed for register merging.)
+    ``topology="flat"`` (default): one data axis.  A flat axis is
+    already correct for register merging — XLA decomposes the global
+    psum/pmax into an ICI reduction per pod slice plus a DCN exchange
+    between hosts on its own.
+
+    ``topology="hybrid"``: the explicit two-level DCN x ICI mesh
+    (SNIPPETS.md [2] ``create_hybrid_device_mesh`` idiom) — an outer
+    ``dcn`` axis of ``dcn`` groups (0 = one per process/host) times an
+    inner ICI axis; ``jax.devices()`` orders devices by process, so the
+    row-major reshape puts each host's devices in one outer group
+    exactly as ``create_hybrid_device_mesh`` would.  Batches shard over
+    both axes and the register merges reduce over both; reports stay
+    bit-identical to the flat mesh (parallel/mesh.py pins the law).
+    This is the committed direction for growing world size past one
+    host: the outer axis is where the autoscaler adds hosts.
     """
-    return Mesh(np.asarray(jax.devices()), (axis,))
+    from . import mesh as mesh_lib
+
+    return mesh_lib.make_mesh(
+        list(jax.devices()), axis, topology=topology, dcn=dcn
+    )
 
 
 def local_batch_slice(global_batch_size: int) -> tuple[int, int]:

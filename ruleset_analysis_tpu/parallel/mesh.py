@@ -1,29 +1,98 @@
 """Mesh construction and batch sharding helpers.
 
-One logical axis, ``data``: log lines are independent records (SURVEY.md
-§3b — data parallelism is the reference's single strategy), so the batch
-axis shards across every chip and all state stays replicated.  The code is
-mesh-generic: the same program runs on 1 chip, a v5e-8's 8 chips, or a
-multi-host DCN×ICI mesh (see distributed.py) without modification.
+Log lines are independent records (SURVEY.md §3b — data parallelism is
+the reference's single strategy), so the batch axis shards across every
+chip and all state stays replicated.  Two topologies:
+
+- **flat** (the historical shape): one logical ``data`` axis over every
+  device.
+- **hybrid**: the two-level DCN x ICI idiom (SNIPPETS.md [2],
+  ``jax.experimental.mesh_utils.create_hybrid_device_mesh``): an outer
+  ``dcn`` axis of host-sized groups times an inner ICI axis.  Batches
+  shard over BOTH axes and every register merge reduces over both, so
+  the device-to-slice mapping — and therefore every report — is
+  bit-identical to the flat mesh over the same devices (pinned on CPU as
+  2x4 vs flat 8, tests/test_autoscale.py).  This is how world size grows
+  past one host: the outer axis is the between-host (DCN) dimension the
+  autoscaler will add hosts along, while within-host merges stay on ICI.
+
+The code is mesh-generic either way: helpers derive the batch axes from
+the mesh itself, so the same program runs on 1 chip, a v5e-8's 8 chips,
+or a multi-host DCN x ICI mesh without modification.
 """
 
 from __future__ import annotations
+
+import math
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..errors import AnalysisError
 from ..runtime import faults
 
+#: Outer (between-host) axis name of the hybrid topology.
+DCN_AXIS = "dcn"
 
-def make_mesh(devices: list | None = None, axis: str = "data") -> Mesh:
-    devs = devices if devices is not None else jax.devices()
-    return Mesh(np.asarray(devs), (axis,))
+
+def make_mesh(
+    devices: list | None = None,
+    axis: str = "data",
+    *,
+    topology: str = "flat",
+    dcn: int = 0,
+) -> Mesh:
+    """Build the device mesh for one process's drivers.
+
+    ``topology="hybrid"`` arranges the devices as a ``[dcn, ici]``
+    2-level mesh (axes ``("dcn", axis)``).  ``dcn=0`` auto-sizes the
+    outer axis: the process count when multi-process (one group per
+    host — the ``create_hybrid_device_mesh`` granule), else 2 (the CPU
+    exercise geometry).  Device order is preserved (row-major reshape),
+    which is what keeps batch slice placement identical to the flat
+    mesh.
+    """
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    if topology == "flat":
+        return Mesh(devs, (axis,))
+    if topology != "hybrid":
+        raise AnalysisError(f"unknown mesh topology {topology!r}")
+    n = devs.size
+    if dcn == 0:
+        dcn = jax.process_count() if jax.process_count() > 1 else 2
+    if dcn < 2:
+        raise AnalysisError(
+            f"hybrid mesh needs an outer (dcn) extent >= 2, got {dcn}"
+        )
+    if n % dcn:
+        raise AnalysisError(
+            f"hybrid mesh: {n} devices do not divide into {dcn} dcn groups"
+            " (pass --mesh-dcn that divides the device count)"
+        )
+    return Mesh(devs.reshape(dcn, n // dcn), (DCN_AXIS, axis))
+
+
+def data_axes(mesh: Mesh, axis: str = "data") -> str | tuple[str, ...]:
+    """The batch axes of ``mesh``: every mesh axis (flat: just ``axis``).
+
+    Returned in PartitionSpec/collective form — a bare name for the flat
+    mesh, the ``("dcn", data)`` tuple for the hybrid one — so callers
+    thread one value through ``P(None, axes)`` and ``lax.psum(x, axes)``
+    alike.
+    """
+    names = tuple(mesh.axis_names)
+    return names[0] if len(names) == 1 else names
+
+
+def data_extent(mesh: Mesh) -> int:
+    """Total batch-parallel width (product of every mesh axis extent)."""
+    return int(math.prod(mesh.shape.values()))
 
 
 def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
-    """Column-major [TUPLE_COLS, B] batches shard along B."""
-    return NamedSharding(mesh, P(None, axis))
+    """Column-major [TUPLE_COLS, B] batches shard along B (all axes)."""
+    return NamedSharding(mesh, P(None, data_axes(mesh, axis)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
@@ -31,7 +100,7 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(mesh: Mesh, batch_np: np.ndarray, axis: str = "data") -> jax.Array:
-    """Host [TUPLE_COLS, B] -> device array sharded over the data axis."""
+    """Host [TUPLE_COLS, B] -> device array sharded over the data axes."""
     # chaos site: H2D transfer failure.  Reached from both the sync chunk
     # loop and the prefetch producer's pack closure, so one site exercises
     # both propagation paths (direct raise vs. typed re-raise at consume).
@@ -42,10 +111,12 @@ def shard_batch(mesh: Mesh, batch_np: np.ndarray, axis: str = "data") -> jax.Arr
 def shard_grouped(mesh: Mesh, grouped_np: np.ndarray, axis: str = "data") -> jax.Array:
     """Host [G, TUPLE_COLS, lane] -> device array, lane axis sharded."""
     faults.fire("stream.device_put.fail")
-    return jax.device_put(grouped_np, NamedSharding(mesh, P(None, None, axis)))
+    return jax.device_put(
+        grouped_np, NamedSharding(mesh, P(None, None, data_axes(mesh, axis)))
+    )
 
 
 def pad_batch_size(batch_size: int, mesh: Mesh, axis: str = "data") -> int:
-    """Round batch_size up to a multiple of the data-axis size."""
-    n = mesh.shape[axis]
+    """Round batch_size up to a multiple of the total data width."""
+    n = data_extent(mesh)
     return ((batch_size + n - 1) // n) * n

@@ -333,6 +333,22 @@ _LOCALS = {
 }
 
 
+def _mesh_axes(mesh: Mesh):
+    """Collective/batch axes of ``mesh`` (mesh.data_axes, deferred import:
+    parallel/mesh.py imports the runtime fault registry at module load).
+
+    The flat topology contributes its single data axis; the hybrid
+    DCN x ICI topology contributes the ``("dcn", data)`` tuple —
+    ``lax.psum``/``pmax``/``all_gather`` and ``PartitionSpec`` all
+    accept the tuple form, and reducing over both axes is
+    associative-identical to the flat reduction over the same devices
+    (the bit-identity tests pin it).
+    """
+    from .mesh import data_axes
+
+    return data_axes(mesh)
+
+
 @functools.lru_cache(maxsize=16)
 def _cached_step(
     kind: str,
@@ -402,7 +418,7 @@ def make_parallel_step(
     return _cached_step(
         "flat",
         mesh,
-        cfg.mesh_axis,
+        _mesh_axes(mesh),
         n_keys,
         cfg.sketch.topk_chunk_candidates,
         cfg.exact_counts,
@@ -429,7 +445,7 @@ def make_parallel_step6(
     return _cached_step(
         "v6",
         mesh,
-        cfg.mesh_axis,
+        _mesh_axes(mesh),
         n_keys,
         cfg.sketch.topk_chunk_candidates,
         cfg.exact_counts,
@@ -457,7 +473,7 @@ def make_parallel_step_stacked(
     return _cached_step(
         "stacked",
         mesh,
-        cfg.mesh_axis,
+        _mesh_axes(mesh),
         n_keys,
         cfg.sketch.topk_chunk_candidates,
         cfg.exact_counts,

@@ -64,6 +64,11 @@ from ..errors import AnalysisError, EXIT_REFORM_BUDGET, StallError, exit_code_fo
 from . import faults, obs
 from .metrics import RecoveryMeter
 
+#: internal rc sentinel for a worker retired by a PLANNED scale event
+#: (outside the kernel's exit-status range, so it can never collide with
+#: a real worker rc or -signal)
+SCALE_RC = -1001
+
 #: seconds between heartbeat-file touches
 HB_INTERVAL = 0.5
 #: a member whose heartbeat is older than this is presumed dead (15 missed
@@ -91,6 +96,12 @@ class FormationTimeout(StallError):
 
     A StallError subclass: formation hanging past its bound is the
     distributed face of the same watchdog tier (CLI exit code 6)."""
+
+
+class _PrevGenDone(Exception):
+    """Internal: the previous generation finished while we headed into
+    the next formation (a scale/death signal raced the final worker
+    exits).  The run is complete; this member exits 0."""
 
 
 # ---------------------------------------------------------------------------
@@ -143,6 +154,7 @@ class ElasticRunSpec:
     base_done: set[int]  # shards fully consumed before this generation
     epoch: int  # generation tag stamped into new snapshots
     die_after_batches: int | None = None  # TEST-ONLY crash injection
+    pace_sec: float = 0.0  # TEST-ONLY offered-load throttle (autoscale drills)
 
 
 # ---------------------------------------------------------------------------
@@ -231,6 +243,7 @@ class ElasticSupervisor:
         fault: dict | None = None,
         heartbeat_timeout: int = JAX_HEARTBEAT_SEC,
         coordinator_host: str | None = None,
+        autoscale=None,  # config.AutoscaleConfig | None
     ):
         from ..hostside.wire import is_wire_file
 
@@ -251,6 +264,26 @@ class ElasticSupervisor:
         self.tag = int(tag)
         self.n_procs = int(n_procs)
         self.max_reforms = int(max_reforms)
+        # -- metrics-driven autoscaling (runtime/autoscale.py) ------------
+        # the launcher pool is the PROVISIONED maximum: members outside
+        # the active world park as warm standbys and join the next
+        # formation when a scale-out (or a death) needs them
+        self.autoscale = autoscale
+        self._ladder: list[int] = []
+        self._initial_world = int(n_procs)
+        if autoscale is not None:
+            from .autoscale import world_ladder
+
+            max_w = autoscale.max_world or self.n_procs
+            if max_w > self.n_procs:
+                raise AnalysisError(
+                    f"--autoscale-max {max_w} exceeds the provisioned "
+                    f"launcher pool ({self.n_procs} members)"
+                )
+            self._ladder = world_ladder(autoscale.min_world, max_w)
+            self._initial_world = autoscale.initial_world or autoscale.min_world
+        self._scale_pending: dict | None = None
+        self._scale_anchor: float | None = None
         # children always start fresh from the shared epoch dir; the
         # per-process --resume machinery must not engage
         self.cfg = cfg.replace(resume=False)
@@ -264,6 +297,7 @@ class ElasticSupervisor:
             "heartbeat_timeout": int(heartbeat_timeout),
             "init_timeout": JAX_INIT_TIMEOUT_SEC,
             "fault": fault,
+            "autoscale": autoscale.to_dict() if autoscale is not None else None,
         }
         self.coordinator_host = coordinator_host or os.environ.get(
             "RA_ELASTIC_HOST", "127.0.0.1"
@@ -289,6 +323,15 @@ class ElasticSupervisor:
     @property
     def epoch_dir(self) -> str:
         return os.path.join(self.dir, "epoch")
+
+    def _scale_path(self) -> str:
+        return os.path.join(self.dir, "scale.json")
+
+    def _scale_log_path(self) -> str:
+        return os.path.join(self.dir, "scale-log.jsonl")
+
+    def _metrics_path(self, gen: int, tag: int) -> str:
+        return os.path.join(self._gen_dir(gen), f"metrics-{tag}.jsonl")
 
     # -- membership -------------------------------------------------------
     def _fresh_members(self) -> set[int]:
@@ -322,6 +365,29 @@ class ElasticSupervisor:
         except OSError:
             return set()
 
+    def _mark_done(self, gen: int) -> None:
+        """Success marker: parked standbys (and racing peers heading into
+        the next formation) learn the run completed and exit 0."""
+        d = os.path.join(self._gen_dir(gen), "done")
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, str(self.tag)), "w") as f:
+            f.write("")
+
+    def _done(self, gen: int) -> bool:
+        try:
+            return bool(os.listdir(os.path.join(self._gen_dir(gen), "done")))
+        except OSError:
+            return False
+
+    def _read_scale(self) -> dict | None:
+        """The current scale request (atomic-written by the leader)."""
+        try:
+            with open(self._scale_path(), "r", encoding="utf-8") as f:
+                req = json.load(f)
+        except (OSError, ValueError):
+            return None
+        return req if isinstance(req, dict) else None
+
     def _mark_failed(self, gen: int) -> None:
         d = os.path.join(self._gen_dir(gen), "failed")
         os.makedirs(d, exist_ok=True)
@@ -336,8 +402,35 @@ class ElasticSupervisor:
             return False
 
     # -- formation --------------------------------------------------------
-    def _form(self, gen: int) -> list[int]:
-        """Join the generation-``gen`` barrier; return the agreed world.
+    def _target_world(self, gen: int, avail: list[int]) -> tuple[int, int]:
+        """Leader-side sizing: (active world size, consumed scale seq).
+
+        The active size carries forward from the previous generation's
+        plan, updated by a pending scale request (``scale.json`` with a
+        seq the previous plan has not consumed) and clamped to the
+        members actually available — a death below the requested world
+        runs with what is left, and a parked standby is promoted to
+        backfill a dead active member (warm-standby replacement).
+        """
+        if gen == 0:
+            prev_size, seen = self._initial_world, 0
+        else:
+            try:
+                with open(self._plan_path(gen - 1), "r", encoding="utf-8") as f:
+                    prev = json.load(f)
+                prev_size = len(prev["world"])
+                seen = int(prev.get("scale_seq", 0))
+            except (OSError, ValueError, KeyError):
+                prev_size, seen = self._initial_world, 0
+        req = self._read_scale()
+        if req is not None and int(req.get("seq", 0)) > seen:
+            seen = int(req["seq"])
+            prev_size = int(req["to_world"])
+        hi = self._ladder[-1] if self._ladder else len(avail)
+        return max(1, min(prev_size, len(avail), hi)), seen
+
+    def _form(self, gen: int) -> dict:
+        """Join the generation-``gen`` barrier; return the agreed plan.
 
         Membership rule: wait until every member with a FRESH heartbeat
         has joined this generation — a slow-failing survivor keeps its
@@ -347,6 +440,11 @@ class ElasticSupervisor:
         may still be starting, heartbeat-less).  The member with the
         lowest surviving tag is the leader: it allocates the coordinator
         port and publishes the plan; everyone else polls for it.
+
+        Under ``--autoscale`` the plan splits the pool into an ACTIVE
+        world (``world``, sized by :meth:`_target_world`) and parked
+        ``standby`` members; without it the plan keeps its historical
+        shape (world = everyone, no standby).
         """
         t_form0 = time.perf_counter()
         self._join(gen)
@@ -355,6 +453,10 @@ class ElasticSupervisor:
         while True:
             if os.path.exists(plan_path):
                 break  # someone already published the plan
+            if gen > 0 and self._done(gen - 1):
+                # the previous generation completed while a scale/death
+                # signal sent us here; nobody will ever form this one
+                raise _PrevGenDone()
             fresh = self._fresh_members()
             fresh.add(self.tag)  # our own hb file may lag a beat
             joined = self._joined(gen)
@@ -364,14 +466,19 @@ class ElasticSupervisor:
                 else fresh <= joined
             )
             if ready:
-                world = sorted(joined & fresh | {self.tag})
-                if world and world[0] == self.tag:
+                avail = sorted(joined & fresh | {self.tag})
+                if avail and avail[0] == self.tag:
                     # re-elected coordinator: publish the formation plan
                     plan = {
                         "gen": gen,
-                        "world": world,
+                        "world": avail,
                         "coordinator": f"{self.coordinator_host}:{_free_port()}",
                     }
+                    if self.autoscale is not None:
+                        target, seen = self._target_world(gen, avail)
+                        plan["world"] = avail[:target]
+                        plan["standby"] = avail[target:]
+                        plan["scale_seq"] = seen
                     _atomic_write_json(plan_path, plan)
                     break
                 # not the leader: fall through and poll for the plan (if
@@ -391,14 +498,99 @@ class ElasticSupervisor:
             "elastic.form", t_form0, time.perf_counter(), cat="elastic",
             args={"gen": gen, "world": list(plan["world"])},
         )
-        if self.tag not in plan["world"]:
+        if (
+            self.tag not in plan["world"]
+            and self.tag not in plan.get("standby", [])
+        ):
             # our heartbeat was stale when the plan was cut; aborting THIS
             # member is the safe outcome (the formed world runs without us)
             raise AnalysisError(
                 f"member {self.tag} missed generation {gen} formation "
                 f"(world={plan['world']}); aborting this launcher"
             )
-        return list(plan["world"])
+        return plan
+
+    # -- autoscale actuation ----------------------------------------------
+    def _standby_wait(self, gen: int, plan: dict) -> str:
+        """Park as a warm standby while generation ``gen`` runs without us.
+
+        Returns ``"done"`` when the run completed (this member exits 0)
+        or ``"next"`` when the generation ended another way — a scale
+        request, a peer-marked failure, or an active member's heartbeat
+        going stale — and the next formation needs us at the barrier.
+        """
+        obs.instant(
+            "autoscale.standby", args={"gen": gen, "tag": self.tag}
+        )
+        scale_seq = int(plan.get("scale_seq", 0))
+        active = set(plan["world"])
+        while True:
+            if self._done(gen):
+                return "done"
+            req = self._read_scale()
+            if req is not None and int(req.get("seq", 0)) > scale_seq:
+                return "next"
+            if self._peer_failed(gen):
+                return "next"
+            if active - self._fresh_members():
+                # an active member died outright; the survivors are
+                # about to re-form and the barrier will want us fresh
+                return "next"
+            time.sleep(0.2)
+
+    def _start_controller(self, gen: int, world: list[int], scale_seq: int):
+        """Leader-only: per-generation policy controller (autoscale.py).
+
+        Tails this member's own worker metrics shard — the leader IS
+        rank 0, so that shard carries the ingest gauges of the rank that
+        paces the collective step — and publishes at most one scale
+        request into the rendezvous directory.  Returns None when the
+        surviving world fell off the ladder (deaths below
+        ``--autoscale-min``): scaling pauses until a formation puts the
+        world back on a rung.
+        """
+        from .autoscale import AutoscaleController, append_decision_log
+
+        a = self.autoscale
+        if len(world) not in self._ladder:
+            return None
+        seq = scale_seq + 1
+
+        def log(dec) -> None:
+            # EVERY decision — actuated or observe-only (budget 0, the
+            # rollout drill) — lands in the shared decision log, which
+            # is what _patch_result folds into totals.autoscale
+            append_decision_log(
+                self._scale_log_path(), dec,
+                gen=gen, seq_global=seq, t_wall=round(time.time(), 3),
+            )
+
+        def publish(dec) -> None:
+            _atomic_write_json(self._scale_path(), {
+                "seq": seq,
+                "from_world": dec.from_world,
+                "to_world": dec.to_world,
+                "direction": dec.direction,
+                "reason": dec.reason,
+                "gen": gen,
+                "t_wall": round(time.time(), 3),
+            })
+
+        ctrl = AutoscaleController(
+            a,
+            world=len(world),
+            ladder=self._ladder,
+            metrics_path=self._metrics_path(gen, self.tag),
+            publish=publish,
+            log=log,
+            budget_left=max(0, a.reform_budget - scale_seq),
+            cooldown_anchor=self._scale_anchor,
+        )
+        # scripted drills: entries already actuated by previous
+        # generations' controllers must not re-fire
+        ctrl.engine._plan_fired = min(scale_seq, len(ctrl.engine._plan))
+        ctrl.start()
+        return ctrl
 
     # -- child lifecycle --------------------------------------------------
     def _spawn_worker(self, gen: int) -> tuple[subprocess.Popen, object]:
@@ -429,7 +621,13 @@ class ElasticSupervisor:
         return proc, log
 
     def _watch_worker(
-        self, proc: subprocess.Popen, world: list[int], gen: int
+        self,
+        proc: subprocess.Popen,
+        world: list[int],
+        gen: int,
+        *,
+        scale_seq: int = 0,
+        ctrl=None,
     ) -> int:
         """Wait for the worker; kill it when a peer is known lost.
 
@@ -452,6 +650,41 @@ class ElasticSupervisor:
             rc = proc.poll()
             if rc is not None:
                 return rc
+            if ctrl is not None and ctrl.error is not None:
+                # the policy controller died (e.g. an injected
+                # autoscale.decide fault): no scale request was ever
+                # published, so the safe outcomes are continue-at-old-
+                # world or typed abort — we abort typed, matching the
+                # serve driver's semantics for the same seam
+                proc.kill()
+                proc.wait()
+                err = ctrl.error
+                if isinstance(err, AnalysisError):
+                    raise err
+                raise AnalysisError(f"autoscale controller failed: {err}") from err
+            if self.autoscale is not None:
+                req = self._read_scale()
+                if (
+                    req is not None
+                    and int(req.get("seq", 0)) > scale_seq
+                    and not self._done(gen)
+                ):
+                    # PLANNED retirement: kill the worker exactly like the
+                    # certified death path — the next generation resumes
+                    # from the epoch checkpoint (replaying at most
+                    # checkpoint_every_chunks), report bit-identical.
+                    # A generation already marked done is finishing: the
+                    # request raced the final exits, and killing rank 0
+                    # mid-report-write would lose the run — let the
+                    # worker exit instead.
+                    obs.instant(
+                        "autoscale.retire",
+                        args={"gen": gen, "tag": self.tag, **req},
+                    )
+                    proc.kill()
+                    proc.wait()
+                    self._scale_pending = {"t": time.monotonic(), **req}
+                    return SCALE_RC
             peers = set(world) - {self.tag}
             stale = bool(peers - self._fresh_members())
             failed = self._peer_failed(gen)
@@ -496,27 +729,110 @@ class ElasticSupervisor:
         )
         try:
             gen = 0
+            world: list[int] = []
             while True:
                 try:
-                    world = self._form(gen)
+                    plan = self._form(gen)
+                except _PrevGenDone:
+                    # the run completed while a scale/death signal sent
+                    # us to the next barrier.  If WE held rank 0 of the
+                    # generation that completed, the report is ours to
+                    # return — and it must exist intact: a planned
+                    # retirement that raced the final report write must
+                    # surface as a typed abort, never a silent exit 0
+                    # with the report lost (the standing invariant)
+                    out = self.job["out"]
+                    if not (world and world[0] == self.tag and out):
+                        return 0, None  # completed without us
+                    path = out + ".json"
+                    try:
+                        with open(path, "r", encoding="utf-8") as f:
+                            json.load(f)
+                    except (OSError, ValueError) as e:
+                        raise AnalysisError(
+                            "elastic: run completed but rank 0's report "
+                            f"at {path!r} is missing or torn (a scale/"
+                            "death signal raced the final write); "
+                            "re-run to regenerate it"
+                        ) from e
+                    return 0, self._patch_result(path)
                 except FormationTimeout as e:
                     print(f"elastic: {e}", file=sys.stderr)
                     return exit_code_for(e), None  # stall class (6)
-                if gen > 0:
+                world = list(plan["world"])
+                scale_seq = int(plan.get("scale_seq", 0))
+                if self.tag not in world:
+                    # parked warm standby: heartbeat on, no worker — we
+                    # join the next formation when a scale-out (or a
+                    # death backfill) needs us
+                    self._scale_pending = None
+                    if self._standby_wait(gen, plan) == "done":
+                        return 0, None
+                    gen += 1
+                    continue
+                if self._scale_pending is not None:
+                    # the planned scale event is applied: the new world
+                    # formed and its worker is about to run
+                    rec = {
+                        "applied_seq": int(self._scale_pending.get("seq", scale_seq)),
+                        "gen": gen,
+                        "world": len(world),
+                        "time_to_effect_sec": round(
+                            time.monotonic() - self._scale_pending["t"], 3
+                        ),
+                    }
+                    self._scale_anchor = time.monotonic()
+                    if world[0] == self.tag:
+                        with open(
+                            self._scale_log_path(), "a", encoding="utf-8"
+                        ) as f:
+                            f.write(json.dumps(
+                                {"kind": "applied", **rec},
+                                separators=(",", ":"),
+                            ) + "\n")
+                    obs.metric_event("autoscale.applied", **rec)
+                    self._scale_pending = None
+                if gen > 0 and self.meter.detecting:
                     # the moment the replacement cluster is formed and its
                     # worker is about to run — the recovery is complete
+                    # (planned scale re-formations have no detect window
+                    # and must not pollute the MTTR statistics)
                     self.meter.recovered(world=len(world))
                 proc, log = self._spawn_worker(gen)
+                ctrl = None
+                if self.autoscale is not None and world[0] == self.tag:
+                    ctrl = self._start_controller(gen, world, scale_seq)
                 try:
-                    rc = self._watch_worker(proc, world, gen)
+                    rc = self._watch_worker(
+                        proc, world, gen, scale_seq=scale_seq, ctrl=ctrl
+                    )
                 finally:
                     log.close()
+                    if ctrl is not None:
+                        ctrl.stop()
+                        ctrl.join(timeout=5.0)
                 if rc == 0:
                     self.final_world = world
+                    self._mark_done(gen)
                     out = self.job["out"]
                     if world[0] == self.tag and out:
                         return 0, self._patch_result(out + ".json")
                     return 0, None
+                if rc == SCALE_RC:
+                    req = self._scale_pending or {}
+                    seq_seen = int(req.get("seq", scale_seq + 1))
+                    print(
+                        f"elastic: planned scale event #{seq_seen}: "
+                        f"world {req.get('from_world')}->{req.get('to_world')} "
+                        f"({req.get('reason', '?')}); re-forming",
+                        file=sys.stderr,
+                    )
+                    # chaos seam: actuation failing between retiring the
+                    # old world and forming the new one must be a typed
+                    # abort over an intact epoch checkpoint, never a hang
+                    faults.fire("autoscale.spawn")
+                    gen += 1
+                    continue
                 if rc == DIE_RC:
                     # fault injection: this NODE is simulated dead — take
                     # the heartbeat down with us, abruptly
@@ -553,7 +869,7 @@ class ElasticSupervisor:
                 self._hb.stop()
 
     def _patch_result(self, result_path: str) -> str:
-        """Fold the supervisor's recovery metrics into the report totals."""
+        """Fold the supervisor's recovery + autoscale totals into the report."""
         try:
             with open(result_path, "r", encoding="utf-8") as f:
                 rep = json.load(f)
@@ -561,6 +877,29 @@ class ElasticSupervisor:
             return result_path  # report stands as written
         rec = {"reforms_used": self.reforms_used, **self.meter.summary()}
         rep.setdefault("totals", {})["recovery"] = rec
+        if self.autoscale is not None:
+            from .autoscale import flap_count, read_decision_log
+
+            log = read_decision_log(self._scale_log_path())
+            decisions = [r for r in log if r.get("kind") != "applied"]
+            applied = [r for r in log if r.get("kind") == "applied"]
+            rep["totals"]["autoscale"] = {
+                "scale_events": len(applied),
+                "scale_out": sum(
+                    1 for r in decisions if r.get("direction") == "out"
+                ),
+                "scale_in": sum(
+                    1 for r in decisions if r.get("direction") == "in"
+                ),
+                "flaps": flap_count(
+                    decisions,
+                    cooldown_sec=self.autoscale.cooldown_sec,
+                    sustain_sec=self.autoscale.sustain_sec,
+                ),
+                "final_world": len(self.final_world or []),
+                "decisions": decisions,
+                "applied": applied,
+            }
         _atomic_write_json(result_path, rep)
         return result_path
 
@@ -653,6 +992,21 @@ def _worker_main(elastic_dir: str, tag: int, gen: int) -> int:
             f"epoch snapshot in {epoch_dir!r} covers different shards; "
             "refusing to merge"
         )
+    acfg = job.get("autoscale")
+    if acfg:
+        # arm the metrics snapshotter on this worker's per-generation
+        # shard: the leader supervisor's policy controller tails rank
+        # 0's shard for the canonical backpressure/starvation signals
+        # (autoscale.ingest_signals) — the SAME JSONL an operator's
+        # --metrics-out would carry, one source of truth
+        obs.start_metrics(
+            os.path.join(elastic_dir, f"gen-{gen}", f"metrics-{tag}.jsonl"),
+            every_sec=float(acfg.get("poll_sec", 0.5)),
+        )
+    try:
+        pace = float(os.environ.get("RA_ELASTIC_PACE", "") or 0.0)
+    except ValueError:
+        pace = 0.0
     fault = job.get("fault")
     die = None
     if (
@@ -672,6 +1026,7 @@ def _worker_main(elastic_dir: str, tag: int, gen: int) -> int:
         base_done=done,
         epoch=gen,
         die_after_batches=die,
+        pace_sec=pace,
     )
     report, regs = run_stream_file_distributed(
         packed,

@@ -116,6 +116,15 @@ SITES: dict[str, tuple[str, str]] = {
     "reload.midbatch": (
         "raise", "a live ruleset reload fails mid-swap; the old rule "
         "tensor and counters must stay intact (atomic reload)"),
+    "autoscale.decide": (
+        "raise", "the autoscale policy engine fails at the moment a "
+        "scale decision is issued (decide->actuate seam); the run must "
+        "abort typed or keep serving at the old world, never actuate a "
+        "half-issued scale event"),
+    "autoscale.spawn": (
+        "raise", "actuating a scale event fails (worker spawn / mesh "
+        "re-formation error analog); registers and in-flight batches "
+        "must survive intact — typed abort or bit-identical report"),
 }
 
 

@@ -152,6 +152,17 @@ class RecoveryMeter:
         #: identical report or typed abort), False on any breach
         self.runs: list[bool] = []
 
+    @property
+    def detecting(self) -> bool:
+        """True while a detected failure awaits its recovered() close.
+
+        The elastic supervisor uses this to record a recovery event only
+        for FAILURE re-formations — a planned autoscale re-formation has
+        no detection window, and a zero-length recovery event would
+        pollute the mean-time-to-recover statistics.
+        """
+        return self._t_detect is not None
+
     def detect(self, reason: str = "") -> None:
         if self._t_detect is None:  # first detection wins per event
             self._t_detect = time.perf_counter()

@@ -54,7 +54,7 @@ from collections import deque
 
 import numpy as np
 
-from ..config import AnalysisConfig, ServeConfig
+from ..config import AnalysisConfig, AutoscaleConfig, ServeConfig
 from ..errors import AnalysisError, FeedWorkerError, StallError
 from ..hostside import pack as pack_mod
 from ..hostside.listener import LineQueue, ListenerSet
@@ -62,6 +62,7 @@ from ..models import pipeline
 from ..ops.topk import TopKTracker
 from . import checkpoint as ckpt
 from . import faults, obs
+from .autoscale import PolicyEngine, render_prom, world_ladder
 from .report import diff_report_objs
 
 def merge_register_arrays(items: list[dict[str, np.ndarray]]) -> dict[str, np.ndarray]:
@@ -313,7 +314,19 @@ class ServeDriver:
         *,
         topk: int = 10,
         mesh=None,
+        ascfg: AutoscaleConfig | None = None,
     ):
+        if ascfg is not None and cfg.mesh_shape != "flat":
+            raise AnalysisError(
+                "serve --autoscale resizes a flat single-host mesh; the "
+                "hybrid DCN x ICI topology is the multi-host direction "
+                "the elastic autoscaler grows along (drop --mesh hybrid)"
+            )
+        if ascfg is not None and mesh is not None:
+            raise AnalysisError(
+                "serve --autoscale owns the mesh; an explicit mesh "
+                "argument cannot be resized"
+            )
         if cfg.layout != "flat":
             raise AnalysisError(
                 "serve supports layout='flat' only (the stacked group "
@@ -335,6 +348,21 @@ class ServeDriver:
         self.scfg = scfg
         self.topk = topk
         self._mesh_arg = mesh
+        self.ascfg = ascfg
+        self._engine: PolicyEngine | None = None  # built in run()
+        self.world = 0  # mesh extent, maintained across scale events
+        # canonical-signal sampling state (runs with or without the
+        # engine: the /metrics gauges are one source of truth either way)
+        self.lines_consumed_total = 0
+        self._gauge_lock = threading.Lock()
+        self._as_next = 0.0
+        self._as_last_t: float | None = None
+        self._as_consumed_last = 0
+        self._last_pressure = 0.0
+        self._last_starved = 0.0
+        self._pressure_sec = 0.0
+        self._starved_sec = 0.0
+        self._rate_inst = 0.0
         try:
             self.packed = pack_mod.load_packed(ruleset_prefix)
         except OSError as e:
@@ -453,6 +481,12 @@ class ServeDriver:
                 "ring_windows": ring_windows,
             },
             "quarantine_hits": quarantine_hits,
+            "world": self.world,
+            **(
+                {"autoscale": self._engine.summary()}
+                if self._engine is not None
+                else {}
+            ),
         }
 
     def _sample_metrics(self) -> dict:
@@ -462,6 +496,49 @@ class ServeDriver:
             "reloads": self.reloads,
             "lines_total": self.total_lines,
         }
+
+    def metrics_gauges(self) -> dict:
+        """Flat numeric gauges: ONE source of truth for the autoscale
+        policy, the JSON ``/metrics`` endpoint, and the Prometheus
+        text variant (``/metrics?format=prom``) external scrapers read —
+        the policy and an operator's dashboard can never disagree about
+        what the service saw."""
+        q = self.queue.snapshot()
+        eng = self._engine
+        with self._gauge_lock:
+            g = {
+                "queue_depth": q["depth"],
+                "queue_capacity": q["capacity"],
+                "lines_received_total": q["received"],
+                "drops_total": q["dropped"],
+                "lines_consumed_total": self.lines_consumed_total,
+                "lines_windowed_total": self.total_lines,
+                "lines_per_sec": round(self._rate_inst, 1),
+                "backpressure_frac": round(self._last_pressure, 4),
+                "starved_frac": round(self._last_starved, 4),
+                "backpressure_sec_total": round(self._pressure_sec, 3),
+                "starved_sec_total": round(self._starved_sec, 3),
+            }
+        g.update({
+            "windows_published": self.windows_published,
+            "reloads_total": self.reloads,
+            "reload_errors_total": self.reload_errors,
+            "listeners_alive": self.listeners.alive(),
+            "world": self.world,
+        })
+        if eng is not None:
+            g.update({
+                "autoscale_decisions_total": len(eng.decisions),
+                "autoscale_scale_out_total": sum(
+                    1 for d in eng.decisions if d.direction == "out"
+                ),
+                "autoscale_scale_in_total": sum(
+                    1 for d in eng.decisions if d.direction == "in"
+                ),
+                "autoscale_flaps_total": eng.flaps,
+                "autoscale_budget_left": eng.budget_left,
+            })
+        return g
 
     # -- report access (HTTP + tests) ------------------------------------
     def published(self, name: str) -> dict | None:
@@ -565,18 +642,63 @@ class ServeDriver:
             # must still disarm the fault plan and close the pre-bound
             # listener/HTTP sockets, exactly like a mid-run abort
             self._mesh_lib = mesh_lib
-            mesh = self._mesh_arg or mesh_lib.make_mesh(axis=self.cfg.mesh_axis)
+            self._devices = list(jax.devices())
+            if self.ascfg is not None:
+                a = self.ascfg
+                max_w = a.max_world or len(self._devices)
+                if max_w > len(self._devices):
+                    raise AnalysisError(
+                        f"--autoscale-max {max_w} exceeds the "
+                        f"{len(self._devices)} available devices"
+                    )
+                # worlds are restricted to DIVISORS of the maximum: the
+                # batch geometry is padded to max_w once and never
+                # changes, so every chunk boundary — and therefore the
+                # full report, candidate tables included — is
+                # bit-identical across scale events (DESIGN §13)
+                self._ladder = world_ladder(
+                    a.min_world, max_w, divisors_of=max_w
+                )
+                start = a.initial_world or self._ladder[0]
+                if start not in self._ladder:
+                    raise AnalysisError(
+                        f"--autoscale-initial {start} is not on the world "
+                        f"ladder {self._ladder} (divisors of {max_w})"
+                    )
+                self._fp_world = max_w
+                self.world = start
+                mesh = mesh_lib.make_mesh(
+                    self._devices[:start], axis=self.cfg.mesh_axis
+                )
+                self.batch_size = (
+                    (self.cfg.batch_size + max_w - 1) // max_w
+                ) * max_w
+                self._engine = PolicyEngine(a, world=start, ladder=self._ladder)
+            else:
+                mesh = self._mesh_arg or mesh_lib.make_mesh(
+                    axis=self.cfg.mesh_axis,
+                    topology=self.cfg.mesh_shape,
+                    dcn=self.cfg.mesh_dcn,
+                )
+                self.world = mesh_lib.data_extent(mesh)
+                self._fp_world = self.world
+                self.batch_size = mesh_lib.pad_batch_size(
+                    self.cfg.batch_size, mesh, self.cfg.mesh_axis
+                )
             self.mesh = mesh
-            self.batch_size = mesh_lib.pad_batch_size(
-                self.cfg.batch_size, mesh, self.cfg.mesh_axis
-            )
             if self.packed.bindings_out and self.batch_size < 2:
                 raise AnalysisError(
                     "batch_size must be >= 2 when out-direction "
                     "access-groups are bound"
                 )
-            self._make_step = lambda p: make_parallel_step(mesh, self.cfg, p.n_keys)
-            self._make_step6 = lambda p: make_parallel_step6(mesh, self.cfg, p.n_keys)
+            # closures read self.mesh so a scale event only has to
+            # rebind it before re-installing the ruleset
+            self._make_step = lambda p: make_parallel_step(
+                self.mesh, self.cfg, p.n_keys
+            )
+            self._make_step6 = lambda p: make_parallel_step6(
+                self.mesh, self.cfg, p.n_keys
+            )
             self._dispatch = DispatchTimer()
             self._install_ruleset(self.packed)
             self._v6_digests: dict[int, int] = {}
@@ -591,6 +713,7 @@ class ServeDriver:
                 self._restore_ring()
 
             obs.register_sampler("listener", self._sample_metrics)
+            obs.register_sampler("serve", self.metrics_gauges)
             self.listeners.start()
             self._begin_window()
             self._start_http()
@@ -622,16 +745,23 @@ class ServeDriver:
             "reload_errors": self.reload_errors,
             "quarantine_hits": int(sum(self.cum_quarantine.values())),
             "serve_dir": os.path.abspath(scfg.serve_dir),
+            "world": self.world,
+            **(
+                {"autoscale": self._engine.summary()}
+                if self._engine is not None
+                else {}
+            ),
         }
         self._write_json("summary.json", summary)
         return summary
 
     def _fingerprint(self, packed) -> str:
+        # under autoscale the fingerprint pins the LADDER MAXIMUM, not
+        # the live world: registers are replicated/world-independent, so
+        # a ring checkpoint taken at world 2 must resume at world 8 (and
+        # vice versa) without a mismatch refusal
         return (
-            ckpt.fingerprint(
-                packed, self.cfg, self.mesh.shape[self.cfg.mesh_axis], 0
-            )
-            + "-serve"
+            ckpt.fingerprint(packed, self.cfg, self._fp_world, 0) + "-serve"
         )
 
     def _install_ruleset(self, packed) -> None:
@@ -687,11 +817,18 @@ class ServeDriver:
             np.asarray(out.cand_est),
         )
 
+    def _kind(self, base: str) -> str:
+        # per-world dispatch kinds: each scale rung compiles its own
+        # program, and the compile-vs-sustained split must price each
+        # geometry's first dispatches, not conflate them
+        return base if self._engine is None else f"{base}w{self.world}"
+
     def _run_chunk(self, batch_np: np.ndarray) -> None:
         wire = pack_mod.compact_batch(batch_np)
         dev = self._mesh_lib.shard_batch(self.mesh, wire, self.cfg.mesh_axis)
         self.state, out = self._dispatch.first(
-            "v4", self.step, self.state, self.dev_rules, dev, self.n_chunks
+            self._kind("v4"), self.step, self.state, self.dev_rules, dev,
+            self.n_chunks,
         )
         self.pending.append(out)
         if len(self.pending) > 2:
@@ -701,7 +838,8 @@ class ServeDriver:
     def _run_chunk6(self, batch6_np: np.ndarray) -> None:
         dev = self._mesh_lib.shard_batch(self.mesh, batch6_np, self.cfg.mesh_axis)
         self.state, out = self._dispatch.first(
-            "v6", self.step6, self.state, self.dev_rules6, dev, self.n_chunks
+            self._kind("v6"), self.step6, self.state, self.dev_rules6, dev,
+            self.n_chunks,
         )
         self.pending.append(out)
         if len(self.pending) > 2:
@@ -1118,6 +1256,97 @@ class ServeDriver:
                 self._render_cumulative().to_json()
             )
 
+    # -- metrics-driven elastic autoscaling (DESIGN §13) -------------------
+    def _maybe_autoscale(self) -> None:
+        """Sample the canonical signals; decide and actuate when armed.
+
+        Runs every loop iteration but only samples at the poll cadence.
+        The signals come from the SAME gauges ``/metrics`` exports:
+        pressure = listener queue occupancy (the device tier is behind
+        the offered load), starvation = the serve loop drained the queue
+        and consumed nothing since the last sample (capacity is idle).
+        """
+        now = time.monotonic()
+        if now < self._as_next:
+            return
+        poll = self.ascfg.poll_sec if self.ascfg is not None else 1.0
+        self._as_next = now + poll
+        q = self.queue.snapshot()
+        pressure = q["depth"] / q["capacity"]
+        consumed = self.lines_consumed_total
+        starved = 1.0 if (
+            consumed == self._as_consumed_last and q["depth"] == 0
+        ) else 0.0
+        with self._gauge_lock:
+            if self._as_last_t is not None:
+                dt = now - self._as_last_t
+                self._pressure_sec += pressure * dt
+                self._starved_sec += starved * dt
+                self._rate_inst = (
+                    (consumed - self._as_consumed_last) / dt if dt > 0 else 0.0
+                )
+            self._as_last_t = now
+            self._as_consumed_last = consumed
+            self._last_pressure = pressure
+            self._last_starved = starved
+        if self._engine is None:
+            return
+        dec = self._engine.observe(
+            now=now,
+            pressure=pressure,
+            starvation=starved,
+            gauges={
+                "queue_depth": q["depth"],
+                "queue_capacity": q["capacity"],
+                "lines_consumed_total": consumed,
+                "world": self.world,
+            },
+        )
+        if dec is not None and dec.actuate:
+            self._apply_scale(dec)
+
+    def _apply_scale(self, dec) -> None:
+        """Re-form the serve mesh at the decided world (a planned event).
+
+        No flush, no extra steps: the batcher and v6 staging are host
+        state, and the replicated registers move device-to-device
+        exactly — so chunk boundaries (and the full report, candidates
+        included) are bit-identical to a fixed-world run over the same
+        lines.  Only in-flight candidate outputs drain first (they are
+        device arrays of the outgoing mesh).
+        """
+        import jax
+
+        with obs.span(
+            "autoscale.apply",
+            seq=dec.seq, direction=dec.direction,
+            from_world=dec.from_world, to_world=dec.to_world,
+        ):
+            # chaos seam: actuation failing must leave the old mesh
+            # serving or abort typed — fire before any mutation
+            faults.fire("autoscale.spawn")
+            while self.pending:
+                self._drain(self.pending.popleft())
+            arrays = pipeline.state_to_host(self.state)
+            k = dec.to_world
+            mesh = self._mesh_lib.make_mesh(
+                self._devices[:k], axis=self.cfg.mesh_axis
+            )
+            with self._pub_lock:  # /health reads world
+                self.mesh = mesh
+                self.world = k
+            self._install_ruleset(self.packed)  # re-ship + rebuild steps
+            self.state = pipeline.AnalysisState(**{
+                name: jax.device_put(v, self._mesh_lib.replicated(mesh))
+                for name, v in arrays.items()
+            })
+        self._engine.applied(dec, now=time.monotonic())
+        obs.metric_event(
+            "autoscale.applied",
+            seq=dec.seq, world=k,
+            time_to_effect_sec=dec.evidence.get("time_to_effect_sec"),
+        )
+
     # -- hot reload -------------------------------------------------------
     def _maybe_reload(self) -> None:
         if not self._reload_req.is_set():
@@ -1328,6 +1557,7 @@ class ServeDriver:
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=5.0)
         obs.unregister_sampler("listener")
+        obs.unregister_sampler("serve")
 
     def _loop(self) -> None:
         scfg = self.scfg
@@ -1341,6 +1571,7 @@ class ServeDriver:
             if scfg.stop_after_sec and time.monotonic() - t0 >= scfg.stop_after_sec:
                 break
             self._maybe_reload()
+            self._maybe_autoscale()
             # wall-clock rotation fires under load too, not just when idle
             if next_rotation is not None and time.monotonic() >= next_rotation:
                 self._rotate()
@@ -1360,6 +1591,7 @@ class ServeDriver:
                 for ev in self.batcher.push(line):
                     self._consume_event(ev)
                 self.win_pushed += 1
+                self.lines_consumed_total += 1
                 # lines-mode rotation: deterministic, replayable windows
                 if scfg.window_lines and self.win_pushed >= scfg.window_lines:
                     self._rotate()
@@ -1431,14 +1663,36 @@ def _make_http_handler():
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_text(self, code: int, text: str, ctype: str) -> None:
+            body = text.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):  # noqa: N802 (http.server API)
             drv: ServeDriver = self.server.driver
-            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            raw_path, _, query = self.path.partition("?")
+            path = raw_path.rstrip("/") or "/"
             try:
                 if path == "/health":
                     return self._send(200, drv.health())
                 if path == "/metrics":
-                    return self._send(200, drv._sample_metrics())
+                    if "format=prom" in query:
+                        # Prometheus text exposition of the SAME gauges
+                        # the autoscale policy consumes (one source of
+                        # truth; version 0.0.4 text format)
+                        return self._send_text(
+                            200,
+                            render_prom(
+                                drv.metrics_gauges(), prefix="ra_serve_"
+                            ),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    return self._send(
+                        200, {**drv._sample_metrics(), **drv.metrics_gauges()}
+                    )
                 if path == "/report":
                     obj = drv.published("report")
                     return self._send(200, obj) if obj else self._send(

@@ -15,6 +15,7 @@ never synchronises the host with the in-flight chunk.
 from __future__ import annotations
 
 import os
+import time
 from collections import deque
 from collections.abc import Iterable, Iterator
 
@@ -623,6 +624,7 @@ class _ShardCursorSource:
         assignments: list[tuple[int, str, int]],
         native: bool,
         die_after_batches: int | None = None,
+        pace_sec: float = 0.0,
     ):
         self._packed = packed
         self._assignments = list(assignments)
@@ -633,6 +635,10 @@ class _ShardCursorSource:
         self.cursors = {int(i): int(start) for i, _p, start in self._assignments}
         self.done: set[int] = set()
         self._die_after = die_after_batches
+        #: TEST-ONLY offered-load throttle (RA_ELASTIC_PACE): sleep this
+        #: long per emitted batch so autoscale drills observe a stream
+        #: that lasts long enough to measure and react to
+        self._pace = float(pace_sec or 0.0)
         self._yielded = 0
         self._subs: list[_TextSource] = []
         if native:
@@ -701,6 +707,8 @@ class _ShardCursorSource:
                 # flushes in-flight work first) covers exactly the lines
                 # the cursors claim
                 self.cursors[idx] += n_raw
+                if self._pace:
+                    time.sleep(self._pace)
                 yield batch, n_raw
                 self._yielded += 1
                 # plan-driven twin of die_after_batches: abrupt node
@@ -907,6 +915,7 @@ def run_stream_file_distributed(
             elastic.assignments,
             native,
             die_after_batches=elastic.die_after_batches,
+            pace_sec=getattr(elastic, "pace_sec", 0.0),
         )
     elif n_wire:
         source = _WireFileSource(packed, local_paths)
@@ -942,7 +951,13 @@ def run_stream_file_distributed(
         if wire_weighted:
             _check_weighted_input_config(cfg)
 
-        mesh = dist.make_global_mesh(cfg.mesh_axis)
+        mesh = dist.make_global_mesh(
+            cfg.mesh_axis, topology=cfg.mesh_shape, dcn=cfg.mesh_dcn
+        )
+        # batch axes of the mesh: the flat data axis, or the ("dcn",
+        # data) pair of the hybrid topology — one value for every
+        # PartitionSpec below
+        data_ax = mesh_lib.data_axes(mesh, cfg.mesh_axis)
         pid, nproc = jax.process_index(), jax.process_count()
         global_batch = mesh_lib.pad_batch_size(
             max(cfg.batch_size, 2 if packed.bindings_out else 1) * nproc,
@@ -1023,7 +1038,7 @@ def run_stream_file_distributed(
         else:
             fp = (
                 ckpt.fingerprint(
-                    packed, cfg, mesh.shape[cfg.mesh_axis], local_lane if stacked else 0
+                    packed, cfg, mesh_lib.data_extent(mesh), local_lane if stacked else 0
                 )
                 + f"-dist{pid}of{nproc}"
                 + (("-wirew" if wire_weighted else "-wire") if wire_src else "")
@@ -1189,7 +1204,7 @@ def run_stream_file_distributed(
                     (pack_mod.TUPLE6_COLS, local_batch), dtype=np.uint32
                 )
             )
-            gb = dist.to_global(mesh, b, P(None, cfg.mesh_axis))
+            gb = dist.to_global(mesh, b, P(None, data_ax))
             state, out = _first_dispatch("v6", step6, state, rules6_g, gb, n_chunks)
             pending.append(out)
             if len(pending) > 2:
@@ -1392,7 +1407,7 @@ def run_stream_file_distributed(
                     if wire_weighted
                     else pack_mod.compact_grouped(grouped)
                 )
-                gbatch = dist.to_global(mesh, wire, P(None, None, cfg.mesh_axis))
+                gbatch = dist.to_global(mesh, wire, P(None, None, data_ax))
             state, out = _first_dispatch("v4", step, state, rules, gbatch, n_chunks)
             pending.append(out)
             if len(pending) > 2:
@@ -1421,7 +1436,7 @@ def run_stream_file_distributed(
                         if wire_src or prepacked
                         else pack_mod.compact_batch(batch_np)
                     )
-                    gbatch = dist.to_global(mesh, wire, P(None, cfg.mesh_axis))
+                    gbatch = dist.to_global(mesh, wire, P(None, data_ax))
                 state, out = _first_dispatch("v4", step, state, rules, gbatch, n_chunks)
                 pending.append(out)
                 if len(pending) > 2:
@@ -1482,7 +1497,7 @@ def run_stream_file_distributed(
                         ),
                         dtype=np.uint32,
                     )
-                gb6 = dist.to_global(mesh, b6, P(None, cfg.mesh_axis))
+                gb6 = dist.to_global(mesh, b6, P(None, data_ax))
                 state, out = _first_dispatch("v6", step6, state, rules6_g, gb6, n_chunks)
                 pending.append(out)
                 if len(pending) > 2:
@@ -1702,7 +1717,11 @@ def _run_core(
     coal = None
     try:
         if mesh is None:
-            mesh = mesh_lib.make_mesh(axis=cfg.mesh_axis)
+            mesh = mesh_lib.make_mesh(
+                axis=cfg.mesh_axis,
+                topology=cfg.mesh_shape,
+                dcn=cfg.mesh_dcn,
+            )
         # Flow coalescing (ISSUE 5): compact duplicate evaluation tuples
         # into (unique row, weight) pairs before the device step.  The
         # compactor runs inside the pack stage, so under pipelined ingest
@@ -1711,7 +1730,7 @@ def _run_core(
         coal = coalesce_mod.make_coalescer(
             cfg,
             mesh_lib.pad_batch_size(cfg.batch_size, mesh, cfg.mesh_axis),
-            mesh.shape[cfg.mesh_axis],
+            mesh_lib.data_extent(mesh),
         )
         if coal is not None:
             obs.register_sampler("coalesce", coal.sample_metrics)
@@ -1838,7 +1857,7 @@ def _run_core_impl(
     # wire offsets count evaluation rows, text offsets count raw lines —
     # the same snapshot must not resume across input kinds (nor may a
     # weighted wire file's stored-row offsets resume a plain file's)
-    fp = ckpt.fingerprint(packed, cfg, mesh.shape[cfg.mesh_axis], lane) + (
+    fp = ckpt.fingerprint(packed, cfg, mesh_lib.data_extent(mesh), lane) + (
         ("-wirew" if wire_weighted else "-wire") if wire_src else ""
     )
     lines_consumed = 0

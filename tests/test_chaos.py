@@ -56,6 +56,7 @@ VOLATILE = (
     "ingest",
     "throughput",
     "coalesce",  # raw/unique accounting differs from the off baseline
+    "autoscale",  # scale decisions/timings are wall-clock, not answers
 )
 
 CFG6 = """\

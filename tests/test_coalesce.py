@@ -39,6 +39,7 @@ VOLATILE = (
     "ingest",
     "throughput",
     "coalesce",
+    "autoscale",  # scale decisions/timings are wall-clock, not answers
 )
 
 

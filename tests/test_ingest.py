@@ -40,6 +40,7 @@ VOLATILE = (
     "ingest",
     "throughput",
     "coalesce",  # raw/unique accounting absent from the off baseline
+    "autoscale",  # scale decisions/timings are wall-clock, not answers
 )
 
 

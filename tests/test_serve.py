@@ -51,6 +51,7 @@ VOLATILE = (
     "ingest",
     "throughput",
     "coalesce",
+    "autoscale",  # scale decisions/timings are wall-clock, not answers
 )
 
 def image(obj) -> dict:

@@ -140,6 +140,73 @@ def summarize(path: str, top: int = 5) -> dict:
             ),
             "listener_drops": instants.get("listener.drop", 0),
         }
+    # autoscale attribution: every policy decision is an instant with
+    # its full evidence attached, every serve-side actuation a span —
+    # so "what did the autoscaler do, on what grounds, and how fast did
+    # it take effect" is answerable from the trace alone
+    autoscale = None
+    decides = sorted(
+        (e for e in events
+         if e.get("ph") == "i" and e.get("name") == "autoscale.decide"
+         and "ts" in e),
+        key=lambda e: e["ts"],
+    )
+    applies = [e for e in spans if e["name"] == "autoscale.apply"]
+    if decides or applies:
+        flaps = 0
+        prev = None
+        for e in decides:
+            a = e.get("args") or {}
+            if prev is not None:
+                pa = prev.get("args") or {}
+                window_us = float(a.get("damping_window_sec", 0)) * 1e6
+                if (
+                    a.get("direction") != pa.get("direction")
+                    and e["ts"] - prev["ts"] < window_us
+                ):
+                    flaps += 1
+            prev = e
+        durs = [e.get("dur", 0) for e in applies]
+        autoscale = {
+            "decisions": [
+                {
+                    "at_sec": round((e["ts"] - t_min) / 1e6, 3),
+                    **{
+                        k: (e.get("args") or {}).get(k)
+                        for k in ("seq", "direction", "from_world",
+                                  "to_world", "reason", "actuate")
+                    },
+                    "evidence": (e.get("args") or {}).get("evidence"),
+                }
+                for e in decides
+            ],
+            "scale_out": sum(
+                1 for e in decides
+                if (e.get("args") or {}).get("direction") == "out"
+            ),
+            "scale_in": sum(
+                1 for e in decides
+                if (e.get("args") or {}).get("direction") == "in"
+            ),
+            "flaps": flaps,
+            **(
+                {
+                    # serve-side: the apply span IS the time-to-effect
+                    "applies": len(applies),
+                    "time_to_effect_mean_ms": round(
+                        sum(durs) / len(durs) / 1e3, 3
+                    ),
+                    "time_to_effect_max_ms": round(max(durs) / 1e3, 3),
+                }
+                if durs
+                else {}
+            ),
+            # elastic-side actuation markers (planned retirements and
+            # parked standbys; time-to-effect lands in the report's
+            # totals.autoscale.applied records)
+            "retirements": instants.get("autoscale.retire", 0),
+            "standby_parks": instants.get("autoscale.standby", 0),
+        }
     return {
         "path": path,
         "events": len(events),
@@ -159,6 +226,7 @@ def summarize(path: str, top: int = 5) -> dict:
         "instants": dict(instants),
         **({"coalesce": coalesce} if coalesce else {}),
         **({"serve": serve} if serve else {}),
+        **({"autoscale": autoscale} if autoscale else {}),
     }
 
 
@@ -200,6 +268,35 @@ def render(s: dict) -> str:
             line += f" (pause {', '.join(f'{p:.1f}' for p in sv['reload_pause_ms'])} ms)"
         line += f", {sv['listener_drops']} listener drop(s)"
         out.append(line)
+    if s.get("autoscale"):
+        a = s["autoscale"]
+        line = (
+            f"  autoscale: {a['scale_out']} out / {a['scale_in']} in, "
+            f"{a['flaps']} flap(s)"
+        )
+        if "time_to_effect_mean_ms" in a:
+            line += (
+                f", time-to-effect mean {a['time_to_effect_mean_ms']:.1f} ms"
+                f" max {a['time_to_effect_max_ms']:.1f} ms"
+            )
+        if a.get("retirements"):
+            line += f", {a['retirements']} planned retirement(s)"
+        out.append(line)
+        for d in a["decisions"]:
+            ev = d.get("evidence") or {}
+            grounds = ""
+            sig = ev.get("pressure" if d.get("reason") == "backpressure"
+                         else "starvation")
+            if isinstance(sig, dict):
+                grounds = (
+                    f"  [min {sig.get('min')} >= thr {sig.get('threshold')}"
+                    f" over {ev.get('window_sec')}s]"
+                )
+            out.append(
+                f"    +{d['at_sec']:9.3f}s  #{d.get('seq')} "
+                f"{d.get('direction')} {d.get('from_world')}->"
+                f"{d.get('to_world')} ({d.get('reason')}){grounds}"
+            )
     if s["instants"]:
         marks = ", ".join(f"{k} x{v}" for k, v in sorted(s["instants"].items()))
         out.append(f"  instants: {marks}")
