@@ -20,7 +20,7 @@ import argparse
 import sys
 
 from . import errors
-from .config import AnalysisConfig, SketchConfig
+from .config import AnalysisConfig, DevprofConfig, SketchConfig
 from .hostside import aclparse, oracle, pack, synth
 from .runtime import report as report_mod
 
@@ -193,6 +193,81 @@ def _add_autoscale_flags(p) -> None:
                         "bypassing the signal thresholds")
 
 
+def _arm_devprof(args) -> int | None:
+    """Validate + arm the device attribution capture (``--devprof-out``).
+
+    Returns an exit code on a usage error, None on success (including
+    the disarmed default).  Shared by ``run`` and ``serve``.
+    """
+    if not args.devprof_out:
+        if (
+            args.devprof_steps != DevprofConfig.steps
+            or args.devprof_warmup != DevprofConfig.warmup
+        ):
+            print(
+                "--devprof-steps/--devprof-warmup require --devprof-out",
+                file=sys.stderr,
+            )
+            return 2
+        return None
+    if getattr(args, "distributed", False) or getattr(args, "elastic", False):
+        # single-controller capture only: the profiler window, the
+        # HLO re-derivation, and the trace parse all cover ONE process;
+        # a multi-process job would publish a summary silently missing
+        # every other rank's device time (DESIGN §14)
+        print(
+            "--devprof-out is a single-controller capture and is "
+            "incompatible with --distributed/--elastic; capture on a "
+            "single-process run of the same geometry instead",
+            file=sys.stderr,
+        )
+        return 2
+    if getattr(args, "profile_dir", None):
+        print(
+            "--devprof-out and --profile-dir both drive jax.profiler "
+            "(one trace session per process); pick one — devprof is the "
+            "bounded window with semantic attribution, profile-dir the "
+            "whole-run TensorBoard trace",
+            file=sys.stderr,
+        )
+        return 2
+    from .runtime import devprof
+
+    try:
+        dcfg = DevprofConfig(
+            out_dir=args.devprof_out,
+            steps=args.devprof_steps,
+            warmup=args.devprof_warmup,
+        )
+        devprof.arm(dcfg.out_dir, steps=dcfg.steps, warmup=dcfg.warmup)
+    except (ValueError, errors.AnalysisError, OSError) as e:
+        print(f"error: cannot arm --devprof-out: {e}", file=sys.stderr)
+        return 2
+    return None
+
+
+def _add_devprof_flags(p) -> None:
+    p.add_argument("--devprof-out", default=None, metavar="DIR",
+                   help="device attribution capture (DESIGN §14): arm "
+                        "jax.profiler for a bounded window of device "
+                        "steps after warmup, classify device time by "
+                        "named semantic stage (ra.match/ra.counts/"
+                        "ra.hll/...), and write DIR/devprof.json — also "
+                        "folded into totals.devprof, the metrics JSONL "
+                        "and the /metrics gauges; diff two captures "
+                        "with tools/trace_diff.py (single-controller "
+                        "runs only)")
+    p.add_argument("--devprof-steps", type=int,
+                   default=DevprofConfig.steps, metavar="N",
+                   help="device dispatches to capture (default "
+                        f"{DevprofConfig.steps})")
+    p.add_argument("--devprof-warmup", type=int,
+                   default=DevprofConfig.warmup, metavar="K",
+                   help="dispatches to skip before the window opens, so "
+                        "compile/cache warmup never pollutes the "
+                        f"attribution (default {DevprofConfig.warmup})")
+
+
 def _iter_log_lines(paths: list[str]):
     for path in paths:
         if path == "-":
@@ -270,6 +345,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--coalesce": args.coalesce != "off",
             "--mesh=hybrid": args.mesh != "flat",
             "--autoscale": args.autoscale,
+            "--devprof-out": bool(args.devprof_out),
         }
         # --prefetch-depth is deliberately NOT rejected: like
         # --batch-size it is a tpu-path tuning knob the oracle ignores,
@@ -375,6 +451,11 @@ def _cmd_run(args: argparse.Namespace) -> int:
                     obs.start_trace(args.trace_out, role="main")
                 if args.metrics_out:
                     obs.start_metrics(args.metrics_out, args.metrics_every)
+                    # live device-memory headroom in every snapshot
+                    # (HBM stats where supported, explicit nulls on CPU)
+                    from .runtime.devprof import device_memory_gauges
+
+                    obs.register_sampler("device_mem", device_memory_gauges)
             except OSError as e:
                 # an unwritable trace dir / metrics file is a usage
                 # mistake, reported like every other bad-path flag —
@@ -391,6 +472,9 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 "fixed-membership run has nothing to scale", file=sys.stderr,
             )
             return 2
+        rc = _arm_devprof(args)
+        if rc is not None:
+            return rc
         if args.elastic:
             # Elastic tier: this process becomes a recovery SUPERVISOR
             # (runtime/elastic.py) — --logs is the FULL shard list, the
@@ -613,12 +697,18 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 obs.start_trace(args.trace_out, role="serve")
             if args.metrics_out:
                 obs.start_metrics(args.metrics_out, args.metrics_every)
+                from .runtime.devprof import device_memory_gauges
+
+                obs.register_sampler("device_mem", device_memory_gauges)
         except OSError as e:
             print(
                 f"error: cannot open --trace-out/--metrics-out target: {e}",
                 file=sys.stderr,
             )
             return 2
+    rc = _arm_devprof(args)
+    if rc is not None:
+        return rc
     try:
         # construction binds the listener sockets: a privileged port or
         # an address in use must be the documented clean error, not a
@@ -982,6 +1072,7 @@ def make_parser() -> argparse.ArgumentParser:
                         "prices them; all bit-identical)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here (TensorBoard profile)")
+    _add_devprof_flags(p)
     p.add_argument("--trace-out", default=None, metavar="DIR",
                    help="record pipeline spans (parse/pack/H2D/step/"
                         "checkpoint/elastic) + fault-site instants to "
@@ -1089,6 +1180,7 @@ def make_parser() -> argparse.ArgumentParser:
                    help="chaos drills: see `run --fault-plan` (adds the "
                         "listener.drop/listener.stall/reload.midbatch and "
                         "autoscale.decide/autoscale.spawn sites)")
+    _add_devprof_flags(p)
     p.add_argument("--trace-out", default=None, metavar="DIR",
                    help="record listener/rotation/reload spans (see "
                         "`run --trace-out`)")
@@ -1177,13 +1269,32 @@ def _finalize_obs() -> None:
     AnalysisError still leaves ONE merged timeline — a disarmed run
     exits through two None-checks.
     """
-    from .runtime import obs
+    from .runtime import devprof, obs
 
+    try:
+        cap = devprof.active_capture()
+        if cap is not None and getattr(cap, "json_path", None):
+            print(
+                f"devprof: {cap.json_path} (per-stage attribution; diff "
+                "two captures with tools/trace_diff.py)",
+                file=sys.stderr,
+            )
+    except Exception as e:
+        print(f"warning: devprof summary hint failed: {e}", file=sys.stderr)
     try:
         merged = obs.shutdown()
     except Exception as e:  # a broken merge must not mask the run's rc
         print(f"warning: trace merge failed: {e}", file=sys.stderr)
-        return
+        merged = None
+    finally:
+        # AFTER obs.shutdown: the metrics plane's final snapshot must
+        # still see the devprof/device_mem samplers; this stops any
+        # dangling profiler window (typed-abort path) without parsing —
+        # never a hang or a half-written devprof.json
+        try:
+            devprof.shutdown()
+        except Exception as e:
+            print(f"warning: devprof shutdown failed: {e}", file=sys.stderr)
     if merged:
         print(
             f"trace: {merged} (open in Perfetto or chrome://tracing; "

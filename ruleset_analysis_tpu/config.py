@@ -191,6 +191,36 @@ class AutoscaleConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class DevprofConfig:
+    """Device attribution capture window (runtime/devprof.py, DESIGN §14).
+
+    ``run/serve --devprof-out DIR`` arms one bounded ``jax.profiler``
+    window: dispatches ``1..warmup`` run unprofiled (compile + cache
+    warm), the next ``steps`` dispatches are captured, parsed in-process
+    against the step programs' optimized HLO, and classified by
+    ``jax.named_scope`` stage — the result lands in ``DIR/devprof.json``,
+    ``totals.devprof``, the metrics JSONL, and the ``/metrics`` gauges.
+    Single-controller capture only (the CLI refuses ``--distributed``).
+    """
+
+    out_dir: str
+    steps: int = 16
+    warmup: int = 3
+
+    def __post_init__(self) -> None:
+        if not self.out_dir:
+            raise ValueError("devprof out_dir must be non-empty")
+        if not 1 <= self.steps <= 4096:
+            raise ValueError(
+                f"devprof steps must be in 1..4096, got {self.steps}"
+            )
+        if not 0 <= self.warmup <= 4096:
+            raise ValueError(
+                f"devprof warmup must be in 0..4096, got {self.warmup}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class ServeConfig:
     """Configuration of the always-on ``serve`` mode (runtime/serve.py).
 

@@ -99,32 +99,37 @@ def batch_cols(batch: jax.Array) -> tuple[dict, jax.Array]:
     idempotent, see DESIGN §11).  The layout is static shape information,
     so under jit this is a free Python branch; the wire unpack is three
     shifts and three ands on the VPU — noise next to the match itself.
+
+    Traces under the ``ra.unpack`` named scope (incl. the coalesce
+    weight plane): the unpack's HLO ops carry their stage label for the
+    device attribution plane (runtime/devprof.py, DESIGN §14).
     """
     u32 = jnp.uint32
-    if batch.shape[-2] in (WIRE_COLS, WIREW_COLS):
-        meta = batch[..., W_META, :]
-        ports = batch[..., W_PORTS, :]
-        cols = {
-            "acl": meta & u32(WIRE_MAX_ACLS - 1),
-            "proto": meta >> u32(24),
-            "src": batch[..., W_SRC, :],
-            "sport": ports >> u32(16),
-            "dst": batch[..., W_DST, :],
-            "dport": ports & u32(0xFFFF),
-        }
-        if batch.shape[-2] == WIREW_COLS:
-            return cols, batch[..., W_WEIGHT, :]
-        return cols, (meta >> u32(23)) & u32(1)
-    if batch.shape[-2] == TUPLE_COLS:
-        cols = {
-            "acl": batch[..., T_ACL, :],
-            "proto": batch[..., T_PROTO, :],
-            "src": batch[..., T_SRC, :],
-            "sport": batch[..., T_SPORT, :],
-            "dst": batch[..., T_DST, :],
-            "dport": batch[..., T_DPORT, :],
-        }
-        return cols, batch[..., T_VALID, :]
+    with jax.named_scope("ra.unpack"):
+        if batch.shape[-2] in (WIRE_COLS, WIREW_COLS):
+            meta = batch[..., W_META, :]
+            ports = batch[..., W_PORTS, :]
+            cols = {
+                "acl": meta & u32(WIRE_MAX_ACLS - 1),
+                "proto": meta >> u32(24),
+                "src": batch[..., W_SRC, :],
+                "sport": ports >> u32(16),
+                "dst": batch[..., W_DST, :],
+                "dport": ports & u32(0xFFFF),
+            }
+            if batch.shape[-2] == WIREW_COLS:
+                return cols, batch[..., W_WEIGHT, :]
+            return cols, (meta >> u32(23)) & u32(1)
+        if batch.shape[-2] == TUPLE_COLS:
+            cols = {
+                "acl": batch[..., T_ACL, :],
+                "proto": batch[..., T_PROTO, :],
+                "src": batch[..., T_SRC, :],
+                "sport": batch[..., T_SPORT, :],
+                "dst": batch[..., T_DST, :],
+                "dport": batch[..., T_DPORT, :],
+            }
+            return cols, batch[..., T_VALID, :]
     raise ValueError(
         f"batch field axis must be TUPLE_COLS={TUPLE_COLS} or "
         f"WIRE_COLS={WIRE_COLS}, got shape {batch.shape}"
@@ -145,36 +150,37 @@ def batch_cols6(batch: jax.Array) -> tuple[dict, jax.Array]:
     )
 
     u32 = jnp.uint32
-    if batch.shape[-2] in (WIRE6_COLS, WIRE6W_COLS):
-        meta = batch[..., W6_META, :]
-        ports = batch[..., W6_PORTS, :]
+    with jax.named_scope("ra.unpack"):
+        if batch.shape[-2] in (WIRE6_COLS, WIRE6W_COLS):
+            meta = batch[..., W6_META, :]
+            ports = batch[..., W6_PORTS, :]
+            cols = {
+                "acl": meta & u32(WIRE_MAX_ACLS - 1),
+                "proto": meta >> u32(24),
+                "sport": ports >> u32(16),
+                "dport": ports & u32(0xFFFF),
+            }
+            for i in range(4):
+                cols[f"src{i}"] = batch[..., W6_SRC + i, :]
+                cols[f"dst{i}"] = batch[..., W6_DST + i, :]
+            if batch.shape[-2] == WIRE6W_COLS:
+                return cols, batch[..., W6_WEIGHT, :]
+            return cols, (meta >> u32(23)) & u32(1)
+        if batch.shape[-2] != TUPLE6_COLS:
+            raise ValueError(
+                f"v6 batch field axis must be TUPLE6_COLS={TUPLE6_COLS} or "
+                f"WIRE6_COLS={WIRE6_COLS}, got shape {batch.shape}"
+            )
         cols = {
-            "acl": meta & u32(WIRE_MAX_ACLS - 1),
-            "proto": meta >> u32(24),
-            "sport": ports >> u32(16),
-            "dport": ports & u32(0xFFFF),
+            "acl": batch[..., T6_ACL, :],
+            "proto": batch[..., T6_PROTO, :],
+            "sport": batch[..., T6_SPORT, :],
+            "dport": batch[..., T6_DPORT, :],
         }
         for i in range(4):
-            cols[f"src{i}"] = batch[..., W6_SRC + i, :]
-            cols[f"dst{i}"] = batch[..., W6_DST + i, :]
-        if batch.shape[-2] == WIRE6W_COLS:
-            return cols, batch[..., W6_WEIGHT, :]
-        return cols, (meta >> u32(23)) & u32(1)
-    if batch.shape[-2] != TUPLE6_COLS:
-        raise ValueError(
-            f"v6 batch field axis must be TUPLE6_COLS={TUPLE6_COLS} or "
-            f"WIRE6_COLS={WIRE6_COLS}, got shape {batch.shape}"
-        )
-    cols = {
-        "acl": batch[..., T6_ACL, :],
-        "proto": batch[..., T6_PROTO, :],
-        "sport": batch[..., T6_SPORT, :],
-        "dport": batch[..., T6_DPORT, :],
-    }
-    for i in range(4):
-        cols[f"src{i}"] = batch[..., T6_SRC + i, :]
-        cols[f"dst{i}"] = batch[..., T6_DST + i, :]
-    return cols, batch[..., T6_VALID, :]
+            cols[f"src{i}"] = batch[..., T6_SRC + i, :]
+            cols[f"dst{i}"] = batch[..., T6_DST + i, :]
+        return cols, batch[..., T6_VALID, :]
 
 
 def pad_rules6(rules6: np.ndarray, rule_block: int = RULE_BLOCK) -> np.ndarray:

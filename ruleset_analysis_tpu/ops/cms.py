@@ -12,6 +12,7 @@ Hash constants are fixed module-wide so independently-built sketches merge.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -37,15 +38,23 @@ def cms_bucket(keys: jnp.ndarray, width: int, depth: int) -> jnp.ndarray:
 
 
 def cms_update(cms: jnp.ndarray, keys: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
-    """Scatter-add ``weights`` for ``keys`` into every depth row."""
-    depth, width = cms.shape
-    buckets = cms_bucket(keys, width, depth)  # [d, B]
-    rows = jnp.arange(depth, dtype=_U32)[:, None]
-    flat_idx = (rows * _U32(width) + buckets).reshape(-1)
-    w = jnp.broadcast_to(weights.astype(_U32)[None, :], buckets.shape).reshape(-1)
-    return (
-        cms.reshape(-1).at[flat_idx].add(w, mode="drop").reshape(depth, width)
-    )
+    """Scatter-add ``weights`` for ``keys`` into every depth row.
+
+    Traces under the ``ra.cms`` named scope so the batch-sized scatter —
+    historically the dominant opaque fusion of the device step — carries
+    its stage label in HLO metadata and profiler traces (DESIGN §14).
+    A caller wrapping this in its own ``ra.*`` scope (the talker plane's
+    ``ra.talk``) wins: classification takes the OUTERMOST scope.
+    """
+    with jax.named_scope("ra.cms"):
+        depth, width = cms.shape
+        buckets = cms_bucket(keys, width, depth)  # [d, B]
+        rows = jnp.arange(depth, dtype=_U32)[:, None]
+        flat_idx = (rows * _U32(width) + buckets).reshape(-1)
+        w = jnp.broadcast_to(weights.astype(_U32)[None, :], buckets.shape).reshape(-1)
+        return (
+            cms.reshape(-1).at[flat_idx].add(w, mode="drop").reshape(depth, width)
+        )
 
 
 def cms_query(cms: jnp.ndarray, keys: jnp.ndarray) -> jnp.ndarray:

@@ -10,17 +10,25 @@ carry propagation — per-chunk deltas are < 2**32 by construction, so
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 _U32 = jnp.uint32
 
+# Every formulation traces under the ``ra.counts`` named scope, so HLO
+# ops (and therefore profiler fusions) carry the stage label instead of
+# an opaque ``fusion.N`` — the attribution substrate runtime/devprof.py
+# classifies device time by (DESIGN §14).  Scopes are trace-time only:
+# zero runtime cost, bit-identical outputs.
+
 
 def segment_counts(keys: jnp.ndarray, weights: jnp.ndarray, n_keys: int) -> jnp.ndarray:
     """[B] keys + [B] uint32 weights -> [n_keys] uint32 per-key sums."""
-    return jnp.zeros(n_keys, dtype=_U32).at[keys].add(
-        weights.astype(_U32), mode="drop"
-    )
+    with jax.named_scope("ra.counts"):
+        return jnp.zeros(n_keys, dtype=_U32).at[keys].add(
+            weights.astype(_U32), mode="drop"
+        )
 
 
 def segment_counts_matmul(
@@ -39,9 +47,10 @@ def segment_counts_matmul(
     """
     if keys.shape[0] >= 1 << 24:
         return segment_counts(keys, weights, n_keys)
-    iota = jnp.arange(n_keys, dtype=_U32)
-    onehot = (keys[:, None] == iota[None, :]).astype(jnp.float32)
-    return jnp.dot(weights.astype(jnp.float32), onehot).astype(_U32)
+    with jax.named_scope("ra.counts"):
+        iota = jnp.arange(n_keys, dtype=_U32)
+        onehot = (keys[:, None] == iota[None, :]).astype(jnp.float32)
+        return jnp.dot(weights.astype(jnp.float32), onehot).astype(_U32)
 
 
 def segment_counts_reduce(
@@ -54,9 +63,10 @@ def segment_counts_reduce(
     VPU, no scatter, no MXU.  ``bench_suite.py stage`` measures all three
     formulations; ``AnalysisConfig.counts_impl`` selects per deployment.
     """
-    iota = jnp.arange(n_keys, dtype=_U32)
-    eq = keys[None, :] == iota[:, None]
-    return jnp.sum(jnp.where(eq, weights.astype(_U32), 0), axis=1)
+    with jax.named_scope("ra.counts"):
+        iota = jnp.arange(n_keys, dtype=_U32)
+        eq = keys[None, :] == iota[:, None]
+        return jnp.sum(jnp.where(eq, weights.astype(_U32), 0), axis=1)
 
 
 #: counts_impl name -> formulation (all bit-identical; see the stage bench)
@@ -69,9 +79,10 @@ SEGMENT_COUNTS_IMPLS = {
 
 def add64(lo: jnp.ndarray, hi: jnp.ndarray, delta: jnp.ndarray):
     """(lo, hi) uint32 pair += delta (uint32), exact 64-bit accumulation."""
-    new_lo = lo + delta
-    carry = (new_lo < delta).astype(_U32)
-    return new_lo, hi + carry
+    with jax.named_scope("ra.counts"):
+        new_lo = lo + delta
+        carry = (new_lo < delta).astype(_U32)
+        return new_lo, hi + carry
 
 
 def to_u64(lo: np.ndarray, hi: np.ndarray) -> np.ndarray:

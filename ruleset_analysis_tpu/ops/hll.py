@@ -16,6 +16,7 @@ with linear-counting small-range correction).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -42,13 +43,14 @@ def hll_update(
     (key, value): a coalesced row carrying weight w must update exactly
     as w identical raw lines would (DESIGN §11).
     """
-    p = int(hll.shape[1]).bit_length() - 1
-    h_idx = fmix32(values, seed=_HLL_SEED_IDX)
-    h_rank = fmix32(values, seed=_HLL_SEED_RANK)
-    reg = h_idx >> _U32(32 - p)  # high p bits -> register index
-    rank = clz32(h_rank) + _U32(1)  # 1..33
-    rank = rank * (valid > 0).astype(_U32)  # invalid -> 0 == identity for max
-    return hll.at[keys, reg].max(rank, mode="drop")
+    with jax.named_scope("ra.hll"):
+        p = int(hll.shape[1]).bit_length() - 1
+        h_idx = fmix32(values, seed=_HLL_SEED_IDX)
+        h_rank = fmix32(values, seed=_HLL_SEED_RANK)
+        reg = h_idx >> _U32(32 - p)  # high p bits -> register index
+        rank = clz32(h_rank) + _U32(1)  # 1..33
+        rank = rank * (valid > 0).astype(_U32)  # invalid -> 0 == identity for max
+        return hll.at[keys, reg].max(rank, mode="drop")
 
 
 # ---------------------------------------------------------------------------

@@ -92,21 +92,25 @@ def first_match_rows(
     rules: [R, RULE_COLS] uint32, R padded to a multiple of rule_block
     (padding rows carry NO_ACL and never match).
     """
-    r = rules.shape[0]
-    if r <= rule_block:
-        return _block_min_row(cols, rules, jnp.uint32(0))
-    assert r % rule_block == 0, "pad the rule tensor to a multiple of rule_block"
-    blocks = rules.reshape(r // rule_block, rule_block, rules.shape[1])
+    # ra.match named scope: the kernel's HLO ops (and the scan's while
+    # loop) carry the stage label for the device attribution plane
+    # (runtime/devprof.py, DESIGN §14); trace-time only, zero run cost
+    with jax.named_scope("ra.match"):
+        r = rules.shape[0]
+        if r <= rule_block:
+            return _block_min_row(cols, rules, jnp.uint32(0))
+        assert r % rule_block == 0, "pad the rule tensor to a multiple of rule_block"
+        blocks = rules.reshape(r // rule_block, rule_block, rules.shape[1])
 
-    def body(best, xs):
-        block, base = xs
-        m = _block_min_row(cols, block, base)
-        return jnp.minimum(best, m), None
+        def body(best, xs):
+            block, base = xs
+            m = _block_min_row(cols, block, base)
+            return jnp.minimum(best, m), None
 
-    bases = (jnp.arange(r // rule_block, dtype=_U32) * _U32(rule_block))
-    init = jnp.full(cols["acl"].shape, NO_MATCH, dtype=_U32)
-    best, _ = lax.scan(body, init, (blocks, bases))
-    return best
+        bases = (jnp.arange(r // rule_block, dtype=_U32) * _U32(rule_block))
+        init = jnp.full(cols["acl"].shape, NO_MATCH, dtype=_U32)
+        best, _ = lax.scan(body, init, (blocks, bases))
+        return best
 
 
 def first_match_rows_stacked(
@@ -136,13 +140,14 @@ def match_keys_stacked(
 ) -> jnp.ndarray:
     """Count-key per line for the grouped layout ([G, Bg] in and out)."""
     row = first_match_rows_stacked(cols, rules3d, rule_block)
-    matched = row != NO_MATCH
-    safe_row = jnp.where(matched, row, _U32(0))
-    keys3 = rules3d[:, :, R_KEY].astype(_U32)  # [G, Rmax]
-    rule_key = jnp.take_along_axis(keys3, safe_row, axis=1)
-    acl = jnp.minimum(cols["acl"], _U32(deny_key.shape[0] - 1))
-    deny = deny_key.astype(_U32)[acl]
-    return jnp.where(matched, rule_key, deny)
+    with jax.named_scope("ra.match"):
+        matched = row != NO_MATCH
+        safe_row = jnp.where(matched, row, _U32(0))
+        keys3 = rules3d[:, :, R_KEY].astype(_U32)  # [G, Rmax]
+        rule_key = jnp.take_along_axis(keys3, safe_row, axis=1)
+        acl = jnp.minimum(cols["acl"], _U32(deny_key.shape[0] - 1))
+        deny = deny_key.astype(_U32)[acl]
+        return jnp.where(matched, rule_key, deny)
 
 
 def match_keys(
@@ -158,7 +163,8 @@ def match_keys(
     consumer weights by ``cols["valid"]`` so they contribute nothing.
     """
     row = first_match_rows(cols, rules, rule_block)
-    return rows_to_keys(row, rules, deny_key, cols["acl"])
+    with jax.named_scope("ra.match"):
+        return rows_to_keys(row, rules, deny_key, cols["acl"])
 
 
 def rows_to_keys(

@@ -111,20 +111,23 @@ def first_match_rows6(
     exceeds one block (padding rows carry NO_ACL).  Returns [B] u32,
     NO_MATCH where nothing matches.
     """
-    r = rules6.shape[0]
-    if r <= rule_block:
-        return _block_min_row6(cols, rules6, jnp.uint32(0))
-    assert r % rule_block == 0, "pad the v6 rule tensor to a rule_block multiple"
-    blocks = rules6.reshape(r // rule_block, rule_block, rules6.shape[1])
+    # ra.match6 named scope: stage label for the attribution plane
+    # (runtime/devprof.py, DESIGN §14) — v6 time never hides under v4's
+    with jax.named_scope("ra.match6"):
+        r = rules6.shape[0]
+        if r <= rule_block:
+            return _block_min_row6(cols, rules6, jnp.uint32(0))
+        assert r % rule_block == 0, "pad the v6 rule tensor to a rule_block multiple"
+        blocks = rules6.reshape(r // rule_block, rule_block, rules6.shape[1])
 
-    def body(best, xs):
-        block, base = xs
-        return jnp.minimum(best, _block_min_row6(cols, block, base)), None
+        def body(best, xs):
+            block, base = xs
+            return jnp.minimum(best, _block_min_row6(cols, block, base)), None
 
-    bases = jnp.arange(r // rule_block, dtype=_U32) * _U32(rule_block)
-    init = jnp.full(cols["acl"].shape, NO_MATCH, dtype=_U32)
-    best, _ = lax.scan(body, init, (blocks, bases))
-    return best
+        bases = jnp.arange(r // rule_block, dtype=_U32) * _U32(rule_block)
+        init = jnp.full(cols["acl"].shape, NO_MATCH, dtype=_U32)
+        best, _ = lax.scan(body, init, (blocks, bases))
+        return best
 
 
 def match_keys6(
@@ -135,13 +138,14 @@ def match_keys6(
 ) -> jnp.ndarray:
     """Count-key per v6 line: first-match rule key or the ACL's deny key."""
     row = first_match_rows6(cols, rules6, rule_block)
-    matched = row != NO_MATCH
-    safe_row = jnp.where(matched, row, _U32(0))
-    rule_key = rules6[:, R6_KEY].astype(_U32)[safe_row]
-    deny = deny_key.astype(_U32)[
-        jnp.minimum(cols["acl"], _U32(deny_key.shape[0] - 1))
-    ]
-    return jnp.where(matched, rule_key, deny)
+    with jax.named_scope("ra.match6"):
+        matched = row != NO_MATCH
+        safe_row = jnp.where(matched, row, _U32(0))
+        rule_key = rules6[:, R6_KEY].astype(_U32)[safe_row]
+        deny = deny_key.astype(_U32)[
+            jnp.minimum(cols["acl"], _U32(deny_key.shape[0] - 1))
+        ]
+        return jnp.where(matched, rule_key, deny)
 
 
 def first_match_rows6_stacked(
@@ -170,6 +174,11 @@ def match_keys6_stacked(
 ) -> jnp.ndarray:
     """Count-key per v6 line for the grouped layout ([G, Bg] in and out)."""
     row = first_match_rows6_stacked(cols, rules3d, rule_block)
+    with jax.named_scope("ra.match6"):
+        return _keys_from_rows6_stacked(cols, rules3d, deny_key, row)
+
+
+def _keys_from_rows6_stacked(cols, rules3d, deny_key, row):
     matched = row != NO_MATCH
     safe_row = jnp.where(matched, row, _U32(0))
     keys3 = rules3d[:, :, R6_KEY].astype(_U32)  # [G, R6max]
@@ -188,8 +197,9 @@ def fold_src32(cols: dict) -> jnp.ndarray:
     against the sketches' own error floors.  The fold is deterministic
     and documented so reports can label these ids as v6 digests.
     """
-    h = cols["src0"] * _U32(0x9E3779B1)
-    h = (h ^ cols["src1"]) * _U32(0x85EBCA77)
-    h = (h ^ cols["src2"]) * _U32(0xC2B2AE3D)
-    h = (h ^ cols["src3"]) * _U32(0x27D4EB2F)
-    return h ^ (h >> _U32(15))
+    with jax.named_scope("ra.match6"):
+        h = cols["src0"] * _U32(0x9E3779B1)
+        h = (h ^ cols["src1"]) * _U32(0x85EBCA77)
+        h = (h ^ cols["src2"]) * _U32(0xC2B2AE3D)
+        h = (h ^ cols["src3"]) * _U32(0x27D4EB2F)
+        return h ^ (h >> _U32(15))

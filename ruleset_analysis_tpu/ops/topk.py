@@ -19,6 +19,7 @@ global CMS, not per-chunk counts.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -58,8 +59,9 @@ def talker_chunk_update(
     scatters (the scatter-bound share of the TPU step).  Deterministic:
     the stride is fixed, so resume replays identically.
     """
-    pair = hash_pair(acl, src)
-    new_cms = cms_update(talk_cms, pair, valid)
+    with jax.named_scope("ra.talk"):
+        pair = hash_pair(acl, src)
+        new_cms = cms_update(talk_cms, pair, valid)
     cand = select_candidates(
         new_cms, acl, src, valid, min(k, acl.shape[0]), salt=salt,
         sample_shift=sample_shift,
@@ -104,34 +106,35 @@ def select_candidates(talk_cms, acl, src, valid, k, slots: int = CAND_SLOTS,
     # feed ZERO candidates every chunk — an empty talker report with no
     # warning (ADVICE r4).  Degrade to exact full-batch selection instead;
     # shapes are static so this resolves at trace time.
-    if sample_shift and acl.shape[0] >= (1 << sample_shift):
-        stride = 1 << sample_shift
-        bs = (acl.shape[0] // stride) * stride
-        phase = jnp.asarray(salt, dtype=_U32) % _U32(stride)
+    with jax.named_scope("ra.topk"):
+        if sample_shift and acl.shape[0] >= (1 << sample_shift):
+            stride = 1 << sample_shift
+            bs = (acl.shape[0] // stride) * stride
+            phase = jnp.asarray(salt, dtype=_U32) % _U32(stride)
 
-        def col(x):
-            return jnp.take(x[:bs].reshape(-1, stride), phase, axis=1)
+            def col(x):
+                return jnp.take(x[:bs].reshape(-1, stride), phase, axis=1)
 
-        acl, src, valid = col(acl), col(src), col(valid)
-        k = min(k, acl.shape[0])
-    b = acl.shape[0]
-    pair = hash_pair(acl, src)
-    slot = fmix32(pair ^ jnp.asarray(salt, dtype=_U32)) & _U32(slots - 1)
-    v32 = valid.astype(_U32)
-    cnt = jnp.zeros(slots, dtype=_U32).at[slot].add(v32, mode="drop")
-    iota = lax.broadcasted_iota(jnp.int32, (b,), 0)
-    rep = (
-        jnp.full(slots, -1, dtype=jnp.int32)
-        .at[slot]
-        .max(jnp.where(v32 > 0, iota, -1), mode="drop")
-    )
-    top_cnt, top_slot = lax.top_k(cnt.astype(jnp.int32), k)
-    rep_idx = rep[top_slot]
-    safe = jnp.maximum(rep_idx, 0)
-    ca, cs = acl[safe], src[safe]
-    est = cms_query(talk_cms, hash_pair(ca, cs))
-    ok = ((rep_idx >= 0) & (top_cnt > 0)).astype(_U32)
-    return ca * ok, cs * ok, est * ok
+            acl, src, valid = col(acl), col(src), col(valid)
+            k = min(k, acl.shape[0])
+        b = acl.shape[0]
+        pair = hash_pair(acl, src)
+        slot = fmix32(pair ^ jnp.asarray(salt, dtype=_U32)) & _U32(slots - 1)
+        v32 = valid.astype(_U32)
+        cnt = jnp.zeros(slots, dtype=_U32).at[slot].add(v32, mode="drop")
+        iota = lax.broadcasted_iota(jnp.int32, (b,), 0)
+        rep = (
+            jnp.full(slots, -1, dtype=jnp.int32)
+            .at[slot]
+            .max(jnp.where(v32 > 0, iota, -1), mode="drop")
+        )
+        top_cnt, top_slot = lax.top_k(cnt.astype(jnp.int32), k)
+        rep_idx = rep[top_slot]
+        safe = jnp.maximum(rep_idx, 0)
+        ca, cs = acl[safe], src[safe]
+        est = cms_query(talk_cms, hash_pair(ca, cs))
+        ok = ((rep_idx >= 0) & (top_cnt > 0)).astype(_U32)
+        return ca * ok, cs * ok, est * ok
 
 
 class TopKTracker:

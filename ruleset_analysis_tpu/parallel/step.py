@@ -37,6 +37,7 @@ from ..ops import counts as count_ops
 from ..ops import hll as hll_ops
 from ..ops import topk as topk_ops
 from ..ops.match import RULE_BLOCK, match_keys, match_keys_stacked
+from ..runtime import devprof
 
 _U32 = jnp.uint32
 
@@ -91,11 +92,16 @@ def _merge_tail(
     # counts_delta: the fused pallas kernel already built the local
     # bincount in VMEM (ops/pallas_fused.py) — skip the batch-sized
     # scatter and merge its row-sized result instead.
+    # Stage boundaries carry jax.named_scope labels (ra.counts/ra.cms/
+    # ra.hll inside the ops; ra.talk/ra.merge here) so profiler fusions
+    # attribute to semantic stages instead of fusion.N — the substrate
+    # runtime/devprof.py classifies (DESIGN §14).  Trace-time only.
     if counts_delta is None:
         counts_delta = count_ops.SEGMENT_COUNTS_IMPLS[counts_impl](
             keys, valid, n_keys
         )
-    delta = lax.psum(counts_delta, axis)
+    with jax.named_scope("ra.merge"):
+        delta = lax.psum(counts_delta, axis)
     if exact_counts:
         lo, hi = count_ops.add64(state.counts_lo, state.counts_hi, delta)
     else:
@@ -105,13 +111,16 @@ def _merge_tail(
     delta_hll = hll_ops.hll_update(
         jnp.zeros_like(state.hll), keys, src, valid
     )
-    hll = jnp.maximum(state.hll, lax.pmax(delta_hll, axis))
+    with jax.named_scope("ra.merge"):
+        hll = jnp.maximum(state.hll, lax.pmax(delta_hll, axis))
 
     dt, wt = state.talk_cms.shape
-    delta_talk = cms_ops.cms_update(
-        jnp.zeros((dt, wt), _U32), topk_ops.hash_pair(acl, src), valid
-    )
-    talk_cms = state.talk_cms + lax.psum(delta_talk, axis)
+    with jax.named_scope("ra.talk"):
+        delta_talk = cms_ops.cms_update(
+            jnp.zeros((dt, wt), _U32), topk_ops.hash_pair(acl, src), valid
+        )
+    with jax.named_scope("ra.merge"):
+        talk_cms = state.talk_cms + lax.psum(delta_talk, axis)
     # candidate selection against the *merged* global talker sketch, then
     # gather every device's candidates so the host sees them all, replicated
     # (sample_shift: salt-rotated sampled selection — the sketch covered
@@ -120,9 +129,10 @@ def _merge_tail(
         talk_cms, acl, src, valid, min(topk_k, valid.shape[0]),
         salt=salt, sample_shift=topk_sample_shift,
     )
-    cand_acl = lax.all_gather(ca, axis, tiled=True)
-    cand_src = lax.all_gather(cs, axis, tiled=True)
-    cand_est = lax.all_gather(ce, axis, tiled=True)
+    with jax.named_scope("ra.merge"):
+        cand_acl = lax.all_gather(ca, axis, tiled=True)
+        cand_src = lax.all_gather(cs, axis, tiled=True)
+        cand_est = lax.all_gather(ce, axis, tiled=True)
 
     return (
         AnalysisState(counts_lo=lo, counts_hi=hi, cms=cms, hll=hll, talk_cms=talk_cms),
@@ -257,7 +267,7 @@ def _rules_nbytes(ruleset) -> int:
 _SPECIALIZED_CACHE_MAX = 4
 
 
-def _make_step(mesh: Mesh, local, batch_spec):
+def _make_step(mesh: Mesh, local, batch_spec, label: str = "step"):
     """Shared builder: ruleset-specialized jits with a generic fallback.
 
     Returns ``step(state, ruleset, batch, salt)``.  For each distinct
@@ -269,6 +279,15 @@ def _make_step(mesh: Mesh, local, batch_spec):
     recompile.  Oversized rulesets fall back to one generic jit with the
     ruleset as a traced argument (the pre-round-4 behavior).  Results are
     bit-identical either way; only specialization differs.
+
+    Every dispatch passes through the device attribution plane's seam
+    (``devprof.active_capture()``): disarmed cost is one module-global
+    None-check; armed, the capture window counts dispatches, brackets
+    the ``jax.profiler`` trace, and remembers each program's jit +
+    abstract arguments so its optimized HLO can be re-derived for
+    semantic attribution (runtime/devprof.py, DESIGN §14).  ``label``
+    names the program (``step.flat`` / ``step.v6`` / ``step.stacked``)
+    in the capture summary.
     """
     generic = None
     by_id: dict[tuple, tuple] = {}  # id-key -> (fingerprint, pinned leaves)
@@ -312,6 +331,9 @@ def _make_step(mesh: Mesh, local, batch_spec):
                 if len(by_value) >= _SPECIALIZED_CACHE_MAX:
                     by_value.pop(next(iter(by_value)))  # evict oldest
                 by_value[fp] = fn
+            cap = devprof.active_capture()
+            if cap is not None:
+                return cap.dispatch(label, fn, (state, batch, salt))
             return fn(state, batch, salt)
         if generic is None:
             sharded = _shard_map(
@@ -321,6 +343,9 @@ def _make_step(mesh: Mesh, local, batch_spec):
                 out_specs=(P(), P()),
             )
             generic = jax.jit(sharded, donate_argnums=(0,))
+        cap = devprof.active_capture()
+        if cap is not None:
+            return cap.dispatch(label, generic, (state, ruleset, batch, salt))
         return generic(state, ruleset, batch, salt)
 
     return step
@@ -387,7 +412,7 @@ def _cached_step(
         kwargs["match_impl"] = match_impl
     local = functools.partial(_LOCALS[kind], **kwargs)
     spec = P(None, None, axis) if kind == "stacked" else P(None, axis)
-    return _make_step(mesh, local, spec)
+    return _make_step(mesh, local, spec, label=f"step.{kind}")
 
 
 def _warn_experimental_match(match_impl: str) -> None:

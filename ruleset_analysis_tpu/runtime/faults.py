@@ -125,6 +125,12 @@ SITES: dict[str, tuple[str, str]] = {
         "raise", "actuating a scale event fails (worker spawn / mesh "
         "re-formation error analog); registers and in-flight batches "
         "must survive intact — typed abort or bit-identical report"),
+    "devprof.capture": (
+        "raise", "the in-process jax.profiler capture window fails at "
+        "its start or stop seam (runtime/devprof.py); the run must end "
+        "in a typed abort or complete as a clean no-trace run with a "
+        "bit-identical report — never a hang, a half-written "
+        "devprof.json, or a corrupted report"),
 }
 
 

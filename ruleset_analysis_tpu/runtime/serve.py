@@ -61,7 +61,7 @@ from ..hostside.listener import LineQueue, ListenerSet
 from ..models import pipeline
 from ..ops.topk import TopKTracker
 from . import checkpoint as ckpt
-from . import faults, obs
+from . import devprof, faults, obs
 from .autoscale import PolicyEngine, render_prom, world_ladder
 from .report import diff_report_objs
 
@@ -526,6 +526,11 @@ class ServeDriver:
             "listeners_alive": self.listeners.alive(),
             "world": self.world,
         })
+        # device attribution + live device-memory headroom (DESIGN §14):
+        # numeric gauges reach the prom variant too; unsupported memory
+        # stats stay explicit nulls in the JSON (prom skips non-numerics)
+        g.update(devprof.gauges())
+        g.update(devprof.device_memory_gauges())
         if eng is not None:
             g.update({
                 "autoscale_decisions_total": len(eng.decisions),
@@ -977,6 +982,12 @@ class ServeDriver:
         return json.loads(rep.to_json())
 
     def _rotate(self, *, partial: bool = False) -> None:
+        # a CLOSED devprof capture window parses here, between windows —
+        # never on the ingest path, and never closing an open window
+        # early (runtime/devprof.py; the gauges go live next scrape)
+        cap = devprof.active_capture()
+        if cap is not None:
+            cap.poll()
         with obs.span("serve.rotate", window=self.win_id):
             self._flush_inflight()
             meta = self._window_meta(partial=partial)

@@ -28,7 +28,7 @@ from ..hostside.pack import T_VALID, TUPLE_COLS, LinePacker, PackedRuleset
 from ..hostside.syslog import parse_line
 from ..models import pipeline
 from ..ops.topk import TopKTracker
-from . import faults, obs
+from . import devprof, faults, obs
 
 
 _SENTINEL = object()
@@ -2164,6 +2164,12 @@ def _run_core_impl(
         # raw-vs-unique accounting + the auto decision, in the report so
         # artifacts can state the compaction ratio a run actually saw
         totals["coalesce"] = coal.summary()
+    dp = devprof.finalize_if_armed()
+    if dp is not None:
+        # per-stage device attribution of the capture window (DESIGN
+        # §14); VOLATILE in the identity tests — armed vs disarmed
+        # reports stay bit-identical outside this block
+        totals["devprof"] = dp
     patch = getattr(source, "totals_patch", None)
     if patch is not None:
         # wire input: restore the converter's raw-line accounting once the

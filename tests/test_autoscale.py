@@ -61,6 +61,7 @@ VOLATILE = (
     "coalesce",
     "autoscale",
     "recovery",
+    "devprof",  # capture-window timings, not answers
 )
 
 

@@ -57,6 +57,7 @@ VOLATILE = (
     "throughput",
     "coalesce",  # raw/unique accounting differs from the off baseline
     "autoscale",  # scale decisions/timings are wall-clock, not answers
+    "devprof",  # capture-window timings, not answers
 )
 
 CFG6 = """\

@@ -1,0 +1,545 @@
+"""Device attribution plane (ISSUE 8): scopes, capture windows, diffs.
+
+Assertion tiers:
+
+- **semantic naming** — every ops stage traces under its ``ra.*``
+  named scope: the scope token is present in the optimized HLO text of
+  a tiny jit of each stage, and the full parallel step program's static
+  stage table covers the whole taxonomy;
+- **capture windows** — ``devprof.arm`` + a driver run produce a
+  well-formed ``devprof.json`` across sync/prefetch x text/wire x
+  v4/v6: the requested number of dispatches profiled, >= 90% of
+  measured device time attributed to named stages, the unattributed
+  remainder reported explicitly, and the report BIT-IDENTICAL to the
+  disarmed run;
+- **trace diffs** — ``tools/trace_diff.py`` on two captures emits the
+  per-stage delta table and detects fusion-boundary changes;
+- **failure model** — ``--devprof-out`` under ``--distributed`` is a
+  typed CLI refusal (single-controller capture only), and the
+  ``devprof.capture`` fault site (4 seeded schedules, start + stop
+  seams, sync + prefetch) ends every run in a typed abort or a clean
+  no-trace run with a bit-identical report — never a hang, never a
+  half-written summary.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+pytest.importorskip("jax")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ruleset_analysis_tpu.config import AnalysisConfig, DevprofConfig, SketchConfig
+from ruleset_analysis_tpu.errors import InjectedFault
+from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+from ruleset_analysis_tpu.hostside import wire as wire_mod
+from ruleset_analysis_tpu.runtime import devprof, obs
+from ruleset_analysis_tpu.runtime.stream import (
+    run_stream_file,
+    run_stream_wire,
+)
+
+sys.path.insert(
+    0,
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"),
+)
+import trace_diff  # noqa: E402
+
+VOLATILE = (
+    "elapsed_sec",
+    "lines_per_sec",
+    "compile_sec",
+    "sustained_lines_per_sec",
+    "ingest",
+    "throughput",
+    "coalesce",
+    "autoscale",
+    "devprof",  # the capture block itself (timings, not answers)
+)
+
+
+def report_image(rep) -> dict:
+    j = json.loads(rep.to_json())
+    for k in VOLATILE:
+        j["totals"].pop(k, None)
+    return j
+
+
+@pytest.fixture(autouse=True)
+def _devprof_clean():
+    """Every test starts and ends disarmed, with no dangling profiler."""
+    devprof.shutdown()
+    obs._reset_for_tests()
+    yield
+    devprof.shutdown()
+    obs._reset_for_tests()
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    # DELIBERATELY the same ruleset + sketch geometry as test_obs's
+    # corpus (synth seed 7, 3 ACLs x 8 rules, batch 512, cms 1<<10 x 2,
+    # hll_p 6): the specialized step jit is keyed on the ruleset VALUE,
+    # so the two suites share one XLA compile in a tier-1 process
+    # instead of paying it twice — the 870 s gate is a hard budget.
+    td = tmp_path_factory.mktemp("devprof")
+    cfg_text = synth.synth_config(n_acls=3, rules_per_acl=8, seed=7)
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    tuples = synth.synth_tuples(packed, 2600, seed=18)
+    lines = synth.render_syslog(packed, tuples, seed=19)
+    log = str(td / "dp.log")
+    with open(log, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    wirep = str(td / "dp.rawire")
+    wire_mod.convert_logs(packed, [log], wirep, block_rows=512)
+    prefix = str(td / "packed")
+    pack.save_packed(packed, prefix)
+    return packed, prefix, log, wirep
+
+
+@pytest.fixture(scope="module")
+def wire_baselines(corpus):
+    """Fault-free disarmed reports per prefetch depth (identity anchors).
+
+    Computed once per module: every identity/chaos assertion below
+    compares against these instead of re-running its own baseline —
+    tier-1 wall time is a hard budget (ROADMAP).
+    """
+    packed, _prefix, _log, wirep = corpus
+    return {
+        depth: run_stream_wire(packed, [wirep], _cfg(depth=depth))
+        for depth in (0, 2)
+    }
+
+
+@pytest.fixture(scope="module")
+def corpus6(tmp_path_factory):
+    """Mixed v4+v6 corpus so the capture sees the step.v6 program too."""
+    td = tmp_path_factory.mktemp("devprof6")
+    cfg_text = synth.synth_config(
+        n_acls=2, rules_per_acl=8, seed=27, v6_fraction=0.4
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    t4 = synth.synth_tuples(packed, 1400, seed=28)
+    lines = synth.render_syslog(packed, t4, seed=29)
+    t6 = synth.synth_tuples6(packed, 1000, seed=30)
+    lines += synth.render_syslog6(packed, t6, seed=31)
+    log = str(td / "dp6.log")
+    with open(log, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines) + "\n")
+    return packed, log
+
+
+def _cfg(depth=0, **kw):
+    # geometry matches test_obs._cfg — see the corpus fixture note
+    return AnalysisConfig(
+        batch_size=512,
+        sketch=SketchConfig(cms_width=1 << 10, cms_depth=2, hll_p=6),
+        prefetch_depth=depth,
+        stall_timeout_sec=5.0,
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Semantic naming: scopes present in lowered (optimized) HLO text.
+# ---------------------------------------------------------------------------
+
+
+def _compiled_text(fn, *args) -> str:
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+def test_every_ops_stage_scoped_in_hlo():
+    """Each register-update stage's scope survives into optimized HLO."""
+    from ruleset_analysis_tpu.models import pipeline
+    from ruleset_analysis_tpu.ops import cms as cms_ops
+    from ruleset_analysis_tpu.ops import counts as count_ops
+    from ruleset_analysis_tpu.ops import hll as hll_ops
+    from ruleset_analysis_tpu.ops import topk as topk_ops
+    from ruleset_analysis_tpu.ops.match import match_keys
+    from ruleset_analysis_tpu.ops.match6 import fold_src32, match_keys6
+
+    b = 128
+    keys = jnp.zeros(b, jnp.uint32)
+    w = jnp.ones(b, jnp.uint32)
+    src = jnp.arange(b, dtype=jnp.uint32)
+
+    # counts: every formulation carries the same stage label
+    for impl, fn in count_ops.SEGMENT_COUNTS_IMPLS.items():
+        assert "ra.counts" in _compiled_text(
+            lambda k, v: fn(k, v, 16), keys, w
+        ), f"counts impl {impl} lost its scope"
+    assert "ra.counts" in _compiled_text(
+        count_ops.add64, jnp.zeros(16, jnp.uint32), jnp.zeros(16, jnp.uint32),
+        jnp.ones(16, jnp.uint32),
+    )
+    assert "ra.cms" in _compiled_text(
+        lambda k, v: cms_ops.cms_update(cms_ops.cms_init(256, 2), k, v), keys, w
+    )
+    assert "ra.hll" in _compiled_text(
+        lambda k, s, v: hll_ops.hll_update(hll_ops.hll_init(16, 4), k, s, v),
+        keys, src, w,
+    )
+    txt = _compiled_text(
+        lambda a, s, v: topk_ops.talker_chunk_update(
+            cms_ops.cms_init(256, 2), a, s, v, 8
+        ),
+        keys, src, w,
+    )
+    assert "ra.talk" in txt and "ra.topk" in txt
+
+    # match kernels (flat v4 + v6) and the wire unpack / weight plane
+    rules = jnp.zeros((4, pack.RULE_COLS), jnp.uint32)
+    deny = jnp.zeros(4, jnp.uint32)
+    cols = {
+        n: jnp.zeros(b, jnp.uint32)
+        for n in ("acl", "proto", "src", "sport", "dst", "dport")
+    }
+    assert "ra.match" in _compiled_text(
+        lambda c: match_keys(c, rules, deny), cols
+    )
+    rules6 = jnp.zeros((4, pack.RULE6_COLS), jnp.uint32)
+    cols6 = {
+        n: jnp.zeros(b, jnp.uint32)
+        for n in (
+            "acl", "proto", "sport", "dport",
+            *(f"src{i}" for i in range(4)), *(f"dst{i}" for i in range(4)),
+        )
+    }
+    txt6 = _compiled_text(lambda c: match_keys6(c, rules6, deny), cols6)
+    assert "ra.match6" in txt6
+    assert "ra.match6" in _compiled_text(fold_src32, cols6)
+    wire_batch = jnp.zeros((pack.WIREW_COLS, b), jnp.uint32)
+    assert "ra.unpack" in _compiled_text(
+        lambda x: pipeline.batch_cols(x)[1], wire_batch
+    )
+
+
+def test_scope_classifier_shared():
+    assert devprof.scope_of("jit(f)/jit(main)/ra.counts/scatter-add") == "ra.counts"
+    # outermost wins: the talker plane owns its inner CMS helper
+    assert devprof.scope_of("jit(f)/ra.talk/ra.cms/scatter") == "ra.talk"
+    assert devprof.scope_of("jit(f)/jit(main)/broadcast") is None
+    assert devprof.classify_event_name("fusion.5") is None
+    assert devprof.classify_event_name(
+        "fusion.5", {"long_name": "jit(step)/ra.hll/scatter-max"}
+    ) == "ra.hll"
+    assert devprof.classify_event_name("ra.merge/all-reduce.3") == "ra.merge"
+
+
+def test_parse_hlo_module_index_and_fusions():
+    text = """\
+HloModule jit_step, entry_computation_layout={()->()}
+
+%fused_computation.1 (p0: u32[8]) -> u32[8] {
+  %p0 = u32[8]{0} parameter(0)
+  %mul.1 = u32[8]{0} multiply(%p0, %p0), metadata={op_name="jit(f)/ra.match/mul"}
+  ROOT %add.2 = u32[8]{0} add(%mul.1, %p0), metadata={op_name="jit(f)/ra.counts/add"}
+}
+
+ENTRY %main.9 (a: u32[8]) -> u32[8] {
+  %a = u32[8]{0} parameter(0)
+  %fusion.1 = u32[8]{0} fusion(u32[8]{0} %a), kind=kLoop, calls=%fused_computation.1, metadata={op_name="jit(f)/ra.counts/add"}
+  ROOT %copy.1 = u32[8]{0} copy(u32[8]{0} %fusion.1)
+}
+"""
+    mod = devprof.parse_hlo_module(text)
+    assert mod["entry"]["fusion.1"]["scope"] == "ra.counts"
+    assert mod["entry"]["fusion.1"]["bytes"] == 32
+    assert mod["entry"]["copy.1"]["scope"] is None
+    assert "mul.1" in mod["nested"] and "mul.1" not in mod["entry"]
+    [fu] = mod["fusions"]
+    assert fu["name"] == "fusion.1"
+    assert fu["stages"] == ["ra.counts", "ra.match"]  # cross-stage fusion
+
+
+# ---------------------------------------------------------------------------
+# Capture windows across driver x input x family.
+# ---------------------------------------------------------------------------
+
+
+def _assert_capture_well_formed(summary: dict, steps: int) -> None:
+    assert summary["steps_profiled"] == steps
+    assert 0.0 <= summary["attributed_frac"] <= 1.0
+    # the acceptance bar: >= 90% of device-step time attributed to named
+    # stages, remainder reported explicitly
+    assert summary["attributed_frac"] >= 0.9, summary
+    assert summary["unattributed"]["device_us"] >= 0.0
+    assert summary["stages"], "no stages attributed"
+    assert abs(
+        sum(st["pct"] for st in summary["stages"].values())
+        + summary["unattributed"]["pct"]
+        - 100.0
+    ) < 0.1
+    for prog in summary["programs"].values():
+        assert prog["dispatches"] >= 1
+        assert prog["hlo_instructions"] > 0
+        assert prog["stages_static"]
+        assert prog["flops"] > 0
+        assert prog["bytes_accessed"] > 0
+
+
+def test_capture_sync_text_v4(corpus, tmp_path):
+    packed, _prefix, log, _wirep = corpus
+    devprof.arm(str(tmp_path / "dp"), steps=2, warmup=1)
+    rep = run_stream_file(packed, [log], _cfg(depth=0), native=False)
+    dp = rep.totals["devprof"]
+    _assert_capture_well_formed(dp, 2)
+    # the full step program exercises the whole v4 stage taxonomy
+    static = dp["programs"]["step.flat"]["stages_static"]
+    for stage in ("ra.unpack", "ra.match", "ra.counts", "ra.cms",
+                  "ra.hll", "ra.talk", "ra.topk", "ra.merge"):
+        assert stage in static, f"{stage} missing from the step program"
+    # the summary also landed on disk, identically
+    disk = json.load(open(tmp_path / "dp" / "devprof.json"))
+    assert disk["steps_profiled"] == dp["steps_profiled"]
+    assert disk["stages"].keys() == dp["stages"].keys()
+
+
+def test_capture_prefetch_wire(corpus, tmp_path):
+    packed, _prefix, _log, wirep = corpus
+    devprof.arm(str(tmp_path / "dp"), steps=2, warmup=1)
+    rep = run_stream_wire(packed, [wirep], _cfg(depth=2))
+    dp = rep.totals["devprof"]
+    _assert_capture_well_formed(dp, 2)
+    assert dp["programs"]["step.flat"]["dispatches"] == 2
+
+
+def test_capture_v6_program(corpus6, tmp_path):
+    packed, log = corpus6
+    # warmup 0 + a window longer than the stream: capture EVERY dispatch
+    # of both family programs (v6 chunk cadence is data-dependent)
+    devprof.arm(str(tmp_path / "dp"), steps=64, warmup=0)
+    rep = run_stream_file(packed, [log], _cfg(depth=0), native=False)
+    dp = rep.totals["devprof"]
+    assert dp["steps_profiled"] >= 4
+    assert dp["attributed_frac"] >= 0.9
+    # both family programs were captured and the v6 kernel attributed
+    assert "step.v6" in dp["programs"]
+    assert "ra.match6" in dp["programs"]["step.v6"]["stages_static"]
+
+
+def test_capture_window_shorter_than_stream(corpus, tmp_path):
+    """A stream ending before the window opens reports itself, cleanly."""
+    packed, _prefix, log, _wirep = corpus
+    devprof.arm(str(tmp_path / "dp"), steps=4, warmup=100)
+    rep = run_stream_file(packed, [log], _cfg(depth=0), native=False)
+    dp = rep.totals["devprof"]
+    assert dp["steps_profiled"] == 0
+    assert "note" in dp and "capture window" in dp["note"]
+
+
+def test_report_bit_identical_armed_vs_disarmed(corpus, wire_baselines, tmp_path):
+    packed, _prefix, _log, wirep = corpus
+    base = wire_baselines[2]
+    assert "devprof" not in base.totals
+    devprof.arm(str(tmp_path / "dp"), steps=2, warmup=1)
+    armed = run_stream_wire(packed, [wirep], _cfg(depth=2))
+    assert "devprof" in armed.totals
+    assert report_image(base) == report_image(armed)
+
+
+# ---------------------------------------------------------------------------
+# Trace diffs.
+# ---------------------------------------------------------------------------
+
+
+def _synthetic_capture(step_us: dict, fusion_stages: list, steps=4) -> dict:
+    total = float(sum(step_us.values())) * steps
+    return {
+        "requested_steps": steps,
+        "warmup": 1,
+        "steps_profiled": steps,
+        "backend": "cpu",
+        "devices": 8,
+        "device_us_total": total,
+        "attributed_frac": 1.0,
+        "unattributed": {"device_us": 0.0, "pct": 0.0},
+        "stages": {
+            s: {
+                "device_us": us * steps,
+                "pct": round(100.0 * us * steps / total, 2),
+                "events": 10,
+            }
+            for s, us in step_us.items()
+        },
+        "programs": {
+            "step.flat": {
+                "dispatches": steps,
+                "hlo_instructions": 50,
+                "stages_static": {},
+                "fusions": [{"name": f"fusion.{i}", "stages": st}
+                            for i, st in enumerate(fusion_stages)],
+                "flops": 1e6,
+                "bytes_accessed": 1e6,
+            }
+        },
+        "cross_stage_fusions": [],
+    }
+
+
+def test_trace_diff_delta_table_and_boundaries(tmp_path):
+    a = _synthetic_capture(
+        {"ra.counts": 900.0, "ra.hll": 500.0, "ra.match": 10.0},
+        [["ra.counts"], ["ra.match", "ra.unpack"]],
+    )
+    b = _synthetic_capture(
+        {"ra.counts": 90.0, "ra.hll": 510.0, "ra.match": 10.0, "ra.merge": 40.0},
+        [["ra.counts", "ra.hll"], ["ra.match", "ra.unpack"]],
+        steps=8,
+    )
+    pa, pb = tmp_path / "a", tmp_path / "b"
+    pa.mkdir(), pb.mkdir()
+    json.dump(a, open(pa / "devprof.json", "w"))
+    json.dump(b, open(pb / "devprof.json", "w"))
+    d = trace_diff.diff_captures(
+        trace_diff.load_capture(str(pa)), trace_diff.load_capture(str(pb))
+    )
+    rows = {r["stage"]: r for r in d["stages"]}
+    # normalized per step despite different window lengths
+    assert rows["ra.counts"]["A_us_per_step"] == 900.0
+    assert rows["ra.counts"]["B_us_per_step"] == 90.0
+    assert rows["ra.counts"]["ratio"] == 0.1
+    assert rows["ra.merge"]["ratio"] is None  # stage new in B
+    assert rows["ra.match"]["ratio"] == 1.0
+    # fusion-boundary change: counts fused alone in A, with hll in B
+    assert d["fusion_boundaries_changed"]
+    ch = d["fusion_boundary_changes"]["step.flat"]
+    assert ["ra.counts", "x1"] in ch["only_A"]
+    assert ["ra.counts", "ra.hll", "x1"] in ch["only_B"]
+    # the renderer runs over the machine form
+    text = trace_diff.render(d)
+    assert "ra.counts" in text and "fusion boundaries CHANGED" in text
+    # identical captures: no boundary noise
+    d_same = trace_diff.diff_captures(a, a)
+    assert not d_same["fusion_boundaries_changed"]
+    assert all(r["ratio"] == 1.0 for r in d_same["stages"])
+
+
+@pytest.mark.slow
+def test_trace_diff_cli_on_real_captures(corpus, tmp_path):
+    """Two real captures (counts scatter vs reduce) diff end to end.
+
+    ``slow``: two full captures (each re-lowers + compiles the step for
+    attribution) on top of the synthetic-diff coverage above; the
+    committed DEVPROF_r12_cpu.json artifact exercises the same path.
+    """
+    packed, _prefix, _log, wirep = corpus
+    outs = {}
+    for impl in ("scatter", "reduce"):
+        devprof.shutdown()
+        devprof.arm(str(tmp_path / impl), steps=2, warmup=1, label=impl)
+        run_stream_wire(packed, [wirep], _cfg(depth=0, counts_impl=impl))
+        devprof.finalize_if_armed()
+        outs[impl] = str(tmp_path / impl)
+        assert os.path.exists(os.path.join(outs[impl], "devprof.json"))
+    rc = trace_diff.main([outs["scatter"], outs["reduce"], "--json"])
+    assert rc == 0
+    d = trace_diff.diff_captures(
+        trace_diff.load_capture(outs["scatter"]),
+        trace_diff.load_capture(outs["reduce"]),
+    )
+    assert {r["stage"] for r in d["stages"]} >= {"ra.counts", "ra.hll"}
+    assert d["A"]["label"] == "scatter" and d["B"]["label"] == "reduce"
+
+
+# ---------------------------------------------------------------------------
+# Typed refusals + failure model.
+# ---------------------------------------------------------------------------
+
+
+def test_cli_refuses_distributed_capture(corpus, capsys):
+    from ruleset_analysis_tpu import cli
+
+    _packed, prefix, log, _wirep = corpus
+    rc = cli.main([
+        "run", "--ruleset", prefix, "--logs", log,
+        "--distributed", "--num-processes", "2", "--process-id", "0",
+        "--devprof-out", "/tmp/never",
+    ])
+    assert rc == 2
+    err = capsys.readouterr().err
+    assert "single-controller" in err
+    rc = cli.main([
+        "run", "--ruleset", prefix, "--logs", log, "--devprof-steps", "9",
+    ])
+    assert rc == 2
+    assert "--devprof-out" in capsys.readouterr().err
+
+
+def test_devprof_config_validation():
+    with pytest.raises(ValueError):
+        DevprofConfig(out_dir="")
+    with pytest.raises(ValueError):
+        DevprofConfig(out_dir="x", steps=0)
+    with pytest.raises(ValueError):
+        DevprofConfig(out_dir="x", warmup=-1)
+
+
+def test_device_memory_gauges_graceful():
+    g = devprof.device_memory_gauges()
+    assert set(g) == {
+        "device_mem_bytes_in_use",
+        "device_mem_peak_bytes_in_use",
+        "device_mem_bytes_limit",
+    }
+    for v in g.values():
+        assert v is None or isinstance(v, int)
+
+
+def test_profiler_failure_is_clean_no_trace_run(
+    corpus, wire_baselines, tmp_path, monkeypatch
+):
+    """A REAL profiler start failure degrades to a no-trace run with the
+    report intact — observability must never take down the run."""
+    packed, _prefix, _log, wirep = corpus
+    base = wire_baselines[0]
+
+    def boom(*a, **k):
+        raise RuntimeError("profiler backend unavailable")
+
+    monkeypatch.setattr(jax.profiler, "start_trace", boom)
+    devprof.arm(str(tmp_path / "dp"), steps=2, warmup=1)
+    rep = run_stream_wire(packed, [wirep], _cfg(depth=0))
+    dp = rep.totals["devprof"]
+    assert dp["steps_profiled"] == 0
+    assert "profiler start failed" in dp["error"]
+    assert report_image(rep) == report_image(base)
+    assert not os.path.exists(tmp_path / "dp" / "devprof.json")
+
+
+#: 4 seeded chaos schedules (tier-1): the devprof.capture site fires at
+#: the window's START (hit 1) or STOP (hit 2) seam, under the sync and
+#: prefetch drivers.  Invariant: typed abort (InjectedFault is an
+#: AnalysisError), no hang, no half-written devprof.json, and the NEXT
+#: run in the same process is healthy and bit-identical to baseline.
+_CHAOS = [
+    ("devprof.capture@1,seed=101", 0),
+    ("devprof.capture@2,seed=102", 0),
+    ("devprof.capture@1,seed=103", 2),
+    ("devprof.capture@2,seed=104", 2),
+]
+
+
+@pytest.mark.parametrize("plan,depth", _CHAOS)
+def test_chaos_capture_site(corpus, wire_baselines, tmp_path, plan, depth):
+    packed, _prefix, _log, wirep = corpus
+    out = tmp_path / "dp"
+    devprof.arm(str(out), steps=2, warmup=1)
+    with pytest.raises(InjectedFault):
+        run_stream_wire(packed, [wirep], _cfg(depth=depth, fault_plan=plan))
+    # never a torn summary on the abort path
+    assert not os.path.exists(out / "devprof.json")
+    devprof.shutdown()  # stops any dangling profiler (the stop-seam case)
+    # the process is healthy afterwards: a fresh disarmed run matches
+    # the module's fault-free baseline bit for bit
+    again = run_stream_wire(packed, [wirep], _cfg(depth=depth))
+    assert report_image(again) == report_image(wire_baselines[depth])

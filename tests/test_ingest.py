@@ -41,6 +41,7 @@ VOLATILE = (
     "throughput",
     "coalesce",  # raw/unique accounting absent from the off baseline
     "autoscale",  # scale decisions/timings are wall-clock, not answers
+    "devprof",  # capture-window timings, not answers
 )
 
 

@@ -52,6 +52,7 @@ VOLATILE = (
     "throughput",
     "coalesce",
     "autoscale",  # scale decisions/timings are wall-clock, not answers
+    "devprof",  # capture-window timings, not answers
 )
 
 def image(obj) -> dict:

@@ -23,6 +23,11 @@ answers the attribution question directly from the timeline:
 - **serve** — for traces from the always-on ``serve`` mode: window
   rotation count + latency (``serve.rotate``), reload pauses
   (``serve.reload``), and ``listener.drop`` instants.
+- **devprof** — when a device attribution capture ran in-process
+  (``run/serve --devprof-out``, runtime/devprof.py): per-stage device
+  occupancy %, the top stage by time, and the unattributed fraction,
+  read from the ``devprof.summary`` instant the capture emits onto the
+  obs timeline (the full table lives in the capture's devprof.json).
 
 ``bench_suite.py obs`` imports :func:`summarize` to record stage
 attribution in its artifact; tests assert the merged traces of chaos
@@ -207,6 +212,36 @@ def summarize(path: str, top: int = 5) -> dict:
             "retirements": instants.get("autoscale.retire", 0),
             "standby_parks": instants.get("autoscale.standby", 0),
         }
+    # device attribution capture (run/serve --devprof-out): the capture
+    # pushes one devprof.summary instant whose args are the flat gauges
+    # — per-stage device occupancy, top stage, attributed fraction
+    devprof = None
+    dp_instants = [
+        e for e in events
+        if e.get("ph") == "i" and e.get("name") == "devprof.summary"
+        and isinstance(e.get("args"), dict)
+    ]
+    if dp_instants:
+        a = dp_instants[-1]["args"]  # latest capture wins
+        stage_pcts = {
+            k[len("devprof_pct_"):].replace("_", ".", 1): v
+            for k, v in a.items()
+            if k.startswith("devprof_pct_")
+        }
+        devprof = {
+            "steps_profiled": a.get("devprof_steps_profiled"),
+            "attributed_frac": a.get("devprof_attributed_frac"),
+            "top_stage": a.get("devprof_top_stage"),
+            "top_stage_pct": a.get("devprof_top_stage_pct"),
+            "stage_pct": dict(
+                sorted(stage_pcts.items(), key=lambda kv: -(kv[1] or 0))
+            ),
+            "unattributed_pct": (
+                round(100.0 * (1.0 - a["devprof_attributed_frac"]), 2)
+                if isinstance(a.get("devprof_attributed_frac"), (int, float))
+                else None
+            ),
+        }
     return {
         "path": path,
         "events": len(events),
@@ -227,6 +262,7 @@ def summarize(path: str, top: int = 5) -> dict:
         **({"coalesce": coalesce} if coalesce else {}),
         **({"serve": serve} if serve else {}),
         **({"autoscale": autoscale} if autoscale else {}),
+        **({"devprof": devprof} if devprof else {}),
     }
 
 
@@ -297,6 +333,20 @@ def render(s: dict) -> str:
                 f"{d.get('direction')} {d.get('from_world')}->"
                 f"{d.get('to_world')} ({d.get('reason')}){grounds}"
             )
+    if s.get("devprof"):
+        dp = s["devprof"]
+        af = dp.get("attributed_frac")
+        line = f"  devprof: {dp.get('steps_profiled')} step(s) captured"
+        if af is not None:
+            line += (
+                f", {100 * af:.1f}% attributed "
+                f"({dp.get('unattributed_pct')}% unattributed)"
+            )
+        if dp.get("top_stage"):
+            line += f", top stage {dp['top_stage']} ({dp.get('top_stage_pct')}%)"
+        out.append(line)
+        for name, pct in dp.get("stage_pct", {}).items():
+            out.append(f"    {pct:6.2f}%  {name}")
     if s["instants"]:
         marks = ", ".join(f"{k} x{v}" for k, v in sorted(s["instants"].items()))
         out.append(f"  instants: {marks}")
