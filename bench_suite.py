@@ -1514,6 +1514,253 @@ def bench_steptrace() -> dict:
     }
 
 
+def bench_stepvariants() -> dict:
+    """Scatter-vs-sorted A/B grid + the two §8 inversion capture pairs
+    (ISSUE 9) — the committed evidence is BENCH_SEGSUM_r13_cpu.json.
+
+    **The grid** drives the production batch geometry (batch 1<<16, the
+    default sketch) over one wire corpus through the stream driver for
+    every update formulation variant: ``update_impl scatter/sorted`` x
+    ``topk_every 1/4``.  Per variant: a warmed sustained e2e rate, a
+    bounded devprof capture (per-stage µs/step), a ``trace_diff`` delta
+    table vs the scatter baseline, and an explicit keep/reject verdict —
+    a measured rejection with trace evidence is a valid outcome; a
+    silent keep is not.  Reports are asserted bit-identical between
+    impls at equal cadence (the tentpole's contract).
+
+    **The inversion pairs** close VERDICT Weak #2/#3 with committed
+    trace diffs instead of smells:
+
+    - flat vs stacked at the ~27k-row multifw geometry (the TPU 0.78x
+      inversion, BENCH_SUITE_r05_tpu.json config4): one capture pair +
+      fusion-boundary verdict;
+    - counts scatter vs matmul at the production geometry (stage win /
+      step loss, BENCH_r05_local.json step_variants): one capture pair
+      showing where the step time went instead.
+
+    CPU caveat (DESIGN §14): per-stage ABSOLUTE times on XLA:CPU are
+    profiling-amplified on loop-lowered scatters; shares are indicative
+    and fusion-boundary detection is exact.  The TPU rows re-capture
+    through the same plane at the next tunnel window (ROADMAP item 5).
+    ``RA_SEGSUM_LINES`` overrides the grid corpus size (default 1M).
+    """
+    import os
+    import tempfile
+
+    import jax
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
+    from ruleset_analysis_tpu.hostside import pack as pack_mod
+    from ruleset_analysis_tpu.hostside import wire as wire_mod
+    from ruleset_analysis_tpu.runtime import devprof
+    from ruleset_analysis_tpu.runtime.stream import (
+        run_stream_packed,
+        run_stream_wire,
+    )
+
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "tools"))
+    import trace_diff
+
+    n = int(float(os.environ.get("RA_SEGSUM_LINES", "1e6")))
+    batch = 1 << 16
+    chunks = max(6, (n + batch - 1) // batch)
+    n = chunks * batch
+    cap_steps, cap_warmup = 2, 2
+    packed = _setup()
+    volatile = (
+        "elapsed_sec", "lines_per_sec", "compile_sec",
+        "sustained_lines_per_sec", "ingest", "throughput", "coalesce",
+        "autoscale", "devprof",
+    )
+
+    def image(rep) -> dict:
+        j = json.loads(rep.to_json())
+        for k in volatile:
+            j["totals"].pop(k, None)
+        return j
+
+    def verdict(e2e_ratio: float) -> str:
+        if e2e_ratio >= 1.02:
+            return "keep (measured e2e win on this backend)"
+        if e2e_ratio <= 0.98:
+            return (
+                "reject on cpu (measured e2e loss; stage table + boundary "
+                "diff committed — re-evaluate on TPU, ROADMAP item 5)"
+            )
+        return "neutral on cpu (within noise; TPU decides)"
+
+    with tempfile.TemporaryDirectory() as d:
+        wire_path = os.path.join(d, "segsum.rawire")
+        w = wire_mod.WireWriter(
+            wire_path, wire_mod.ruleset_fingerprint(packed), block_rows=batch
+        )
+        with w:
+            for i in range(chunks):
+                t = np.ascontiguousarray(_tuples(packed, batch, seed=i).T)
+                dense = t[:, t[pack_mod.T_VALID] == 1]
+                w.add(pack_mod.compact_batch(dense), batch, batch - dense.shape[1])
+
+        def cfg_for(update_impl="scatter", topk_every=1, counts_impl="scatter"):
+            return AnalysisConfig(
+                batch_size=batch,
+                sketch=SketchConfig(topk_every=topk_every),
+                update_impl=update_impl,
+                counts_impl=counts_impl,
+            )
+
+        def sustained(cfg) -> tuple[float, object]:
+            run_stream_wire(packed, [wire_path], cfg)  # warm the jit
+            rep = run_stream_wire(packed, [wire_path], cfg)
+            return rep.totals["sustained_lines_per_sec"], rep
+
+        def capture(cfg, name: str) -> dict:
+            devprof.shutdown()
+            out = os.path.join(d, f"cap-{name}")
+            devprof.arm(out, steps=cap_steps, warmup=cap_warmup, label=name)
+            run_stream_wire(packed, [wire_path], cfg)
+            devprof.finalize_if_armed()
+            return trace_diff.load_capture(out)
+
+        variants = [
+            ("scatter", dict()),
+            ("sorted", dict(update_impl="sorted")),
+            ("scatter_topk4", dict(topk_every=4)),
+            ("sorted_topk4", dict(update_impl="sorted", topk_every=4)),
+        ]
+        grid, caps, reps = {}, {}, {}
+        for name, kw in variants:
+            log(f"stepvariants: grid variant {name}")
+            lps, rep = sustained(cfg_for(**kw))
+            caps[name] = capture(cfg_for(**kw), name)
+            reps[name] = rep
+            grid[name] = {"sustained_lines_per_sec": round(lps, 1)}
+        base_lps = grid["scatter"]["sustained_lines_per_sec"]
+        for name, _kw in variants:
+            g = grid[name]
+            ratio = round(g["sustained_lines_per_sec"] / base_lps, 4)
+            g["e2e_ratio_vs_scatter"] = ratio
+            g["step_us_per_step"] = round(
+                caps[name]["device_us_total"]
+                / max(1, caps[name]["steps_profiled"]),
+                1,
+            )
+            g["stages_pct"] = {
+                s: st["pct"] for s, st in caps[name]["stages"].items()
+            }
+            if name != "scatter":
+                diff = trace_diff.diff_captures(caps["scatter"], caps[name])
+                for side in ("A", "B"):
+                    diff[side].pop("path", None)
+                g["trace_diff_vs_scatter"] = diff
+                g["verdict"] = verdict(ratio)
+            else:
+                g["verdict"] = "baseline"
+        # the tentpole's contract: bit-identical reports between impls at
+        # equal selection cadence (full-matrix enforcement lives in
+        # tests/test_sorted_update.py; this pins the bench geometry too)
+        ident = {
+            "sorted_vs_scatter": image(reps["sorted"]) == image(reps["scatter"]),
+            "sorted_vs_scatter_topk4": image(reps["sorted_topk4"])
+            == image(reps["scatter_topk4"]),
+        }
+        if not all(ident.values()):
+            raise AssertionError(f"bit-identity violated: {ident}")
+
+        # ---- inversion pair 1: flat vs stacked @ ~27k rows ----
+        log("stepvariants: inversion pair flat vs stacked @27k rows")
+        packed27 = _setup(n_acls=2, rules_per_acl=1024, firewalls=8)
+        batch27, chunks27 = 1 << 13, 5
+        feeds = [
+            np.ascontiguousarray(_tuples(packed27, batch27, seed=i).T)
+            for i in range(2)
+        ]
+
+        def arrays():
+            for i in range(chunks27):
+                yield feeds[i % len(feeds)]
+
+        def run27(layout, cap_dir=None):
+            cfg = AnalysisConfig(
+                batch_size=batch27,
+                sketch=SketchConfig(cms_width=1 << 14, cms_depth=4),
+                layout=layout,
+            )
+            if cap_dir is None:
+                run_stream_packed(packed27, arrays(), cfg)  # warm
+                t0 = time.perf_counter()
+                run_stream_packed(packed27, arrays(), cfg)
+                return batch27 * chunks27 / (time.perf_counter() - t0)
+            devprof.shutdown()
+            devprof.arm(cap_dir, steps=2, warmup=1, label=f"{layout}-27k")
+            run_stream_packed(packed27, arrays(), cfg)
+            devprof.finalize_if_armed()
+            return trace_diff.load_capture(cap_dir)
+
+        flat_lps = run27("flat")
+        stacked_lps = run27("stacked")
+        cap_flat = run27("flat", os.path.join(d, "cap-flat27"))
+        cap_stacked = run27("stacked", os.path.join(d, "cap-stacked27"))
+        diff_stacked = trace_diff.diff_captures(cap_flat, cap_stacked)
+        for side in ("A", "B"):
+            diff_stacked[side].pop("path", None)
+
+        # ---- inversion pair 2: counts scatter vs matmul, production ----
+        log("stepvariants: inversion pair counts scatter vs matmul")
+        mat_lps, _rep = sustained(cfg_for(counts_impl="matmul"))
+        cap_matmul = capture(cfg_for(counts_impl="matmul"), "counts-matmul")
+        diff_matmul = trace_diff.diff_captures(caps["scatter"], cap_matmul)
+        for side in ("A", "B"):
+            diff_matmul[side].pop("path", None)
+        devprof.shutdown()
+
+    sorted_ratio = grid["sorted"]["e2e_ratio_vs_scatter"]
+    return {
+        "metric": "segsum_sorted_over_scatter_e2e",
+        "value": sorted_ratio,
+        "unit": "sustained e2e ratio, update_impl=sorted vs scatter",
+        "vs_baseline": sorted_ratio,
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "devices": len(jax.devices()),
+            "lines": n,
+            "chunks": chunks,
+            "batch": batch,
+            "capture_steps": cap_steps,
+            "grid": grid,
+            "report_identity": ident,
+            "inversions": {
+                "stacked_27k": {
+                    "flat_rows": int(packed27.rules.shape[0]),
+                    "batch": batch27,
+                    "cpu_flat_lines_per_sec": round(flat_lps, 1),
+                    "cpu_stacked_lines_per_sec": round(stacked_lps, 1),
+                    "cpu_stacked_over_flat": round(
+                        stacked_lps / max(flat_lps, 1.0), 4
+                    ),
+                    "tpu_committed_ratio": 0.78,
+                    "trace_diff_flat_vs_stacked": diff_stacked,
+                    "fusion_boundaries_changed": diff_stacked[
+                        "fusion_boundaries_changed"
+                    ],
+                },
+                "counts_matmul": {
+                    "cpu_matmul_lines_per_sec": round(mat_lps, 1),
+                    "cpu_matmul_over_scatter": round(mat_lps / base_lps, 4),
+                    "trace_diff_scatter_vs_matmul": diff_matmul,
+                    "fusion_boundaries_changed": diff_matmul[
+                        "fusion_boundaries_changed"
+                    ],
+                },
+            },
+            "cpu_caveat": (
+                "XLA:CPU profiling amplifies loop-lowered scatters and "
+                "sorts; shares indicative, boundary detection exact; TPU "
+                "re-capture at the next tunnel window (ROADMAP item 5)"
+            ),
+        },
+    }
+
+
 def bench_coalesce() -> dict:
     """Flow-coalescing guard (ISSUE 5): skewed speedup + uniform overhead.
 
@@ -2129,6 +2376,7 @@ BENCHES = {
     "autoscale": bench_autoscale,
     "obs": bench_obs,
     "steptrace": bench_steptrace,
+    "stepvariants": bench_stepvariants,
     "coalesce": bench_coalesce,
     "convert": bench_convert,
     "v6": bench_v6,

@@ -287,6 +287,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 cms_depth=args.cms_depth,
                 hll_p=args.hll_p,
                 topk_sample_shift=args.topk_sample_shift,
+                topk_every=args.topk_every,
             ),
             exact_counts=args.exact_counts,
             register_memory_budget_bytes=args.register_budget_mb << 20,
@@ -295,6 +296,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             report_every_chunks=args.report_every,
             match_impl=args.experimental_match_impl or args.match_impl,
             counts_impl=args.counts_impl,
+            update_impl=args.update_impl,
             layout=args.layout,
             stacked_lane=args.stacked_lane,
             prefetch_depth=args.prefetch_depth,
@@ -346,6 +348,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--mesh=hybrid": args.mesh != "flat",
             "--autoscale": args.autoscale,
             "--devprof-out": bool(args.devprof_out),
+            "--update-impl=sorted": args.update_impl != "scatter",
+            "--topk-every": args.topk_every != 1,
         }
         # --prefetch-depth is deliberately NOT rejected: like
         # --batch-size it is a tpu-path tuning knob the oracle ignores,
@@ -657,10 +661,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 cms_width=args.cms_width,
                 cms_depth=args.cms_depth,
                 hll_p=args.hll_p,
+                topk_every=args.topk_every,
             ),
             register_memory_budget_bytes=args.register_budget_mb << 20,
             resume=args.resume,
             stall_timeout_sec=args.stall_timeout,
+            update_impl=args.update_impl,
             fault_plan=_resolve_fault_plan(args.fault_plan),
         )
         ascfg = _autoscale_config(args)
@@ -1070,6 +1076,21 @@ def make_parser() -> argparse.ArgumentParser:
                    default="scatter",
                    help="exact-counts formulation (bench_suite.py stage "
                         "prices them; all bit-identical)")
+    p.add_argument("--update-impl", choices=["scatter", "sorted"],
+                   default="scatter",
+                   help="register-update formulation (DESIGN §15): scatter "
+                        "= batch-sized scatter updates; sorted = sort the "
+                        "batch's register keys once and segment-reduce "
+                        "over the sorted runs (the MapReduce-combiner "
+                        "sort half; weight-linear, composes with "
+                        "--coalesce).  Reports are bit-identical; "
+                        "bench_suite.py stepvariants prices both")
+    p.add_argument("--topk-every", type=int, default=1, metavar="N",
+                   help="run talker candidate SELECTION every Nth chunk "
+                        "only (the talker sketch still absorbs every "
+                        "line; heavy hitters recur, so deferred selection "
+                        "still surfaces them — trims the candidate-table "
+                        "share of the device step; 1 = every chunk)")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here (TensorBoard profile)")
     _add_devprof_flags(p)
@@ -1175,6 +1196,13 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--topk", type=int, default=10)
     p.add_argument("--stall-timeout", type=float,
                    default=AnalysisConfig.stall_timeout_sec, metavar="SEC")
+    p.add_argument("--update-impl", choices=["scatter", "sorted"],
+                   default="scatter",
+                   help="register-update formulation (see `run "
+                        "--update-impl`; bit-identical windows)")
+    p.add_argument("--topk-every", type=int, default=1, metavar="N",
+                   help="defer talker candidate selection to every Nth "
+                        "chunk (see `run --topk-every`)")
     _add_autoscale_flags(p)
     p.add_argument("--fault-plan", default=None, metavar="SPEC",
                    help="chaos drills: see `run --fault-plan` (adds the "

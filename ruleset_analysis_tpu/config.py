@@ -73,6 +73,15 @@ class SketchConfig:
     #: feeds it next chunk).  0 = select from the full batch (bit-exact
     #: pre-round-4 candidates).
     topk_sample_shift: int = 0
+    #: Deferred candidate SELECTION cadence: run the candidate table +
+    #: top_k on every Nth chunk only (Space-Saving spirit — heavy
+    #: hitters recur across chunks, so a chunk-stride sample still
+    #: surfaces them).  The talker CMS absorbs EVERY line regardless, so
+    #: reported estimates are untouched; skipped chunks feed est=0
+    #: candidates the host tracker ignores.  Deterministic in the chunk
+    #: salt: resume replays the same selection schedule.  1 = select
+    #: every chunk (the historical behavior, byte-identical HLO).
+    topk_every: int = 1
 
     def __post_init__(self) -> None:
         if self.cms_width < 2 or self.cms_width & (self.cms_width - 1):
@@ -90,6 +99,10 @@ class SketchConfig:
         if not 0 <= self.topk_sample_shift <= 8:
             raise ValueError(
                 f"topk_sample_shift must be in 0..8, got {self.topk_sample_shift}"
+            )
+        if not 1 <= self.topk_every <= 4096:
+            raise ValueError(
+                f"topk_every must be in 1..4096, got {self.topk_every}"
             )
 
     @property
@@ -332,6 +345,18 @@ class AnalysisConfig:
     #: deployment hardware — the TPU trace shows the scatter at 9.2 ms of
     #: a 60 ms step, so flipping this is a measured-default candidate.
     counts_impl: str = "scatter"
+    #: Register-update formulation (DESIGN §15): "scatter" (five
+    #: batch-sized scatter-add/scatter-max updates per step — the
+    #: historical path) or "sorted" (sort the batch's register keys once
+    #: with lax.sort, then segment-sum / segment-max over the sorted
+    #: runs — the MapReduce-combiner sort half, ops/sorted_update.py).
+    #: Bit-identical reports either way (uint32 add/max associativity);
+    #: ``bench_suite.py stepvariants`` prices both on the deployment
+    #: hardware.  Composes with counts_impl (matmul/reduce counts are
+    #: already scatter-free and keep their formulation) and with
+    #: coalesced/weighted inputs (the sorted updates are weight-linear
+    #: by construction).
+    update_impl: str = "scatter"
     #: Batch layout: "flat" scans every line against the whole rule
     #: tensor; "stacked" buckets lines by ACL host-side (pack.GroupBuffer)
     #: and vmaps the match over per-ACL rule slabs — O(max slab rows)
@@ -390,6 +415,23 @@ class AnalysisConfig:
             raise ValueError(
                 "counts_impl must be 'scatter', 'matmul', or 'reduce', "
                 f"got {self.counts_impl!r}"
+            )
+        if self.update_impl not in ("scatter", "sorted"):
+            raise ValueError(
+                "update_impl must be 'scatter' or 'sorted', "
+                f"got {self.update_impl!r}"
+            )
+        if self.update_impl == "sorted" and self.match_impl == "pallas_fused":
+            # the fused kernel computes its count histogram in-kernel
+            # (its own scatter tail), so the sorted counts formulation
+            # would silently never run — and the kernel is not
+            # weight-linear, so the combination is unsafe for the
+            # weighted inputs the sorted path exists to serve
+            raise ValueError(
+                "update_impl='sorted' is incompatible with the "
+                "experimental match_impl='pallas_fused' (the fused kernel "
+                "builds counts in-VMEM with its own scatter tail); use "
+                "the default match_impl"
             )
         if self.match_impl == "pallas_fused" and self.counts_impl != "scatter":
             # the fused kernel produces the counts delta itself (in-VMEM

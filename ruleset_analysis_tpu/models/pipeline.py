@@ -345,6 +345,8 @@ def _update_registers(
     topk_sample_shift: int = 0,
     counts_delta: jax.Array | None = None,
     counts_impl: str = "scatter",
+    update_impl: str = "scatter",
+    topk_every: int = 1,
 ) -> tuple[AnalysisState, ChunkOut]:
     """Shared register tail: the reducer's whole job, for any match layout."""
     # One bincount into the (small) key space feeds BOTH the exact counts
@@ -355,21 +357,75 @@ def _update_registers(
     # whole step at 1M-line chunks).  counts_delta: the fused pallas
     # kernel already built the bincount in VMEM (mirrors parallel/step.py
     # _merge_tail — keep the two tails in lockstep).
-    if counts_delta is None:
-        counts_delta = count_ops.SEGMENT_COUNTS_IMPLS[counts_impl](
-            keys, valid, n_keys
+    #
+    # update_impl="sorted" (DESIGN §15): the batch-sized scatters become
+    # segment reductions over sorted key runs (ops/sorted_update.py) —
+    # bit-identical by add/max associativity.  counts_impl composes: the
+    # matmul/reduce counts formulations are already scatter-free, so the
+    # sorted path only takes over the counts stage at the default
+    # "scatter" setting.
+    if update_impl == "sorted":
+        from ..ops import sorted_update as sorted_ops
+
+        need = counts_delta is None and counts_impl == "scatter"
+        sorted_delta, hll = sorted_ops.counts_hll_sorted(
+            state.hll, keys, valid, src, n_keys, need_counts=need
         )
+        if counts_delta is None:
+            counts_delta = (
+                sorted_delta
+                if need
+                else count_ops.SEGMENT_COUNTS_IMPLS[counts_impl](
+                    keys, valid, n_keys
+                )
+            )
+    else:
+        if counts_delta is None:
+            counts_delta = count_ops.SEGMENT_COUNTS_IMPLS[counts_impl](
+                keys, valid, n_keys
+            )
+        hll = hll_ops.hll_update(state.hll, keys, src, valid)
     delta = counts_delta
     if exact_counts:
         lo, hi = count_ops.add64(state.counts_lo, state.counts_hi, delta)
     else:
         lo, hi = state.counts_lo, state.counts_hi
     cms = cms_ops.cms_update(state.cms, jnp.arange(n_keys, dtype=_U32), delta)
-    hll = hll_ops.hll_update(state.hll, keys, src, valid)
-    talk_cms, ca, cs, ce = topk_ops.talker_chunk_update(
-        state.talk_cms, acl, src, valid, topk_k, salt=salt,
-        sample_shift=topk_sample_shift,
-    )
+    if update_impl == "sorted":
+        from ..ops import sorted_update as sorted_ops
+
+        salt_u = jnp.asarray(salt, dtype=_U32)
+        dt, wt = state.talk_cms.shape
+
+        def _tables(sel):
+            return sorted_ops.talker_tables_sorted(
+                acl, src, valid, salt_u, width=wt, depth=dt,
+                slots=topk_ops.CAND_SLOTS, sample_shift=topk_sample_shift,
+                with_candidates=sel,
+            )
+
+        if topk_every > 1:
+            cms_delta, cnt, rep = jax.lax.cond(
+                salt_u % _U32(topk_every) == _U32(0),
+                lambda _: _tables(True),
+                lambda _: _tables(False),
+                None,
+            )
+        else:
+            cms_delta, cnt, rep = _tables(True)
+        talk_cms = state.talk_cms + cms_delta
+        s_acl, s_src, _sv = topk_ops.sample_cols(
+            acl, src, valid, salt_u, topk_sample_shift
+        )
+        ca, cs, ce = topk_ops.select_from_tables(
+            cnt, rep, s_acl, s_src, talk_cms,
+            min(topk_k, s_acl.shape[0]),
+        )
+    else:
+        talk_cms, ca, cs, ce = topk_ops.talker_chunk_update(
+            state.talk_cms, acl, src, valid, topk_k, salt=salt,
+            sample_shift=topk_sample_shift, topk_every=topk_every,
+        )
     return (
         AnalysisState(counts_lo=lo, counts_hi=hi, cms=cms, hll=hll, talk_cms=talk_cms),
         ChunkOut(cand_acl=ca, cand_src=cs, cand_est=ce),
@@ -389,6 +445,8 @@ def analysis_step(
     match_impl: str = "xla",
     topk_sample_shift: int = 0,
     counts_impl: str = "scatter",
+    update_impl: str = "scatter",
+    topk_every: int = 1,
 ) -> tuple[AnalysisState, ChunkOut]:
     """One fused device step over a batch of packed log lines.
 
@@ -416,7 +474,8 @@ def analysis_step(
         state, keys, valid, cols["src"], cols["acl"],
         n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts, salt=salt,
         topk_sample_shift=topk_sample_shift, counts_delta=counts_delta,
-        counts_impl=counts_impl,
+        counts_impl=counts_impl, update_impl=update_impl,
+        topk_every=topk_every,
     )
 
 
@@ -432,6 +491,8 @@ def analysis_step6(
     salt: jax.Array | int = 0,
     topk_sample_shift: int = 0,
     counts_impl: str = "scatter",
+    update_impl: str = "scatter",
+    topk_every: int = 1,
 ) -> tuple[AnalysisState, ChunkOut]:
     """One fused device step over a batch of v6 lines.
 
@@ -448,6 +509,7 @@ def analysis_step6(
         state, keys, valid, fold_src32(cols), cols["acl"] | V6_ACL_TAG,
         n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts, salt=salt,
         topk_sample_shift=topk_sample_shift, counts_impl=counts_impl,
+        update_impl=update_impl, topk_every=topk_every,
     )
 
 
@@ -478,6 +540,8 @@ def analysis_step_stacked(
     rule_block: int = RULE_BLOCK,
     salt: jax.Array | int = 0,
     topk_sample_shift: int = 0,
+    update_impl: str = "scatter",
+    topk_every: int = 1,
 ) -> tuple[AnalysisState, ChunkOut]:
     """Grouped-batch variant of analysis_step (vmap over rule slabs).
 
@@ -498,6 +562,8 @@ def analysis_step_stacked(
         exact_counts=exact_counts,
         salt=salt,
         topk_sample_shift=topk_sample_shift,
+        update_impl=update_impl,
+        topk_every=topk_every,
     )
 
 

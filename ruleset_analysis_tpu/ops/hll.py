@@ -32,6 +32,23 @@ def hll_init(n_keys: int, p: int) -> jnp.ndarray:
     return jnp.zeros((n_keys, 1 << p), dtype=_U32)
 
 
+def hll_reg_rank(
+    values: jnp.ndarray, valid: jnp.ndarray, p: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-line (register index, masked rank) — the HLL update's math.
+
+    ONE definition shared by the scatter formulation below and the sorted
+    segment-reduce formulation (ops/sorted_update.py), so the two can
+    never drift: rank 0 (invalid lines) is the identity for max.
+    """
+    h_idx = fmix32(values, seed=_HLL_SEED_IDX)
+    h_rank = fmix32(values, seed=_HLL_SEED_RANK)
+    reg = h_idx >> _U32(32 - p)  # high p bits -> register index
+    rank = clz32(h_rank) + _U32(1)  # 1..33
+    rank = rank * (valid > 0).astype(_U32)  # invalid -> 0 == identity for max
+    return reg, rank
+
+
 def hll_update(
     hll: jnp.ndarray, keys: jnp.ndarray, values: jnp.ndarray, valid: jnp.ndarray
 ) -> jnp.ndarray:
@@ -45,11 +62,7 @@ def hll_update(
     """
     with jax.named_scope("ra.hll"):
         p = int(hll.shape[1]).bit_length() - 1
-        h_idx = fmix32(values, seed=_HLL_SEED_IDX)
-        h_rank = fmix32(values, seed=_HLL_SEED_RANK)
-        reg = h_idx >> _U32(32 - p)  # high p bits -> register index
-        rank = clz32(h_rank) + _U32(1)  # 1..33
-        rank = rank * (valid > 0).astype(_U32)  # invalid -> 0 == identity for max
+        reg, rank = hll_reg_rank(values, valid, p)
         return hll.at[keys, reg].max(rank, mode="drop")
 
 

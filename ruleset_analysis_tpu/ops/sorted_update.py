@@ -1,0 +1,194 @@
+"""Sorted segment-reduce register updates (``update_impl='sorted'``).
+
+DESIGN §8's named-stage capture shows the device step SCATTER-BOUND:
+the five batch-sized scatters (exact counts, talker CMS, per-key HLL,
+candidate count, candidate representative) are ~77% of the TPU step.
+This module is the structural alternative — the sort/segment-reduce
+half of the MapReduce combiner (Dean & Ghemawat, OSDI '04), applied on
+device: sort the batch's register keys once with ``lax.sort``, then
+update every register file with segment reductions over the sorted
+runs (``indices_are_sorted=True`` scatters — XLA can lower a sorted,
+run-grouped scatter without the hazard handling a random-order
+batch-sized scatter needs).
+
+Two sort domains per step (DESIGN §15):
+
+- **rule-key domain** — ONE sort of the packed ``key * m + hll_reg``
+  composite feeds BOTH the exact-counts segment-sum (major key = the
+  count key) and the HLL segment-max (full composite = the flat HLL
+  register index).  The composite fits uint32 whenever the HLL register
+  file itself fits memory (``n_keys * m`` entries); pathological
+  geometries fall back to the scatter forms, value-identically.
+- **shared talker index space** — the ``[depth * width]`` talker-CMS
+  cells and the ``[slots]`` candidate-table cells concatenate into ONE
+  index space ``[depth*width | slots]``, so ONE sort + one segment-sum
+  + one segment-max update the talker CMS AND the candidate table
+  together ("one gather/sort feeds both").  The slot hash and the CMS
+  bucket hash are byte-for-byte the scatter path's (ops/topk.py
+  ``cand_slot``, ops/hashing multiply-shift), which is what makes the
+  two formulations bit-identical end to end.
+
+Every update is weight-linear (sums of the uint32 weight plane) or
+idempotent (HLL max), so the sorted path accepts coalesced/weighted
+batches everywhere by construction.  uint32 addition and max are
+associative and commutative, so reordering the updates along the sorted
+permutation produces bit-identical registers — the property the
+scatter-vs-sorted identity matrix in tests/test_sorted_update.py pins.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import counts as count_ops
+from . import hll as hll_ops
+from .cms import cms_bucket
+from .hashing import hash_pair
+from .topk import cand_slot, sample_cols
+
+_U32 = jnp.uint32
+
+#: The packed (rule key, HLL register) composite must fit uint32.  Under
+#: the default register budget the HLL file itself caps n_keys * m at
+#: 2^30 entries, so the guard only fires for raised-budget geometries.
+COMPOSITE_LIMIT = 1 << 32
+
+
+def composite_fits(n_keys: int, m: int) -> bool:
+    """True when ``key * m + reg`` sort keys cannot wrap uint32."""
+    return n_keys * m < COMPOSITE_LIMIT
+
+
+def counts_hll_sorted(
+    hll: jnp.ndarray,
+    keys: jnp.ndarray,
+    valid: jnp.ndarray,
+    src: jnp.ndarray,
+    n_keys: int,
+    *,
+    need_counts: bool,
+):
+    """Rule-key domain: one sort feeds exact counts AND the HLL update.
+
+    Returns ``(counts_delta | None, new_hll)``; ``counts_delta`` is the
+    [n_keys] per-key weight sum when ``need_counts`` (i.e. the counts
+    stage runs the default scatter formulation — matmul/reduce impls
+    compose separately and skip it).  ``hll`` may be the live register
+    file (single-device in-place semantics) or zeros (the parallel
+    delta-then-pmax path); both are just "max into this base".
+    """
+    m = int(hll.shape[1])
+    p = m.bit_length() - 1
+    if not composite_fits(n_keys, m):
+        delta = (
+            count_ops.segment_counts(keys, valid, n_keys) if need_counts else None
+        )
+        return delta, hll_ops.hll_update(hll, keys, src, valid)
+    with jax.named_scope("ra.hll"):
+        reg, rank = hll_ops.hll_reg_rank(src, valid, p)
+    with jax.named_scope("ra.sort"):
+        # out-of-range keys must DROP exactly as the scatters' mode="drop"
+        # does: route them to the all-ones sentinel (whose major key
+        # 0xFFFFFFFF >> p is >= n_keys by the composite_fits guard) and
+        # zero their operands for belt and braces
+        oob = keys >= _U32(n_keys)
+        ck = jnp.where(oob, _U32(0xFFFFFFFF), keys * _U32(m) + reg)
+        w = jnp.where(oob, _U32(0), valid.astype(_U32))
+        rk = jnp.where(oob, _U32(0), rank)
+        ck_s, w_s, rk_s = lax.sort((ck, w, rk), num_keys=1)
+    counts_delta = None
+    if need_counts:
+        with jax.named_scope("ra.counts"):
+            counts_delta = jnp.zeros(n_keys, dtype=_U32).at[ck_s >> _U32(p)].add(
+                w_s, mode="drop", indices_are_sorted=True
+            )
+    with jax.named_scope("ra.hll"):
+        new_hll = (
+            hll.reshape(-1)
+            .at[ck_s]
+            .max(rk_s, mode="drop", indices_are_sorted=True)
+            .reshape(hll.shape)
+        )
+    return counts_delta, new_hll
+
+
+def talker_tables_sorted(
+    acl: jnp.ndarray,
+    src: jnp.ndarray,
+    valid: jnp.ndarray,
+    salt: jnp.ndarray,
+    *,
+    width: int,
+    depth: int,
+    slots: int,
+    sample_shift: int = 0,
+    with_candidates: bool = True,
+):
+    """Shared talker index space: one sort updates CMS + candidate table.
+
+    Returns ``(cms_delta [depth, width], cnt [slots], rep [slots])``.
+    ``cms_delta`` sums the full batch's weights per CMS cell (add it to
+    the live register file, or psum it first on the parallel path); the
+    candidate tables cover the salt-rotated SAMPLE when ``sample_shift``
+    is set, exactly like the scatter path.  ``with_candidates=False``
+    (a deferred-selection chunk, --topk-every) sorts the CMS cells only
+    and returns empty tables — per-cell sums are permutation-invariant,
+    so the CMS values are identical either way.
+    """
+    b = acl.shape[0]
+    with jax.named_scope("ra.talk"):
+        pair = hash_pair(acl, src)
+        # the scatter path's own bucket hash (ops/cms.py) — shared like
+        # cand_slot/hll_reg_rank so the formulations can never drift
+        buckets = cms_bucket(pair, width, depth)  # [d, B]
+        rows = jnp.arange(depth, dtype=_U32)[:, None]
+        cms_idx = (rows * _U32(width) + buckets).reshape(-1)  # [d*B]
+        w_cms = jnp.broadcast_to(
+            valid.astype(_U32)[None, :], (depth, b)
+        ).reshape(-1)
+    base = depth * width
+    if not with_candidates:
+        with jax.named_scope("ra.sort"):
+            k_s, w_s = lax.sort((cms_idx, w_cms), num_keys=1)
+        with jax.named_scope("ra.talk"):
+            cms_delta = (
+                jnp.zeros(base, dtype=_U32)
+                .at[k_s]
+                .add(w_s, mode="drop", indices_are_sorted=True)
+                .reshape(depth, width)
+            )
+        return (
+            cms_delta,
+            jnp.zeros(slots, dtype=_U32),
+            jnp.full(slots, -1, dtype=jnp.int32),
+        )
+    with jax.named_scope("ra.topk"):
+        s_acl, s_src, s_valid = sample_cols(acl, src, valid, salt, sample_shift)
+        s_pair = pair if s_acl is acl else hash_pair(s_acl, s_src)
+        slot = cand_slot(s_pair, salt, slots)
+        sv32 = s_valid.astype(_U32)
+        iota = lax.broadcasted_iota(jnp.int32, (s_acl.shape[0],), 0)
+        vmax_slot = jnp.where(sv32 > 0, iota, -1)
+    with jax.named_scope("ra.sort"):
+        keys_all = jnp.concatenate([cms_idx, _U32(base) + slot])
+        w_all = jnp.concatenate([w_cms, sv32])
+        vmax_all = jnp.concatenate(
+            [jnp.full(cms_idx.shape[0], -1, dtype=jnp.int32), vmax_slot]
+        )
+        k_s, w_s, v_s = lax.sort((keys_all, w_all, vmax_all), num_keys=1)
+    total = base + slots
+    with jax.named_scope("ra.talk"):
+        seg_sum = jnp.zeros(total, dtype=_U32).at[k_s].add(
+            w_s, mode="drop", indices_are_sorted=True
+        )
+        cms_delta = seg_sum[:base].reshape(depth, width)
+    with jax.named_scope("ra.topk"):
+        cnt = seg_sum[base:]
+        rep = (
+            jnp.full(total, -1, dtype=jnp.int32)
+            .at[k_s]
+            .max(v_s, mode="drop", indices_are_sorted=True)[base:]
+        )
+    return cms_delta, cnt, rep

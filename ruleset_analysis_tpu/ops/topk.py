@@ -36,6 +36,82 @@ _U32 = jnp.uint32
 CAND_SLOTS = 1 << 15
 
 
+def cand_slot(pair: jnp.ndarray, salt: jnp.ndarray | int, slots: int) -> jnp.ndarray:
+    """Candidate-table slot of each (acl, src) pair hash.
+
+    ONE definition shared by the scatter path below and the sorted
+    segment-reduce path (ops/sorted_update.py): the two formulations must
+    place every pair in the SAME slot or their selected candidates — and
+    therefore reports — could diverge.
+    """
+    return fmix32(pair ^ jnp.asarray(salt, dtype=_U32)) & _U32(slots - 1)
+
+
+def sample_cols(acl, src, valid, salt: jnp.ndarray | int, sample_shift: int):
+    """Salt-rotated strided sample of the batch (candidate SELECTION only).
+
+    Extracted from :func:`select_candidates` so the sorted formulation
+    samples identically; see there for why the phase rotates with the
+    chunk salt.  Degrades to the full batch when a shard is smaller than
+    the stride (shapes are static, so this resolves at trace time).
+    """
+    if sample_shift and acl.shape[0] >= (1 << sample_shift):
+        stride = 1 << sample_shift
+        bs = (acl.shape[0] // stride) * stride
+        phase = jnp.asarray(salt, dtype=_U32) % _U32(stride)
+
+        def col(x):
+            return jnp.take(x[:bs].reshape(-1, stride), phase, axis=1)
+
+        return col(acl), col(src), col(valid)
+    return acl, src, valid
+
+
+def cand_k(k: int, b: int, sample_shift: int) -> int:
+    """Static candidate count after sampling: min(k, sampled length)."""
+    if sample_shift and b >= (1 << sample_shift):
+        return min(k, b >> sample_shift)
+    return min(k, b)
+
+
+def select_from_tables(cnt, rep, acl, src, talk_cms, k: int):
+    """Top-k selection over an already-built candidate table.
+
+    ``cnt``/``rep`` are the per-slot frequency and representative-line
+    tables (however they were built — batch-sized scatters or the sorted
+    segment reduce); ``acl``/``src`` are the arrays ``rep``'s line
+    indices point into.  Estimates come from the (merged) global talker
+    CMS, so the host tracker's values stay chunk-order invariant.
+    """
+    with jax.named_scope("ra.topk"):
+        top_cnt, top_slot = lax.top_k(cnt.astype(jnp.int32), k)
+        rep_idx = rep[top_slot]
+        safe = jnp.maximum(rep_idx, 0)
+        ca, cs = acl[safe], src[safe]
+        est = cms_query(talk_cms, hash_pair(ca, cs))
+        ok = ((rep_idx >= 0) & (top_cnt > 0)).astype(_U32)
+        return ca * ok, cs * ok, est * ok
+
+
+def maybe_select(fn, salt: jnp.ndarray | int, topk_every: int, k: int):
+    """Run candidate-producing ``fn`` on selection chunks only.
+
+    ``topk_every > 1`` defers top-K candidate selection to every Nth
+    chunk (Space-Saving spirit: heavy hitters recur, so a stride sample
+    of CHUNKS still surfaces them while the talker CMS keeps absorbing
+    every line).  Deterministic in the chunk salt — resume replays the
+    same selection schedule — and skipped chunks yield est=0 candidates,
+    which the host tracker ignores.  ``topk_every == 1`` is a straight
+    call: the pre-existing single-knob HLO is untouched.
+    """
+    if topk_every <= 1:
+        return fn(None)
+    with jax.named_scope("ra.topk"):
+        z = jnp.zeros(k, dtype=_U32)
+        sel = jnp.asarray(salt, dtype=_U32) % _U32(topk_every) == _U32(0)
+        return lax.cond(sel, fn, lambda _: (z, z, z), None)
+
+
 def talker_chunk_update(
     talk_cms: jnp.ndarray,
     acl: jnp.ndarray,
@@ -44,6 +120,7 @@ def talker_chunk_update(
     k: int,
     salt: jnp.ndarray | int = 0,
     sample_shift: int = 0,
+    topk_every: int = 1,
 ):
     """Absorb one chunk; return (new_cms, cand_acl, cand_src, cand_est).
 
@@ -58,13 +135,22 @@ def talker_chunk_update(
     covers the full batch; the sample only shrinks the candidate-table
     scatters (the scatter-bound share of the TPU step).  Deterministic:
     the stride is fixed, so resume replays identically.
+
+    ``topk_every > 1`` additionally defers selection to every Nth chunk
+    (see :func:`maybe_select`); the CMS still absorbs every chunk.
     """
     with jax.named_scope("ra.talk"):
         pair = hash_pair(acl, src)
         new_cms = cms_update(talk_cms, pair, valid)
-    cand = select_candidates(
-        new_cms, acl, src, valid, min(k, acl.shape[0]), salt=salt,
-        sample_shift=sample_shift,
+    k1 = min(k, acl.shape[0])
+
+    def _select(_):
+        return select_candidates(
+            new_cms, acl, src, valid, k1, salt=salt, sample_shift=sample_shift
+        )
+
+    cand = maybe_select(
+        _select, salt, topk_every, cand_k(k1, acl.shape[0], sample_shift)
     )
     return (new_cms, *cand)
 
@@ -107,19 +193,11 @@ def select_candidates(talk_cms, acl, src, valid, k, slots: int = CAND_SLOTS,
     # warning (ADVICE r4).  Degrade to exact full-batch selection instead;
     # shapes are static so this resolves at trace time.
     with jax.named_scope("ra.topk"):
-        if sample_shift and acl.shape[0] >= (1 << sample_shift):
-            stride = 1 << sample_shift
-            bs = (acl.shape[0] // stride) * stride
-            phase = jnp.asarray(salt, dtype=_U32) % _U32(stride)
-
-            def col(x):
-                return jnp.take(x[:bs].reshape(-1, stride), phase, axis=1)
-
-            acl, src, valid = col(acl), col(src), col(valid)
-            k = min(k, acl.shape[0])
+        acl, src, valid = sample_cols(acl, src, valid, salt, sample_shift)
+        k = min(k, acl.shape[0])
         b = acl.shape[0]
         pair = hash_pair(acl, src)
-        slot = fmix32(pair ^ jnp.asarray(salt, dtype=_U32)) & _U32(slots - 1)
+        slot = cand_slot(pair, salt, slots)
         v32 = valid.astype(_U32)
         cnt = jnp.zeros(slots, dtype=_U32).at[slot].add(v32, mode="drop")
         iota = lax.broadcasted_iota(jnp.int32, (b,), 0)
@@ -128,13 +206,7 @@ def select_candidates(talk_cms, acl, src, valid, k, slots: int = CAND_SLOTS,
             .at[slot]
             .max(jnp.where(v32 > 0, iota, -1), mode="drop")
         )
-        top_cnt, top_slot = lax.top_k(cnt.astype(jnp.int32), k)
-        rep_idx = rep[top_slot]
-        safe = jnp.maximum(rep_idx, 0)
-        ca, cs = acl[safe], src[safe]
-        est = cms_query(talk_cms, hash_pair(ca, cs))
-        ok = ((rep_idx >= 0) & (top_cnt > 0)).astype(_U32)
-        return ca * ok, cs * ok, est * ok
+    return select_from_tables(cnt, rep, acl, src, talk_cms, k)
 
 
 class TopKTracker:

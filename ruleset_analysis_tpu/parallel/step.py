@@ -79,6 +79,8 @@ def _merge_tail(
     topk_sample_shift: int = 0,
     counts_delta: jax.Array | None = None,
     counts_impl: str = "scatter",
+    update_impl: str = "scatter",
+    topk_every: int = 1,
 ) -> tuple[AnalysisState, ChunkOut]:
     # The register-update tail shared by the flat and stacked shard steps:
     # mirrors pipeline._update_registers with the collective merges
@@ -96,9 +98,35 @@ def _merge_tail(
     # ra.hll inside the ops; ra.talk/ra.merge here) so profiler fusions
     # attribute to semantic stages instead of fusion.N — the substrate
     # runtime/devprof.py classifies (DESIGN §14).  Trace-time only.
-    if counts_delta is None:
-        counts_delta = count_ops.SEGMENT_COUNTS_IMPLS[counts_impl](
-            keys, valid, n_keys
+    #
+    # update_impl="sorted": local deltas come from the sorted
+    # segment-reduce formulations (ops/sorted_update.py, DESIGN §15);
+    # the collective merge seams are IDENTICAL — only how each shard
+    # builds its delta changes, so bit-identity to the scatter path
+    # follows from per-shard value identity plus the same merges.
+    if update_impl == "sorted":
+        from ..ops import sorted_update as sorted_ops
+
+        need = counts_delta is None and counts_impl == "scatter"
+        sorted_delta, delta_hll = sorted_ops.counts_hll_sorted(
+            jnp.zeros_like(state.hll), keys, valid, src, n_keys,
+            need_counts=need,
+        )
+        if counts_delta is None:
+            counts_delta = (
+                sorted_delta
+                if need
+                else count_ops.SEGMENT_COUNTS_IMPLS[counts_impl](
+                    keys, valid, n_keys
+                )
+            )
+    else:
+        if counts_delta is None:
+            counts_delta = count_ops.SEGMENT_COUNTS_IMPLS[counts_impl](
+                keys, valid, n_keys
+            )
+        delta_hll = hll_ops.hll_update(
+            jnp.zeros_like(state.hll), keys, src, valid
         )
     with jax.named_scope("ra.merge"):
         delta = lax.psum(counts_delta, axis)
@@ -108,27 +136,59 @@ def _merge_tail(
         lo, hi = state.counts_lo, state.counts_hi
     cms = cms_ops.cms_update(state.cms, jnp.arange(n_keys, dtype=_U32), delta)
 
-    delta_hll = hll_ops.hll_update(
-        jnp.zeros_like(state.hll), keys, src, valid
-    )
     with jax.named_scope("ra.merge"):
         hll = jnp.maximum(state.hll, lax.pmax(delta_hll, axis))
 
     dt, wt = state.talk_cms.shape
-    with jax.named_scope("ra.talk"):
-        delta_talk = cms_ops.cms_update(
-            jnp.zeros((dt, wt), _U32), topk_ops.hash_pair(acl, src), valid
+    if update_impl == "sorted":
+        from ..ops import sorted_update as sorted_ops
+
+        def _tables(sel):
+            return sorted_ops.talker_tables_sorted(
+                acl, src, valid, salt, width=wt, depth=dt,
+                slots=topk_ops.CAND_SLOTS, sample_shift=topk_sample_shift,
+                with_candidates=sel,
+            )
+
+        if topk_every > 1:
+            delta_talk, cnt, rep = lax.cond(
+                salt % _U32(topk_every) == _U32(0),
+                lambda _: _tables(True),
+                lambda _: _tables(False),
+                None,
+            )
+        else:
+            delta_talk, cnt, rep = _tables(True)
+        with jax.named_scope("ra.merge"):
+            talk_cms = state.talk_cms + lax.psum(delta_talk, axis)
+        s_acl, s_src, _sv = topk_ops.sample_cols(
+            acl, src, valid, salt, topk_sample_shift
         )
-    with jax.named_scope("ra.merge"):
-        talk_cms = state.talk_cms + lax.psum(delta_talk, axis)
-    # candidate selection against the *merged* global talker sketch, then
-    # gather every device's candidates so the host sees them all, replicated
-    # (sample_shift: salt-rotated sampled selection — the sketch covered
-    # every line above; see ops.topk.select_candidates)
-    ca, cs, ce = topk_ops.select_candidates(
-        talk_cms, acl, src, valid, min(topk_k, valid.shape[0]),
-        salt=salt, sample_shift=topk_sample_shift,
-    )
+        ca, cs, ce = topk_ops.select_from_tables(
+            cnt, rep, s_acl, s_src, talk_cms,
+            min(topk_k, s_acl.shape[0]),
+        )
+    else:
+        with jax.named_scope("ra.talk"):
+            delta_talk = cms_ops.cms_update(
+                jnp.zeros((dt, wt), _U32), topk_ops.hash_pair(acl, src), valid
+            )
+        with jax.named_scope("ra.merge"):
+            talk_cms = state.talk_cms + lax.psum(delta_talk, axis)
+        # candidate selection against the *merged* global talker sketch,
+        # then gather every device's candidates so the host sees them all,
+        # replicated (sample_shift: salt-rotated sampled selection — the
+        # sketch covered every line above; see ops.topk.select_candidates;
+        # topk_every: deferred selection, ops.topk.maybe_select)
+        k1 = min(topk_k, valid.shape[0])
+        ca, cs, ce = topk_ops.maybe_select(
+            lambda _: topk_ops.select_candidates(
+                talk_cms, acl, src, valid, k1,
+                salt=salt, sample_shift=topk_sample_shift,
+            ),
+            salt, topk_every,
+            topk_ops.cand_k(k1, valid.shape[0], topk_sample_shift),
+        )
     with jax.named_scope("ra.merge"):
         cand_acl = lax.all_gather(ca, axis, tiled=True)
         cand_src = lax.all_gather(cs, axis, tiled=True)
@@ -154,6 +214,8 @@ def _local_shard_step(
     match_impl: str = "xla",
     topk_sample_shift: int = 0,
     counts_impl: str = "scatter",
+    update_impl: str = "scatter",
+    topk_every: int = 1,
 ) -> tuple[AnalysisState, ChunkOut]:
     cols, valid = batch_cols(batch)
     counts_delta = None
@@ -176,7 +238,8 @@ def _local_shard_step(
         state, keys, valid, cols["src"], cols["acl"], salt,
         axis=axis, n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts,
         topk_sample_shift=topk_sample_shift, counts_delta=counts_delta,
-        counts_impl=counts_impl,
+        counts_impl=counts_impl, update_impl=update_impl,
+        topk_every=topk_every,
     )
 
 
@@ -193,6 +256,8 @@ def _local_shard_step_stacked(
     rule_block: int,
     topk_sample_shift: int = 0,
     counts_impl: str = "scatter",
+    update_impl: str = "scatter",
+    topk_every: int = 1,
 ) -> tuple[AnalysisState, ChunkOut]:
     # Grouped twin of _local_shard_step: each line scans only its own
     # ACL's slab (vmapped match over the group axis); the mergeable
@@ -212,6 +277,8 @@ def _local_shard_step_stacked(
         exact_counts=exact_counts,
         topk_sample_shift=topk_sample_shift,
         counts_impl=counts_impl,
+        update_impl=update_impl,
+        topk_every=topk_every,
     )
 
 
@@ -228,6 +295,8 @@ def _local_shard_step6(
     rule_block: int,
     topk_sample_shift: int = 0,
     counts_impl: str = "scatter",
+    update_impl: str = "scatter",
+    topk_every: int = 1,
 ) -> tuple[AnalysisState, ChunkOut]:
     # IPv6 twin of _local_shard_step: lexicographic limb match, then the
     # SAME mergeable register tail into the shared key universe.  Source
@@ -242,6 +311,7 @@ def _local_shard_step6(
         cols["acl"] | jnp.uint32(V6_ACL_TAG), salt,
         axis=axis, n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts,
         topk_sample_shift=topk_sample_shift, counts_impl=counts_impl,
+        update_impl=update_impl, topk_every=topk_every,
     )
 
 
@@ -386,6 +456,8 @@ def _cached_step(
     match_impl: str | None,
     topk_sample_shift: int,
     counts_impl: str,
+    update_impl: str,
+    topk_every: int,
 ):
     """Step builders memoized on their full geometry.
 
@@ -407,6 +479,8 @@ def _cached_step(
         rule_block=rule_block,
         topk_sample_shift=topk_sample_shift,
         counts_impl=counts_impl,
+        update_impl=update_impl,
+        topk_every=topk_every,
     )
     if match_impl is not None:
         kwargs["match_impl"] = match_impl
@@ -451,6 +525,8 @@ def make_parallel_step(
         cfg.match_impl,
         cfg.sketch.topk_sample_shift,
         cfg.counts_impl,
+        cfg.update_impl,
+        cfg.sketch.topk_every,
     )
 
 
@@ -478,6 +554,8 @@ def make_parallel_step6(
         None,
         cfg.sketch.topk_sample_shift,
         cfg.counts_impl,
+        cfg.update_impl,
+        cfg.sketch.topk_every,
     )
 
 
@@ -506,4 +584,6 @@ def make_parallel_step_stacked(
         None,
         cfg.sketch.topk_sample_shift,
         cfg.counts_impl,
+        cfg.update_impl,
+        cfg.sketch.topk_every,
     )

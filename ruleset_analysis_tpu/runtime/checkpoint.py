@@ -78,6 +78,14 @@ def fingerprint(
         f"{padded},{n_shards},{s.topk_chunk_candidates},{s.topk_capacity},"
         f"{cfg.layout},{lane},{s.topk_sample_shift}".encode()
     )
+    if s.topk_every != 1:
+        # deferred selection changes WHICH chunks feed candidates, so a
+        # cross-cadence resume would not replay an uninterrupted run's
+        # talker tables.  Folded in only when non-default so every
+        # pre-existing snapshot keeps its fingerprint.  update_impl is
+        # deliberately NOT part of the identity: scatter and sorted are
+        # bit-identical, so a crash under one may resume under the other.
+        h.update(f",topk_every={s.topk_every}".encode())
     return h.hexdigest()[:16]
 
 

@@ -70,6 +70,8 @@ from . import faults, obs
 #:   ra.hll     per-key HLL scatter-max
 #:   ra.talk    talker (acl, src) sketch update
 #:   ra.topk    chunk-local candidate table + top_k selection
+#:   ra.sort    register-key sorts feeding the segment-reduce updates
+#:              (update_impl=sorted, ops/sorted_update.py — DESIGN §15)
 #:   ra.merge   cross-device psum/pmax/all_gather merges
 STAGES = (
     "ra.unpack",
@@ -80,6 +82,7 @@ STAGES = (
     "ra.hll",
     "ra.talk",
     "ra.topk",
+    "ra.sort",
     "ra.merge",
 )
 

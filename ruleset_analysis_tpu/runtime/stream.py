@@ -1624,6 +1624,12 @@ def _check_weighted_input_config(cfg: AnalysisConfig) -> None:
       < 2^24 (f32 integer range); a weighted chunk's summed weights are
       bounded by the ORIGINAL corpus's lines behind it, not by the
       stored batch size the formulation's shape guard sees.
+
+    ``update_impl='sorted'`` needs NO entry here: every sorted segment
+    reduce is weight-linear (sums of the uint32 weight plane) or
+    idempotent by construction (DESIGN §15), so weighted inputs are
+    accepted everywhere the default scatter path accepts them —
+    tests/test_sorted_update.py pins the combination.
     """
     from ..errors import AnalysisError
 

@@ -35,15 +35,27 @@ CASES = [
 # dual-eval, batch > corpus) — the fused kernel's counts fold has its own
 # failure modes there (deny routing, lane padding, grid accumulation) —
 # plus two plain cases; xla runs everything.  Interpret mode on CPU.
-IMPL_CASES = [("xla", c) for c in CASES] + [
-    (impl, c)
-    for impl in ("pallas", "pallas_fused")
-    for c in (CASES[1], CASES[2], CASES[4], CASES[5])
-]
+# The sorted register-update formulation (ISSUE 9) rides the same
+# flip-variant pattern: the padding-stress cases again (odd batches are
+# where a sort+segment boundary bug would hide) with the deferred
+# candidate cadence flipped alongside — re-certifying that topk_every
+# never perturbs exact hits or the unused set.
+IMPL_CASES = (
+    [("xla", 1, c) for c in CASES]
+    + [
+        (impl, 1, c)
+        for impl in ("pallas", "pallas_fused")
+        for c in (CASES[1], CASES[2], CASES[4], CASES[5])
+    ]
+    + [
+        ("xla+sorted", every, c)
+        for every, c in ((1, CASES[2]), (2, CASES[4]), (4, CASES[5]))
+    ]
+)
 
 
-@pytest.mark.parametrize("impl,case", IMPL_CASES)
-def test_device_matches_oracle(impl, case):
+@pytest.mark.parametrize("impl,every,case", IMPL_CASES)
+def test_device_matches_oracle(impl, every, case):
     seed, n_acls, rules, egress, lines, batch = case
     cfg_text = synth.synth_config(
         n_acls=n_acls, rules_per_acl=rules, seed=seed, egress_acls=egress
@@ -54,13 +66,17 @@ def test_device_matches_oracle(impl, case):
     log_lines = synth.render_syslog(packed, tuples, seed=seed, variety=0.3)
     res = oracle.Oracle([rs]).consume(list(log_lines))
 
+    match_impl, _, update = impl.partition("+")
     rep = run_stream(
         packed,
         iter(log_lines),
         AnalysisConfig(
             batch_size=batch,
-            sketch=SketchConfig(cms_width=1 << 11, cms_depth=4, hll_p=6),
-            match_impl=impl,
+            sketch=SketchConfig(
+                cms_width=1 << 11, cms_depth=4, hll_p=6, topk_every=every
+            ),
+            match_impl=match_impl,
+            update_impl=update or "scatter",
         ),
         topk=5,
     )

@@ -163,13 +163,47 @@ def render(d: dict, label_a: str = "A", label_b: str = "B") -> str:
     return "\n".join(out)
 
 
+def render_csv(d: dict, label_a: str = "A", label_b: str = "B") -> str:
+    """Spreadsheet-ready stage table (one header + one row per stage,
+    plus a ``(step)`` totals row).  Fusion-boundary changes are not
+    tabular — use ``--json`` for those; the boundary VERDICT rides the
+    totals row's last column so a CSV consumer still sees it.
+    """
+    import csv
+    import io
+
+    ka, kb = f"{label_a}_us_per_step", f"{label_b}_us_per_step"
+    buf = io.StringIO()
+    w = csv.writer(buf, lineterminator="\n")
+    w.writerow([
+        "stage", ka, kb, "delta_us_per_step", "ratio",
+        f"{label_a}_pct", f"{label_b}_pct", "fusion_boundaries_changed",
+    ])
+    for r in d["stages"]:
+        w.writerow([
+            r["stage"], r[ka], r[kb], r["delta_us_per_step"],
+            "" if r["ratio"] is None else r["ratio"],
+            r[f"{label_a}_pct"], r[f"{label_b}_pct"], "",
+        ])
+    w.writerow([
+        "(step)", d[label_a]["step_us"], d[label_b]["step_us"],
+        round(d[label_b]["step_us"] - d[label_a]["step_us"], 1),
+        "" if d["step_ratio"] is None else d["step_ratio"],
+        100.0, 100.0, d["fusion_boundaries_changed"],
+    ])
+    return buf.getvalue()
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="per-stage delta table between two devprof captures"
     )
     ap.add_argument("old", help="baseline capture (devprof.json or its dir)")
     ap.add_argument("new", help="comparison capture")
-    ap.add_argument("--json", action="store_true", help="machine output")
+    fmt = ap.add_mutually_exclusive_group()
+    fmt.add_argument("--json", action="store_true", help="machine output")
+    fmt.add_argument("--csv", action="store_true",
+                     help="stage table as CSV (README: reading a trace diff)")
     args = ap.parse_args(argv)
     try:
         a, b = load_capture(args.old), load_capture(args.new)
@@ -179,6 +213,8 @@ def main(argv: list[str] | None = None) -> int:
     d = diff_captures(a, b)
     if args.json:
         print(json.dumps(d, indent=2))
+    elif args.csv:
+        print(render_csv(d), end="")
     else:
         print(render(d))
     return 0
