@@ -340,7 +340,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--packed-input": args.packed_input,
             "--no-exact-counts": not args.exact_counts,
             "--feed-workers": args.feed_workers > 1,
-            "--feed-mode=thread": args.feed_workers > 1 and args.feed_mode != "process",
+            "--feed-mode=thread": args.feed_workers > 1 and args.feed_mode == "thread",
+            "--feed-mode=ring": args.feed_mode == "ring",
             "--experimental-match-impl": bool(args.experimental_match_impl),
             "--elastic": args.elastic,
             "--fault-plan": bool(args.fault_plan),
@@ -407,6 +408,13 @@ def _cmd_run(args: argparse.Namespace) -> int:
             print(f"error: tpu backend unavailable ({e})", file=sys.stderr)
             return 1
         enable_persistent_cache()  # skip the ~15s recompile on repeat runs
+        # convert-fleet manifests expand to their shard lists first: the
+        # multi-file WireReader concatenates shard payloads and counts
+        # resume offsets in stored-row units, so a fleet output is one
+        # corpus from here on
+        from .hostside.convertfleet import expand_wire_inputs
+
+        args.logs = expand_wire_inputs(args.logs)
         file_input = all(p != "-" for p in args.logs)
         from .hostside.wire import is_wire_file
 
@@ -438,6 +446,22 @@ def _cmd_run(args: argparse.Namespace) -> int:
             print(
                 "--feed-workers requires file inputs and the native parser, "
                 "and is not available with --distributed", file=sys.stderr,
+            )
+            return 2
+        if args.feed_mode == "ring" and args.feed_workers < 1:
+            print(
+                "--feed-mode ring needs --feed-workers N (the per-chip "
+                "producer pool size)", file=sys.stderr,
+            )
+            return 2
+        if args.feed_mode == "ring" and (
+            not file_input or args.distributed or args.native_parse is False
+            or wire_input
+        ):
+            print(
+                "--feed-mode ring requires text file inputs and the native "
+                "parser, and is not available with --distributed",
+                file=sys.stderr,
             )
             return 2
         if args.trace_out or args.metrics_out:
@@ -746,7 +770,11 @@ def _cmd_convert(args: argparse.Namespace) -> int:
     if args.block_rows < 1:
         print("error: --block-rows must be >= 1", file=sys.stderr)
         return 2
-    already = [p for p in args.logs if wire.is_wire_file(p)]
+    from .hostside.convertfleet import is_manifest_file
+
+    already = [
+        p for p in args.logs if wire.is_wire_file(p) or is_manifest_file(p)
+    ]
     if already:
         # a shell glob catching *.rawire must not "convert" binary data
         # through the text parser into a valid-but-empty wire file
@@ -757,15 +785,36 @@ def _cmd_convert(args: argparse.Namespace) -> int:
         )
         return 2
     packed = pack.load_packed(args.ruleset)
-    stats = wire.convert_logs(
-        packed,
-        args.logs,
-        args.out,
-        native=args.native_parse,
-        block_rows=args.block_rows,
-        feed_workers=args.feed_workers,
-        coalesce=args.coalesce,
-    )
+    if args.workers and args.workers >= 1:
+        # convert fleet (ISSUE 11): N processes, N pre-coalesced weighted
+        # shards, one manifest at --out; byte-identical for any N
+        from .hostside.convertfleet import convert_logs_fleet
+
+        if args.native_parse is False:
+            print("error: --workers requires the native parser", file=sys.stderr)
+            return 2
+        stats = convert_logs_fleet(
+            packed,
+            args.logs,
+            args.out,
+            workers=args.workers,
+            # --block-rows doubles as the descriptor granularity: shards
+            # split (and batches coalesce) at exact multiples of it, so
+            # the stored stream is a pure function of (corpus, block-rows)
+            batch_size=args.block_rows,
+            block_rows=args.block_rows,
+            coalesce=True,  # the fleet always writes the weighted format
+        )
+    else:
+        stats = wire.convert_logs(
+            packed,
+            args.logs,
+            args.out,
+            native=args.native_parse,
+            block_rows=args.block_rows,
+            feed_workers=args.feed_workers,
+            coalesce=args.coalesce,
+        )
     mb = stats["bytes"] / 1e6
     if stats.get("weighted"):
         stored = stats["rows"] + stats["rows6"]
@@ -791,7 +840,9 @@ def _cmd_wire_info(args: argparse.Namespace) -> int:
     import json as json_mod
 
     from .hostside import wire
+    from .hostside.convertfleet import expand_wire_inputs
 
+    args.files = expand_wire_inputs(args.files)
     # hash the ruleset once, not once per file
     fp = (
         wire.ruleset_fingerprint(pack.load_packed(args.ruleset))
@@ -1015,11 +1066,15 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--feed-workers", type=int, default=0, metavar="N",
                    help="parse with N workers over file shards "
                         "(multi-core hosts; implies the native parser; 0/1 = off)")
-    p.add_argument("--feed-mode", choices=["process", "thread"],
+    p.add_argument("--feed-mode", choices=["process", "thread", "ring"],
                    default="process",
                    help="worker kind for --feed-workers: separate processes "
-                        "packing into shared memory, or in-process threads "
-                        "around the GIL-releasing native parser")
+                        "packing into shared memory, in-process threads "
+                        "around the GIL-releasing native parser, or 'ring' — "
+                        "one pinned shared-memory ring PER CHIP with a "
+                        "partitioned producer pool, each chip's device_put "
+                        "fed straight from its own ring (bit-identical "
+                        "reports across all three modes)")
     p.add_argument("--coalesce", choices=["off", "on", "auto"], default="off",
                    help="pre-aggregate each batch's duplicate flow tuples "
                         "into (unique row, weight) pairs before the device "
@@ -1240,6 +1295,13 @@ def make_parser() -> argparse.ArgumentParser:
                         "(20 B/row + weights; bit-identical reports, file "
                         "and every later device step shrink by the "
                         "corpus's compaction ratio)")
+    p.add_argument("--workers", type=int, default=0, metavar="N",
+                   help="convert FLEET: shard the corpus by exact-raw-line "
+                        "descriptors across N worker processes, each "
+                        "writing one pre-coalesced RAWIREv3 shard; --out "
+                        "becomes a merge manifest `run` consumes as one "
+                        "corpus (bit-identical for any N; implies the "
+                        "weighted format; 0 = classic single-file convert)")
     p.set_defaults(fn=_cmd_convert)
 
     p = sub.add_parser(

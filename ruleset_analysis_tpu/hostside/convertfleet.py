@@ -1,0 +1,336 @@
+"""Parallel convert fleet: shard one corpus across worker processes
+into pre-coalesced RAWIREv3 wire shards + a deterministic merge manifest.
+
+ISSUE 11 / ROADMAP item 3: a single `convert` parses ~1.7-2.5M lines/s
+per core, but an 8-chip mesh needs ~16.7M parsed lines/s aggregate —
+convert must scale across cores the same way the feed plane does.  The
+fleet applies the feeder's exact-raw-line descriptor model to convert:
+
+- The coordinator chops the corpus into descriptors of exactly
+  ``batch_size`` raw lines (``hostside.feeder._scan_batches`` — byte
+  ranges only, native newline scanner, descriptors never span files) and
+  assigns CONTIGUOUS descriptor ranges to N worker processes.
+- Each worker parses its range with its own :class:`NativePacker` and
+  writes one complete RAWIREv3 **weighted** shard: rows coalesce
+  per-descriptor-batch into (unique row, weight) pairs — 20 B/row + the
+  uint32 weights plane, the cheapest bytes a chip can be fed.
+- The coordinator writes ``out`` as a MANIFEST: a small JSON file
+  listing the shards in corpus order with their row/line accounting and
+  the ruleset fingerprint.  ``run`` expands a manifest into its shard
+  list and feeds them through the existing multi-file
+  :class:`~.wire.WireReader`, which already concatenates payloads and
+  counts resume offsets in stored-row units across files — so the fleet
+  output is consumed as ONE corpus with bit-identical reports.
+
+Determinism: the descriptor set is a pure function of (corpus bytes,
+batch_size), workers only vary WHICH process handles a range, and
+coalescing is per-batch — so the concatenated row stream (and therefore
+every shard boundary, resume offset, and report) is byte-identical for
+any worker count.  ``--workers 1`` is the reference the identity tests
+pin ``--workers N`` against.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing
+import os
+import pickle
+
+import numpy as np
+
+from ..errors import AnalysisError, FeedWorkerError
+from . import fastparse
+from .feeder import _scan_batches
+from .pack import T_VALID, TUPLE_COLS, PackedRuleset
+from .wire import (
+    DEFAULT_BLOCK_ROWS,
+    WireWriter,
+    ruleset_fingerprint,
+)
+
+#: Manifest identity: first bytes of the JSON file, relied on by the
+#: cheap sniff in :func:`is_manifest_file` (mirrors the wire magic).
+MANIFEST_MAGIC = "RAWIRE-MANIFEST-v1"
+_MANIFEST_PREFIX = ('{"magic": "' + MANIFEST_MAGIC + '"').encode()
+
+
+def is_manifest_file(path: str) -> bool:
+    """True if ``path`` is a convert-fleet manifest (cheap byte sniff)."""
+    try:
+        with open(path, "rb") as f:
+            return f.read(len(_MANIFEST_PREFIX)) == _MANIFEST_PREFIX
+    except OSError:
+        return False
+
+
+def read_manifest(path: str) -> dict:
+    """Load + validate a manifest; shard paths resolve relative to it."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            m = json.load(f)
+    except (OSError, ValueError) as e:
+        raise AnalysisError(f"cannot read manifest {path!r}: {e}") from e
+    if m.get("magic") != MANIFEST_MAGIC:
+        raise AnalysisError(f"{path!r} is not a convert-fleet manifest")
+    base = os.path.dirname(os.path.abspath(path))
+    m["shard_paths"] = [
+        s if os.path.isabs(s) else os.path.join(base, s)
+        for s in (e["name"] for e in m["shards"])
+    ]
+    missing = [p for p in m["shard_paths"] if not os.path.exists(p)]
+    if missing:
+        raise AnalysisError(
+            f"manifest {path!r} names missing shard(s): {missing[:3]}"
+        )
+    return m
+
+
+def expand_wire_inputs(paths: list[str]) -> list[str]:
+    """Replace each manifest in ``paths`` with its shard list, in order.
+
+    Plain files (wire or text) pass through untouched, so callers can
+    route the expanded list through the existing wire/text sniffing.
+    """
+    out: list[str] = []
+    for p in paths:
+        if p != "-" and is_manifest_file(p):
+            out.extend(read_manifest(p)["shard_paths"])
+        else:
+            out.append(p)
+    return out
+
+
+def _shard_name(out_path: str, k: int, n: int) -> str:
+    return f"{out_path}.shard{k:02d}-of-{n:02d}"
+
+
+def _convert_descs(
+    packed: PackedRuleset,
+    paths: list[str],
+    descs: list[tuple],
+    shard_path: str,
+    *,
+    block_rows: int,
+    batch_size: int,
+    coalesce: bool,
+) -> dict:
+    """Parse one contiguous descriptor range into one complete shard.
+
+    Runs inline for ``workers == 1`` and inside each spawned worker
+    otherwise — one code path, so the reference and fleet outputs cannot
+    drift.  Coalescing is per-descriptor-batch, which is what makes the
+    row stream independent of how descriptors are grouped into shards.
+    """
+    from .pack import (
+        coalesce_wire,
+        coalesce_wire6,
+        compact_batch,
+        compact_batch6,
+    )
+
+    packer = fastparse.NativePacker(packed)
+    rows_cap = (2 if packed.bindings_out else 1) * batch_size
+    out = np.empty((TUPLE_COLS, rows_cap), dtype=np.uint32)
+    files: dict[int, object] = {}
+    w = WireWriter(
+        shard_path, ruleset_fingerprint(packed), block_rows, weighted=coalesce
+    )
+    try:
+        if packed.has_v6:
+            w.begin6()
+        last_skipped = 0
+        for path_i, offset, nbytes, n_lines in descs:
+            f = files.get(path_i)
+            if f is None:
+                f = files[path_i] = open(paths[path_i], "rb")
+            f.seek(offset)
+            data = f.read(nbytes)
+            _, lines, _used = packer.pack_chunk(
+                data, rows_cap, final=True, max_lines=n_lines, n_threads=1,
+                out=out,
+            )
+            assert lines == n_lines  # descriptors are exact raw-line spans
+            wire4 = compact_batch(out[:, out[T_VALID] == 1])
+            if coalesce:
+                wire4 = coalesce_wire(wire4)
+            w.add(wire4, n_lines, packer.skipped - last_skipped)
+            last_skipped = packer.skipped
+            if packed.has_v6:
+                rows6 = packer.take_v6()
+                if len(rows6):
+                    wire6 = compact_batch6(
+                        np.asarray(rows6, dtype=np.uint32).T
+                    )
+                    if coalesce:
+                        wire6 = coalesce_wire6(wire6)
+                    w.add6(wire6, 0, 0)
+        w.close()
+    except BaseException:
+        w.abort()  # partial magic: every reader refuses the torn shard
+        raise
+    finally:
+        for f in files.values():
+            f.close()
+    return {
+        "name": os.path.basename(shard_path),
+        "rows": w.n_rows,
+        "rows6": w.n6_rows,
+        "raw_lines": w.raw_lines,
+        "evals": w._evals if coalesce else w.n_rows + w.n6_rows,
+        "skipped": w.n_skipped,
+        "bytes": os.path.getsize(shard_path),
+    }
+
+
+def _fleet_worker(blob, paths, descs, shard_path, block_rows, batch_size,
+                  coalesce, k, done_q):
+    """Spawned worker: one descriptor range -> one shard; stats via queue."""
+    from ..runtime import obs
+
+    obs.note_role("convert-worker")
+    try:
+        packed = pickle.loads(blob)
+        stats = _convert_descs(
+            packed, paths, descs, shard_path,
+            block_rows=block_rows, batch_size=batch_size, coalesce=coalesce,
+        )
+    except Exception as e:  # forward instead of dying silently
+        done_q.put(("error", k, f"{type(e).__name__}: {e}"))
+        return
+    done_q.put(("ok", k, stats))
+
+
+def convert_logs_fleet(
+    packed: PackedRuleset,
+    log_paths: list[str],
+    out_path: str,
+    *,
+    workers: int,
+    batch_size: int = DEFAULT_BLOCK_ROWS,
+    block_rows: int = DEFAULT_BLOCK_ROWS,
+    coalesce: bool = True,
+) -> dict:
+    """Convert ``log_paths`` into ``workers`` wire shards + a manifest.
+
+    Returns the aggregate stats dict (same keys as ``wire.convert_logs``
+    plus ``workers``/``shards``).  Shards land next to ``out_path`` as
+    ``<out>.shardKK-of-NN``; ``out_path`` itself becomes the manifest.
+    A failed worker aborts the whole convert: its shard keeps the
+    partial-magic header every reader refuses, and the coordinator
+    removes all shard files before raising — never a silently short
+    corpus.
+    """
+    if workers < 1:
+        raise AnalysisError(f"convert fleet needs workers >= 1, got {workers}")
+    if not fastparse.available():
+        from ..errors import NativeParserUnavailable
+
+        raise NativeParserUnavailable("convert --workers requires the native parser")
+    descs = list(_scan_batches(list(log_paths), batch_size, 0))
+    n_shards = min(workers, max(1, len(descs)))
+    spans = [
+        descs[k * len(descs) // n_shards:(k + 1) * len(descs) // n_shards]
+        for k in range(n_shards)
+    ]
+    shard_paths = [_shard_name(out_path, k, n_shards) for k in range(n_shards)]
+
+    per_shard: list[dict | None] = [None] * n_shards
+    try:
+        if n_shards == 1:
+            per_shard[0] = _convert_descs(
+                packed, list(log_paths), spans[0], shard_paths[0],
+                block_rows=block_rows, batch_size=batch_size,
+                coalesce=coalesce,
+            )
+        else:
+            # spawn, not fork: the caller may run JAX thread pools, and
+            # the workers import only numpy + the native parser
+            ctx = multiprocessing.get_context("spawn")
+            done_q = ctx.Queue()
+            blob = pickle.dumps(packed)
+            procs = [
+                ctx.Process(
+                    target=_fleet_worker,
+                    args=(blob, list(log_paths), spans[k], shard_paths[k],
+                          block_rows, batch_size, coalesce, k, done_q),
+                    daemon=True,
+                )
+                for k in range(n_shards)
+            ]
+            for p in procs:
+                p.start()
+            try:
+                got = 0
+                while got < n_shards:
+                    try:
+                        msg = done_q.get(timeout=5.0)
+                    except Exception:
+                        dead = [p.pid for p in procs if not p.is_alive()]
+                        if dead and got < n_shards:
+                            # a worker died without reporting (OOM-kill
+                            # analog) — check again after a beat in case
+                            # its message is still in flight
+                            try:
+                                msg = done_q.get(timeout=2.0)
+                            except Exception:
+                                raise FeedWorkerError(
+                                    f"convert worker(s) {dead} died without "
+                                    "reporting (killed by the OS?)"
+                                ) from None
+                        else:
+                            continue
+                    if msg[0] == "error":
+                        raise FeedWorkerError(
+                            f"convert worker {msg[1]} failed: {msg[2]}"
+                        )
+                    _, k, stats = msg
+                    per_shard[k] = stats
+                    got += 1
+            finally:
+                for p in procs:
+                    p.join(timeout=10)
+                for p in procs:
+                    if p.is_alive():
+                        p.terminate()
+                for p in procs:
+                    p.join(timeout=5)
+                done_q.cancel_join_thread()
+                done_q.close()
+    except BaseException:
+        for sp in shard_paths:
+            try:
+                os.unlink(sp)
+            except OSError:
+                pass
+        raise
+
+    totals = {
+        key: sum(s[key] for s in per_shard)
+        for key in ("rows", "rows6", "raw_lines", "evals", "skipped", "bytes")
+    }
+    manifest = {
+        "magic": MANIFEST_MAGIC,
+        "fingerprint": ruleset_fingerprint(packed).hex(),
+        "weighted": coalesce,
+        "block_rows": block_rows,
+        "batch_size": batch_size,
+        "workers": n_shards,
+        **totals,
+        "shards": per_shard,
+    }
+    tmp = out_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        # no indent: the sniff in is_manifest_file keys on the first
+        # bytes being exactly '{"magic": "RAWIRE-MANIFEST-v1"'
+        json.dump(manifest, f)
+        f.write("\n")
+    os.replace(tmp, out_path)  # atomic: a crashed convert leaves no manifest
+    return {
+        **totals,
+        "bytes": totals["bytes"],
+        "parser": f"fleet-x{n_shards}",
+        "weighted": coalesce,
+        "workers": n_shards,
+        "shards": [s["name"] for s in per_shard],
+    }

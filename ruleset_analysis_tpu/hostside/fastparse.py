@@ -174,11 +174,51 @@ def _bind(lib: ctypes.CDLL) -> None:
             ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_int64),
         ]
         lib.asa_coalesce.restype = ctypes.c_int64
+        # SIMD tokenizer dispatch (ISSUE 11): runtime probe + A/B switch
+        lib.asa_simd_kind.argtypes = []
+        lib.asa_simd_kind.restype = ctypes.c_int
+        lib.asa_simd_set.argtypes = [ctypes.c_int]
 
 
 def available() -> bool:
     """True if the native parser library is loadable (building if needed)."""
     return _load() is not None
+
+
+#: asa_simd_kind() codes -> human-readable ISA names.
+_SIMD_KINDS = {0: "scalar", 1: "avx2", 2: "neon"}
+
+
+def simd_kind() -> str:
+    """Active tokenizer dispatch: ``"avx2"``/``"neon"``/``"scalar"``.
+
+    ``"scalar"`` means the CPU lacks both ISAs, the library is not
+    loadable, or ``RA_SIMD=off`` (the A/B override) disabled dispatch.
+    """
+    lib = _load()
+    if lib is None:
+        return "scalar"
+    return _SIMD_KINDS.get(int(lib.asa_simd_kind()), "scalar")
+
+
+def simd_active() -> bool:
+    """True when a vectorized scan implementation is dispatched."""
+    return simd_kind() != "scalar"
+
+
+def set_simd(on: bool) -> str:
+    """Force the tokenizer dispatch on/off at runtime; returns the
+    resulting :func:`simd_kind`.
+
+    The in-process twin of the ``RA_SIMD=off`` env override: the
+    identity sweep and the feedscale bench flip this to compare scalar
+    and SIMD parses of the same bytes in one process.  ``set_simd(True)``
+    on a CPU without AVX2/NEON is a no-op (stays ``"scalar"``).
+    """
+    lib = _load()
+    if lib is not None:
+        lib.asa_simd_set(1 if on else 0)
+    return simd_kind()
 
 
 def native_coalesce(
@@ -380,25 +420,16 @@ class NativePacker:
     def pack_lines(self, lines: list[str], batch_size: int | None = None) -> np.ndarray:
         """LinePacker-compatible helper (row-major [B, TUPLE_COLS]).
 
-        Mirrors ``LinePacker.pack_parsed``: raises :class:`AnalysisError`
-        when the parse staged v6 evaluations — this v4-only call has no
-        channel to return them, and silently leaving them in ``_staged6``
-        both loses supported traffic and accumulates memory across calls
-        (ADVICE r5 #2).  Use :meth:`pack_lines2` (or the streaming driver,
-        which drains :meth:`take_v6`) for unified corpora.
+        Returns the v4 plane only; v6 evaluations the parse produced stay
+        staged for :meth:`take_v6`, exactly like the chunk API and the
+        streaming drivers (ISSUE 11 closed the last v6-refusing tier, so
+        this call follows the same side-channel contract instead of the
+        old loud v4-only refusal).  Callers that never drain
+        :meth:`take_v6` on a unified corpus would accumulate staged rows
+        — the historical reason for the refusal (ADVICE r5 #2) — so
+        prefer :meth:`pack_lines2` when v6 traffic is possible.
         """
-        out = self._pack_lines_v4(lines, batch_size)
-        if self._staged6:
-            n6 = sum(a.shape[0] for a in self._staged6)
-            self._staged6 = []  # don't leak the rows into a later take_v6
-            from ..errors import AnalysisError
-
-            raise AnalysisError(
-                f"pack_lines is v4-only but the parse staged {n6} IPv6 "
-                "evaluation row(s); use pack_lines2 (or the streaming "
-                "driver, which handles both families)"
-            )
-        return out
+        return self._pack_lines_v4(lines, batch_size)
 
     def pack_lines2(
         self, lines: list[str], batch_size: int | None = None

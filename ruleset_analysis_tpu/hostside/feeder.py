@@ -413,6 +413,452 @@ class ParallelFeeder(_FeederBase):
             shm.unlink()
 
 
+def _ring_worker(packed_blob, paths, rows_cap_shard, rows6_cap_shard,
+                 ring_depth, shm_name, task_q, done_q):
+    """Ring-partition parse worker: fine descriptors -> per-chip slots.
+
+    Each task names the chip (ring) and slot its output belongs to; the
+    worker parses the descriptor's byte range straight into that slot's
+    shared-memory planes.  One worker may own several rings (W < D) or
+    share a ring with siblings (W > D); the coordinator's routing keeps
+    every ring's slots written in group order either way.
+    """
+    obs.note_role("ring-worker")
+    packed = pickle.loads(packed_blob)
+    packer = fastparse.NativePacker(packed)
+    shm = shared_memory.SharedMemory(name=shm_name)
+    slot_words = (
+        TUPLE_COLS * rows_cap_shard + TUPLE6_COLS * rows6_cap_shard
+    )
+    files = {}
+    try:
+        while True:
+            task = task_q.get()
+            if task is None:
+                return
+            t0_span = time.perf_counter()
+            # the ring twin of the queue tier's fault sites, plus the
+            # ring-specific stall: a wedged partition producer starves
+            # exactly one chip — the coordinator must bound it
+            faults.fire("feeder.worker.crash")
+            faults.fire("feeder.ring.stall")
+            g, j, slot, path_i, offset, nbytes, n_lines = task
+            try:
+                f = files.get(path_i)
+                if f is None:
+                    f = files[path_i] = open(paths[path_i], "rb")
+                f.seek(offset)
+                data = f.read(nbytes)
+                slot_off = 4 * (j * ring_depth + slot) * slot_words
+                out = np.ndarray(
+                    (TUPLE_COLS, rows_cap_shard), dtype=np.uint32,
+                    buffer=shm.buf, offset=slot_off,
+                )
+                p0, s0 = packer.parsed, packer.skipped
+                _, lines, _used = packer.pack_chunk(
+                    data, rows_cap_shard, final=True, max_lines=n_lines,
+                    n_threads=1, out=out,
+                )
+                n6 = 0
+                if rows6_cap_shard:
+                    rows6 = packer.take_v6()
+                    n6 = len(rows6)
+                    if n6:
+                        plane6 = np.ndarray(
+                            (TUPLE6_COLS, rows6_cap_shard), dtype=np.uint32,
+                            buffer=shm.buf,
+                            offset=slot_off + 4 * TUPLE_COLS * rows_cap_shard,
+                        )
+                        plane6[:, :n6] = np.asarray(rows6, dtype=np.uint32).T
+            except Exception as e:  # forward instead of dying silently
+                done_q.put(("error", g, f"{type(e).__name__}: {e}"))
+                return
+            obs.complete(
+                "feeder.parse", t0_span, time.perf_counter(), cat="feeder",
+                args={"group": g, "ring": j, "lines": lines},
+            )
+            done_q.put(
+                (g, j, slot, lines, packer.parsed - p0, packer.skipped - s0,
+                 n6)
+            )
+    finally:
+        for f in files.values():
+            f.close()
+        shm.close()
+
+
+class _RingBatch:
+    """One committed group: per-chip zero-copy views of ring slots.
+
+    ``views[d]`` is chip d's ``[TUPLE_COLS, shard_rows]`` plane, a view
+    STRAIGHT INTO that chip's shared-memory ring slot.  The consumer
+    must call :meth:`release` once it has copied the data out (the wire
+    bit-pack copies, so the per-chip ``device_put`` path releases right
+    after compacting); :meth:`assemble` is the copy-and-release
+    convenience for consumers that want one plain batch.
+    """
+
+    __slots__ = ("views", "n_raw", "_release_cb", "released")
+
+    def __init__(self, views, n_raw, release_cb):
+        self.views = views
+        self.n_raw = n_raw
+        self._release_cb = release_cb
+        self.released = False
+
+    def release(self) -> None:
+        if not self.released:
+            self.released = True
+            self._release_cb()
+
+    def assemble(self) -> np.ndarray:
+        """Concatenate to one ``[TUPLE_COLS, D*shard_rows]`` batch
+        (copies, then releases the ring slots)."""
+        out = np.concatenate(self.views, axis=1)
+        self.release()
+        return out
+
+
+class RingFeeder(_FeederBase):
+    """Per-chip feeder rings: one shared-memory ring per device.
+
+    The global task/completion queue of :class:`ParallelFeeder` funnels
+    every batch through one coordinator copy and one whole-batch
+    ``device_put`` — a host-side serialization point an 8-chip mesh
+    outgrows.  This tier partitions the producer pool BY CHIP instead
+    (ISSUE 11; the per-host data-tier idiom of the hybrid DCN x ICI
+    mesh): each device d owns a ring of ``ring_depth`` shared-memory
+    slots, descriptors chop ``batch_size/D`` lines fine (so a group of D
+    consecutive descriptors covers exactly the lines a queue-tier batch
+    would), and the worker partition serving ring d parses its line
+    sub-ranges straight into d's slots.  The driver's pack stage then
+    bit-packs each chip's view and issues that chip's ``device_put``
+    directly from the ring — no global assembly, no coordinator copy.
+
+    Equivalence with the queue tier: a group covers the same raw lines
+    as the queue batch with the same index (groups reset at file
+    boundaries exactly like batches), every register update is
+    order/padding-invariant, and v6 rows commit in line order through
+    the same rings — reports are bit-identical (pinned in
+    tests/test_feeder.py).  Within a group, chip d's shard holds the
+    rows of line sub-range d with its own valid prefix; padding between
+    shards is masked on device like any other padding.
+
+    ``emit_views`` (set by the driver): True yields :class:`_RingBatch`
+    per-chip views for the direct ``device_put`` path (flat layout +
+    prefetch); False yields plain assembled ``[TUPLE_COLS, rows_cap]``
+    arrays so the sync driver and the stacked layout consume this tier
+    unchanged.
+    """
+
+    yields_ring = True
+
+    def __init__(
+        self,
+        packed: PackedRuleset,
+        paths: list[str],
+        n_workers: int | None = None,
+        stall_timeout: float | None = None,
+        n_rings: int | None = None,
+        ring_depth: int = 4,
+    ):
+        super().__init__(packed, paths, n_workers, stall_timeout)
+        #: one ring per device; the driver resolves None to the mesh's
+        #: data extent before pulling batches
+        self.n_rings = n_rings
+        self.ring_depth = max(2, ring_depth)
+        self.emit_views = False
+        #: per-ring starved seconds (coordinator waited on this chip's
+        #: shard) — the trace_summary feed block's starved-chip gauge
+        self._starved_sec: list[float] = []
+        self._occupancy: list[int] = []
+
+    def batches(self, skip_lines: int, batch_size: int):
+        self.packer.parsed, self.packer.skipped = self._resume_counts
+        D = int(self.n_rings or 1)
+        if batch_size % D:
+            from ..errors import AnalysisError
+
+            raise AnalysisError(
+                f"ring feeder needs batch_size divisible by the ring count "
+                f"({batch_size} % {D} != 0); pad the batch size"
+            )
+        sub = batch_size // D
+        rows_cap_shard = (2 if self.packed.bindings_out else 1) * sub
+        rows6_cap_shard = 2 * sub if self.packed.has_v6 else 0
+        R = self.ring_depth
+        W = self.n_workers
+        slot_words = TUPLE_COLS * rows_cap_shard + TUPLE6_COLS * rows6_cap_shard
+        shm = shared_memory.SharedMemory(
+            create=True, size=4 * D * R * slot_words
+        )
+        ctx = multiprocessing.get_context("spawn")
+        # one producer pool partition per chip: ring d is served by a
+        # fixed worker set — contiguous ring blocks when W < D, the
+        # w ≡ d (mod D) residue class when W >= D — so chip d's feed
+        # never contends with another chip's parse backlog
+        if W >= D:
+            ring_workers = [[w for w in range(W) if w % D == d]
+                            for d in range(D)]
+        else:
+            ring_workers = [[d * W // D] for d in range(D)]
+        used_workers = sorted({w for ws in ring_workers for w in ws})
+        task_qs = {w: ctx.Queue() for w in used_workers}
+        done_q = ctx.Queue()
+        blob = pickle.dumps(self.packed)
+        workers = {
+            w: ctx.Process(
+                target=_ring_worker,
+                args=(blob, self.paths, rows_cap_shard, rows6_cap_shard, R,
+                      shm.name, task_qs[w], done_q),
+                daemon=True,
+            )
+            for w in used_workers
+        }
+        for w in workers.values():
+            w.start()
+        self._workers = list(workers.values())  # fault-injection tests
+        self._starved_sec = [0.0] * D
+        self._occupancy = [0] * D
+        import queue as _queue
+
+        next_submit = 0  # defined before try: the finally reads them
+        next_yield = 0
+        t_feed0 = None
+        occ_integral = [0.0] * D
+        try:
+            free_slots = [list(range(R)) for _ in range(D)]
+            # group bookkeeping: meta[g] = (n_shards, n_raw); done[g] =
+            # {j: (slot, lines, dp, ds, n6)}
+            meta: dict[int, tuple[int, int]] = {}
+            done: dict[int, dict[int, tuple]] = {}
+
+            def group_it():
+                """Yield [descriptors] groups of <= D fine descriptors,
+                resetting at file boundaries (exactly the line spans the
+                queue tier's batch_size-line batches cover)."""
+                cur: list[tuple] = []
+                for d in _scan_batches(self.paths, sub, skip_lines):
+                    if cur and (d[0] != cur[0][0] or len(cur) == D):
+                        yield cur
+                        cur = []
+                    cur.append(d)
+                    if d[3] < sub:  # short descriptor: file ends here
+                        yield cur
+                        cur = []
+                if cur:
+                    yield cur
+
+            groups = group_it()
+            groups_done = False
+
+            def submit_until_full():
+                # a group submits only when EVERY ring it touches has a
+                # free slot, so submission order per ring == group order
+                nonlocal next_submit, groups_done
+                while not groups_done:
+                    if any(not free_slots[j] for j in range(D)):
+                        return
+                    grp = next(groups, None)
+                    if grp is None:
+                        groups_done = True
+                        return
+                    g = next_submit
+                    next_submit += 1
+                    meta[g] = (len(grp), sum(d[3] for d in grp))
+                    done.setdefault(g, {})
+                    for j, desc in enumerate(grp):
+                        slot = free_slots[j].pop()
+                        self._occupancy[j] += 1
+                        ws = ring_workers[j]
+                        task_qs[ws[g % len(ws)]].put((g, j, slot, *desc))
+
+            def _gauges() -> dict:
+                occ = list(self._occupancy)
+                return {
+                    "mode": "ring",
+                    "rings": D,
+                    "ring_depth": R,
+                    "workers": len(workers),
+                    "alive": sum(1 for w in workers.values() if w.is_alive()),
+                    "inflight": next_submit - next_yield,
+                    "ring_occupancy": occ,
+                    "partition_imbalance": max(occ) - min(occ) if occ else 0,
+                    "starved_sec": [round(s, 3) for s in self._starved_sec],
+                }
+
+            obs.register_sampler("feeder", _gauges)
+            submit_until_full()
+            t_feed0 = time.monotonic()  # occupancy integral starts here
+            t_occ = t_feed0
+            stall_deadline = time.monotonic() + self.stall_timeout
+            while True:
+                if next_yield == next_submit:
+                    if groups_done:
+                        break
+                    # input remains but nothing could submit: the consumer
+                    # still holds every slot of some ring, and releases can
+                    # only happen on the consumer's own thread between
+                    # pulls — progress is impossible from inside this
+                    # generator, so abort loudly rather than silently
+                    # truncating the corpus at this point
+                    raise FeedWorkerError(
+                        "ring slots exhausted with unparsed input left: "
+                        "the consumer holds batches for every slot of a "
+                        "ring; release each batch before pulling the next "
+                        "(or raise ring_depth)"
+                    )
+                n_shards, n_raw = meta[next_yield]
+                while len(done[next_yield]) < n_shards:
+                    pending = [
+                        j for j in range(n_shards)
+                        if j not in done[next_yield]
+                    ]
+                    t0 = time.monotonic()
+                    try:
+                        msg = done_q.get(timeout=5.0)
+                    except _queue.Empty:
+                        dt = time.monotonic() - t0
+                        for j in pending:
+                            self._starved_sec[j] += dt
+                        dead = [
+                            w.pid for w in workers.values()
+                            if not w.is_alive()
+                        ]
+                        if dead:
+                            raise FeedWorkerError(
+                                f"ring feed worker(s) {dead} died without "
+                                "reporting (killed by the OS?)"
+                            )
+                        if time.monotonic() > stall_deadline:
+                            starving = ", ".join(
+                                f"chip{j}" for j in pending[:4]
+                            )
+                            raise StallError(
+                                f"ring feed made no progress in "
+                                f"{self.stall_timeout:.0f}s (rings dry: "
+                                f"{starving}); raise --stall-timeout if "
+                                "the input is legitimately this slow"
+                            )
+                        continue
+                    now = time.monotonic()
+                    dt = now - t0
+                    for j in pending:
+                        self._starved_sec[j] += dt
+                    for j in range(D):  # occupancy integral (slot-seconds)
+                        occ_integral[j] += self._occupancy[j] * (now - t_occ)
+                    t_occ = now
+                    stall_deadline = time.monotonic() + self.stall_timeout
+                    if msg[0] == "error":
+                        raise FeedWorkerError(
+                            f"ring feed worker failed on group {msg[1]}: "
+                            f"{msg[2]}"
+                        )
+                    g, j, slot, lines, dp, ds, n6 = msg
+                    done[g][j] = (slot, lines, dp, ds, n6)
+                shards = done.pop(next_yield)
+                meta.pop(next_yield)
+                views = []
+                taken: list[tuple[int, int]] = []  # (ring, slot) to free
+                for j in range(n_shards):
+                    slot, lines, dp, ds, n6 = shards[j]
+                    slot_off = 4 * (j * R + slot) * slot_words
+                    views.append(np.ndarray(
+                        (TUPLE_COLS, rows_cap_shard), dtype=np.uint32,
+                        buffer=shm.buf, offset=slot_off,
+                    ))
+                    if n6:
+                        plane6 = np.ndarray(
+                            (TUPLE6_COLS, rows6_cap_shard), dtype=np.uint32,
+                            buffer=shm.buf,
+                            offset=slot_off + 4 * TUPLE_COLS * rows_cap_shard,
+                        )
+                        # committed in shard (= line) order, same stream
+                        # as the queue tier stages
+                        self._stage_v6(
+                            np.ascontiguousarray(plane6[:, :n6].T)
+                        )
+                    self.packer.parsed += dp
+                    self.packer.skipped += ds
+                    taken.append((j, slot))
+                for j in range(n_shards, D):
+                    # short group (file end): missing chips feed zeros —
+                    # valid=0 padding, masked on device like any other
+                    views.append(np.zeros(
+                        (TUPLE_COLS, rows_cap_shard), dtype=np.uint32
+                    ))
+
+                def release(taken=taken):
+                    for j, slot in taken:
+                        free_slots[j].append(slot)
+                        self._occupancy[j] -= 1
+
+                rb = _RingBatch(views, n_raw, release)
+                next_yield += 1
+                if not self.emit_views:
+                    out = rb.assemble()  # copies + releases before yield
+                    submit_until_full()
+                    yield out, n_raw
+                else:
+                    yield rb, n_raw
+                    # the consumer released during pack (same thread);
+                    # anything still held just waits another round
+                    submit_until_full()
+        finally:
+            obs.unregister_sampler("feeder")
+            # one summary instant on the obs timeline (the devprof.summary
+            # pattern): the trace_summary feed block renders these without
+            # needing the metrics JSONL
+            if next_submit and t_feed0 is not None:
+                elapsed = max(1e-9, time.monotonic() - t_feed0)
+                occ_pct = [
+                    round(100.0 * occ_integral[j] / (R * elapsed), 2)
+                    for j in range(D)
+                ]
+                obs.instant(
+                    "feeder.summary",
+                    args={
+                        "mode": "ring",
+                        "rings": D,
+                        "ring_depth": R,
+                        "workers": len(workers),
+                        "groups": next_yield,
+                        "ring_occupancy_pct": occ_pct,
+                        "partition_imbalance_pct": round(
+                            max(occ_pct) - min(occ_pct), 2
+                        ) if occ_pct else 0.0,
+                        "starved_sec": [
+                            round(s, 3) for s in self._starved_sec
+                        ],
+                        "starved_total_sec": round(
+                            sum(self._starved_sec), 3
+                        ),
+                    },
+                )
+            for w_id, q in task_qs.items():
+                q.put(None)
+            deadline = time.monotonic() + 10.0
+            for w in workers.values():
+                w.join(timeout=max(0.0, deadline - time.monotonic()))
+            for w in workers.values():
+                if w.is_alive():
+                    w.terminate()
+            for w in workers.values():
+                w.join(timeout=5)
+            for q in (*task_qs.values(), done_q):
+                q.cancel_join_thread()
+                q.close()
+            try:
+                shm.close()
+            except BufferError:
+                # a consumer still holds a zero-copy slot view (e.g. an
+                # exception unwound mid-pack); dropping our reference
+                # lets GC finalize the mapping once the view dies — and
+                # teardown must not mask the consumer's real error
+                pass
+            shm.unlink()
+
+
 class ThreadedFeeder(_FeederBase):
     """In-process threaded twin of :class:`ParallelFeeder`.
 

@@ -14,804 +14,165 @@
 // corpora.  Both paths skip lines whose IPv4 octets, ports (> 65535) or
 // protocol numbers (> 255) exceed their field widths.
 //
+// SIMD layout (ISSUE 11): the line parser body lives in
+// asaparse_line.inl and compiles once per ISA — the scalar reference
+// here, AVX2 in asaparse_avx2.cpp, NEON in asaparse_neon.cpp — with the
+// ISA's scan kernels inlined into the tokenizer loops.  This TU owns the
+// runtime dispatch (CPU probe, RA_SIMD override, asa_simd_set A/B
+// switch): chunk loops resolve ONE handle-line pointer per call and the
+// bulk newline scans go through the ra_simd::ScanOps table.  Outputs are
+// byte-identical across every dispatch state (the 12k mutant sweep in
+// tests/test_fastparse.py pins it).
+//
 // C ABI only (loaded via ctypes; no pybind11 in this image).
 
+#include <atomic>
 #include <cstddef>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <unordered_map>
 #include <vector>
 
+#include "asaparse_types.h"
+#include "simd_scan.h"
+
+// ---------------------------------------------------------------------------
+// Scalar scan kernels for the reference build of the line parser: plain
+// byte loops (the compiler may auto-vectorize under -march=native, but
+// the SEMANTICS are the reference), and a dotted-quad hook that always
+// defers to the inline scalar parse.
+// ---------------------------------------------------------------------------
+
+static inline const char* ra_scan_token_end(const char* p, const char* end) {
+    while (p < end &&
+           !(*p == ' ' || *p == '\t' || *p == '\v' || *p == '\f' ||
+             *p == '\r' || *p == '\n'))
+        ++p;
+    return p;
+}
+
+static inline const char* ra_scan_addr_end(const char* p, const char* end) {
+    while (p < end &&
+           ((*p >= '0' && *p <= '9') || (*p >= 'a' && *p <= 'f') ||
+            (*p >= 'A' && *p <= 'F') || *p == ':' || *p == '.'))
+        ++p;
+    return p;
+}
+
+static inline int ra_scan_ipv4(const char** pp, const char* end,
+                               uint32_t* out) {
+    (void)pp;
+    (void)end;
+    (void)out;
+    return -1;  // always use the inline scalar reference parse
+}
+
+#define RA_PARSE_NS ra_scalar
+#include "asaparse_line.inl"
+#undef RA_PARSE_NS
+
+namespace ra_parse {
+HandleLineFn scalar_handle_line() { return &ra_scalar::handle_line; }
+}  // namespace ra_parse
+
 namespace {
+
+using ra_parse::HandleLineFn;
+using ra_parse::LocalCtx;
+using ra_parse::Packer;
 
 constexpr int64_t TUPLE_COLS = 7;
 
-struct Packer {
-    // key: firewall + '\x01' + acl   -> acl gid  (named-ACL messages)
-    //      firewall + '\x02' + iface -> acl gid  (in-direction binding)
-    //      firewall + '\x03' + iface -> acl gid  (out-direction binding)
-    std::unordered_map<std::string, uint32_t> resolve;
-    int64_t parsed = 0;   // ACL evaluations emitted (LinePacker.parsed)
-    int64_t skipped = 0;  // lines yielding none (LinePacker.skipped)
-};
+// ---------------------------------------------------------------------------
+// Runtime SIMD dispatch: ONE handle-line pointer (whole-line parser,
+// per-ISA build) plus one ScanOps table (bulk newline scans).  Selected
+// once per process from the CPU probe; RA_SIMD=off/0/false forces
+// scalar, asa_simd_set() flips at runtime so one process can A/B both
+// sides of the identity sweep and the feedscale bench.
+// ---------------------------------------------------------------------------
 
-// Per-thread parse context: the shared resolve table is read-only during a
-// parse; everything mutable is thread-local so N workers can parse one
-// batch's line ranges concurrently (the Hadoop input-split analog,
-// SURVEY.md §2 L2).
-struct LocalCtx {
-    const std::unordered_map<std::string, uint32_t>* resolve;
-    std::string keybuf;
-};
+std::atomic<HandleLineFn> g_handle{nullptr};
+std::atomic<const ra_simd::ScanOps*> g_scan_ops{nullptr};
+std::once_flag g_simd_once;
 
-inline bool is_sp(char c) { return c == ' ' || c == '\t' || c == '\v' || c == '\f' || c == '\r' || c == '\n'; }
-inline bool is_dig(char c) { return c >= '0' && c <= '9'; }
-
-const char* find_sub(const char* p, const char* end, const char* pat, size_t n) {
-    if (end - p < (std::ptrdiff_t)n) return nullptr;
-    return (const char*)memmem(p, end - p, pat, n);
-}
-
-// Parse a decimal run; false if no digits or value > 2^32-1.
-bool parse_u32(const char*& p, const char* end, uint32_t* out) {
-    if (p >= end || !is_dig(*p)) return false;
-    uint64_t v = 0;
-    const char* q = p;
-    while (q < end && is_dig(*q)) {
-        v = v * 10 + (uint64_t)(*q - '0');
-        if (v > 0xFFFFFFFFull) return false;
-        ++q;
+void pick_dispatch(bool simd_on) {
+    HandleLineFn h = nullptr;
+    const ra_simd::ScanOps* o = nullptr;
+    if (simd_on) {
+        h = ra_parse::avx2_handle_line();
+        if (!h) h = ra_parse::neon_handle_line();
+        o = ra_simd::avx2_ops();
+        if (!o) o = ra_simd::neon_ops();
     }
-    *out = (uint32_t)v;
-    p = q;
-    return true;
+    g_handle.store(h ? h : ra_parse::scalar_handle_line(),
+                   std::memory_order_relaxed);
+    g_scan_ops.store(o, std::memory_order_relaxed);
 }
 
-inline bool is_hex(char c) {
-    return is_dig(c) || (c >= 'a' && c <= 'f') || (c >= 'A' && c <= 'F');
-}
-inline bool is_addr_char(char c) { return is_hex(c) || c == ':' || c == '.'; }
-inline uint32_t hex_val(char c) {
-    if (is_dig(c)) return (uint32_t)(c - '0');
-    if (c >= 'a' && c <= 'f') return (uint32_t)(c - 'a' + 10);
-    return (uint32_t)(c - 'A' + 10);
+void simd_init() {
+    std::call_once(g_simd_once, [] {
+        const char* e = std::getenv("RA_SIMD");
+        bool off = e && (strcmp(e, "off") == 0 || strcmp(e, "0") == 0 ||
+                         strcmp(e, "false") == 0);
+        pick_dispatch(!off);
+    });
 }
 
-// Dotted-quad IPv4 over a [0-9.] run: exactly 4 octets, each 0..255
-// (hostside.aclparse.ip_to_u32 semantics).  Advances p past the run on
-// success; on failure leaves p unspecified and returns false.
-bool parse_ipv4_run(const char*& p, const char* end, uint32_t* out) {
-    uint32_t v = 0;
-    int octets = 0;
-    const char* q = p;
-    while (octets < 4) {
-        if (q >= end || !is_dig(*q)) return false;
-        uint64_t o = 0;
-        while (q < end && is_dig(*q)) {
-            o = o * 10 + (uint64_t)(*q - '0');
-            if (o > 0xFFFFFFFFull) return false;
-            ++q;
+inline HandleLineFn handle_line_fn() {
+    return g_handle.load(std::memory_order_relaxed);
+}
+
+inline const ra_simd::ScanOps* scan_ops() {
+    return g_scan_ops.load(std::memory_order_relaxed);
+}
+
+// Build the line-start index for the MT parse paths: up to ``want``
+// complete lines from [buf, buf+len), plus the trailing unterminated
+// fragment as a final line when ``final_``.  Pushes each line's start
+// offset onto ``off`` and returns one past the consumed region.  The
+// SIMD path gathers every newline position in bulk (32 bytes/cycle of
+// classify+movemask) instead of one memchr call per line.
+const char* build_line_index(const char* buf, int64_t len, int final_,
+                             int64_t want, std::vector<uint32_t>& off) {
+    const char* end = buf + len;
+    const char* p = buf;
+    const ra_simd::ScanOps* ops = scan_ops();
+    if (ops && want > 0) {
+        std::vector<uint32_t> nls((size_t)want);
+        int64_t c = ops->nl_positions(buf, len, nls.data(), want);
+        uint32_t start = 0;
+        for (int64_t i = 0; i < c; ++i) {
+            off.push_back(start);
+            start = nls[(size_t)i] + 1;
         }
-        if (o > 255) return false;
-        v = (v << 8) | (uint32_t)o;
-        ++octets;
-        if (octets < 4) {
-            if (q >= end || *q != '.') return false;
-            ++q;
+        p = buf + start;
+        if (c < want && p < end && final_) {  // trailing fragment
+            off.push_back(start);
+            p = end;
         }
+        return p;
     }
-    // the regex run [\d.]+ is maximal: a trailing '.' or digit means the
-    // run does not parse as exactly four octets
-    if (q < end && (*q == '.' || is_dig(*q))) return false;
-    *out = v;
-    p = q;
-    return true;
-}
-
-// One parsed address of either family: fam is 4 or 6; v6 addresses carry
-// 4 big-endian uint32 limbs (pack.u128_limbs layout).
-struct Addr {
-    uint32_t fam = 4;
-    uint32_t v4 = 0;
-    uint32_t l[4] = {0, 0, 0, 0};
-};
-
-// Parse [rs, re) — one complete address text run — as an IPv6 literal
-// (RFC 4291 forms: hex groups, one '::' compression, optional embedded
-// trailing dotted quad).  Mirrors the stdlib ipaddress acceptance the
-// Python path delegates to (hostside.aclparse.ip6_to_int): groups are
-// 1-4 hex digits, exactly 8 groups without '::', fewer with, the
-// embedded v4 counts as two groups and may only appear last.
-bool parse_ipv6_text(const char* rs, const char* re, uint32_t limbs[4]) {
-    uint16_t head[8];
-    uint16_t tail[8];
-    int n_head = 0, n_tail = 0;
-    bool compressed = false;
-    const char* p = rs;
-    if (p >= re) return false;
-    if (*p == ':') {
-        // must be a leading '::'
-        if (p + 1 >= re || p[1] != ':') return false;
-        compressed = true;
-        p += 2;
+    while (p < end && (int64_t)off.size() < want) {
+        const char* nl = (const char*)memchr(p, '\n', end - p);
+        if (!nl && !final_) break;  // incomplete tail line
+        off.push_back((uint32_t)(p - buf));
+        p = nl ? nl + 1 : end;
     }
-    bool want_group = !(compressed && p == re);
-    while (p < re) {
-        // embedded trailing dotted quad? detect a digit run followed by '.'
-        const char* q = p;
-        while (q < re && is_dig(*q)) ++q;
-        if (q > p && q < re && *q == '.') {
-            const char* v4p = p;
-            uint32_t v4;
-            if (!parse_ipv4_run(v4p, re, &v4) || v4p != re) return false;
-            uint16_t* dst = compressed ? tail : head;
-            int& n = compressed ? n_tail : n_head;
-            if (n + 2 > 8) return false;
-            dst[n++] = (uint16_t)(v4 >> 16);
-            dst[n++] = (uint16_t)(v4 & 0xFFFF);
-            p = re;
-            want_group = false;
-            break;
-        }
-        // hex group: 1-4 hex digits
-        uint32_t g = 0;
-        int nd = 0;
-        while (p < re && is_hex(*p) && nd < 5) {
-            g = (g << 4) | hex_val(*p);
-            ++p;
-            ++nd;
-        }
-        if (nd == 0 || nd > 4) return false;
-        uint16_t* dst = compressed ? tail : head;
-        int& n = compressed ? n_tail : n_head;
-        if (n >= 8) return false;
-        dst[n++] = (uint16_t)g;
-        want_group = false;
-        if (p < re) {
-            if (*p != ':') return false;
-            ++p;
-            if (p < re && *p == ':') {
-                if (compressed) return false;  // second '::'
-                compressed = true;
-                ++p;
-                if (p == re) { want_group = false; break; }
-            } else {
-                if (p == re) return false;  // single trailing ':'
-                want_group = true;
-            }
-        }
-    }
-    if (want_group) return false;
-    int total = n_head + n_tail;
-    if (compressed ? total >= 8 : total != 8) return false;
-    uint16_t groups[8] = {0, 0, 0, 0, 0, 0, 0, 0};
-    for (int i = 0; i < n_head; ++i) groups[i] = head[i];
-    for (int i = 0; i < n_tail; ++i) groups[8 - n_tail + i] = tail[i];
-    for (int i = 0; i < 4; ++i)
-        limbs[i] = ((uint32_t)groups[2 * i] << 16) | groups[2 * i + 1];
-    return true;
-}
-
-// Parse the maximal [0-9A-Fa-f:.] run at p as an address of either
-// family (the Python regexes capture exactly this class and then parse
-// by ':' presence).  Returns 1 on success (p past the run), 0 when the
-// run is not address-shaped at all (structural failure — caller keeps
-// scanning), -1 when the run IS the address capture but its value is
-// invalid (semantic failure: Python raises inside _addr and the whole
-// line skips with no rescan).
-int parse_addr_run(const char*& p, const char* end, Addr* a) {
-    const char* rs = p;
-    const char* re = rs;
-    bool has_colon = false;
-    while (re < end && is_addr_char(*re)) {
-        has_colon |= (*re == ':');
-        ++re;
-    }
-    if (re == rs) return 0;
-    if (!has_colon) {
-        const char* q = rs;
-        uint32_t v4;
-        if (!parse_ipv4_run(q, re, &v4) || q != re) return -1;
-        a->fam = 4;
-        a->v4 = v4;
-        p = re;
-        return 1;
-    }
-    if (!parse_ipv6_text(rs, re, a->l)) return -1;
-    a->fam = 6;
-    p = re;
-    return 1;
-}
-
-void skip_ws(const char*& p, const char* end) {
-    while (p < end && is_sp(*p)) ++p;
-}
-
-bool skip_ws1(const char*& p, const char* end) {  // require at least one
-    if (p >= end || !is_sp(*p)) return false;
-    skip_ws(p, end);
-    return true;
-}
-
-// Token = maximal non-space run.
-bool token(const char*& p, const char* end, const char** t0, const char** t1) {
-    if (p >= end || is_sp(*p)) return false;
-    *t0 = p;
-    while (p < end && !is_sp(*p)) ++p;
-    *t1 = p;
-    return true;
-}
-
-bool tok_eq(const char* t0, const char* t1, const char* s) {
-    size_t n = strlen(s);
-    return (size_t)(t1 - t0) == n && memcmp(t0, s, n) == 0;
-}
-
-// _proto_num: PROTO_NUMBERS name (case-insensitive) -> number; else
-// decimal; else 0.
-uint32_t proto_num(const char* t0, const char* t1) {
-    char buf[16];
-    size_t n = (size_t)(t1 - t0);
-    if (n < sizeof(buf)) {
-        for (size_t i = 0; i < n; ++i) {
-            char c = t0[i];
-            buf[i] = (c >= 'A' && c <= 'Z') ? (char)(c + 32) : c;
-        }
-        buf[n] = 0;
-        // ordered by real-traffic frequency: tcp/udp dominate ASA logs
-        struct { const char* name; uint32_t v; } static const tbl[] = {
-            {"tcp", 6},  {"udp", 17},  {"icmp", 1},  {"ip", 0},
-            {"igmp", 2}, {"ipinip", 4}, {"gre", 47},  {"esp", 50},
-            {"ah", 51},  {"icmp6", 58}, {"eigrp", 88}, {"ospf", 89},
-            {"nos", 94}, {"pim", 103}, {"pcp", 108}, {"snp", 109},
-            {"sctp", 132},
-        };
-        for (auto& e : tbl)
-            if (strcmp(buf, e.name) == 0) return e.v;
-    }
-    const char* p = t0;
-    uint32_t v = 0;
-    if (parse_u32(p, t1, &v) && p == t1) return v;
-    return 0;
-}
-
-struct Parsed {
-    const char* fw0; const char* fw1;
-    const char* acl0; const char* acl1;   // acl0 == nullptr: resolve by iface
-    const char* if0; const char* if1;     // ingress interface (in binding)
-    const char* eif0 = nullptr;           // egress interface (out binding);
-    const char* eif1 = nullptr;           // 302013/302015 only
-    uint32_t proto, sport, dport;
-    Addr src, dst;                        // either family; must agree
-};
-
-// "if/ADDR(port)" endpoint of 106100: iface is the shortest prefix whose
-// '/' is followed by a parseable "ADDR(port)" of either family.
-// Returns 1 ok / 0 structural mismatch (caller keeps scanning) /
-// -1 semantic failure (address text captured but invalid — Python raises
-// inside _addr and the whole line skips, so callers must abort).
-int endpoint_slash_paren(const char*& p, const char* end,
-                         const char** if0, const char** if1,
-                         Addr* addr, uint32_t* port) {
-    const char* t0; const char* t1;
-    const char* q = p;
-    if (!token(q, end, &t0, &t1)) return 0;
-    for (const char* s = t0; s < t1; ++s) {
-        if (*s != '/') continue;
-        if (s == t0) continue;  // iface must be non-empty
-        const char* c = s + 1;
-        // structure first: maximal addr run, then '(digits)'
-        const char* re = c;
-        while (re < t1 && is_addr_char(*re)) ++re;
-        if (re == c || re >= t1 || *re != '(') continue;
-        const char* pc = re + 1;
-        uint32_t pv;
-        if (!parse_u32(pc, t1, &pv)) continue;
-        if (pc >= t1 || *pc != ')') continue;
-        ++pc;
-        Addr a;
-        const char* ac = c;
-        if (parse_addr_run(ac, re, &a) != 1 || ac != re) return -1;
-        *if0 = t0; *if1 = s; *addr = a; *port = pv;
-        p = pc;  // just past ')': an extra paren group may follow unspaced
-        return 1;
-    }
-    return 0;
-}
-
-// "if:ADDR[/port]" endpoint of 106023 (port optional, defaults 0) and
-// 302013 (port required).  Same 1/0/-1 contract as endpoint_slash_paren.
-//
-// ``require_token_end``: the 106023 SRC endpoint is followed by ``\s+dst``
-// in the regex, so Python only commits to a colon split whose endpoint
-// reaches the end of the token — a mid-token leftover is a STRUCTURAL
-// mismatch that backtracks to a later colon (fuzz: "inside:1side:A.B.C.D"
-// must split at the SECOND colon).  The DST endpoint is followed by
-// ``.*?by`` (anything matches), so it commits to the first structural
-// split and a bad value there skips the line — require_token_end=false.
-int endpoint_colon(const char*& p, const char* end, bool port_required,
-                   const char** if0, const char** if1,
-                   Addr* addr, uint32_t* port,
-                   bool require_token_end = false) {
-    const char* t0; const char* t1;
-    const char* q = p;
-    if (!token(q, end, &t0, &t1)) return 0;
-    for (const char* s = t0; s < t1; ++s) {
-        if (*s != ':') continue;
-        if (s == t0) continue;
-        const char* c = s + 1;
-        const char* re = c;
-        while (re < t1 && is_addr_char(*re)) ++re;
-        if (re == c) continue;
-        uint32_t pv = 0;
-        const char* after = re;
-        if (after < t1 && *after == '/') {
-            const char* c2 = after + 1;
-            if (parse_u32(c2, t1, &pv)) after = c2;
-            else if (port_required) continue;
-        } else if (port_required) {
-            continue;
-        }
-        if (require_token_end && after != t1) continue;
-        Addr a;
-        const char* ac = c;
-        if (parse_addr_run(ac, re, &a) != 1 || ac != re) return -1;
-        *if0 = t0; *if1 = s; *addr = a; *port = pv;
-        p = after;
-        return 1;
-    }
-    return 0;
-}
-
-bool parse_106100(const char* b, const char* be, Parsed* out) {
-    const char* pos = b;
-    while (true) {
-        const char* hit = find_sub(pos, be, "access-list", 11);
-        if (!hit) return false;
-        pos = hit + 1;
-        const char* p = hit + 11;
-        const char* a0; const char* a1; const char* v0; const char* v1;
-        const char* pr0; const char* pr1;
-        if (!skip_ws1(p, be)) continue;
-        if (!token(p, be, &a0, &a1)) continue;
-        if (!skip_ws1(p, be)) continue;
-        if (!token(p, be, &v0, &v1)) continue;
-        if (!(tok_eq(v0, v1, "permitted") || tok_eq(v0, v1, "denied") ||
-              tok_eq(v0, v1, "est-allowed")))
-            continue;
-        if (!skip_ws1(p, be)) continue;
-        if (!token(p, be, &pr0, &pr1)) continue;
-        if (!skip_ws1(p, be)) continue;
-        const char* i0; const char* i1; Addr sa; uint32_t spo;
-        int rc = endpoint_slash_paren(p, be, &i0, &i1, &sa, &spo);
-        if (rc < 0) return false;  // invalid address text: line skips
-        if (!rc) continue;
-        if (p < be && *p == '(') {  // optional "(...)" (e.g. identity info)
-            const char* c = (const char*)memchr(p, ')', be - p);
-            if (c) p = c + 1;
-        }
-        skip_ws(p, be);
-        if (p + 1 >= be || p[0] != '-' || p[1] != '>') continue;
-        p += 2;
-        skip_ws(p, be);
-        const char* j0; const char* j1; Addr da; uint32_t dpo;
-        rc = endpoint_slash_paren(p, be, &j0, &j1, &da, &dpo);
-        if (rc < 0) return false;
-        if (!rc) continue;
-        if (sa.fam != da.fam) return false;  // mixed-family line: skip
-        uint32_t proto = proto_num(pr0, pr1);
-        // ICMP/ICMPv6: parenthesised values are type/code; type -> dport,
-        // sport=0 (58 added with the v6 data model; mirrors syslog.py)
-        if (proto == 1 || proto == 58) { dpo = spo; spo = 0; }
-        out->acl0 = a0; out->acl1 = a1;
-        out->if0 = i0; out->if1 = i1;
-        out->proto = proto; out->src = sa; out->sport = spo;
-        out->dst = da; out->dport = dpo;
-        return true;
-    }
-}
-
-bool parse_106023(const char* b, const char* be, Parsed* out) {
-    const char* pos = b;
-    while (true) {
-        const char* hit = find_sub(pos, be, "Deny", 4);
-        if (!hit) return false;
-        pos = hit + 1;
-        const char* p = hit + 4;
-        const char* pr0; const char* pr1; const char* s0; const char* s1;
-        if (!skip_ws1(p, be)) continue;
-        if (!token(p, be, &pr0, &pr1)) continue;
-        if (!skip_ws1(p, be)) continue;
-        if (!token(p, be, &s0, &s1) || !tok_eq(s0, s1, "src")) continue;
-        if (!skip_ws1(p, be)) continue;
-        const char* i0; const char* i1; Addr sa; uint32_t spo;
-        int rc = endpoint_colon(p, be, false, &i0, &i1, &sa, &spo,
-                                /*require_token_end=*/true);
-        if (rc < 0) return false;
-        if (!rc) continue;
-        if (!skip_ws1(p, be)) continue;
-        if (!token(p, be, &s0, &s1) || !tok_eq(s0, s1, "dst")) continue;
-        if (!skip_ws1(p, be)) continue;
-        const char* j0; const char* j1; Addr da; uint32_t dpo;
-        rc = endpoint_colon(p, be, false, &j0, &j1, &da, &dpo);
-        if (rc < 0) return false;
-        if (!rc) continue;
-        if (sa.fam != da.fam) return false;
-        // optional " (type T, code C)"
-        bool have_type = false;
-        uint32_t icmp_type = 0, tmp;
-        {
-            const char* q = p;
-            if (skip_ws1(q, be) && q + 5 <= be && memcmp(q, "(type", 5) == 0) {
-                const char* c = q + 5;
-                if (skip_ws1(c, be) && parse_u32(c, be, &icmp_type) &&
-                    c < be && *c == ',') {
-                    ++c;
-                    skip_ws(c, be);
-                    if (c + 4 <= be && memcmp(c, "code", 4) == 0) {
-                        c += 4;
-                        if (skip_ws1(c, be) && parse_u32(c, be, &tmp) &&
-                            c < be && *c == ')') {
-                            have_type = true;
-                            p = c + 1;
-                        }
-                    }
-                }
-            }
-        }
-        // .*?by\s+access-group\s+"<acl>"
-        const char* scan = p;
-        const char* a0 = nullptr; const char* a1 = nullptr;
-        while (true) {
-            const char* ag = find_sub(scan, be, "access-group", 12);
-            if (!ag) break;
-            scan = ag + 1;
-            const char* back = ag;
-            if (back <= p || !is_sp(back[-1])) continue;
-            while (back > p && is_sp(back[-1])) --back;
-            if (back - p < 2 || back[-1] != 'y' || back[-2] != 'b') continue;
-            const char* c = ag + 12;
-            if (!skip_ws1(c, be)) continue;
-            if (c >= be || *c != '"') continue;
-            ++c;
-            const char* close = (const char*)memchr(c, '"', be - c);
-            if (!close || close == c) continue;  // regex [^"]+ needs >=1 char
-            a0 = c; a1 = close;
-            break;
-        }
-        if (!a0) continue;
-        uint32_t proto = proto_num(pr0, pr1);
-        if ((proto == 1 || proto == 58) && have_type) { dpo = icmp_type; spo = 0; }
-        out->acl0 = a0; out->acl1 = a1;
-        out->if0 = i0; out->if1 = i1;
-        out->proto = proto; out->src = sa; out->sport = spo;
-        out->dst = da; out->dport = dpo;
-        return true;
-    }
-}
-
-bool parse_302013(const char* b, const char* be, Parsed* out) {
-    const char* pos = b;
-    while (true) {
-        const char* hit = find_sub(pos, be, "Built", 5);
-        if (!hit) return false;
-        pos = hit + 1;
-        const char* p = hit + 5;
-        const char* t0; const char* t1;
-        if (!skip_ws1(p, be)) continue;
-        if (!token(p, be, &t0, &t1)) continue;
-        bool inbound;
-        if (tok_eq(t0, t1, "inbound")) inbound = true;
-        else if (tok_eq(t0, t1, "outbound")) inbound = false;
-        else continue;
-        if (!skip_ws1(p, be)) continue;
-        if (!token(p, be, &t0, &t1)) continue;
-        uint32_t proto;
-        if (tok_eq(t0, t1, "TCP")) proto = 6;
-        else if (tok_eq(t0, t1, "UDP")) proto = 17;
-        else continue;
-        if (!skip_ws1(p, be)) continue;
-        if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "connection")) continue;
-        if (!skip_ws1(p, be)) continue;
-        if (!token(p, be, &t0, &t1)) continue;  // connection id
-        if (!skip_ws1(p, be)) continue;
-        if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "for")) continue;
-        if (!skip_ws1(p, be)) continue;
-        const char* ia0; const char* ia1; Addr aa; uint32_t poa;
-        int rc = endpoint_colon(p, be, true, &ia0, &ia1, &aa, &poa);
-        if (rc < 0) return false;
-        if (!rc) continue;
-        skip_ws(p, be);
-        if (p < be && *p == '(') {
-            const char* c = (const char*)memchr(p, ')', be - p);
-            if (c) p = c + 1;
-        }
-        skip_ws(p, be);
-        if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "to")) continue;
-        if (!skip_ws1(p, be)) continue;
-        const char* ib0; const char* ib1; Addr ab; uint32_t pob;
-        rc = endpoint_colon(p, be, true, &ib0, &ib1, &ab, &pob);
-        if (rc < 0) return false;
-        if (!rc) continue;
-        if (aa.fam != ab.fam) return false;
-        out->acl0 = nullptr; out->acl1 = nullptr;
-        // inbound: initiated at A (src=A, ingress=ifA, egress=ifB);
-        // outbound: initiated at B (src=B, ingress=ifB, egress=ifA).
-        // The egress side's out-direction ACL (if bound) also filters.
-        if (inbound) {
-            out->if0 = ia0; out->if1 = ia1;
-            out->eif0 = ib0; out->eif1 = ib1;
-            out->src = aa; out->sport = poa; out->dst = ab; out->dport = pob;
-        } else {
-            out->if0 = ib0; out->if1 = ib1;
-            out->eif0 = ia0; out->eif1 = ia1;
-            out->src = ab; out->sport = pob; out->dst = aa; out->dport = poa;
-        }
-        out->proto = proto;
-        return true;
-    }
-}
-
-// "ADDR/port" endpoint of the 106001/106006/106015 family ("from A/p to
-// B/q"): a bare address of either family, '/', decimal port — no
-// interface prefix.  Same 1/0/-1 contract as the other endpoints.
-int endpoint_bare(const char*& p, const char* end, Addr* addr, uint32_t* port) {
-    const char* re = p;
-    while (re < end && is_addr_char(*re)) ++re;
-    if (re == p) return 0;
-    if (re >= end || *re != '/') return 0;
-    const char* q = re + 1;
-    uint32_t pv;
-    if (!parse_u32(q, end, &pv)) return 0;
-    Addr a;
-    const char* ac = p;
-    if (parse_addr_run(ac, re, &a) != 1 || ac != re) return -1;
-    *addr = a; *port = pv;
-    p = q;
-    return 1;
-}
-
-// First "on interface <if>" at or after p (the 106001/106015 regexes use
-// a lazy ".*?", so the FIRST occurrence wins, matching syslog.py).
-bool on_interface_scan(const char* p, const char* be, const char** i0, const char** i1) {
-    const char* scan = p;
-    while (true) {
-        const char* hit = find_sub(scan, be, "on", 2);
-        if (!hit) return false;
-        scan = hit + 1;
-        // \bon: previous char must not be a word char (regex \b semantics)
-        char prev = hit > p ? hit[-1] : ' ';
-        if ((prev >= 'a' && prev <= 'z') || (prev >= 'A' && prev <= 'Z') ||
-            (prev >= '0' && prev <= '9') || prev == '_')
-            continue;
-        const char* c = hit + 2;
-        if (!skip_ws1(c, be)) continue;
-        const char* t0; const char* t1;
-        if (!token(c, be, &t0, &t1) || !tok_eq(t0, t1, "interface")) continue;
-        if (!skip_ws1(c, be)) continue;
-        if (!token(c, be, &t0, &t1)) continue;
-        *i0 = t0; *i1 = t1;
-        return true;
-    }
-}
-
-// 106001: Inbound TCP connection denied from A/p to B/q flags ... on
-// interface IF.  106015: Deny TCP (no connection) from A/p to B/q flags
-// ... on interface IF.  106006: Deny inbound UDP from A/p to B/q on
-// interface IF (immediately — no flags text).  All resolve via the
-// interface's in-direction binding.  ``lead`` is a token sequence matched
-// with \s+ separators (the regexes' flexibility); a token prefixed with
-// '\x01' must instead be separated from its predecessor by EXACTLY one
-// space (the 106015 pattern embeds a literal space inside
-// "\(no connection\)").
-bool parse_106001_like(const char* b, const char* be,
-                       const char* const* lead, int lead_n,
-                       bool need_flags, uint32_t proto, Parsed* out) {
-    size_t first_n = strlen(lead[0]);
-    const char* pos = b;
-    while (true) {
-        const char* hit = find_sub(pos, be, lead[0], first_n);
-        if (!hit) return false;
-        pos = hit + 1;
-        const char* p = hit;
-        const char* t0; const char* t1;
-        bool lead_ok = true;
-        for (int i = 0; i < lead_n; ++i) {
-            const char* want = lead[i];
-            if (i) {
-                if (want[0] == '\x01') {
-                    ++want;
-                    if (p >= be || *p != ' ') { lead_ok = false; break; }
-                    ++p;  // exactly one space; token() rejects a second
-                } else if (!skip_ws1(p, be)) {
-                    lead_ok = false;
-                    break;
-                }
-            }
-            if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, want)) {
-                lead_ok = false;
-                break;
-            }
-        }
-        if (!lead_ok) continue;
-        if (!skip_ws1(p, be)) continue;
-        Addr sa; uint32_t spo;
-        int rc = endpoint_bare(p, be, &sa, &spo);
-        if (rc < 0) return false;
-        if (!rc) continue;
-        if (!skip_ws1(p, be)) continue;
-        if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "to")) continue;
-        if (!skip_ws1(p, be)) continue;
-        Addr da; uint32_t dpo;
-        rc = endpoint_bare(p, be, &da, &dpo);
-        if (rc < 0) return false;
-        if (!rc) continue;
-        if (sa.fam != da.fam) return false;
-        const char* i0; const char* i1;
-        if (need_flags) {
-            if (!skip_ws1(p, be)) continue;
-            if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "flags")) continue;
-            if (!on_interface_scan(p, be, &i0, &i1)) continue;
-        } else {
-            // 106006: "on interface" must follow the endpoints directly
-            if (!skip_ws1(p, be)) continue;
-            if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "on")) continue;
-            if (!skip_ws1(p, be)) continue;
-            if (!token(p, be, &t0, &t1) || !tok_eq(t0, t1, "interface")) continue;
-            if (!skip_ws1(p, be)) continue;
-            if (!token(p, be, &i0, &i1)) continue;
-        }
-        out->acl0 = nullptr; out->acl1 = nullptr;
-        out->if0 = i0; out->if1 = i1;
-        out->proto = proto;
-        out->src = sa; out->sport = spo; out->dst = da; out->dport = dpo;
-        return true;
-    }
-}
-
-// Parse one line; emit its ACL evaluations into the column-major output.
-//
-// Returns the number of tuple rows written (0 = line skipped), or -1 when
-// the line's rows do NOT fit in [row, cap) — the caller must close the
-// batch without consuming the line.  A connection message whose ingress
-// interface has an in-ACL and whose egress interface has an out-ACL emits
-// TWO rows (two independent evaluations), mirroring LinePacker.
-//
-// Parity note (syslog.parse_line): _TAG_RE.search finds the FIRST
-// well-formed "%ASA-<d>-<dddddd>:" marker that has a host token before
-// it; the line's fate is then decided by that one tag — an unhandled
-// msgid or a failed body parse means the line is skipped, with no retry
-// against later markers.  Only malformed markers keep the scan going.
-int handle_line(LocalCtx* pk, const char* ls, const char* le,
-                uint32_t* out, int64_t cap, int64_t row,
-                uint32_t* out6 = nullptr, int64_t cap6 = 0,
-                int64_t* row6 = nullptr) {
-    const char* pos = ls;
-    const char* msgid = nullptr;
-    const char* body = nullptr;
-    const char* h0 = nullptr; const char* h1 = nullptr;
-    while (true) {
-        const char* tag = find_sub(pos, le, "%ASA-", 5);
-        if (!tag) return 0;
-        pos = tag + 1;
-        const char* t = tag + 5;
-        if (t >= le || !is_dig(*t)) continue;
-        ++t;
-        if (t >= le || *t != '-') continue;
-        ++t;
-        const char* mid = t;
-        int nd = 0;
-        while (t < le && is_dig(*t) && nd < 7) { ++t; ++nd; }
-        if (nd != 6 || t >= le || *t != ':') continue;
-
-        // host: last token (one optional trailing ':') before the marker
-        const char* q = tag;
-        while (q > ls && is_sp(q[-1])) --q;
-        if (q > ls && q[-1] == ':') {
-            --q;
-            while (q > ls && is_sp(q[-1])) --q;
-        }
-        const char* he = q;
-        while (q > ls && !is_sp(q[-1])) --q;
-        if (he == q) continue;  // no host token; try a later marker
-
-        msgid = mid;
-        body = t + 1;
-        skip_ws(body, le);
-        h0 = q; h1 = he;
-        break;
-    }
-
-    Parsed pr;
-    bool ok;
-    if (memcmp(msgid, "106100", 6) == 0) ok = parse_106100(body, le, &pr);
-    else if (memcmp(msgid, "106023", 6) == 0) ok = parse_106023(body, le, &pr);
-    else if (memcmp(msgid, "302013", 6) == 0 || memcmp(msgid, "302015", 6) == 0)
-        ok = parse_302013(body, le, &pr);
-    else if (memcmp(msgid, "106001", 6) == 0) {
-        static const char* const lead[] = {
-            "Inbound", "TCP", "connection", "denied", "from"};
-        ok = parse_106001_like(body, le, lead, 5, /*need_flags=*/true, 6, &pr);
-    } else if (memcmp(msgid, "106015", 6) == 0) {
-        static const char* const lead[] = {
-            // "\001" (octal): "\x01c..." would munch the 'c' as a hex digit
-            "Deny", "TCP", "(no", "\001connection)", "from"};
-        ok = parse_106001_like(body, le, lead, 5, /*need_flags=*/true, 6, &pr);
-    } else if (memcmp(msgid, "106006", 6) == 0) {
-        static const char* const lead[] = {"Deny", "inbound", "UDP", "from"};
-        ok = parse_106001_like(body, le, lead, 4, /*need_flags=*/false, 17, &pr);
-    } else return 0;  // unhandled message class
-    if (!ok) return 0;
-    // wire-width validation (syslog.py _field_ranges_ok): ports are
-    // 16-bit, protocol numbers 8-bit; a line claiming more is malformed
-    // and skipping beats silently truncating it into a false match
-    if (pr.sport > 0xFFFF || pr.dport > 0xFFFF || pr.proto > 0xFF) return 0;
-
-    // resolve into up to two gids: named ACL, or in-binding of the
-    // ingress interface plus out-binding of the egress interface
-    std::string& k = pk->keybuf;
-    uint32_t gids[2];
-    int n_gids = 0;
-    if (pr.acl0) {
-        k.assign(h0, h1 - h0);
-        k.push_back('\x01');
-        k.append(pr.acl0, pr.acl1 - pr.acl0);
-        auto it = pk->resolve->find(k);
-        if (it != pk->resolve->end()) gids[n_gids++] = it->second;
-    } else {
-        k.assign(h0, h1 - h0);
-        k.push_back('\x02');
-        k.append(pr.if0, pr.if1 - pr.if0);
-        auto it = pk->resolve->find(k);
-        if (it != pk->resolve->end()) gids[n_gids++] = it->second;
-        if (pr.eif0) {
-            k.assign(h0, h1 - h0);
-            k.push_back('\x03');
-            k.append(pr.eif0, pr.eif1 - pr.eif0);
-            it = pk->resolve->find(k);
-            if (it != pk->resolve->end()) gids[n_gids++] = it->second;
-        }
-    }
-    if (n_gids == 0) return 0;
-    if (pr.src.fam == 6) {
-        // v6 line: rows land in the [TUPLE6_COLS=13, cap6] side plane
-        // (mirrors LinePacker.pack_parsed2 / _TextSource staging); a v6
-        // line against a pure-v4 ruleset is a counted skip
-        if (!out6 || !row6) return 0;
-        int64_t r6 = *row6;
-        if (r6 + n_gids > cap6) return -1;
-        for (int g = 0; g < n_gids; ++g, ++r6) {
-            out6[0 * cap6 + r6] = gids[g];
-            out6[1 * cap6 + r6] = pr.proto;
-            for (int i = 0; i < 4; ++i) out6[(2 + i) * cap6 + r6] = pr.src.l[i];
-            out6[6 * cap6 + r6] = pr.sport;
-            for (int i = 0; i < 4; ++i) out6[(7 + i) * cap6 + r6] = pr.dst.l[i];
-            out6[11 * cap6 + r6] = pr.dport;
-            out6[12 * cap6 + r6] = 1;
-        }
-        *row6 = r6;
-        return n_gids;
-    }
-    if (row + n_gids > cap) return -1;  // close the batch; line unconsumed
-    for (int g = 0; g < n_gids; ++g, ++row) {
-        out[0 * cap + row] = gids[g];
-        out[1 * cap + row] = pr.proto;
-        out[2 * cap + row] = pr.src.v4;
-        out[3 * cap + row] = pr.sport;
-        out[4 * cap + row] = pr.dst.v4;
-        out[5 * cap + row] = pr.dport;
-        out[6 * cap + row] = 1;
-    }
-    return n_gids;
+    return p;
 }
 
 }  // namespace
 
 extern "C" {
 
-void* asa_packer_new() { return new Packer(); }
+void* asa_packer_new() {
+    simd_init();
+    return new Packer();
+}
 
 void asa_packer_free(void* h) { delete (Packer*)h; }
 
@@ -864,18 +225,21 @@ void zero_tail(uint32_t* out, int64_t cap, int64_t valid) {
 // *n_valid_out tuples written (rows 0..n_valid-1; rows beyond are zero).
 //
 // Parallel structure (SURVEY.md §2 L2 — the input-split analog): one
-// memchr pass builds the line-offset index; lines split evenly across
-// workers; each worker parses its range into a private column-major slab
-// with a thread-local context; a sequential compaction then concatenates
-// the slabs' valid rows in range order.  The output — tuple order, counts,
-// consumed bytes — is bit-identical to the single-threaded parse.
+// newline-scan pass builds the line-offset index; lines split evenly
+// across workers; each worker parses its range into a private
+// column-major slab with a thread-local context; a sequential compaction
+// then concatenates the slabs' valid rows in range order.  The output —
+// tuple order, counts, consumed bytes — is bit-identical to the
+// single-threaded parse.
 int64_t asa_pack_chunk_mt(void* h, const char* buf, int64_t len, int final_,
                           int64_t max_lines, uint32_t* out, int64_t cap,
                           int64_t* n_lines_out, int64_t* n_valid_out,
                           int n_threads) {
+    simd_init();
     Packer* pk = (Packer*)h;
     const char* end = buf + len;
     int64_t want = max_lines < cap ? max_lines : cap;
+    const HandleLineFn handle = handle_line_fn();
 
     // the parallel path indexes lines with uint32 offsets, and its
     // even-line split can't honor the "keep consuming raw lines while
@@ -899,7 +263,7 @@ int64_t asa_pack_chunk_mt(void* h, const char* buf, int64_t len, int final_,
             const char* nl = (const char*)memchr(p, '\n', end - p);
             const char* le = nl ? nl : end;
             if (!nl && !final_) break;  // incomplete tail line
-            int n = handle_line(&cx, p, le, out, cap, valid);
+            int n = handle(&cx, p, le, out, cap, valid, nullptr, 0, nullptr);
             if (n < 0) break;  // rows don't fit: close batch, keep line
             if (n == 0) ++skipped;
             else { valid += n; parsed += n; }
@@ -918,13 +282,7 @@ int64_t asa_pack_chunk_mt(void* h, const char* buf, int64_t len, int final_,
     // one past the consumed region)
     std::vector<uint32_t> off;
     off.reserve((size_t)(want > 0 ? want + 1 : 1));
-    const char* p = buf;
-    while (p < end && (int64_t)off.size() < want) {
-        const char* nl = (const char*)memchr(p, '\n', end - p);
-        if (!nl && !final_) break;  // incomplete tail line
-        off.push_back((uint32_t)(p - buf));
-        p = nl ? nl + 1 : end;
-    }
+    const char* p = build_line_index(buf, len, final_, want, off);
     const int64_t L = (int64_t)off.size();
     if (L == 0) {
         zero_tail(out, cap, 0);  // same "padding rows are zero" contract
@@ -966,7 +324,8 @@ int64_t asa_pack_chunk_mt(void* h, const char* buf, int64_t len, int final_,
             LocalCtx* cx = &ctx[w];
             int64_t v = 0;
             for (int64_t i = i0; i < i1; ++i) {
-                int n = handle_line(cx, buf + off[i], line_end(i), slab, slab_cap, v);
+                int n = handle(cx, buf + off[i], line_end(i), slab, slab_cap,
+                               v, nullptr, 0, nullptr);
                 // n < 0 impossible: slab_cap == 2 * range lines
                 rows_per_line[(size_t)i] = (uint8_t)(n > 0 ? n : 0);
                 if (n > 0) v += n;
@@ -1030,10 +389,12 @@ int64_t asa_pack_chunk2(void* h, const char* buf, int64_t len, int final_,
                         uint32_t* out6, int64_t cap6,
                         int64_t* n_lines_out, int64_t* n_valid_out,
                         int64_t* n_valid6_out, int n_threads) {
+    simd_init();
     constexpr int64_t T6 = 13;  // TUPLE6_COLS
     Packer* pk = (Packer*)h;
     const char* end = buf + len;
     int64_t want = max_lines < cap ? max_lines : cap;
+    const HandleLineFn handle = handle_line_fn();
     if (n_threads != 1 && (len > (int64_t)0xFFFFFFFF || max_lines > cap))
         n_threads = 1;  // same constraints as the v4 MT path
 
@@ -1047,7 +408,7 @@ int64_t asa_pack_chunk2(void* h, const char* buf, int64_t len, int final_,
             const char* le = nl ? nl : end;
             if (!nl && !final_) break;  // incomplete tail line
             int64_t v6_before = valid6;
-            int n = handle_line(&cx, p, le, out, cap, valid, out6, cap6, &valid6);
+            int n = handle(&cx, p, le, out, cap, valid, out6, cap6, &valid6);
             if (n < 0) break;  // rows don't fit: close batch, keep line
             if (n == 0) ++skipped;
             else {
@@ -1072,13 +433,7 @@ int64_t asa_pack_chunk2(void* h, const char* buf, int64_t len, int final_,
     // ---- pass 1: line-offset index (as asa_pack_chunk_mt)
     std::vector<uint32_t> off;
     off.reserve((size_t)(want > 0 ? want + 1 : 1));
-    const char* p = buf;
-    while (p < end && (int64_t)off.size() < want) {
-        const char* nl = (const char*)memchr(p, '\n', end - p);
-        if (!nl && !final_) break;
-        off.push_back((uint32_t)(p - buf));
-        p = nl ? nl + 1 : end;
-    }
+    const char* p = build_line_index(buf, len, final_, want, off);
     const int64_t L = (int64_t)off.size();
     if (L == 0) {
         zero_tail(out, cap, 0);
@@ -1122,9 +477,9 @@ int64_t asa_pack_chunk2(void* h, const char* buf, int64_t len, int final_,
             int64_t v4 = 0, v6 = 0;
             for (int64_t i = i0; i < i1; ++i) {
                 int64_t v6_before = v6;
-                int n = handle_line(cx, buf + off[i], line_end(i),
-                                    slab4, slab_cap, v4,
-                                    slab6, slab_cap, &v6);
+                int n = handle(cx, buf + off[i], line_end(i),
+                               slab4, slab_cap, v4,
+                               slab6, slab_cap, &v6);
                 // n < 0 impossible: slab caps are 2 * range lines
                 if (n > 0 && v6 != v6_before) {
                     rows6_per_line[(size_t)i] = (uint8_t)n;
@@ -1183,9 +538,12 @@ int64_t asa_pack_chunk2(void* h, const char* buf, int64_t len, int final_,
     return K < L ? (int64_t)off[K] : consumed;
 }
 
-// Plain newline count (streaming buffer bookkeeping; memchr is ~5-10x
-// faster than Python-level bytes.count here).
+// Plain newline count (streaming buffer bookkeeping; the SIMD popcount
+// pass beats even libc memchr chaining, and both beat Python-level
+// bytes.count by ~5-10x).
 int64_t asa_count_nl(const char* buf, int64_t len) {
+    simd_init();
+    if (const ra_simd::ScanOps* o = scan_ops()) return o->count_nl(buf, len);
     int64_t n = 0;
     const char* p = buf;
     const char* end = buf + len;
@@ -1199,6 +557,18 @@ int64_t asa_count_nl(const char* buf, int64_t len) {
 // Count newline-terminated lines in buf (resume fast-skip helper).
 int64_t asa_count_lines(const char* buf, int64_t len, int final_,
                         int64_t max_lines, int64_t* bytes_out) {
+    simd_init();
+    if (const ra_simd::ScanOps* o = scan_ops()) {
+        int64_t bytes = 0;
+        int64_t lines = o->nl_skip(buf, len, max_lines, &bytes);
+        if (lines < max_lines && bytes < len && final_) {
+            // trailing unterminated fragment counts as a line when final
+            ++lines;
+            bytes = len;
+        }
+        *bytes_out = bytes;
+        return lines;
+    }
     const char* p = buf;
     const char* end = buf + len;
     int64_t lines = 0;
@@ -1210,6 +580,23 @@ int64_t asa_count_lines(const char* buf, int64_t len, int final_,
     }
     *bytes_out = p - buf;
     return lines;
+}
+
+// SIMD dispatch introspection/override (ISSUE 11): kind is 0 scalar,
+// 1 AVX2, 2 NEON; asa_simd_set(0) forces scalar, (1) re-enables the
+// detected ISA — the in-process A/B switch the identity sweep and the
+// feedscale bench use (RA_SIMD=off is the env-level equivalent).
+int asa_simd_kind() {
+    simd_init();
+    HandleLineFn h = handle_line_fn();
+    if (h && h == ra_parse::avx2_handle_line()) return 1;
+    if (h && h == ra_parse::neon_handle_line()) return 2;
+    return 0;
+}
+
+void asa_simd_set(int on) {
+    simd_init();
+    pick_dispatch(on != 0);
 }
 
 // Flow coalescing (ISSUE 5): compact a column-major [rows, b] uint32

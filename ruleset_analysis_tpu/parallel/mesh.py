@@ -116,6 +116,39 @@ def shard_grouped(mesh: Mesh, grouped_np: np.ndarray, axis: str = "data") -> jax
     )
 
 
+def shard_ring_batch(mesh: Mesh, ring_batch, axis: str = "data") -> jax.Array:
+    """Per-chip ring views -> ONE sharded device array, chip by chip.
+
+    The ring feeder (hostside.feeder.RingFeeder) hands each device's
+    ``[TUPLE_COLS, shard_rows]`` plane as a zero-copy view into that
+    chip's shared-memory ring slot.  Each view bit-packs to the 16 B/row
+    wire layout (a copy out of the slot — the slots release right after)
+    and ``device_put``s straight to ITS device; the global array is then
+    assembled from the per-device shards with no host-side concatenation
+    — the whole-batch copy + single global ``device_put`` the queue tier
+    pays disappears.  The resulting array carries the exact sharding
+    ``shard_batch`` would produce, so the compiled step is byte-for-byte
+    the same program.
+    """
+    from ..hostside import pack as pack_mod
+
+    faults.fire("stream.device_put.fail")
+    sharding = batch_sharding(mesh, axis)
+    wires = [pack_mod.compact_batch(v) for v in ring_batch.views]
+    ring_batch.release()  # compact_batch copied out of the shm slots
+    cols = wires[0].shape[0]
+    shard_w = wires[0].shape[1]
+    global_shape = (cols, shard_w * len(wires))
+    arrs = []
+    for dev, idx in sharding.devices_indices_map(global_shape).items():
+        col = idx[1]
+        start = 0 if col.start is None else int(col.start)
+        arrs.append(jax.device_put(wires[start // shard_w], dev))
+    return jax.make_array_from_single_device_arrays(
+        global_shape, sharding, arrs
+    )
+
+
 def pad_batch_size(batch_size: int, mesh: Mesh, axis: str = "data") -> int:
     """Round batch_size up to a multiple of the total data width."""
     n = data_extent(mesh)

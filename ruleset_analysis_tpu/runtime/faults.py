@@ -85,6 +85,10 @@ SITES: dict[str, tuple[str, str]] = {
         "crash", "a parse feed worker process dies abruptly (OOM-kill analog)"),
     "feeder.worker.stall": (
         "stall", "a feed worker wedges mid-parse and stops completing batches"),
+    "feeder.ring.stall": (
+        "stall", "a per-chip ring producer wedges before filling its "
+        "slot; the ring runs dry and the coordinator's watchdog must "
+        "bound the starved chip to a typed abort, never a hang"),
     "ingest.producer.raise": (
         "raise", "the prefetch producer thread fails mid-batch"),
     "ingest.queue.stall": (

@@ -805,22 +805,34 @@ def run_stream_file(
     if isinstance(paths, str):
         paths = [paths]
     use_native = native if native is not None else fastparse.available()
-    if feed_mode not in ("process", "thread"):
+    if feed_mode not in ("process", "thread", "ring"):
         from ..errors import AnalysisError
 
         raise AnalysisError(
-            f"feed_mode must be 'process' or 'thread', got {feed_mode!r}"
+            f"feed_mode must be 'process', 'thread' or 'ring', got {feed_mode!r}"
         )
-    if feed_workers and feed_workers > 1:
+    if feed_mode == "ring" and not (feed_workers and feed_workers >= 1):
+        from ..errors import AnalysisError
+
+        # an explicitly requested topology must never be silently dropped
+        raise AnalysisError(
+            "feed_mode='ring' needs feed_workers >= 1 (the per-chip "
+            "producer pool size)"
+        )
+    if feed_workers and (feed_workers > 1 or feed_mode == "ring"):
         if native is False:
             from ..errors import AnalysisError
 
             raise AnalysisError(
                 "feed_workers requires the native parser; drop native=False"
             )
-        from ..hostside.feeder import ParallelFeeder, ThreadedFeeder
+        from ..hostside.feeder import ParallelFeeder, RingFeeder, ThreadedFeeder
 
-        feeder_cls = ThreadedFeeder if feed_mode == "thread" else ParallelFeeder
+        feeder_cls = {
+            "thread": ThreadedFeeder,
+            "process": ParallelFeeder,
+            "ring": RingFeeder,
+        }[feed_mode]
         source = feeder_cls(
             packed, paths, n_workers=feed_workers,
             stall_timeout=cfg.stall_timeout_sec,
@@ -1740,6 +1752,26 @@ def _run_core(
         )
         if coal is not None:
             obs.register_sampler("coalesce", coal.sample_metrics)
+        # per-chip ring feeder (ISSUE 11): resolve the ring count to the
+        # mesh's data extent, and pick the consumption mode — per-chip
+        # views for the direct device_put path (flat + prefetch), or
+        # assembled plain batches everywhere else (sync, stacked)
+        ring_src = getattr(source, "yields_ring", False)
+        if ring_src:
+            if coal is not None:
+                from ..errors import AnalysisError
+
+                raise AnalysisError(
+                    "runtime coalescing is not available with the ring "
+                    "feeder (per-chip shards compact independently, which "
+                    "would change batch grouping); pre-coalesce with "
+                    "`convert --coalesce` or the convert fleet instead"
+                )
+            if not getattr(source, "n_rings", None):
+                source.n_rings = mesh_lib.data_extent(mesh)
+            source.emit_views = (
+                cfg.prefetch_depth > 0 and cfg.layout != "stacked"
+            )
         device_ready = False
         if cfg.prefetch_depth > 0:
             from ..hostside import pack as _pm
@@ -1749,7 +1781,12 @@ def _run_core(
             if cfg.layout != "stacked":
                 axis = cfg.mesh_axis
                 wire_src = getattr(source, "yields_wire", False)
-                if wire_src:
+                if ring_src:
+                    # per-chip compact + device_put straight from each
+                    # chip's ring view; no global host-side assembly
+                    def pack(rb):
+                        return mesh_lib.shard_ring_batch(mesh, rb, axis)
+                elif wire_src:
                     def pack(b):
                         if coal is not None and coal.enabled():
                             b = coal.wire4(b)

@@ -295,6 +295,57 @@ def test_chaos_feeder_under_prefetch_typed(chaos_corpus, tmp_path):
             )
 
 
+@pytest.mark.skipif(
+    not fastparse.available(), reason="native parser not buildable here"
+)
+def test_chaos_ring_stall_bounded_sync(chaos_corpus, tmp_path):
+    """feeder.ring.stall (ISSUE 11): a wedged per-chip ring producer
+    starves exactly one chip; the coordinator's watchdog must bound it
+    to a typed StallError naming the dry ring — never a hang."""
+    packed, text, _ = chaos_corpus
+    cfg = _cfg(0, "flat", 0, str(tmp_path / "ck"))
+    t0 = time.monotonic()
+    with faults.armed(faults.FaultPlan.parse("feeder.ring.stall@2")):
+        with pytest.raises(StallError, match="rings dry"):
+            run_stream_file(
+                packed, text, cfg, topk=5, feed_workers=2, feed_mode="ring"
+            )
+    assert time.monotonic() - t0 < 10 * STALL_SEC
+
+
+@pytest.mark.skipif(
+    not fastparse.available(), reason="native parser not buildable here"
+)
+def test_chaos_ring_stall_under_prefetch_typed(chaos_corpus, tmp_path):
+    """Ring stall below the prefetch wrapper (the production path: the
+    ring coordinator runs inside the pump): still a typed abort, still
+    no leaked ring workers or shared memory (the autouse leak fixture
+    enforces the latter)."""
+    packed, text, _ = chaos_corpus
+    cfg = _cfg(2, "flat", 0, str(tmp_path / "ck"))
+    with faults.armed(faults.FaultPlan.parse("feeder.ring.stall@3")):
+        with pytest.raises((StallError, FeedWorkerError, IngestError)):
+            run_stream_file(
+                packed, text, cfg, topk=5, feed_workers=2, feed_mode="ring"
+            )
+
+
+@pytest.mark.skipif(
+    not fastparse.available(), reason="native parser not buildable here"
+)
+def test_chaos_ring_worker_crash_typed(chaos_corpus, tmp_path):
+    """An OOM-killed ring worker surfaces as FeedWorkerError via the
+    liveness probe (the plan reaches the spawned worker through the
+    RA_FAULT_PLAN env export, as in production drills)."""
+    packed, text, _ = chaos_corpus
+    cfg = _cfg(0, "flat", 0, str(tmp_path / "ck"))
+    with faults.armed(faults.FaultPlan.parse("feeder.worker.crash@2")):
+        with pytest.raises((FeedWorkerError, StallError)):
+            run_stream_file(
+                packed, text, cfg, topk=5, feed_workers=2, feed_mode="ring"
+            )
+
+
 # ---------------------------------------------------------------------------
 # Units: plan round-trips, exit codes, on-disk wire damage
 # ---------------------------------------------------------------------------

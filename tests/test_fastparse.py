@@ -343,12 +343,11 @@ class TestFuzzParity:
         assert py.skipped > 0  # the corpus really contains corrupt lines
 
 
-def test_pack_lines_refuses_staged_v6_rows():
-    """pack_lines is v4-only: a unified corpus that stages v6 evaluations
-    must raise (mirroring LinePacker.pack_parsed), not silently drop the
-    rows into _staged6 where they leak across calls (ADVICE r5 #2)."""
-    from ruleset_analysis_tpu.errors import AnalysisError
-
+def test_pack_lines_stages_v6_rows_for_take_v6():
+    """pack_lines on a unified corpus returns the v4 plane and stages the
+    v6 evaluations for take_v6 — the same side-channel contract as the
+    chunk API and the streaming drivers (the old loud v4-only refusal
+    was deleted with ISSUE 11, which closed the last v6-refusing tier)."""
     cfg = synth.synth_config(n_acls=2, rules_per_acl=6, seed=3, v6_fraction=0.5)
     rs = aclparse.parse_asa_config(cfg, "fw6")
     packed = pack.pack_rulesets([rs])
@@ -360,13 +359,199 @@ def test_pack_lines_refuses_staged_v6_rows():
         packed, synth.synth_tuples(packed, 4, seed=5), seed=6
     )
     nat = fastparse.NativePacker(packed)
-    with pytest.raises(AnalysisError, match="pack_lines2"):
-        nat.pack_lines(v6_lines + v4_lines, batch_size=16)
-    # the refused rows were cleared, not left to leak into a later drain
-    assert len(nat.take_v6()) == 0
-    # pure-v4 calls still work on the same packer afterwards
-    out = nat.pack_lines(v4_lines, batch_size=16)
+    out = nat.pack_lines(v6_lines + v4_lines, batch_size=16)
     assert out.shape == (16, pack.TUPLE_COLS)
-    # the dual-plane API remains the sanctioned route for unified corpora
+    assert int((out[:, pack.T_VALID] == 1).sum()) == len(v4_lines)
+    rows6 = nat.take_v6()
+    assert len(rows6) == len(v6_lines)  # staged, not lost, not raised
+    assert len(nat.take_v6()) == 0  # drained exactly once
+    # the dual-plane API remains the convenient route for unified corpora
     b4, b6 = nat.pack_lines2(v6_lines + v4_lines, batch_size=16)
     assert int((b6[:, pack.T6_VALID] == 1).sum()) == len(v6_lines)
+
+
+# ---------------------------------------------------------------------------
+# SIMD tokenizer byte-identity (ISSUE 11): the dispatched parse (AVX2 /
+# NEON line parser + bulk newline scans) must produce byte-for-byte the
+# output of the scalar reference on ANY input — well-formed lines of all
+# seven message classes in both address families, 12k adversarial
+# mutants, and truncated/oversize tails placed flush against the end of
+# exactly-sized buffers (no primitive may read past the buffer).
+# ---------------------------------------------------------------------------
+
+simd_required = pytest.mark.skipif(
+    not (fastparse.available() and fastparse.simd_active()),
+    reason="CPU has neither AVX2 nor NEON (or RA_SIMD=off): nothing to A/B",
+)
+
+
+@pytest.fixture
+def _simd_restore():
+    was = fastparse.simd_active()
+    yield
+    fastparse.set_simd(was)
+
+
+def _dual_family_case(seed=11):
+    cfg_text = synth.synth_config(
+        n_acls=3, rules_per_acl=12, seed=seed, v6_fraction=0.4,
+        egress_acls=True,
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fwS")
+    packed = pack.pack_rulesets([rs])
+    lines = synth.render_syslog(
+        packed, synth.synth_tuples(packed, 500, seed=seed + 1),
+        seed=seed + 2, variety=0.7,
+    )
+    lines += synth.render_syslog6(
+        packed, synth.synth_tuples6(packed, 400, seed=seed + 3),
+        seed=seed + 4, variety=0.7,
+    )
+    return packed, lines
+
+
+def _parse_both_modes(packed, data: bytes, cap: int, *, final=True,
+                      max_lines=None):
+    """Parse the same bytes under SIMD and scalar dispatch; return both."""
+    results = {}
+    for mode in (True, False):
+        fastparse.set_simd(mode)
+        pk = fastparse.NativePacker(packed)
+        out, lines, used = pk.pack_chunk(
+            data, cap, final=final,
+            max_lines=max_lines if max_lines is not None else cap,
+            n_threads=1,
+        )
+        rows6 = pk.take_v6() if packed.has_v6 else []
+        results[mode] = (out, lines, used, pk.parsed, pk.skipped,
+                         np.asarray(rows6, dtype=np.uint32))
+    return results[True], results[False]
+
+
+def _assert_identical(simd_res, scal_res):
+    s_out, s_lines, s_used, s_p, s_s, s_v6 = simd_res
+    c_out, c_lines, c_used, c_p, c_s, c_v6 = scal_res
+    np.testing.assert_array_equal(s_out, c_out)
+    np.testing.assert_array_equal(s_v6, c_v6)
+    assert (s_lines, s_used, s_p, s_s) == (c_lines, c_used, c_p, c_s)
+
+
+@simd_required
+def test_simd_identity_all_message_classes(_simd_restore):
+    """Well-formed corpus, all 7 msg classes, both families, dual-row
+    connection lines (egress bindings): SIMD == scalar byte-for-byte."""
+    packed, lines = _dual_family_case()
+    data = ("\n".join(lines) + "\n").encode()
+    simd_res, scal_res = _parse_both_modes(
+        packed, data, 2 * len(lines), max_lines=len(lines)
+    )
+    _assert_identical(simd_res, scal_res)
+    assert simd_res[3] > 0 and len(simd_res[5]) > 0  # both planes exercised
+
+
+@simd_required
+def test_simd_identity_mutant_sweep_12k(_simd_restore):
+    """12k adversarial mutants (truncations, substitutions, splices,
+    garbage tails) of all-message-class dual-family lines: the SIMD and
+    scalar parses must agree byte-for-byte, including counters and
+    consumed bytes.  The mutation grammar mirrors the python-vs-native
+    sweep above; this one A/Bs the two NATIVE dispatch states."""
+    import random
+
+    packed, lines = _dual_family_case(seed=21)
+    garbage = ["", "\x00\x01\x02", "%ASA-6-106100", "a" * 5000, "١٠",
+               ":" * 40, "." * 40, "1" * 40, "f" * 40]
+    mutated = []
+    for trial in range(12000):
+        rng = random.Random(trial)
+        line = rng.choice(lines)
+        op = rng.randrange(5)
+        if op == 0:
+            line = line[: rng.randrange(len(line))]
+        elif op == 1:
+            i = rng.randrange(len(line))
+            line = line[:i] + rng.choice("()/:->% .\x00日١") + line[i + 1:]
+        elif op == 2:
+            line = line + rng.choice(garbage)
+        elif op == 3:
+            i, j = sorted(rng.randrange(len(line)) for _ in range(2))
+            line = line[:j] + line[i:]
+        else:
+            # digit-run stress: double a random digit run (overlong
+            # octets/ports exercise the ipv4 fast path's defer branch)
+            i = rng.randrange(len(line))
+            line = line[:i] + line[i:i + rng.randrange(1, 8)] + line[i:]
+        mutated.append(line.replace("\n", " ").replace("\r", " "))
+    data = ("\n".join(mutated) + "\n").encode()
+    simd_res, scal_res = _parse_both_modes(
+        packed, data, 2 * len(mutated), max_lines=len(mutated)
+    )
+    _assert_identical(simd_res, scal_res)
+    assert simd_res[4] > 0  # the corpus really contains corrupt lines
+
+
+@simd_required
+def test_simd_identity_buffer_edge_tails(_simd_restore):
+    """Lines placed flush against the end of exactly-sized buffers, with
+    truncated (final=False) and final unterminated tails: every
+    dispatch state must consume identical bytes and emit identical
+    rows.  Covers address runs of every length straddling the 16/32-byte
+    SIMD windows at the buffer edge."""
+    packed, lines = _dual_family_case(seed=31)
+    base = "\n".join(lines[:50]).encode()
+    edge_tails = [
+        b"",  # clean newline-terminated end
+        b"\nJul 29 01:02:03 fwS : %ASA-6-106100: access-list A denied tcp "
+        b"a/1.2.3.4(1) -> b/5.6.7.8",          # truncated mid-address
+        b"\nfwS : %ASA-6-106100: access-list A permitted tcp a/" +
+        b"1" * 40,                              # oversize digit run at EOF
+        b"\nfwS : %ASA-4-106023: Deny tcp src a:" + b"0001.2.3.4",
+        b"\nfwS : %ASA-6-302013: Built inbound TCP connection 1 for "
+        b"a:255.255.255.255/65535",             # max-width quad at EOF
+        b"\nfwS : %ASA-6-106100: access-list A permitted tcp a/"
+        b"2001:db8::1(80) -> b/2001:db8::2",    # v6 run truncated at EOF
+    ]
+    for tail in edge_tails:
+        for final in (True, False):
+            data = bytes(base + tail)  # exactly sized; no slack bytes
+            simd_res, scal_res = _parse_both_modes(
+                packed, data, 2 * 64, max_lines=64, final=final
+            )
+            _assert_identical(simd_res, scal_res)
+
+
+@simd_required
+def test_simd_identity_bulk_newline_primitives(_simd_restore):
+    """count_nl / count_lines (nl_skip) primitives: scalar vs SIMD over
+    newline layouts that stress block boundaries — runs of newlines,
+    32/16-byte-aligned clusters, no trailing newline, k edge cases."""
+    import ctypes
+    import random
+
+    lib = fastparse._load()
+    rng = random.Random(5)
+    cases = [
+        b"",
+        b"\n",
+        b"x" * 31 + b"\n",
+        b"\n" * 200,
+        (b"y" * 15 + b"\n") * 40,
+        b"tail-without-newline",
+    ]
+    for _ in range(40):
+        n = rng.randrange(0, 400)
+        cases.append(bytes(rng.choice(b"ab\n") for _ in range(n)))
+    for data in cases:
+        got = {}
+        for mode in (True, False):
+            lib.asa_simd_set(1 if mode else 0)
+            cnt = int(lib.asa_count_nl(data, len(data)))
+            per_k = []
+            for k in (0, 1, 3, 10**6):
+                for final in (0, 1):
+                    used = ctypes.c_int64(0)
+                    c = int(lib.asa_count_lines(
+                        data, len(data), final, k, ctypes.byref(used)))
+                    per_k.append((k, final, c, int(used.value)))
+            got[mode] = (cnt, per_k)
+        assert got[True] == got[False], f"bulk scan divergence on {data!r}"

@@ -15,6 +15,7 @@ from ruleset_analysis_tpu.config import AnalysisConfig, SketchConfig
 from ruleset_analysis_tpu.hostside import aclparse, fastparse, oracle, pack, synth
 from ruleset_analysis_tpu.hostside.feeder import (
     ParallelFeeder,
+    RingFeeder,
     ThreadedFeeder,
     _scan_batches,
 )
@@ -225,7 +226,7 @@ def _row_streams(batches_it, take_v6):
     return cat4, cat6
 
 
-@pytest.mark.parametrize("tier", ["process", "thread"])
+@pytest.mark.parametrize("tier", ["process", "thread", "ring"])
 def test_feeder_v6_plane_byte_identical_to_sequential(corpus6, tier):
     packed, paths = corpus6
     packer = fastparse.NativePacker(packed)
@@ -233,8 +234,17 @@ def test_feeder_v6_plane_byte_identical_to_sequential(corpus6, tier):
         fastparse.batches_from_files(paths, packer, 256), packer.take_v6
     )
     assert seq6.shape[0] > 0  # the corpus genuinely exercises the plane
-    feeder_cls = ParallelFeeder if tier == "process" else ThreadedFeeder
-    feeder = feeder_cls(packed, paths, n_workers=2)
+    feeder_cls = {
+        "process": ParallelFeeder, "thread": ThreadedFeeder,
+        "ring": RingFeeder,
+    }[tier]
+    if tier == "ring":
+        # 13-tuple v6 staging rides the per-chip rings (ISSUE 11): the
+        # committed stream must still be the sequential parse's, byte
+        # for byte, in line order across ring partitions
+        feeder = feeder_cls(packed, paths, n_workers=2, n_rings=8)
+    else:
+        feeder = feeder_cls(packed, paths, n_workers=2)
     par4, par6 = _row_streams(feeder.batches(0, 256), feeder.take_v6)
     assert np.array_equal(seq4, par4)
     assert np.array_equal(seq6, par6)
@@ -251,3 +261,211 @@ def test_feeder_v6_plane_byte_identical_to_sequential(corpus6, tier):
         src = limbs_u128(*r[T6_SRC:T6_SRC + 4])
         want.setdefault(fold_src32_host(src), src)
     assert feeder.v6_digests == want
+
+
+# ---------------------------------------------------------------------------
+# per-chip feeder rings (ISSUE 11): one shared-memory ring per device,
+# producer pool partitioned by chip, per-chip device_put fed straight
+# from the rings — reports bit-identical to the global-queue tier
+# ---------------------------------------------------------------------------
+
+
+def _stripped(rep):
+    import json
+
+    j = json.loads(rep.to_json())
+    for k in (
+        "elapsed_sec", "lines_per_sec", "compile_sec",
+        "sustained_lines_per_sec", "ingest", "throughput",
+    ):
+        j["totals"].pop(k, None)
+    return j
+
+
+def test_ring_feeder_report_equals_queue_tier(corpus):
+    """Full report — including the chunk-boundary-sensitive top-K
+    talkers — must match the global-queue process tier, under BOTH the
+    prefetched per-chip-device_put path and the sync assembled path
+    (ring groups cover exactly the lines queue batches cover, and every
+    register update is padding/order-invariant)."""
+    packed, rs, paths, res = corpus
+    cfg = AnalysisConfig(
+        batch_size=256,
+        sketch=SketchConfig(cms_width=1 << 11, cms_depth=4, hll_p=6),
+    )
+    queue_rep = _stripped(
+        run_stream_file(packed, paths, cfg, feed_workers=2, feed_mode="process")
+    )
+    ring_prefetch = _stripped(
+        run_stream_file(packed, paths, cfg, feed_workers=2, feed_mode="ring")
+    )
+    ring_sync = _stripped(
+        run_stream_file(
+            packed, paths, cfg.replace(prefetch_depth=0),
+            feed_workers=2, feed_mode="ring",
+        )
+    )
+    assert ring_prefetch == queue_rep
+    assert ring_sync == queue_rep
+
+
+def test_ring_feeder_resume_checkpoint(corpus, tmp_path):
+    """Crash-at-K resume through the ring plane: registers, per-rule
+    hits, HLL uniques, and the unused set must equal an uninterrupted
+    queue-tier run (chunk counts may differ — a snapshot flushes partial
+    v6 buffers — so the pin is register-level, exactly like the other
+    feeder tiers' resume contract)."""
+    packed, rs, paths, res = corpus
+    ck = str(tmp_path / "ck")
+    cfg = AnalysisConfig(
+        batch_size=256,
+        sketch=SketchConfig(cms_width=1 << 11, cms_depth=4, hll_p=6),
+        checkpoint_every_chunks=3,
+        checkpoint_dir=ck,
+    )
+    run_stream_file(packed, paths, cfg, feed_workers=2, feed_mode="ring",
+                    max_chunks=5)
+    rep = run_stream_file(
+        packed, paths, cfg.replace(resume=True), feed_workers=2,
+        feed_mode="ring",
+    )
+    full = run_stream_file(
+        packed, paths, cfg.replace(checkpoint_every_chunks=0),
+        feed_workers=2, feed_mode="process",
+    )
+    hr = {(e["firewall"], e["acl"], e["index"]): (e["hits"], e.get("unique_sources"))
+          for e in rep.per_rule}
+    hf = {(e["firewall"], e["acl"], e["index"]): (e["hits"], e.get("unique_sources"))
+          for e in full.per_rule}
+    assert hr == hf
+    assert rep.unused == full.unused
+    assert rep.totals["lines_total"] == 3000
+    assert rep.totals["lines_matched"] == full.totals["lines_matched"]
+
+
+def test_ring_feeder_killed_worker_detected_not_hung(corpus):
+    """An OS-killed ring worker must surface as a typed FeedWorkerError
+    via the liveness probe — one starved chip must never hang the run."""
+    import os
+    import signal
+
+    packed, rs, paths, res = corpus
+    feeder = RingFeeder(packed, paths, n_workers=2, n_rings=8)
+    gen = feeder.batches(0, 256)
+    next(gen)
+    os.kill(feeder._workers[0].pid, signal.SIGKILL)
+    with pytest.raises(RuntimeError, match="died without reporting"):
+        for _ in gen:
+            pass
+
+
+def test_ring_feeder_rejects_runtime_coalesce(corpus):
+    from ruleset_analysis_tpu.errors import AnalysisError
+
+    packed, rs, paths, res = corpus
+    cfg = AnalysisConfig(
+        batch_size=256,
+        sketch=SketchConfig(cms_width=1 << 11, cms_depth=4, hll_p=6),
+        coalesce="on",
+    )
+    with pytest.raises(AnalysisError, match="convert --coalesce"):
+        run_stream_file(packed, paths, cfg, feed_workers=2, feed_mode="ring")
+
+
+def test_ring_feeder_gauges_cover_every_ring(corpus, tmp_path):
+    """The metrics sampler must expose per-ring occupancy, partition
+    imbalance, and starved-chip seconds — the gauges the trace_summary
+    feed block renders."""
+    packed, rs, paths, res = corpus
+    from ruleset_analysis_tpu.runtime import obs
+
+    obs.start_metrics(str(tmp_path / "m.jsonl"), every_sec=60.0)
+    seen = {}
+    try:
+        feeder = RingFeeder(packed, paths, n_workers=2, n_rings=4)
+        gen = feeder.batches(0, 256)
+        try:
+            for i, _ in enumerate(gen):
+                if i == 2:
+                    seen = dict(obs.metrics_snapshot().get("feeder", {}))
+                    break
+        finally:
+            gen.close()
+    finally:
+        obs.shutdown()
+    assert seen.get("mode") == "ring"
+    assert seen.get("rings") == 4
+    assert len(seen.get("ring_occupancy", [])) == 4
+    assert len(seen.get("starved_sec", [])) == 4
+    assert "partition_imbalance" in seen
+
+
+def test_ring_feeder_summary_instant_feeds_trace_summary(corpus, tmp_path):
+    """A ring run under --trace-out leaves one feeder.summary instant;
+    tools/trace_summary.py renders it as the feed block."""
+    import sys
+
+    from ruleset_analysis_tpu.runtime import obs
+
+    sys.path.insert(0, "tools")
+    try:
+        import trace_summary
+    finally:
+        sys.path.pop(0)
+    packed, rs, paths, res = corpus
+    td = str(tmp_path / "tr")
+    obs.start_trace(td, role="main")
+    try:
+        feeder = RingFeeder(packed, paths, n_workers=2, n_rings=4)
+        for _ in feeder.batches(0, 256):
+            pass
+    finally:
+        merged = obs.merge_trace(td)
+        obs.shutdown()
+    s = trace_summary.summarize(merged)
+    feed = s.get("feed")
+    assert feed and feed["mode"] == "ring" and feed["rings"] == 4
+    assert len(feed["ring_occupancy_pct"]) == 4
+    assert len(feed["starved_sec"]) == 4
+    assert "partition_imbalance_pct" in feed
+    text = trace_summary.render(s)
+    assert "feed: ring x4" in text and "ring 0:" in text
+
+
+def test_ring_feeder_requires_workers_at_api_level(corpus):
+    """feed_mode='ring' without feed_workers must be a typed refusal at
+    the run_stream_file API, not a silent fall-through to the plain
+    source (the requested topology would otherwise be dropped)."""
+    from ruleset_analysis_tpu.errors import AnalysisError
+
+    packed, rs, paths, res = corpus
+    cfg = AnalysisConfig(
+        batch_size=256,
+        sketch=SketchConfig(cms_width=1 << 11, cms_depth=4, hll_p=6),
+    )
+    with pytest.raises(AnalysisError, match="feed_workers"):
+        run_stream_file(packed, paths, cfg, feed_mode="ring")
+
+
+def test_ring_feeder_slot_exhaustion_aborts_typed(corpus):
+    """A consumer that hoards unreleased _RingBatch views past the ring
+    depth must get a typed FeedWorkerError, never a silently truncated
+    corpus (the generator cannot make progress while every slot of a
+    ring is held)."""
+    from ruleset_analysis_tpu.errors import FeedWorkerError
+
+    packed, rs, paths, res = corpus
+    feeder = RingFeeder(
+        packed, paths, n_workers=2, n_rings=2, ring_depth=2
+    )
+    feeder.emit_views = True
+    held = []
+    gen = feeder.batches(0, 256)
+    try:
+        with pytest.raises(FeedWorkerError, match="ring slots exhausted"):
+            for rb, _n in gen:
+                held.append(rb)  # never released: slots run dry
+    finally:
+        for rb in held:
+            rb.release()
+        gen.close()

@@ -23,6 +23,10 @@ answers the attribution question directly from the timeline:
 - **serve** — for traces from the always-on ``serve`` mode: window
   rotation count + latency (``serve.rotate``), reload pauses
   (``serve.reload``), and ``listener.drop`` instants.
+- **feed** — for runs on the per-chip ring feeder (``--feed-mode
+  ring``): per-ring occupancy %, producer-partition imbalance, and
+  starved-chip seconds, from the ``feeder.summary`` instant the ring
+  coordinator emits at teardown.
 - **devprof** — when a device attribution capture ran in-process
   (``run/serve --devprof-out``, runtime/devprof.py): per-stage device
   occupancy %, the top stage by time, and the unattributed fraction,
@@ -212,6 +216,29 @@ def summarize(path: str, top: int = 5) -> dict:
             "retirements": instants.get("autoscale.retire", 0),
             "standby_parks": instants.get("autoscale.standby", 0),
         }
+    # feed-fleet attribution (ISSUE 11): the ring feeder pushes one
+    # feeder.summary instant at teardown — per-ring occupancy %, the
+    # producer-partition imbalance, and how long each chip's ring sat
+    # dry while the coordinator waited on it (starved-chip seconds)
+    feed = None
+    feed_instants = [
+        e for e in events
+        if e.get("ph") == "i" and e.get("name") == "feeder.summary"
+        and isinstance(e.get("args"), dict)
+    ]
+    if feed_instants:
+        a = feed_instants[-1]["args"]  # latest feed run wins
+        feed = {
+            "mode": a.get("mode"),
+            "rings": a.get("rings"),
+            "ring_depth": a.get("ring_depth"),
+            "workers": a.get("workers"),
+            "groups": a.get("groups"),
+            "ring_occupancy_pct": a.get("ring_occupancy_pct"),
+            "partition_imbalance_pct": a.get("partition_imbalance_pct"),
+            "starved_sec": a.get("starved_sec"),
+            "starved_total_sec": a.get("starved_total_sec"),
+        }
     # device attribution capture (run/serve --devprof-out): the capture
     # pushes one devprof.summary instant whose args are the flat gauges
     # — per-stage device occupancy, top stage, attributed fraction
@@ -262,6 +289,7 @@ def summarize(path: str, top: int = 5) -> dict:
         **({"coalesce": coalesce} if coalesce else {}),
         **({"serve": serve} if serve else {}),
         **({"autoscale": autoscale} if autoscale else {}),
+        **({"feed": feed} if feed else {}),
         **({"devprof": devprof} if devprof else {}),
     }
 
@@ -332,6 +360,22 @@ def render(s: dict) -> str:
                 f"    +{d['at_sec']:9.3f}s  #{d.get('seq')} "
                 f"{d.get('direction')} {d.get('from_world')}->"
                 f"{d.get('to_world')} ({d.get('reason')}){grounds}"
+            )
+    if s.get("feed"):
+        fd = s["feed"]
+        out.append(
+            f"  feed: {fd.get('mode')} x{fd.get('rings')} ring(s) depth "
+            f"{fd.get('ring_depth')}, {fd.get('workers')} worker(s), "
+            f"{fd.get('groups')} group(s); partition imbalance "
+            f"{fd.get('partition_imbalance_pct')}%, starved "
+            f"{fd.get('starved_total_sec')}s total"
+        )
+        occ = fd.get("ring_occupancy_pct") or []
+        sts = fd.get("starved_sec") or []
+        for j, pct in enumerate(occ):
+            starved = sts[j] if j < len(sts) else 0.0
+            out.append(
+                f"    ring {j}: occupancy {pct:6.2f}%  starved {starved:.3f}s"
             )
     if s.get("devprof"):
         dp = s["devprof"]
