@@ -2604,6 +2604,107 @@ def bench_feedscale() -> dict:
     }
 
 
+def bench_rulescale() -> dict:
+    """ISSUE 12: static-analyzer wall time vs R (the O(R²) tiling model).
+
+    Sweeps ruleset size for the two shapes that matter: ONE big ACL
+    (the worst case — the pair grid is R², witness pass included) and
+    the same total rows split across stacked ACL slabs (per-ACL O(Ra²)
+    grids, the shardable case).  The honest model for this 1-core
+    container: tile kernels are sequential XLA:CPU dispatches, so wall
+    time is ~linear in tiles_run = Σ ceil(Ra/T)² plus the witness pass
+    (which scales with overlap density, not R²); on a real mesh the
+    tile grid round-robins across chips (pair_relations(devices=...))
+    — embarrassingly parallel, since a tile reads only its two row
+    blocks.  Verdict parity across shapes/tiles guards the sweep.
+    """
+    from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+    from ruleset_analysis_tpu.runtime import staticanalysis
+
+    def build(n_acls, rules_per_acl, seed=0):
+        return pack.pack_rulesets([
+            aclparse.parse_asa_config(
+                synth.synth_config(
+                    n_acls=n_acls, rules_per_acl=rules_per_acl, seed=seed
+                ),
+                "fw0",
+            )
+        ])
+
+    def run(packed, tile=None):
+        t0 = time.perf_counter()
+        res = staticanalysis.analyze_ruleset(packed, tile=tile or 512)
+        dt = time.perf_counter() - t0
+        return res, dt
+
+    sweep = []
+    for r in (128, 256, 512, 1024, 2048):
+        flat = build(1, r, seed=r)
+        stacked = build(4, r // 4, seed=r)
+        res_f, dt_f = run(flat)
+        res_s, dt_s = run(stacked)
+        rows_f = int(res_f.meta["n_rows"])
+        rows_s = int(res_s.meta["n_rows"])
+        entry = {
+            "rules": r,
+            "flat_1acl": {
+                "rows": rows_f,
+                "pairs_m": round(rows_f ** 2 / 1e6, 3),
+                "tiles": res_f.meta["tiles_run"],
+                "witnesses": res_f.meta["witnesses_checked"],
+                "dead": res_f.meta["dead"],
+                "sec": round(dt_f, 3),
+            },
+            "stacked_4acl": {
+                "rows": rows_s,
+                "tiles": res_s.meta["tiles_run"],
+                "witnesses": res_s.meta["witnesses_checked"],
+                "dead": res_s.meta["dead"],
+                "sec": round(dt_s, 3),
+            },
+        }
+        sweep.append(entry)
+        log(f"rulescale R={r}: flat {dt_f:.2f}s ({res_f.meta['tiles_run']} "
+            f"tiles, {res_f.meta['dead']} dead), stacked {dt_s:.2f}s "
+            f"({res_s.meta['tiles_run']} tiles)")
+
+    # tile-grid parity at the largest R: a small tile must not change a
+    # single verdict (the sharding-safety invariant)
+    big = build(1, 512, seed=512)
+    v_big, _ = run(big)
+    v_small, _ = run(big, tile=128)
+    parity = {
+        k: (v.verdict, v.basis) for k, v in v_big.verdicts.items()
+    } == {
+        k: (v.verdict, v.basis) for k, v in v_small.verdicts.items()
+    }
+
+    last = sweep[-1]["flat_1acl"]
+    sec_per_mpair = last["sec"] / max(last["pairs_m"], 1e-9)
+    return {
+        "bench": "rulescale",
+        "metric": "analyzer_sec_per_million_pairs_flat",
+        "value": round(sec_per_mpair, 4),
+        "detail": {
+            "sweep": sweep,
+            "tile": 512,
+            "tile_parity_512_vs_128": parity,
+            "model": (
+                "O(R^2) pairs per ACL, walked as the LOWER-TRIANGLE "
+                "[T,T] tile grid only (row order is key-ascending, so "
+                "upper tiles cannot survive the earlier-key mask — "
+                "~half the pair work, bit-identical verdicts); on this "
+                "1-core CPU container tiles dispatch sequentially so "
+                "wall ~ tiles_run x per-tile cost + witness pass "
+                "(overlap-density-bound, not R^2); stacked ACLs divide "
+                "the exponent's base (4 ACLs = R^2/4 total pairs) and "
+                "the tile grid itself is embarrassingly device-parallel "
+                "(pair_relations devices=) — unmeasured here, 1 core"
+            ),
+        },
+    }
+
+
 BENCHES = {
     "stage": bench_stage,
     "exact": bench_exact,
@@ -2623,6 +2724,7 @@ BENCHES = {
     "coalesce": bench_coalesce,
     "convert": bench_convert,
     "feedscale": bench_feedscale,
+    "rulescale": bench_rulescale,
     "v6": bench_v6,
     "v6recall": bench_v6recall,
 }

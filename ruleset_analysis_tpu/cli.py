@@ -313,6 +313,17 @@ def _cmd_run(args: argparse.Namespace) -> int:
         # mistake, so the usage exit code — not a runtime failure class
         print(f"error: {e}", file=sys.stderr)
         return 2
+    if not args.static_analysis and args.static_witness_budget != 4096:
+        # the devprof dependent-flag convention: a budget without the
+        # analysis would be silently ignored, not a smaller analysis
+        print("error: --static-witness-budget requires --static-analysis",
+              file=sys.stderr)
+        return 2
+    if args.static_analysis and args.static_witness_budget < 1:
+        # fail BEFORE the (possibly hours-long) traffic run, not at the
+        # post-run analysis step where the computed report would be lost
+        print("error: --static-witness-budget must be >= 1", file=sys.stderr)
+        return 2
     packed = pack.load_packed(args.ruleset)
     lines = _iter_log_lines(args.logs)
 
@@ -543,6 +554,13 @@ def _cmd_run(args: argparse.Namespace) -> int:
                     "write; add --json", file=sys.stderr,
                 )
                 return 2
+            if args.static_analysis:
+                print(
+                    "--static-analysis does not ride the --elastic "
+                    "result relay; run the `analyze` subcommand against "
+                    "the same --ruleset instead", file=sys.stderr,
+                )
+                return 2
             import json as json_mod
             import os as os_mod
 
@@ -659,6 +677,23 @@ def _cmd_run(args: argparse.Namespace) -> int:
         print(f"unknown backend {args.backend!r}", file=sys.stderr)
         return 2
 
+    if args.static_analysis:
+        # join the static verdicts into the live-evidence report: the
+        # whole run counted under this one ruleset, so a hit on a
+        # provably-dead rule is a hard contradiction (strict=True ->
+        # typed AnalyzerContradiction, handled by main()).  Strict only
+        # with EXACT counters: under --no-exact-counts the per-rule
+        # "hits" are CMS estimates, and a sketch collision can inflate a
+        # dead rule's estimate above zero — annotate, don't abort.
+        from .runtime import staticanalysis
+
+        sa = staticanalysis.analyze_ruleset(
+            packed, witness_budget=args.static_witness_budget
+        )
+        # (oracle runs always count exactly; --no-exact-counts is
+        # rejected for that backend above)
+        staticanalysis.attach_static(rep, packed, sa, strict=args.exact_counts)
+
     payload = rep.to_json() if args.json else rep.to_text()
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
@@ -677,6 +712,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
     """
     from .config import ServeConfig
 
+    if not args.static_analysis and args.static_witness_budget != 4096:
+        print("error: --static-witness-budget requires --static-analysis",
+              file=sys.stderr)
+        return 2
     try:
         cfg = AnalysisConfig(
             backend="tpu",
@@ -710,6 +749,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             reload_poll_sec=args.reload_poll,
             max_windows=args.max_windows,
             stop_after_sec=args.stop_after,
+            static_analysis=args.static_analysis,
+            static_witness_budget=args.static_witness_budget,
         )
     except (ValueError, errors.AnalysisError) as e:
         print(f"error: {e}", file=sys.stderr)
@@ -898,6 +939,47 @@ def _cmd_wire_info(args: argparse.Namespace) -> int:
     return rc
 
 
+def _cmd_analyze(args: argparse.Namespace) -> int:
+    """Static ruleset analysis: which rules can NEVER get a hit.
+
+    The dual of ``run``: no traffic at all — per-rule reachability
+    verdicts from the packed rule tensor alone (runtime/staticanalysis),
+    with every dead verdict carrying an exact single-rule cover or a
+    complete witness-exhaustion record.
+    """
+    import json as json_mod
+
+    from .runtime import faults, staticanalysis
+
+    if args.witness_budget < 1:
+        print("error: --witness-budget must be >= 1", file=sys.stderr)
+        return 2
+    if args.tile is not None and args.tile < 1:
+        print("error: --tile must be >= 1", file=sys.stderr)
+        return 2
+    packed = pack.load_packed(args.ruleset)
+    armed_here = faults.arm_spec(_resolve_fault_plan(args.fault_plan))
+    try:
+        sa = staticanalysis.analyze_ruleset(
+            packed, tile=args.tile, witness_budget=args.witness_budget
+        )
+    finally:
+        if armed_here:
+            faults.disarm()
+    obj = sa.to_obj(packed)
+    payload = (
+        json_mod.dumps(obj, indent=2)
+        if args.json
+        else staticanalysis.render_text(packed, obj)
+    )
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload + "\n")
+    else:
+        print(payload)
+    return 0
+
+
 def _cmd_diff_reports(args: argparse.Namespace) -> int:
     """Compare two JSON run reports: the operator's delete-decision view.
 
@@ -950,6 +1032,13 @@ def _cmd_diff_reports(args: argparse.Namespace) -> int:
     if out["top_hit_movers"]:
         print("# top hit movers:")
         for m in out["top_hit_movers"]:
+            print(f"  {m['rule']}: {m['old']} -> {m['new']}")
+    if out.get("verdict_transitions"):
+        print(
+            f"# static verdict transitions: {len(out['verdict_transitions'])}"
+            " (a rule changing reachability class across a ruleset change)"
+        )
+        for m in out["verdict_transitions"]:
             print(f"  {m['rule']}: {m['old']} -> {m['new']}")
     if out.get("window_incomplete"):
         print(
@@ -1185,9 +1274,45 @@ def make_parser() -> argparse.ArgumentParser:
                    help="abort after N automatic cluster re-formations "
                         "(the Hadoop max-task-retries analog; default 2)")
     _add_autoscale_flags(p)
+    p.add_argument("--static-analysis", action="store_true",
+                   help="join static reachability verdicts into the "
+                        "report: unused rules split into provably-dead "
+                        "(safe to delete) vs traffic-dependent classes, "
+                        "and a rule with hits but a dead verdict is a "
+                        "typed error (see the `analyze` subcommand; off "
+                        "by default — the report is bit-identical without "
+                        "it)")
+    p.add_argument("--static-witness-budget", type=int, default=4096,
+                   metavar="N",
+                   help="per-rule witness-grid cap for --static-analysis")
     p.add_argument("--json", action="store_true")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_run)
+
+    p = sub.add_parser(
+        "analyze",
+        help="static ruleset analysis (no traffic): per-rule first-match "
+             "reachability verdicts — shadowed/redundant/conflict rules "
+             "are PROVABLY dead (device-tiled pair relations; union "
+             "coverage certified by corner-point witness packets run "
+             "through the production match kernel)",
+    )
+    p.add_argument("--ruleset", required=True,
+                   help="packed ruleset path prefix (parse-acls output)")
+    p.add_argument("--tile", type=int, default=None, metavar="T",
+                   help="pair-tile edge (default 512); the O(R^2)-per-ACL "
+                        "grid is walked in [T, T] device tiles")
+    p.add_argument("--witness-budget", type=int, default=4096, metavar="N",
+                   help="per-rule cap on witness-grid enumeration; a rule "
+                        "whose corner grid exceeds it stays "
+                        "partially-masked/uncertified instead of dead "
+                        "(dead verdicts always carry a complete proof)")
+    p.add_argument("--fault-plan", default=None, metavar="SPEC",
+                   help="chaos drills (adds the analyze.tile site); see "
+                        "`run --fault-plan`")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--out", default=None)
+    p.set_defaults(fn=_cmd_analyze)
 
     p = sub.add_parser(
         "serve",
@@ -1258,6 +1383,19 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--topk-every", type=int, default=1, metavar="N",
                    help="defer talker candidate selection to every Nth "
                         "chunk (see `run --topk-every`)")
+    p.add_argument("--static-analysis", action="store_true",
+                   help="run the static ruleset analyzer at start and on "
+                        "every hot reload (unchanged ACLs reuse their "
+                        "verdicts): /report/static publishes the verdict "
+                        "table, every window report's unused rules carry "
+                        "evidence classes (provably-dead vs "
+                        "traffic-dependent), and /metrics gains "
+                        "static_analysis_age_sec / "
+                        "static_analysis_duration_sec")
+    p.add_argument("--static-witness-budget", type=int, default=4096,
+                   metavar="N",
+                   help="per-rule witness-grid cap for the serve analyzer "
+                        "(see `analyze --witness-budget`)")
     _add_autoscale_flags(p)
     p.add_argument("--fault-plan", default=None, metavar="SPEC",
                    help="chaos drills: see `run --fault-plan` (adds the "

@@ -263,6 +263,14 @@ class ServeConfig:
     reload_poll_sec: float = 2.0
     max_windows: int = 0  # stop after N rotations (0 = run forever)
     stop_after_sec: float = 0.0  # soft wall deadline (0 = none); bounds tests
+    #: run the static ruleset analyzer (runtime/staticanalysis.py) at
+    #: start and on every hot reload (unchanged ACLs reuse their
+    #: verdicts); publishes /report/static and joins evidence classes
+    #: into every window report.  Off by default: reports stay
+    #: bit-identical to the analysis-free service.
+    static_analysis: bool = False
+    #: per-rule witness-grid enumeration cap for the serve analyzer
+    static_witness_budget: int = 4096
 
     def __post_init__(self) -> None:
         if (self.window_lines > 0) == (self.window_sec > 0):
@@ -292,6 +300,11 @@ class ServeConfig:
             raise ValueError("reload_poll_sec must be > 0")
         if self.max_windows < 0 or self.stop_after_sec < 0:
             raise ValueError("max_windows/stop_after_sec must be >= 0")
+        if self.static_witness_budget < 1:
+            raise ValueError(
+                f"static_witness_budget must be >= 1, got "
+                f"{self.static_witness_budget}"
+            )
         if self.http != "off":
             host, _, port = self.http.rpartition(":")
             if not host or not port.isdigit():

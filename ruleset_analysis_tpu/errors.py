@@ -70,6 +70,18 @@ class ReformBudgetExhausted(AnalysisError):
     """The elastic supervisor used up ``--max-reforms`` re-formations."""
 
 
+class AnalyzerContradiction(AnalysisError):
+    """Live hit evidence contradicts a static "provably dead" verdict.
+
+    A rule the analyzer certified as unreachable (shadowed / redundant /
+    conflict) recorded hits under the SAME ruleset — one of the two
+    planes is wrong (analyzer bug, corrupted rule tensor, or damaged
+    counters), and a deletion report built from either would be
+    untrustworthy.  Raised loudly instead of publishing the
+    contradiction as if both facts could hold (ISSUE 12: "hit +
+    shadow-verdict -> typed error, never silent")."""
+
+
 class InjectedFault(AnalysisError):
     """A deterministic fault fired by an armed plan (runtime/faults.py).
 

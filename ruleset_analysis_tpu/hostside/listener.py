@@ -346,7 +346,12 @@ class FileTailer(BaseListener):
         super().__init__(q, f"tail-{os.path.basename(path)}")
         self.path = path
         self.poll_sec = poll_sec
-        self._from_start = from_start
+        # "pre-existing" is decided HERE, not at the serve thread's first
+        # open attempt: a file created between construction and the
+        # thread's first poll is NEW traffic and must be read from 0.
+        # Deciding it at open time raced exactly that window — whether
+        # the first lines survived depended on thread-spawn latency.
+        self._from_start = from_start or not os.path.exists(path)
 
     @staticmethod
     def _ino(f) -> int:

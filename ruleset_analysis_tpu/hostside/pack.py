@@ -208,6 +208,12 @@ class KeyMeta:
     index: int  # 1-based rule position; 0 for the ACL's implicit deny
     text: str
     implicit_deny: bool = False
+    #: PERMIT(1)/DENY(0) of the configured entry, or -1 when unknown (a
+    #: packed artifact written before the static-analysis plane).  The
+    #: action never affects matching/counting — only the analyzer's
+    #: redundant-vs-conflict split reads it, and it degrades to the
+    #: action-free "shadowed" verdict on -1.
+    action: int = -1
 
 
 @dataclasses.dataclass
@@ -277,7 +283,12 @@ def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> P
             for rule in rules:
                 key = len(key_meta)
                 key_meta.append(
-                    KeyMeta(firewall=rs.firewall, acl=acl, index=rule.index, text=rule.text)
+                    KeyMeta(
+                        firewall=rs.firewall, acl=acl, index=rule.index,
+                        text=rule.text,
+                        # one config line = one action; every ACE agrees
+                        action=rule.aces[0].action if rule.aces else -1,
+                    )
                 )
                 for a in rule.aces:
                     if a.family == 6:
@@ -329,7 +340,10 @@ def pack_rulesets(rulesets: list[Ruleset], pad_rules_to: int | None = None) -> P
     for (fw, acl), gid in acl_gid.items():
         deny_key[gid] = n_rules + gid
         key_meta.append(
-            KeyMeta(firewall=fw, acl=acl, index=0, text="<implicit deny>", implicit_deny=True)
+            KeyMeta(
+                firewall=fw, acl=acl, index=0, text="<implicit deny>",
+                implicit_deny=True, action=0,
+            )
         )
 
     parse_skips = [

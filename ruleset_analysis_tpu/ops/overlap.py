@@ -1,0 +1,170 @@
+"""Pairwise rule-relation kernel: the static half of first-match semantics.
+
+The match kernel (ops/match.py) asks "which rule does this PACKET hit";
+this kernel asks the packet-free dual: "how do two RULE rows relate as
+boxes in the 5-field interval space".  Under first-match-wins (SURVEY
+§5: overlapping rules + implicit deny), an earlier row that *covers* a
+later one makes the later one unreachable, and partial overlaps are the
+raw material of union-shadowing — so the per-pair relations below are
+the entire input of the static analyzer (runtime/staticanalysis.py).
+
+TPU realisation: a pair tile ``[Ti, Tj]`` of boolean predicates from
+pure uint32 compares on the VPU — the same broadcast-compare shape as
+the match kernel's ``[B, R]`` predicate, with rules on BOTH axes.  The
+O(R²) pair space is walked in fixed-size tiles so one compiled program
+serves any R (and the tile grid shards embarrassingly over devices —
+each tile touches only its two row blocks).  Everything runs under
+``jax.named_scope("ra.overlap")`` so tile time shows up as its own
+stage in the device attribution plane (runtime/devprof.py, DESIGN §14).
+
+Relation semantics per ordered pair (a = row of the i-block, b = row of
+the j-block), all conditioned on both rows being real (not NO_ACL
+padding) and in the SAME ACL — cross-ACL rows never interact under
+first-match:
+
+  ``covered[a, b]``  row b's box contains row a's box on ALL 5 fields
+                     (proto, src, sport, dst, dport) — b fully masks a
+                     if b comes earlier in config order.
+  ``overlap[a, b]``  the boxes intersect on ALL 5 fields — b can steal
+                     at least one of a's packets if earlier.
+
+``covered`` implies ``overlap`` (a box is non-empty: lo <= hi is a pack
+invariant enforced by validate_rule_ranges).  Subset/superset/disjoint/
+partial per-pair classes derive from the two matrices:
+
+  disjoint  = ~overlap
+  subset    = covered           (a  ⊆ b)
+  superset  = covered^T         (a  ⊇ b, read at [b, a])
+  partial   = overlap & ~subset & ~superset
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..hostside.pack import _RANGE_COLS, NO_ACL, R_ACL, RULE_COLS
+
+_U32 = jnp.uint32
+
+#: Default pair-tile edge.  512x512 = 256k boolean lanes per predicate —
+#: the same VMEM scale as the match kernel's [B, RULE_BLOCK] tiles.
+PAIR_TILE = 512
+
+#: (lo, hi) column pairs of the 5 interval fields — derived from the
+#: pack layer's canonical range-column table so a rule-tensor layout
+#: change cannot silently desynchronize the relation predicates.
+_FIELDS = tuple((lo, hi) for lo, hi, _name in _RANGE_COLS)
+
+
+@jax.jit
+def relation_tile(rows_i: jnp.ndarray, rows_j: jnp.ndarray):
+    """One pair tile: ``([Ti, RULE_COLS], [Tj, RULE_COLS]) -> (covered,
+    overlap)`` boolean ``[Ti, Tj]`` matrices (semantics in the module
+    docstring).  Padding rows (acl == NO_ACL) relate to nothing.
+    """
+    with jax.named_scope("ra.overlap"):
+        ri = rows_i.astype(_U32)
+        rj = rows_j.astype(_U32)
+        acl_i = ri[:, R_ACL][:, None]  # [Ti, 1]
+        acl_j = rj[:, R_ACL][None, :]  # [1, Tj]
+        same = (acl_i == acl_j) & (acl_i != NO_ACL) & (acl_j != NO_ACL)
+        covered = same
+        overlap = same
+        for lo, hi in _FIELDS:
+            li, ha = ri[:, lo][:, None], ri[:, hi][:, None]
+            lj, hb = rj[:, lo][None, :], rj[:, hi][None, :]
+            covered &= (lj <= li) & (ha <= hb)
+            overlap &= jnp.maximum(li, lj) <= jnp.minimum(ha, hb)
+        return covered, overlap
+
+
+def _pad_rows(rows: np.ndarray, to: int) -> np.ndarray:
+    """Pad a row block to ``to`` rows with never-matching NO_ACL rows."""
+    if rows.shape[0] == to:
+        return rows
+    out = np.zeros((to, RULE_COLS), dtype=np.uint32)
+    out[:, R_ACL] = NO_ACL
+    out[: rows.shape[0]] = rows
+    return out
+
+
+def iter_pair_tiles(r: int, tile: int = PAIR_TILE):
+    """Tile-grid index iterator: yields ``(i0, i1, j0, j1)`` row ranges.
+
+    Separated from :func:`pair_relations` so drivers that need a seam
+    per tile (fault injection, device round-robin, progress) can own
+    the loop while reusing the exact same grid.
+    """
+    for i0 in range(0, r, tile):
+        i1 = min(i0 + tile, r)
+        for j0 in range(0, r, tile):
+            yield i0, i1, j0, min(j0 + tile, r)
+
+
+def pair_relations(
+    rules: np.ndarray,
+    tile: int = PAIR_TILE,
+    devices: list | None = None,
+    on_tile=None,
+    lower_only: bool = False,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Full ``[R, R]`` covered/overlap matrices via fixed-size tiles.
+
+    Every tile is padded to ``[tile, tile]`` so ONE jit compile serves
+    the whole grid (and any later ruleset).  ``devices`` round-robins
+    tile rows across jax devices — the O(R²) grid is embarrassingly
+    shardable because a tile reads only its two row blocks.  ``on_tile``
+    (if given) is called once per tile BEFORE it is computed — the
+    analyzer threads its ``analyze.tile`` fault site through it.
+
+    ``lower_only`` skips tiles strictly above the diagonal (``j0 > i0``
+    — every pair there has ``b > a``), leaving those entries False: the
+    analyzer only consumes earlier-row relations, and row order is
+    key-ascending, so the upper triangle is provably masked out anyway
+    — skipping it drops ~half the O(R²) device work.
+    """
+    r = rules.shape[0]
+    rules = np.ascontiguousarray(rules, dtype=np.uint32)
+    covered = np.zeros((r, r), dtype=bool)
+    overlap = np.zeros((r, r), dtype=bool)
+    if r == 0:
+        return covered, overlap
+    blocks: dict[tuple[int, int], jnp.ndarray] = {}
+
+    def block(b0: int, b1: int, dev):
+        key = (b0, id(dev))
+        if key not in blocks:
+            padded = _pad_rows(rules[b0:b1], tile)
+            blocks[key] = (
+                jax.device_put(padded, dev) if dev is not None else jnp.asarray(padded)
+            )
+        return blocks[key]
+
+    for i0, i1, j0, j1 in iter_pair_tiles(r, tile):
+        if lower_only and j0 > i0:
+            continue
+        if on_tile is not None:
+            on_tile(i0, j0)
+        dev = devices[(i0 // tile) % len(devices)] if devices else None
+        cov, ovl = relation_tile(block(i0, i1, dev), block(j0, j1, dev))
+        covered[i0:i1, j0:j1] = np.asarray(cov)[: i1 - i0, : j1 - j0]
+        overlap[i0:i1, j0:j1] = np.asarray(ovl)[: i1 - i0, : j1 - j0]
+    return covered, overlap
+
+
+def pair_relations_np(rules: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Pure-numpy twin of :func:`pair_relations` (tests pin agreement)."""
+    acl = rules[:, R_ACL]
+    same = (acl[:, None] == acl[None, :]) & (acl != NO_ACL)[:, None] & (
+        acl != NO_ACL
+    )[None, :]
+    covered = same.copy()
+    overlap = same.copy()
+    for lo, hi in _FIELDS:
+        li, ha = rules[:, lo][:, None], rules[:, hi][:, None]
+        lj, hb = rules[:, lo][None, :], rules[:, hi][None, :]
+        covered &= (lj <= li) & (ha <= hb)
+        overlap &= np.maximum(li, lj) <= np.minimum(ha, hb)
+    return covered, overlap

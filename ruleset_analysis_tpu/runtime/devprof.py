@@ -72,6 +72,7 @@ from . import faults, obs
 #:   ra.topk    chunk-local candidate table + top_k selection
 #:   ra.sort    register-key sorts feeding the segment-reduce updates
 #:              (update_impl=sorted, ops/sorted_update.py — DESIGN §15)
+#:   ra.overlap static-analysis pairwise rule-relation tiles (ISSUE 12)
 #:   ra.merge   cross-device psum/pmax/all_gather merges
 STAGES = (
     "ra.unpack",
@@ -84,6 +85,7 @@ STAGES = (
     "ra.topk",
     "ra.sort",
     "ra.merge",
+    "ra.overlap",
 )
 
 _SCOPE_RE = re.compile(r"ra\.[a-z0-9_]+")

@@ -129,6 +129,12 @@ SITES: dict[str, tuple[str, str]] = {
         "raise", "actuating a scale event fails (worker spawn / mesh "
         "re-formation error analog); registers and in-flight batches "
         "must survive intact — typed abort or bit-identical report"),
+    "analyze.tile": (
+        "raise", "a static-analysis pair tile fails mid-grid "
+        "(runtime/staticanalysis.py); the analysis must abort typed — a "
+        "partial verdict table must NEVER be published as complete, and "
+        "a serve reload's re-analysis failing must leave the previous "
+        "complete verdict set serving"),
     "devprof.capture": (
         "raise", "the in-process jax.profiler capture window fails at "
         "its start or stop seam (runtime/devprof.py); the run must end "

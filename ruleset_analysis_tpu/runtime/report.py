@@ -88,8 +88,38 @@ class Report:
                 f"preserved): {q['hits']} hits across {len(q['rules'])} rule(s)"
             )
         out.append(f"\n# unused rules: {len(self.unused)}")
+        # static-analysis join (runtime/staticanalysis.py): every unused
+        # rule prints with its evidence class, so "no hits observed" is
+        # never mistaken for "provably dead" (or vice versa)
+        st = t.get("static") or {}
+        cls_of: dict[str, str] = {}
+        for cls, label in (
+            ("safe_to_delete", "provably dead — safe to delete"),
+            ("traffic_dependent", "reachable — traffic-dependent"),
+            ("undecided", "undecided — witness budget exhausted"),
+        ):
+            for rule in (st.get("unused_classes") or {}).get(cls, []):
+                cls_of[rule] = label
         for fw, acl, idx in self.unused:
-            out.append(f"  UNUSED {fw} {acl} rule {idx}")
+            tag = cls_of.get(f"{fw} {acl} {idx}")
+            out.append(
+                f"  UNUSED {fw} {acl} rule {idx}"
+                + (f"  [{tag}]" if tag else "")
+            )
+        if st:
+            sm = st.get("meta", {})
+            out.append(
+                f"\n# static analysis: {sm.get('dead', 0)} provably dead "
+                f"rule(s) of {sm.get('n_rules', 0)} "
+                f"({sm.get('witnesses_checked', 0)} witness packets "
+                "device-checked)"
+            )
+            for c in st.get("contradictions", []):
+                out.append(
+                    f"# CONTRADICTION: {c['rule']} has {c['hits']} hit(s) "
+                    f"but a dead '{c['verdict']}' verdict — counters span "
+                    "a ruleset reload, or the analyzer is wrong"
+                )
         return "\n".join(out)
 
 
@@ -186,6 +216,26 @@ def diff_report_objs(old: dict, new: dict, top: int = 10) -> dict:
             if d > 0
         ],
     }
+    # verdict-transition awareness (ISSUE 12): when BOTH reports carry
+    # static-analysis verdicts, a rule moving reachable -> shadowed
+    # across a reload is a typed diff row — an operator must see that a
+    # rule DIED (config-order change), not just a silent count change
+    verd_a = {
+        (e["firewall"], e["acl"], e["index"]): e["verdict"]
+        for e in old.get("per_rule", [])
+        if "verdict" in e
+    }
+    verd_b = {
+        (e["firewall"], e["acl"], e["index"]): e["verdict"]
+        for e in new.get("per_rule", [])
+        if "verdict" in e
+    }
+    if verd_a and verd_b:
+        out["verdict_transitions"] = [
+            {"rule": key_str(k), "old": verd_a[k], "new": verd_b[k]}
+            for k in sorted(set(verd_a) & set(verd_b) & common)
+            if verd_a[k] != verd_b[k]
+        ]
     # serve-mode reports: surface incompleteness so a diff over a lossy
     # window is never mistaken for clean churn evidence
     inc = [

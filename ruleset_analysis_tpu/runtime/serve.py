@@ -408,6 +408,12 @@ class ServeDriver:
         self.total_chunks = 0
         self.cum_quarantine: dict[tuple, int] = {}
         self.talker_entries_dropped = 0
+        # static ruleset analysis plane (runtime/staticanalysis.py):
+        # computed at start + on every reload when scfg.static_analysis
+        self._sa = None
+        self._static_obj: dict | None = None
+        self._static_done_t: float | None = None
+        self._static_duration = 0.0
         self.drops_restored = 0  # drops from checkpointed history (--resume)
         # cumulative incompleteness: EVERY reason a window was marked
         # (dead/stalled listeners included), not just queue drops — the
@@ -531,6 +537,17 @@ class ServeDriver:
         # stats stay explicit nulls in the JSON (prom skips non-numerics)
         g.update(devprof.gauges())
         g.update(devprof.device_memory_gauges())
+        # static-analysis freshness: how stale the published verdicts
+        # are (age since the last completed run) and what a run costs —
+        # an operator
+        # alerting on age > reload cadence catches a wedged re-analysis
+        if self.scfg.static_analysis and self._static_done_t is not None:
+            g["static_analysis_age_sec"] = round(
+                time.time() - self._static_done_t, 3
+            )
+            g["static_analysis_duration_sec"] = round(
+                self._static_duration, 4
+            )
         if eng is not None:
             g.update({
                 "autoscale_decisions_total": len(eng.decisions),
@@ -576,9 +593,72 @@ class ServeDriver:
                 for ep in self.ring.last(k)
             ]
             packed = self.packed
+            # same snapshot as the ruleset: a reload completing mid-
+            # render must not join new-key-space verdicts onto this
+            # old-key-space report by key_id
+            sa_obj = self._static_obj
         if not eps:
             return None
-        return json.loads(self._render_merged(eps, packed).to_json())
+        obj = json.loads(self._render_merged(eps, packed).to_json())
+        if sa_obj is not None:
+            from . import staticanalysis
+
+            staticanalysis.attach_static_obj(obj, sa_obj, strict=False)
+        return obj
+
+    # -- static analysis plane (ISSUE 12) ---------------------------------
+    def _compute_static(self, packed, reuse):
+        """Run the analyzer (compute only — nothing published on failure)."""
+        from . import staticanalysis
+
+        t0 = time.monotonic()
+        with obs.span("serve.static_analysis"):
+            sa = staticanalysis.analyze_ruleset(
+                packed,
+                witness_budget=self.scfg.static_witness_budget,
+                reuse=reuse,
+            )
+        return sa, time.monotonic() - t0
+
+    def _install_static(self, sa, obj: dict, duration: float) -> None:
+        """Swap in a COMPLETE verdict set.  Caller holds ``_pub_lock`` —
+        the reload path installs this INSIDE its one locked ruleset swap
+        so an HTTP render can never join old-ruleset verdicts onto
+        new-ruleset key ids (or vice versa)."""
+        self._sa = sa
+        self._static_obj = obj
+        self._published["static"] = obj
+        self._static_done_t = time.time()
+        self._static_duration = duration
+
+    def _static_side_effects(self, obj: dict, duration: float) -> None:
+        """Off-lock tail of a static publish: disk + metrics."""
+        self._write_json("static.json", obj)
+        obs.metric_event(
+            "serve.static",
+            dead=obj["meta"]["dead"],
+            reused_acls=obj["meta"]["reused_acls"],
+            duration_sec=round(duration, 4),
+        )
+
+    def _publish_static(self, packed, sa, duration: float) -> None:
+        obj = sa.to_obj(packed)
+        with self._pub_lock:
+            self._install_static(sa, obj, duration)
+        self._static_side_effects(obj, duration)
+
+    def _attach_static(self, obj: dict, *, strict: bool) -> dict:
+        """Join the live verdicts into a report object (no-op when the
+        analyzer is off).  ``strict`` reports raise the typed
+        AnalyzerContradiction on hit+dead-verdict; non-strict (counters
+        spanning a reload, restored history, cumulative/merged views)
+        record contradictions in ``totals.static`` instead."""
+        sa_obj = self._static_obj
+        if sa_obj is None:
+            return obj
+        from . import staticanalysis
+
+        return staticanalysis.attach_static_obj(obj, sa_obj, strict=strict)
 
     # -- internals -------------------------------------------------------
     def _render_merged(self, eps: list[WindowEpoch], packed):
@@ -709,6 +789,12 @@ class ServeDriver:
             self._v6_digests: dict[int, int] = {}
             self._v6rows: list = []
             self._fp = self._fingerprint(self.packed)
+            if scfg.static_analysis:
+                # initial analysis: a failure here (incl. the
+                # analyze.tile fault site) aborts the service typed —
+                # the endpoint NEVER serves a partial verdict table
+                sa, dur = self._compute_static(self.packed, reuse=None)
+                self._publish_static(self.packed, sa, dur)
 
             # fresh window scaffolding (possibly replaced by resume below)
             self.win_id = 0
@@ -979,7 +1065,9 @@ class ServeDriver:
             totals=self._window_totals(ep.meta, ep.quarantine),
             v6_digests=self._v6_digests,
         )
-        return json.loads(rep.to_json())
+        # restored history may predate the analyzed ruleset: annotate,
+        # never abort, on a contradiction
+        return self._attach_static(json.loads(rep.to_json()), strict=False)
 
     def _rotate(self, *, partial: bool = False) -> None:
         # a CLOSED devprof capture window parses here, between windows —
@@ -1004,7 +1092,15 @@ class ServeDriver:
                 totals=self._window_totals(meta, self.win_quarantine),
                 v6_digests=self._v6_digests,
             )
-            rep_obj = json.loads(rep.to_json())
+            # strict contradiction check only when every counter in this
+            # window was earned under the analyzed ruleset (no reload
+            # mid-window) AND the counters are exact — CMS-estimated
+            # hits can collide above zero on a genuinely dead rule;
+            # hit+dead-verdict then aborts typed
+            rep_obj = self._attach_static(
+                json.loads(rep.to_json()),
+                strict=meta.get("reloads", 0) == 0 and self.cfg.exact_counts,
+            )
             if meta.get("incomplete"):
                 self.cum_incomplete_windows.append(meta["id"])
                 for r in meta["incomplete"]["reasons"]:
@@ -1045,7 +1141,11 @@ class ServeDriver:
 
     def _publish(self, rep_obj: dict, prev: dict | None, meta: dict) -> None:
         with obs.span("serve.publish", window=meta["id"]):
-            cum_obj = json.loads(self._render_cumulative().to_json())
+            # cumulative counters may span reloads: contradictions there
+            # annotate rather than abort (attach docstring)
+            cum_obj = self._attach_static(
+                json.loads(self._render_cumulative().to_json()), strict=False
+            )
             diff_obj = None
             if prev is not None:
                 # window-over-window churn via the diff-reports machinery
@@ -1087,7 +1187,12 @@ class ServeDriver:
                     # mutator of ring + packed, so no snapshot needed
                     self._write_json(
                         f"merged-{k}.json",
-                        json.loads(self._render_merged(eps, self.packed).to_json()),
+                        self._attach_static(
+                            json.loads(
+                                self._render_merged(eps, self.packed).to_json()
+                            ),
+                            strict=False,
+                        ),
                     )
 
     def _render_cumulative(self):
@@ -1263,8 +1368,9 @@ class ServeDriver:
             self._published["report"] = self._window_reports[
                 self.ring.epochs[-1].meta["id"]
             ]
-            self._published["cumulative"] = json.loads(
-                self._render_cumulative().to_json()
+            self._published["cumulative"] = self._attach_static(
+                json.loads(self._render_cumulative().to_json()),
+                strict=False,  # restored counters may predate the ruleset
             )
 
     # -- metrics-driven elastic autoscaling (DESIGN §13) -------------------
@@ -1382,6 +1488,13 @@ class ServeDriver:
         # old tensor, registers, and in-flight batch completely intact
         faults.fire("reload.midbatch")
         mig = build_migration(old_packed, new_packed)
+        # re-analyze the NEW ruleset before anything swaps: only changed
+        # ACLs re-tile (signature reuse); a failure here — including the
+        # analyze.tile fault site — is an atomic reload failure, so the
+        # previous COMPLETE verdict set keeps serving
+        sa_new = dur_new = None
+        if self.scfg.static_analysis:
+            sa_new, dur_new = self._compute_static(new_packed, reuse=self._sa)
         # step everything parsed under the OLD ruleset through the OLD
         # programs — gids/keys in flight belong to the old space
         try:
@@ -1419,9 +1532,14 @@ class ServeDriver:
                 for k, v in new_arrays.items()
             })
         # ONE publish-locked swap: ring epochs, cumulative image, live
-        # state, rule tensor, programs, and batcher move to the new key
-        # space together — an HTTP render can never pair migrated arrays
-        # with the old ruleset (or old arrays with the new one)
+        # state, rule tensor, programs, batcher, AND the static verdict
+        # table move to the new key space together — an HTTP render can
+        # never pair migrated arrays with the old ruleset (or old
+        # arrays / old verdicts with the new one).  The (O(R)) verdict
+        # serialization happens off-lock, above.
+        sa_obj_new = (
+            sa_new.to_obj(new_packed) if sa_new is not None else None
+        )
         with self._pub_lock:
             if not mig.identity:
                 _merge_quarantine(self.win_quarantine, q)
@@ -1464,6 +1582,10 @@ class ServeDriver:
             self.dev_rules6 = dev_rules6
             self.step6 = step6
             self.batcher = batcher
+            if sa_new is not None:
+                self._install_static(sa_new, sa_obj_new, dur_new)
+        if sa_new is not None:
+            self._static_side_effects(sa_obj_new, dur_new)
         self._fp = self._fingerprint(new_packed)
         self.reloads += 1
         self.win_reloads += 1
@@ -1714,6 +1836,13 @@ def _make_http_handler():
                     return self._send(200, obj) if obj else self._send(
                         404, {"error": "no window published yet"}
                     )
+                if path == "/report/static":
+                    obj = drv.published("static")
+                    return self._send(200, obj) if obj else self._send(
+                        404,
+                        {"error": "static analysis disabled "
+                                  "(serve --static-analysis) or not yet run"},
+                    )
                 if path == "/diff":
                     obj = drv.published("diff")
                     return self._send(200, obj) if obj else self._send(
@@ -1753,8 +1882,8 @@ def _make_http_handler():
                     "error": "unknown path",
                     "endpoints": [
                         "/health", "/metrics", "/report",
-                        "/report/cumulative", "/report/window/<id>",
-                        "/report/merged/<k>", "/diff",
+                        "/report/cumulative", "/report/static",
+                        "/report/window/<id>", "/report/merged/<k>", "/diff",
                     ],
                 })
             except BrokenPipeError:

@@ -68,6 +68,15 @@ import time as _time  # noqa: E402
 import pytest  # noqa: E402  (after the re-exec guard above)
 
 # ---------------------------------------------------------------------------
+# Test-calibration note (carried forward from PR 10): the 2 in-suite
+# distributed flakes occasionally seen on this 1-core container are LOAD
+# artifacts — xla:cpu collective/heartbeat timeouts when the host is
+# oversubscribed — not product bugs.  Do not chase them, and NEVER run
+# anything concurrently with the tier-1 gate run (a parallel build or
+# bench steals the core and manufactures exactly these failures).
+# ---------------------------------------------------------------------------
+
+# ---------------------------------------------------------------------------
 # Tier-1 wall-budget guard (ROADMAP: the `-m 'not slow'` suite must stay
 # under the 870 s gate, with headroom).  Suite-budget discipline is part
 # of the test contract — new variant tests share compiles and mark

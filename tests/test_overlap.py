@@ -1,0 +1,152 @@
+"""Pairwise rule-relation kernel units (ops/overlap.py, ISSUE 12).
+
+Pins the kernel's contract against an independent numpy twin: per-pair
+covered/overlap semantics, padding/ACL isolation, and tiled-grid ==
+single-tile equivalence (the property the analyzer's O(R²) sharding
+rests on).
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.hostside.pack import NO_ACL, R_ACL, RULE_COLS
+from ruleset_analysis_tpu.ops.overlap import (
+    PAIR_TILE,
+    iter_pair_tiles,
+    pair_relations,
+    pair_relations_np,
+    relation_tile,
+)
+
+_FIELD_LOHI = [(1, 2), (3, 4), (5, 6), (7, 8), (9, 10)]
+
+
+def random_rules(rng, r, n_acls=2, pad=0):
+    """Random valid rule tensor: lo <= hi everywhere, some 'any' fields."""
+    rules = np.zeros((r + pad, RULE_COLS), dtype=np.uint32)
+    rules[:, R_ACL] = NO_ACL
+    for i in range(r):
+        rules[i, R_ACL] = rng.integers(0, n_acls)
+        rules[i, 11] = i  # key
+        for lo, hi in _FIELD_LOHI:
+            if rng.random() < 0.25:  # any
+                a, b = 0, np.iinfo(np.uint32).max
+            else:
+                a, b = sorted(rng.integers(0, 100, size=2))
+            rules[i, lo], rules[i, hi] = a, b
+    return rules
+
+
+def test_relation_tile_matches_numpy_twin():
+    rng = np.random.default_rng(0)
+    rules = random_rules(rng, 40, n_acls=3, pad=8)
+    cov_np, ovl_np = pair_relations_np(rules)
+    cov, ovl = relation_tile(rules, rules)
+    np.testing.assert_array_equal(np.asarray(cov), cov_np)
+    np.testing.assert_array_equal(np.asarray(ovl), ovl_np)
+    # covered is a sub-relation of overlap (boxes are non-empty)
+    assert not (cov_np & ~ovl_np).any()
+    # padding rows relate to nothing, in either direction
+    assert not cov_np[40:].any() and not cov_np[:, 40:].any()
+    assert not ovl_np[40:].any() and not ovl_np[:, 40:].any()
+
+
+def test_cross_acl_rows_never_relate():
+    rng = np.random.default_rng(1)
+    rules = random_rules(rng, 20, n_acls=1)
+    other = rules.copy()
+    other[:, R_ACL] = 1  # identical boxes, different ACL
+    both = np.concatenate([rules, other])
+    _, ovl = pair_relations(both)
+    assert not ovl[:20, 20:].any() and not ovl[20:, :20].any()
+    # within an ACL every row overlaps itself
+    assert ovl[np.arange(20), np.arange(20)].all()
+
+
+def test_known_relations():
+    def row(acl, plo, phi, slo, shi):
+        out = [acl, plo, phi, slo, shi, 0, 65535, 0, 0xFFFFFFFF, 0, 65535, 0]
+        return out
+
+    rules = np.asarray(
+        [
+            row(0, 6, 6, 10, 20),  # 0: tcp src 10-20
+            row(0, 6, 6, 0, 100),  # 1: tcp src 0-100 (covers 0)
+            row(0, 6, 6, 15, 30),  # 2: tcp src 15-30 (partial vs 0)
+            row(0, 17, 17, 10, 20),  # 3: udp — proto-disjoint from all
+        ],
+        dtype=np.uint32,
+    )
+    cov, ovl = pair_relations(rules)
+    assert cov[0, 1] and not cov[1, 0]  # 1 covers 0, not vice versa
+    assert ovl[0, 2] and not cov[0, 2] and not cov[2, 0]  # partial
+    assert not ovl[0, 3] and not ovl[3, 0]  # disjoint on proto
+    assert cov[0, 0]  # a row covers itself
+
+
+@pytest.mark.parametrize("tile", [4, 16])
+def test_tiled_grid_equals_single_tile(tile):
+    rng = np.random.default_rng(2)
+    rules = random_rules(rng, 37, n_acls=2)  # not a tile multiple
+    cov1, ovl1 = pair_relations(rules)  # one PAIR_TILE tile
+    covt, ovlt = pair_relations(rules, tile=tile)
+    np.testing.assert_array_equal(cov1, covt)
+    np.testing.assert_array_equal(ovl1, ovlt)
+
+
+def test_tile_grid_iterator_covers_every_pair_once():
+    seen = np.zeros((37, 37), dtype=int)
+    for i0, i1, j0, j1 in iter_pair_tiles(37, 16):
+        seen[i0:i1, j0:j1] += 1
+    assert (seen == 1).all()
+
+
+def test_on_tile_seam_fires_per_tile_and_devices_shard():
+    import jax
+
+    rng = np.random.default_rng(3)
+    rules = random_rules(rng, 33, n_acls=2)
+    calls = []
+    cov, ovl = pair_relations(
+        rules, tile=16, devices=list(jax.devices()),
+        on_tile=lambda i0, j0: calls.append((i0, j0)),
+    )
+    assert len(calls) == 9  # ceil(33/16)^2 tiles
+    c2, o2 = pair_relations(rules, tile=PAIR_TILE)
+    np.testing.assert_array_equal(cov, c2)
+    np.testing.assert_array_equal(ovl, o2)
+
+
+def test_lower_only_skips_upper_triangle_tiles_losslessly():
+    """The analyzer's half-grid mode: tiles with j0 > i0 are skipped and
+    their entries stay False; everything at or below the tile diagonal
+    is identical to the full grid."""
+    rng = np.random.default_rng(4)
+    rules = random_rules(rng, 33, n_acls=2)
+    calls = []
+    cov, ovl = pair_relations(
+        rules, tile=16, lower_only=True,
+        on_tile=lambda i0, j0: calls.append((i0, j0)),
+    )
+    assert all(j0 <= i0 for i0, j0 in calls)
+    assert len(calls) == 6  # lower-triangle tiles of a 3x3 grid
+    full_cov, full_ovl = pair_relations(rules, tile=16)
+    for a in range(33):
+        for b in range(33):
+            if b // 16 <= a // 16:  # tile at-or-below the diagonal
+                assert cov[a, b] == full_cov[a, b]
+                assert ovl[a, b] == full_ovl[a, b]
+            else:
+                assert not cov[a, b] and not ovl[a, b]
+
+
+def test_empty_and_single_row():
+    empty = np.zeros((0, RULE_COLS), dtype=np.uint32)
+    cov, ovl = pair_relations(empty)
+    assert cov.shape == (0, 0)
+    one = np.zeros((1, RULE_COLS), dtype=np.uint32)
+    one[0, 2] = 255  # proto any-ish; acl 0, all other ranges [0, 0]
+    cov, ovl = pair_relations(one)
+    assert cov[0, 0] and ovl[0, 0]

@@ -1,0 +1,647 @@
+"""Static ruleset analysis plane (ISSUE 12; DESIGN §17).
+
+Pins the subsystem's four contracts:
+
+- **Exactness** (the acceptance bar): on exhaustively enumerable field
+  domains the analyzer's dead set equals a brute-force first-match
+  oracle's EXACTLY, across seeded random rulesets (any/point/range
+  fields, duplicates, multi-ACE rules) — and every dead verdict carries
+  an exact single-rule cover or a complete witness-exhaustion record.
+- **Evidence join**: unused rules classify into provably-dead vs
+  traffic-dependent vs undecided; a hit on a dead-verdict rule is a
+  typed AnalyzerContradiction (strict) or an annotated contradiction
+  (reports spanning reloads) — never silent.
+- **Chaos**: an `analyze.tile` fault mid-grid aborts typed; a partial
+  verdict table is never published as complete (direct + serve-reload).
+- **Serve integration**: /report/static, window-report verdict fields,
+  freshness gauges, and signature-reuse across hot reload.
+"""
+
+import itertools
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import AnalysisConfig, ServeConfig
+from ruleset_analysis_tpu.errors import (
+    AnalysisError,
+    AnalyzerContradiction,
+    InjectedFault,
+)
+from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+from ruleset_analysis_tpu.hostside.aclparse import Ace, AclRule, Ruleset
+from ruleset_analysis_tpu.runtime import faults
+from ruleset_analysis_tpu.runtime import report as report_mod
+from ruleset_analysis_tpu.runtime import staticanalysis as sa_mod
+
+# ---------------------------------------------------------------------------
+# Brute-force oracle over tiny enumerable domains.
+#
+# The universe is the PRODUCT of the tiny domains: every rule interval is
+# drawn inside it ("any" == the full tiny domain), so reachability over
+# the enumerated universe equals reachability over uint32 space — the
+# oracle is exact, not a sample.
+# ---------------------------------------------------------------------------
+
+DOM_PROTO, DOM_ADDR, DOM_PORT = 4, 16, 4  # 4*16*4*16*4 = 16384 packets
+
+_G = np.meshgrid(
+    np.arange(DOM_PROTO), np.arange(DOM_ADDR), np.arange(DOM_PORT),
+    np.arange(DOM_ADDR), np.arange(DOM_PORT), indexing="ij",
+)
+PKT_PROTO, PKT_SRC, PKT_SPORT, PKT_DST, PKT_DPORT = (
+    g.ravel().astype(np.int64) for g in _G
+)
+
+
+def oracle_reachable(rules: list[AclRule]) -> set[int]:
+    """First-match scan over EVERY packet: which rule positions can win."""
+    unclaimed = np.ones(PKT_PROTO.size, dtype=bool)
+    reach: set[int] = set()
+    for k, rule in enumerate(rules):
+        m = np.zeros(PKT_PROTO.size, dtype=bool)
+        for a in rule.aces:
+            m |= (
+                (PKT_PROTO >= a.proto_lo) & (PKT_PROTO <= a.proto_hi)
+                & (PKT_SRC >= a.src_lo) & (PKT_SRC <= a.src_hi)
+                & (PKT_SPORT >= a.sport_lo) & (PKT_SPORT <= a.sport_hi)
+                & (PKT_DST >= a.dst_lo) & (PKT_DST <= a.dst_hi)
+                & (PKT_DPORT >= a.dport_lo) & (PKT_DPORT <= a.dport_hi)
+            )
+        if (m & unclaimed).any():
+            reach.add(k)
+        unclaimed &= ~m
+    return reach
+
+
+def _iv(rng, dom):
+    r = rng.random()
+    if r < 0.3:
+        return 0, dom - 1  # any
+    if r < 0.6:
+        v = int(rng.integers(dom))
+        return v, v  # point
+    a, b = sorted(int(x) for x in rng.integers(0, dom, size=2))
+    return a, b
+
+
+def tiny_ruleset(rng, n_rules: int) -> Ruleset:
+    rules = []
+    for i in range(n_rules):
+        n_aces = 1 if rng.random() < 0.8 else 2
+        aces = [
+            Ace(
+                int(rng.integers(2)),
+                *_iv(rng, DOM_PROTO), *_iv(rng, DOM_ADDR), *_iv(rng, DOM_PORT),
+                *_iv(rng, DOM_ADDR), *_iv(rng, DOM_PORT),
+            )
+            for _ in range(n_aces)
+        ]
+        rules.append(AclRule(acl="T", index=i + 1, text=f"r{i}", aces=aces))
+    return Ruleset(firewall="fw", acls={"T": rules})
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_analyzer_matches_bruteforce_oracle_exactly(seed):
+    rng = np.random.default_rng(seed)
+    n_rules = int(rng.integers(5, 11))
+    rs = tiny_ruleset(rng, n_rules)
+    # fixed pad -> one shared first_match compile across all seeds
+    packed = pack.pack_rulesets([rs], pad_rules_to=32)
+    res = sa_mod.analyze_ruleset(packed, witness_budget=1 << 17)
+
+    reach = oracle_reachable(rs.acls["T"])
+    dead_oracle = set(range(n_rules)) - reach
+    dead_analyzer = res.dead_keys()
+    assert dead_analyzer == dead_oracle, (
+        f"seed {seed}: analyzer dead {sorted(dead_analyzer)} != oracle "
+        f"dead {sorted(dead_oracle)}"
+    )
+    # proof-object discipline: every dead verdict is certified with an
+    # exact cover or a COMPLETE exhaustion record; nothing undecided can
+    # be dead
+    for kid, v in res.verdicts.items():
+        if v.dead:
+            assert v.certified
+            assert v.basis in ("single-cover", "witness-exhaustion")
+            if v.basis == "single-cover":
+                assert v.cover_key is not None and v.cover_key < kid
+            else:
+                assert v.witness_grid >= v.witnesses_checked > 0
+        if v.witness is not None:
+            # a claimed witness really is a packet the rule wins
+            p = v.witness
+            claimed = oracle_reachable(rs.acls["T"][: kid + 1])
+            assert kid in claimed
+    assert res.meta["complete"] is True
+
+
+def test_implicit_any_rule_first_kills_everything_after():
+    """Degenerate but common misconfig: any-any first -> all later dead."""
+    rng = np.random.default_rng(99)
+    rs = tiny_ruleset(rng, 6)
+    any_rule = AclRule(
+        acl="T", index=1, text="any",
+        aces=[Ace(1, 0, DOM_PROTO - 1, 0, DOM_ADDR - 1, 0, DOM_PORT - 1,
+                  0, DOM_ADDR - 1, 0, DOM_PORT - 1)],
+    )
+    rs.acls["T"] = [any_rule] + [
+        AclRule(acl="T", index=i + 2, text=r.text, aces=r.aces)
+        for i, r in enumerate(rs.acls["T"])
+    ]
+    packed = pack.pack_rulesets([rs])
+    res = sa_mod.analyze_ruleset(packed)
+    assert res.verdicts[0].verdict == sa_mod.REACHABLE
+    assert res.dead_keys() == set(range(1, 7))
+
+
+# ---------------------------------------------------------------------------
+# Verdict lattice on a hand-built ruleset.
+# ---------------------------------------------------------------------------
+
+LATTICE_CFG = """
+hostname fw1
+access-list A extended permit tcp any any eq 80
+access-list A extended deny tcp any any eq 80
+access-list A extended permit tcp host 10.0.0.1 any eq 80
+access-list A extended permit udp any any range 100 200
+access-list A extended deny udp any any range 150 250
+access-list A extended permit udp any any range 100 250
+access-list A extended permit ip any any
+access-group A in interface outside
+"""
+
+
+@pytest.fixture(scope="module")
+def lattice_packed():
+    rs = aclparse.parse_asa_config(LATTICE_CFG, "fw1")
+    return pack.pack_rulesets([rs])
+
+
+@pytest.fixture(scope="module")
+def lattice_analysis(lattice_packed):
+    return sa_mod.analyze_ruleset(lattice_packed)
+
+
+def test_verdict_lattice_hand_built(lattice_packed, lattice_analysis):
+    v = lattice_analysis.verdicts
+    assert v[0].verdict == sa_mod.REACHABLE and v[0].basis == "disjoint"
+    # same box, different action, single earlier cover -> conflict
+    assert v[1].verdict == sa_mod.CONFLICT and v[1].cover_key == 0
+    # subset box, same action -> redundant
+    assert v[2].verdict == sa_mod.REDUNDANT and v[2].cover_key == 0
+    assert v[3].verdict == sa_mod.REACHABLE
+    # udp 150-250 partially masked by 100-200; witness must be a dport
+    # in 201..250 (device-checked concrete packet)
+    assert v[4].verdict == sa_mod.PARTIAL and v[4].basis == "witness"
+    assert v[4].certified and 201 <= v[4].witness[4] <= 250
+    # udp 100-250 covered by the UNION of rules 4+5 only: dead via
+    # complete witness exhaustion, never via a single cover
+    assert v[5].verdict == sa_mod.SHADOWED
+    assert v[5].basis == "witness-exhaustion"
+    assert v[5].certified and v[5].witness_grid == v[5].witnesses_checked > 0
+    assert v[6].verdict == sa_mod.PARTIAL and v[6].basis == "witness"
+
+
+def test_witness_budget_truncation_is_honest(lattice_packed):
+    """Grid > budget and no witness found -> undecided, NEVER dead."""
+    res = sa_mod.analyze_ruleset(lattice_packed, witness_budget=1)
+    v = res.verdicts[5]  # the union-shadowed rule (grid of 2)
+    assert v.verdict == sa_mod.PARTIAL
+    assert v.basis == "witness-budget"
+    assert not v.certified
+    assert v.witness_grid > 1 and v.witnesses_checked == 1
+    assert 5 not in res.dead_keys()
+
+
+def test_v6_bearing_rules_never_die_from_v4_plane():
+    cfg = """
+hostname fw1
+access-list A extended permit ip any any
+access-list A extended permit tcp any any eq 80
+access-group A in interface inside
+"""
+    rs = aclparse.parse_asa_config(cfg, "fw1")
+    packed = pack.pack_rulesets([rs])
+    res = sa_mod.analyze_ruleset(packed)
+    if packed.has_v6:
+        # unified-ACL semantics: rule 2 has v6 rows too; its v4 side is
+        # single-covered but the v4 plane must not certify it dead
+        v = res.verdicts[1]
+        assert v.verdict == sa_mod.PARTIAL
+        assert v.basis == "v4-dead-v6-unanalyzed"
+        assert not v.certified
+        assert not res.dead_keys()
+    else:  # pure-v4 expansion: plain single-cover redundancy
+        assert res.verdicts[1].verdict == sa_mod.REDUNDANT
+
+
+def test_tile_grid_independence(lattice_packed, lattice_analysis):
+    """Tiny tiles (multi-tile grid) produce identical verdicts."""
+    res = sa_mod.analyze_ruleset(lattice_packed, tile=2)
+    assert res.meta["tiles_run"] > lattice_analysis.meta["tiles_run"]
+    assert {
+        k: (v.verdict, v.basis) for k, v in res.verdicts.items()
+    } == {
+        k: (v.verdict, v.basis)
+        for k, v in lattice_analysis.verdicts.items()
+    }
+
+
+# ---------------------------------------------------------------------------
+# Report join: evidence classes + the contradiction invariant.
+# ---------------------------------------------------------------------------
+
+
+def _report_with_hits(packed, hits_by_kid):
+    hits = {}
+    for kid, h in hits_by_kid.items():
+        m = packed.key_meta[kid]
+        hits[(m.firewall, m.acl, m.index)] = h
+    return report_mod.build_report(packed, hits, backend="test")
+
+
+def test_unused_rules_classify_by_evidence(lattice_packed, lattice_analysis):
+    # traffic hit only rule 0: everything else is unused, split by verdict
+    rep = _report_with_hits(lattice_packed, {0: 10})
+    sa_mod.attach_static(rep, lattice_packed, lattice_analysis)
+    st = rep.totals["static"]
+    classes = st["unused_classes"]
+    dead_rules = {f"fw1 A {k + 1}" for k in (1, 2, 5)}
+    assert set(classes[sa_mod.CLASS_SAFE]) == dead_rules
+    assert "fw1 A 4" in classes[sa_mod.CLASS_TRAFFIC]  # reachable
+    assert "fw1 A 5" in classes[sa_mod.CLASS_TRAFFIC]  # certified witness
+    assert classes[sa_mod.CLASS_UNDECIDED] == []
+    # per-rule fields joined; implicit-deny keys carry none
+    assert rep.per_rule[1]["verdict"] == sa_mod.CONFLICT
+    assert "verdict" not in rep.per_rule[-1]
+    # the text rendering names the classes
+    txt = rep.to_text()
+    assert "provably dead — safe to delete" in txt
+    assert "reachable — traffic-dependent" in txt
+
+
+def test_hit_on_dead_rule_is_typed_contradiction(
+    lattice_packed, lattice_analysis
+):
+    rep = _report_with_hits(lattice_packed, {1: 3})  # dead rule with hits
+    with pytest.raises(AnalyzerContradiction, match="fw1 A 2"):
+        sa_mod.attach_static(rep, lattice_packed, lattice_analysis)
+    # non-strict (counters spanning a reload): annotated, never silent
+    rep2 = _report_with_hits(lattice_packed, {1: 3})
+    sa_mod.attach_static(rep2, lattice_packed, lattice_analysis, strict=False)
+    cons = rep2.totals["static"]["contradictions"]
+    assert cons == [{"rule": "fw1 A 2", "hits": 3, "verdict": "conflict"}]
+    assert "CONTRADICTION" in rep2.to_text()
+
+
+def test_report_without_analysis_is_untouched(lattice_packed):
+    rep = _report_with_hits(lattice_packed, {0: 1})
+    assert "static" not in rep.totals
+    assert "verdict" not in rep.per_rule[0]
+    assert "[provably dead" not in rep.to_text()
+
+
+def test_diff_reports_verdict_transitions(lattice_packed, lattice_analysis):
+    rep_a = _report_with_hits(lattice_packed, {0: 1})
+    rep_b = _report_with_hits(lattice_packed, {0: 2})
+    sa_mod.attach_static(rep_a, lattice_packed, lattice_analysis)
+    sa_mod.attach_static(rep_b, lattice_packed, lattice_analysis)
+    obj_a = json.loads(rep_a.to_json())
+    obj_b = json.loads(rep_b.to_json())
+    # same verdicts -> present but empty
+    assert report_mod.diff_report_objs(obj_a, obj_b)["verdict_transitions"] == []
+    # a verdict flip is a TYPED diff row
+    for e in obj_b["per_rule"]:
+        if e["index"] == 4 and not e.get("verdict") is None:
+            e["verdict"] = sa_mod.SHADOWED
+    d = report_mod.diff_report_objs(obj_a, obj_b)
+    assert d["verdict_transitions"] == [
+        {"rule": "fw1 A 4", "old": "reachable", "new": "shadowed"}
+    ]
+    # verdict-free reports (analysis off) don't grow the key at all
+    plain_a = json.loads(_report_with_hits(lattice_packed, {0: 1}).to_json())
+    plain_b = json.loads(_report_with_hits(lattice_packed, {0: 2}).to_json())
+    assert "verdict_transitions" not in report_mod.diff_report_objs(
+        plain_a, plain_b
+    )
+
+
+# ---------------------------------------------------------------------------
+# Incremental re-analysis (the reload path's signature reuse).
+# ---------------------------------------------------------------------------
+
+TWO_ACL_CFG = """
+hostname fwx
+access-list A extended permit tcp any any eq 80
+access-list A extended permit tcp any any eq 80
+access-list B extended permit udp any any eq 53
+access-list B extended deny udp any any eq 53
+access-group A in interface inside
+access-group B in interface outside
+"""
+
+TWO_ACL_CFG_B_CHANGED = """
+hostname fwx
+access-list A extended permit tcp any any eq 80
+access-list A extended permit tcp any any eq 80
+access-list B extended deny udp any any eq 53
+access-list B extended permit udp any any eq 53
+access-group A in interface inside
+access-group B in interface outside
+"""
+
+
+def test_reanalysis_reuses_unchanged_acls_exactly():
+    old = pack.pack_rulesets(
+        [aclparse.parse_asa_config(TWO_ACL_CFG, "fwx")]
+    )
+    new = pack.pack_rulesets(
+        [aclparse.parse_asa_config(TWO_ACL_CFG_B_CHANGED, "fwx")]
+    )
+    sa_old = sa_mod.analyze_ruleset(old)
+    incremental = sa_mod.analyze_ruleset(new, reuse=sa_old)
+    assert incremental.meta["reused_acls"] == 1  # A untouched
+    assert incremental.meta["analyzed_acls"] == 1  # B re-tiled
+    fresh = sa_mod.analyze_ruleset(new)
+    assert {
+        k: (v.verdict, v.basis, v.certified, v.cover_key)
+        for k, v in incremental.verdicts.items()
+    } == {
+        k: (v.verdict, v.basis, v.certified, v.cover_key)
+        for k, v in fresh.verdicts.items()
+    }
+    # B's swap changed which rule dies (deny now first)
+    assert incremental.verdicts[3].verdict == sa_mod.CONFLICT
+    # identical ruleset -> everything reused, nothing re-tiled
+    cached = sa_mod.analyze_ruleset(old, reuse=sa_old)
+    assert cached.meta["reused_acls"] == 2
+    assert cached.meta["analyzed_acls"] == 0
+    assert cached.meta["tiles_run"] == 0
+
+
+def test_reuse_remaps_key_ids_across_renumbering():
+    """An ACL inserted BEFORE an unchanged one shifts its key ids; the
+    reused verdicts (cover keys included) must follow."""
+    base_cfg = """
+hostname fwx
+access-list Z extended permit tcp any any eq 80
+access-list Z extended deny tcp any any eq 80
+access-group Z in interface inside
+"""
+    grown_cfg = """
+hostname fwx
+access-list A extended permit udp any any eq 1
+access-list A extended permit udp any any eq 2
+access-list A extended permit udp any any eq 3
+access-list Z extended permit tcp any any eq 80
+access-list Z extended deny tcp any any eq 80
+access-group A in interface outside
+access-group Z in interface inside
+"""
+    old = pack.pack_rulesets([aclparse.parse_asa_config(base_cfg, "fwx")])
+    new = pack.pack_rulesets([aclparse.parse_asa_config(grown_cfg, "fwx")])
+    sa_old = sa_mod.analyze_ruleset(old)
+    inc = sa_mod.analyze_ruleset(new, reuse=sa_old)
+    assert inc.meta["reused_acls"] == 1
+    # Z's keys moved 0,1 -> 3,4; the conflict + its cover pointer moved too
+    assert inc.verdicts[4].verdict == sa_mod.CONFLICT
+    assert inc.verdicts[4].cover_key == 3
+
+
+# ---------------------------------------------------------------------------
+# Chaos: the analyze.tile fault site (satellite; 2 seeded schedules).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_chaos_tile_fault_aborts_typed_never_partial(lattice_packed, seed):
+    """Seeded schedules over the analyze.tile site: the analysis must
+    abort with a TYPED error before any verdict object exists — a
+    partial verdict table can never be mistaken for a complete one."""
+    plan = faults.FaultPlan.random(seed, sites=["analyze.tile"], n_faults=1)
+    at = plan.specs["analyze.tile"].at
+    with faults.armed(plan):
+        # tile=2 -> the 7-row ACL runs the 10 lower-triangle tiles of
+        # its ceil(7/2)^2 grid >= any at (max 4)
+        with pytest.raises(InjectedFault) as ei:
+            sa_mod.analyze_ruleset(lattice_packed, tile=2)
+    assert isinstance(ei.value, AnalysisError)
+    assert f"hit {at}" in str(ei.value)
+    # disarmed, the same call completes with the full verdict set
+    res = sa_mod.analyze_ruleset(lattice_packed, tile=2)
+    assert len(res.verdicts) == lattice_packed.n_rules
+    assert res.meta["complete"] is True
+
+
+# ---------------------------------------------------------------------------
+# Serve integration: endpoint, gauges, reload reuse, atomic failure.
+# ---------------------------------------------------------------------------
+
+SERVE_OLD_CFG = """
+hostname fws
+access-list A extended permit tcp any any eq 80
+access-list A extended deny tcp any any eq 80
+access-list B extended permit udp any any eq 53
+access-group A in interface inside
+access-group B in interface outside
+"""
+
+SERVE_NEW_CFG = """
+hostname fws
+access-list A extended permit tcp any any eq 80
+access-list A extended deny tcp any any eq 80
+access-list B extended permit udp any any eq 53
+access-list B extended permit udp any any eq 53
+access-group A in interface inside
+access-group B in interface outside
+"""
+
+
+def _serve_lines(packed, n, seed):
+    t = synth.synth_tuples(packed, n, seed=seed)
+    return synth.render_syslog(packed, t, seed=seed)
+
+
+def test_serve_static_plane_end_to_end(tmp_path):
+    from tests.test_serve import (  # shared driver harness
+        finish, get_json, send_tcp, start_serve, wait_for,
+    )
+
+    old = pack.pack_rulesets([aclparse.parse_asa_config(SERVE_OLD_CFG, "fws")])
+    new = pack.pack_rulesets([aclparse.parse_asa_config(SERVE_NEW_CFG, "fws")])
+    prefix = str(tmp_path / "fws")
+    pack.save_packed(old, prefix)
+    lines = _serve_lines(old, 120, seed=5)
+
+    cfg = AnalysisConfig(batch_size=128, prefetch_depth=0)
+    scfg = ServeConfig(
+        listen=("tcp:127.0.0.1:0",),
+        window_lines=100,
+        ring=4,
+        serve_dir=str(tmp_path / "serve"),
+        stop_after_sec=90,
+        reload_watch=False,
+        static_analysis=True,
+        checkpoint_every_windows=0,
+    )
+    drv, th, out = start_serve(prefix, cfg, scfg)
+    try:
+        http = drv.http_address
+        # verdicts are served before any traffic arrives
+        st = get_json(http, "/report/static")
+        assert st["meta"]["complete"] is True
+        assert st["meta"]["dead"] == 1  # A's rule 2 (conflict)
+        verd = {v["rule"]: v["verdict"] for v in st["verdicts"]}
+        assert verd["fws A 2"] == sa_mod.CONFLICT
+        # freshness gauges, JSON and prom text from the same source
+        g = get_json(http, "/metrics")
+        assert g["static_analysis_age_sec"] >= 0
+        assert g["static_analysis_duration_sec"] >= 0
+        host, port = http
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics?format=prom", timeout=10
+        ) as r:
+            prom = r.read().decode()
+        assert "ra_serve_static_analysis_age_sec" in prom
+        assert "ra_serve_static_analysis_duration_sec" in prom
+
+        # a rotated window joins the verdicts into its report
+        addr = drv.listeners.listeners[0].address
+        send_tcp(addr, lines[:100])
+        wait_for(
+            lambda: get_json(http, "/health")["windows_published"] >= 1,
+            60, "first rotation",
+        )
+        w0 = get_json(http, "/report/window/0")
+        assert w0["totals"]["static"]["meta"]["dead"] == 1
+        assert any(
+            e.get("verdict") == sa_mod.CONFLICT for e in w0["per_rule"]
+        )
+        classes = w0["totals"]["static"]["unused_classes"]
+        assert "fws A 2" in classes[sa_mod.CLASS_SAFE]
+
+        # atomic reload failure: analyze.tile fires during re-analysis,
+        # BEFORE anything swaps — old verdicts keep serving, complete
+        pack.save_packed(new, prefix)
+        faults.arm(faults.FaultPlan.parse("analyze.tile@1"))
+        try:
+            drv.request_reload()
+            wait_for(
+                lambda: get_json(http, "/health")["reload_errors"] == 1,
+                30, "failed reload",
+            )
+        finally:
+            faults.disarm()
+        assert get_json(http, "/health")["reloads"] == 0
+        still = get_json(http, "/report/static")
+        assert still["meta"]["n_rules"] == old.n_rules
+        assert still["meta"]["complete"] is True
+
+        # successful reload: unchanged ACL A reuses its verdicts
+        drv.request_reload()
+        wait_for(lambda: get_json(http, "/health")["reloads"] == 1, 30,
+                 "reload")
+        st2 = get_json(http, "/report/static")
+        assert st2["meta"]["n_rules"] == new.n_rules
+        assert st2["meta"]["reused_acls"] == 1  # A
+        assert st2["meta"]["analyzed_acls"] == 1  # B grew a rule
+        verd2 = {v["rule"]: v["verdict"] for v in st2["verdicts"]}
+        assert verd2["fws B 2"] == sa_mod.REDUNDANT  # duplicated udp rule
+    finally:
+        drv.stop()
+        summary = finish(th, out)
+    assert summary["reload_errors"] == 1
+    # static verdicts landed on disk next to the window reports
+    disk = json.loads((tmp_path / "serve" / "static.json").read_text())
+    assert disk["meta"]["n_rules"] == new.n_rules
+
+
+def test_serve_without_static_analysis_unchanged(tmp_path):
+    """Analysis off (the default): no endpoint, no gauges, no report
+    fields — the pre-ISSUE-12 service surface, bit-identical."""
+    from tests.test_serve import finish, get_json, send_tcp, start_serve, wait_for
+
+    old = pack.pack_rulesets([aclparse.parse_asa_config(SERVE_OLD_CFG, "fws")])
+    prefix = str(tmp_path / "fws")
+    pack.save_packed(old, prefix)
+    cfg = AnalysisConfig(batch_size=128, prefetch_depth=0)
+    scfg = ServeConfig(
+        listen=("tcp:127.0.0.1:0",),
+        window_lines=50,
+        serve_dir=str(tmp_path / "serve"),
+        stop_after_sec=60,
+        reload_watch=False,
+        checkpoint_every_windows=0,
+    )
+    drv, th, out = start_serve(prefix, cfg, scfg)
+    try:
+        http = drv.http_address
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get_json(http, "/report/static", retries=1)
+        assert ei.value.code == 404
+        assert "static_analysis_age_sec" not in get_json(http, "/metrics")
+        send_tcp(drv.listeners.listeners[0].address,
+                 _serve_lines(old, 50, seed=6))
+        wait_for(
+            lambda: get_json(http, "/health")["windows_published"] >= 1,
+            60, "rotation",
+        )
+        w0 = get_json(http, "/report/window/0")
+        assert "static" not in w0["totals"]
+        assert all("verdict" not in e for e in w0["per_rule"])
+    finally:
+        drv.stop()
+        finish(th, out)
+
+
+# ---------------------------------------------------------------------------
+# Units: guards + serialization.
+# ---------------------------------------------------------------------------
+
+
+def test_analyze_rejects_bad_budget(lattice_packed):
+    with pytest.raises(AnalysisError, match="witness budget"):
+        sa_mod.analyze_ruleset(lattice_packed, witness_budget=0)
+
+
+def test_to_obj_round_trips_through_json(lattice_packed, lattice_analysis):
+    obj = lattice_analysis.to_obj(lattice_packed)
+    again = json.loads(json.dumps(obj))
+    assert again == obj
+    rules = [v["rule"] for v in obj["verdicts"]]
+    assert rules == sorted(rules, key=lambda r: int(r.rsplit(" ", 1)[1]))
+
+
+def test_key_meta_action_round_trips_and_defaults(tmp_path, lattice_packed):
+    prefix = str(tmp_path / "p")
+    pack.save_packed(lattice_packed, prefix)
+    loaded = pack.load_packed(prefix)
+    assert [m.action for m in loaded.key_meta] == [
+        m.action for m in lattice_packed.key_meta
+    ]
+    assert loaded.key_meta[0].action == aclparse.PERMIT
+    assert loaded.key_meta[1].action == aclparse.DENY
+    # pre-ISSUE-12 artifact (no action in the json): loads as unknown
+    meta_path = prefix + ".json"
+    meta = json.loads(open(meta_path).read())
+    for m in meta["key_meta"]:
+        m.pop("action")
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+    old_style = pack.load_packed(prefix)
+    assert all(
+        m.action == -1 for m in old_style.key_meta if not m.implicit_deny
+    )
+    # unknown actions degrade covered verdicts to the action-free
+    # "shadowed" (still dead) — never a wrong redundant/conflict claim
+    res = sa_mod.analyze_ruleset(old_style)
+    assert res.verdicts[1].verdict == sa_mod.SHADOWED
+    assert res.verdicts[2].verdict == sa_mod.SHADOWED
