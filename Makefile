@@ -1,0 +1,23 @@
+# ruleset-analysis-tpu — developer targets.
+#
+# NOTE (tier-1 calibration, tests/conftest.py): NEVER run these targets
+# concurrently with the tier-1 gate run on the 1-core container — a
+# parallel python process starves the distributed rendezvous tests and
+# fabricates failures.  Run `make lint`, THEN the gate.
+
+.PHONY: lint lint-fast test
+
+# Static program-invariant lint (DESIGN §18): abstract-eval traces of
+# the full shipping step grid + the repo registry audit.  No device, no
+# XLA compile — finishes in well under 60 s on one CPU core.
+lint:
+	JAX_PLATFORMS=cpu python tools/ralint.py
+
+# The tier-1 representative subset (what tests/test_ralint.py runs).
+lint-fast:
+	JAX_PLATFORMS=cpu python tools/ralint.py --fast
+
+# The tier-1 suite (see ROADMAP.md for the exact gate invocation).
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider

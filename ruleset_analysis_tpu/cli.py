@@ -980,6 +980,38 @@ def _cmd_analyze(args: argparse.Namespace) -> int:
     return 0
 
 
+def _cmd_lint(args: argparse.Namespace) -> int:
+    """ralint: static program-invariant verification (DESIGN §18).
+
+    Traces every shipping step program to a closed jaxpr by abstract
+    eval (no device data, no XLA compile) and verifies weight-linearity,
+    scatter safety, ra.* scope coverage, and merge-law conformance;
+    cross-checks the derived weighted-refusal set against the ONE
+    declarative table in config.py; audits the repo registries (fault
+    sites / CLI flags vs docs / volatile totals keys).  Exit 0 = every
+    invariant proven (or typed-refused), 1 = findings.
+    """
+    import json as json_mod
+
+    from .verify import render_text, run_lint
+
+    rep = run_lint(
+        full=not args.fast,
+        registry=not args.skip_registry,
+        repo_root=args.repo_root,
+    )
+    if args.json:
+        payload = json_mod.dumps(rep.to_dict(), indent=2)
+    else:
+        payload = render_text(rep)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload + "\n")
+    else:
+        print(payload)
+    return 0 if rep.ok else 1
+
+
 def _cmd_diff_reports(args: argparse.Namespace) -> int:
     """Compare two JSON run reports: the operator's delete-decision view.
 
@@ -1313,6 +1345,27 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", action="store_true")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_analyze)
+
+    p = sub.add_parser(
+        "lint",
+        help="ralint: static program-invariant verification — traces "
+             "every shipping step program to a closed jaxpr (abstract "
+             "eval; no device, no compile) and proves weight-linearity, "
+             "scatter safety, ra.* scope coverage, and merge-law "
+             "conformance; audits repo registries (fault sites, CLI "
+             "flags vs docs, volatile totals keys)",
+    )
+    p.add_argument("--fast", action="store_true",
+                   help="lint the representative program subset instead "
+                        "of the full impl grid (the tier-1 test budget)")
+    p.add_argument("--skip-registry", action="store_true",
+                   help="skip the repo registry auditor (jaxpr checks only)")
+    p.add_argument("--repo-root", default=None, metavar="DIR",
+                   help="repo root for the registry auditor (default: "
+                        "the installed package's parent)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--out", default=None)
+    p.set_defaults(fn=_cmd_lint)
 
     p = sub.add_parser(
         "serve",

@@ -41,6 +41,85 @@ OUTPUT_DIR = os.environ.get("RA_OUTPUT_DIR", "out")
 MAX_CMS_DEPTH = 8
 
 
+# ---------------------------------------------------------------------------
+# Weighted-input compatibility — ONE declarative table (DESIGN §11/§18).
+#
+# A weighted batch (coalesced on the fly, or a RAWIREv3 wire file whose
+# rows carry original-line weights) is only correct through device
+# formulations that are weight-linear (adds scale with the weight plane)
+# or idempotent (max gates on weight>0).  Three consumers read this
+# table so the refusal set can never drift between them:
+#
+# - AnalysisConfig.__post_init__ — config-time refusal of `coalesce`
+#   with an incompatible impl choice;
+# - runtime/stream.py::_check_weighted_input_config — run-time refusal
+#   when a weighted WIRE input reaches a driver whose config the
+#   validator accepted (it never saw the input's weights);
+# - ruleset_analysis_tpu/verify — the static linter DERIVES each impl
+#   combination's weight-linearity verdict from its traced jaxpr and
+#   cross-checks the derived refusal set against exactly this table
+#   (tests/test_ralint.py pins the equality).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class WeightedRefusal:
+    """One impl choice that cannot accept weighted (coalesced) inputs."""
+
+    #: AnalysisConfig field and value naming the incompatible choice.
+    field: str
+    value: str
+    #: Human reason, embedded in both refusal messages.
+    reason: str
+    #: The linearity verdict the static linter must derive for programs
+    #: built with this choice ("unprovable" = opaque kernel the taint
+    #: walk cannot enter; "float-bounded" = linear but through an f32
+    #: formulation whose exactness is range-bounded).
+    lint_verdict: str
+    #: Config-time `coalesce` refusal bound: None = refuse always;
+    #: an int N = refuse only when batch_size >= N (below it the
+    #: formulation's own guards keep the combination exact).
+    coalesce_min_batch: int | None = None
+
+
+WEIGHTED_INPUT_REFUSALS: tuple[WeightedRefusal, ...] = (
+    WeightedRefusal(
+        field="match_impl",
+        value="pallas_fused",
+        reason=(
+            "the experimental pallas_fused kernel's in-VMEM count "
+            "histogram is not weight-linear (it adds ONE per valid "
+            "line, so a weight-w row would silently count as one "
+            "line); use the default match_impl"
+        ),
+        lint_verdict="unprovable",
+    ),
+    WeightedRefusal(
+        field="counts_impl",
+        value="matmul",
+        reason=(
+            "the matmul counts formulation is exact only while per-key "
+            "per-chunk sums stay < 2^24 (f32 integer range), and a "
+            "weighted chunk's summed weights are bounded by the "
+            "ORIGINAL corpus lines behind it, not the stored batch "
+            "size its shape guard sees; use 'scatter' or 'reduce'"
+        ),
+        lint_verdict="float-bounded",
+        coalesce_min_batch=1 << 24,
+    ),
+)
+
+#: Per-chunk summed-weight ceiling for weighted wire inputs: the exact-
+#: counts accumulator's carry detection (ops/counts.py add64) assumes
+#: per-chunk deltas < 2^32.  A plain chunk satisfies it by shape; a
+#: weighted chunk's delta is the original line count behind its rows, so
+#: the stream drivers refuse chunks at or past this bound
+#: (runtime/stream.py::_WireFileSource._check_chunk_weight) — the
+#: run-time member of the weighted-input refusal set, which no static
+#: check can prove away (it depends on the data, not the program).
+WEIGHTED_CHUNK_WEIGHT_LIMIT = 1 << 32
+
+
 @dataclasses.dataclass(frozen=True)
 class SketchConfig:
     """Geometry of the mergeable sketches kept on device.
@@ -488,31 +567,24 @@ class AnalysisConfig:
             raise ValueError(
                 f"coalesce must be 'off', 'on', or 'auto', got {self.coalesce!r}"
             )
-        if self.coalesce != "off" and self.match_impl == "pallas_fused":
-            # the fused kernel's in-VMEM histogram counts each valid line
-            # as ONE — it is not weight-linear, so a coalesced batch would
-            # silently undercount by the compaction ratio.  (The stream
-            # drivers enforce the same refusal for weighted .rawire
-            # inputs, which this config-time check cannot see.)
-            raise ValueError(
-                "coalesce is incompatible with the experimental "
-                "pallas_fused kernel (its in-kernel count histogram is "
-                "not weight-linear); use the default match_impl"
-            )
-        if (
-            self.coalesce != "off"
-            and self.counts_impl == "matmul"
-            and self.batch_size >= 1 << 24
-        ):
-            # the matmul counts formulation is exact while per-key
-            # per-chunk sums stay < 2^24 (f32 integer range); a coalesced
-            # chunk's summed weights are bounded by the RAW batch size,
-            # which this geometry lets exceed that — its shape guard only
-            # sees the (smaller) unique-row count, so refuse up front
-            raise ValueError(
-                "coalesce with counts_impl='matmul' needs batch_size < "
-                f"2^24 to keep the f32 formulation exact; got {self.batch_size}"
-            )
+        if self.coalesce != "off":
+            # the ONE weighted-input compatibility table (module top):
+            # coalesced batches reach the step weighted, so every
+            # registered incompatibility refuses here at config time.
+            # (The stream drivers apply the same table to weighted
+            # .rawire inputs, which this config-time check cannot see.)
+            for r in WEIGHTED_INPUT_REFUSALS:
+                if getattr(self, r.field) != r.value:
+                    continue
+                if (
+                    r.coalesce_min_batch is not None
+                    and self.batch_size < r.coalesce_min_batch
+                ):
+                    continue
+                raise ValueError(
+                    f"coalesce is incompatible with "
+                    f"{r.field}={r.value!r}: {r.reason}"
+                )
 
     def replace(self, **kw) -> "AnalysisConfig":
         return dataclasses.replace(self, **kw)

@@ -205,14 +205,19 @@ def counts_from_hists(
     counts land on each ACL's deny key.  Padding rows never match (their
     hist entries are 0), so their R_KEY=0 contributions add zero.
     """
-    r = rules.shape[0]
-    delta = jnp.zeros(n_keys, dtype=_U32)
-    delta = delta.at[rules[:, R_KEY].astype(_U32)].add(
-        hist_rows[:r], mode="drop"
-    )
-    a = deny_key.shape[0]
-    delta = delta.at[deny_key.astype(_U32)].add(hist_deny[:a], mode="drop")
-    return delta
+    # ra.counts: these two row-sized scatters ARE the fused path's
+    # counts stage — scoped so devprof attribution (DESIGN §14) and the
+    # static scope-coverage lint (DESIGN §18) see them like every other
+    # counts formulation.
+    with jax.named_scope("ra.counts"):
+        r = rules.shape[0]
+        delta = jnp.zeros(n_keys, dtype=_U32)
+        delta = delta.at[rules[:, R_KEY].astype(_U32)].add(
+            hist_rows[:r], mode="drop"
+        )
+        a = deny_key.shape[0]
+        delta = delta.at[deny_key.astype(_U32)].add(hist_deny[:a], mode="drop")
+        return delta
 
 
 def match_keys_and_counts_pallas(
@@ -233,6 +238,9 @@ def match_keys_and_counts_pallas(
     row, hist_rows, hist_deny = match_rows_and_hists_pallas(
         cols, valid, rules_fm, deny_key.shape[0], block_lines, interpret
     )
-    keys = rows_to_keys(row, rules, deny_key, cols["acl"])
+    # ra.match: the shared row->key epilogue (xla's match_keys wraps the
+    # same call in the same scope)
+    with jax.named_scope("ra.match"):
+        keys = rows_to_keys(row, rules, deny_key, cols["acl"])
     delta = counts_from_hists(hist_rows, hist_deny, rules, deny_key, n_keys)
     return keys, delta

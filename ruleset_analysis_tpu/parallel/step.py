@@ -200,10 +200,11 @@ def _merge_tail(
     )
 
 
-def _local_shard_step(
+def _core_flat(
     state: AnalysisState,
     ruleset: DeviceRuleset,
-    batch: jax.Array,  # [TUPLE_COLS or WIRE_COLS, B/n] local shard
+    cols: dict,  # unpacked field columns (batch_cols)
+    valid: jax.Array,  # [b] u32 weight plane
     salt: jax.Array,  # u32 scalar (chunk counter), replicated
     *,
     axis: str,
@@ -217,7 +218,12 @@ def _local_shard_step(
     update_impl: str = "scatter",
     topk_every: int = 1,
 ) -> tuple[AnalysisState, ChunkOut]:
-    cols, valid = batch_cols(batch)
+    # The post-unpack body of the flat shard step.  Split from the
+    # batch unpack so the static lint plane (verify/, DESIGN §18) can
+    # trace the SHIPPING program with the weight plane as an explicit
+    # jaxpr input — the taint source of the weight-linearity proof —
+    # instead of a slice of the packed batch.  One definition: the real
+    # step and the linter trace this exact function.
     counts_delta = None
     if match_impl == "pallas_fused" and ruleset.rules_fm is not None:
         from ..ops import pallas_fused
@@ -243,10 +249,22 @@ def _local_shard_step(
     )
 
 
-def _local_shard_step_stacked(
+def _local_shard_step(
+    state: AnalysisState,
+    ruleset: DeviceRuleset,
+    batch: jax.Array,  # [TUPLE_COLS or WIRE_COLS, B/n] local shard
+    salt: jax.Array,  # u32 scalar (chunk counter), replicated
+    **kw,
+) -> tuple[AnalysisState, ChunkOut]:
+    cols, valid = batch_cols(batch)
+    return _core_flat(state, ruleset, cols, valid, salt, **kw)
+
+
+def _core_stacked(
     state: AnalysisState,
     ruleset: DeviceRulesetStacked,
-    batch: jax.Array,  # [G, TUPLE_COLS or WIRE_COLS, lane/n] local shard
+    cols: dict,  # grouped field columns [G, lane/n]
+    valid: jax.Array,  # [G, lane/n] u32 weight plane
     salt: jax.Array,
     *,
     axis: str,
@@ -259,10 +277,9 @@ def _local_shard_step_stacked(
     update_impl: str = "scatter",
     topk_every: int = 1,
 ) -> tuple[AnalysisState, ChunkOut]:
-    # Grouped twin of _local_shard_step: each line scans only its own
-    # ACL's slab (vmapped match over the group axis); the mergeable
-    # register tail — and therefore the final report — is identical.
-    cols, valid = batch_cols(batch)
+    # Grouped twin of _core_flat: each line scans only its own ACL's
+    # slab (vmapped match over the group axis); the mergeable register
+    # tail — and therefore the final report — is identical.
     keys = match_keys_stacked(cols, ruleset.rules3d, ruleset.deny_key, rule_block).reshape(-1)
     return _merge_tail(
         state,
@@ -282,10 +299,22 @@ def _local_shard_step_stacked(
     )
 
 
-def _local_shard_step6(
+def _local_shard_step_stacked(
+    state: AnalysisState,
+    ruleset: DeviceRulesetStacked,
+    batch: jax.Array,  # [G, TUPLE_COLS or WIRE_COLS, lane/n] local shard
+    salt: jax.Array,
+    **kw,
+) -> tuple[AnalysisState, ChunkOut]:
+    cols, valid = batch_cols(batch)
+    return _core_stacked(state, ruleset, cols, valid, salt, **kw)
+
+
+def _core6(
     state: AnalysisState,
     ruleset6: DeviceRuleset6,
-    batch: jax.Array,  # [TUPLE6_COLS, B6/n] local shard
+    cols: dict,  # unpacked v6 field columns (batch_cols6)
+    valid: jax.Array,  # [b] u32 weight plane
     salt: jax.Array,
     *,
     axis: str,
@@ -298,13 +327,12 @@ def _local_shard_step6(
     update_impl: str = "scatter",
     topk_every: int = 1,
 ) -> tuple[AnalysisState, ChunkOut]:
-    # IPv6 twin of _local_shard_step: lexicographic limb match, then the
-    # SAME mergeable register tail into the shared key universe.  Source
+    # IPv6 twin of _core_flat: lexicographic limb match, then the SAME
+    # mergeable register tail into the shared key universe.  Source
     # identity for HLL/talkers is the 32-bit limb digest; the talker ACL
     # gid carries V6_ACL_TAG so digests never merge with v4 addresses.
     from ..ops.match6 import fold_src32, match_keys6
 
-    cols, valid = batch_cols6(batch)
     keys = match_keys6(cols, ruleset6.rules6, ruleset6.deny_key, rule_block)
     return _merge_tail(
         state, keys, valid, fold_src32(cols),
@@ -313,6 +341,28 @@ def _local_shard_step6(
         topk_sample_shift=topk_sample_shift, counts_impl=counts_impl,
         update_impl=update_impl, topk_every=topk_every,
     )
+
+
+def _local_shard_step6(
+    state: AnalysisState,
+    ruleset6: DeviceRuleset6,
+    batch: jax.Array,  # [TUPLE6_COLS, B6/n] local shard
+    salt: jax.Array,
+    **kw,
+) -> tuple[AnalysisState, ChunkOut]:
+    cols, valid = batch_cols6(batch)
+    return _core6(state, ruleset6, cols, valid, salt, **kw)
+
+
+#: Post-unpack shard-step bodies by program kind — what the static lint
+#: plane traces (verify/grid.py).  The shipping steps above are thin
+#: unpack wrappers around exactly these functions, so a lint verdict on
+#: a core IS a verdict on the shipping program.
+CORES = {
+    "flat": _core_flat,
+    "stacked": _core_stacked,
+    "v6": _core6,
+}
 
 
 #: Bake the rule tensor into the compiled step as an XLA constant when it

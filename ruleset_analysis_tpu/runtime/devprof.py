@@ -58,37 +58,13 @@ import time
 
 from . import faults, obs
 
-#: The stage taxonomy (DESIGN §14).  Classification accepts any
-#: ``ra.<word>`` token — new stages need no registry edit — but these
-#: are the stages the step programs emit today:
-#:
-#:   ra.unpack  wire bit-unpack + the coalesce weight plane (batch_cols)
-#:   ra.match   v4 first-match kernel (flat + stacked)
-#:   ra.match6  v6 lexicographic limb match + source fold
-#:   ra.counts  exact per-key counts (scatter/matmul/reduce impls + add64)
-#:   ra.cms     per-rule count-min scatter
-#:   ra.hll     per-key HLL scatter-max
-#:   ra.talk    talker (acl, src) sketch update
-#:   ra.topk    chunk-local candidate table + top_k selection
-#:   ra.sort    register-key sorts feeding the segment-reduce updates
-#:              (update_impl=sorted, ops/sorted_update.py — DESIGN §15)
-#:   ra.overlap static-analysis pairwise rule-relation tiles (ISSUE 12)
-#:   ra.merge   cross-device psum/pmax/all_gather merges
-STAGES = (
-    "ra.unpack",
-    "ra.match",
-    "ra.match6",
-    "ra.counts",
-    "ra.cms",
-    "ra.hll",
-    "ra.talk",
-    "ra.topk",
-    "ra.sort",
-    "ra.merge",
-    "ra.overlap",
-)
-
-_SCOPE_RE = re.compile(r"ra\.[a-z0-9_]+")
+# The stage taxonomy (DESIGN §14) is single-sourced in
+# ruleset_analysis_tpu/stages.py — this module, tools/trace_attrib.py,
+# and the static lint plane (verify/) all import the SAME tuple, so the
+# three consumers can never drift.  Re-exported here because this module
+# historically owned it and callers import devprof.STAGES.
+from ..stages import SCOPE_RE as _SCOPE_RE  # noqa: F401
+from ..stages import STAGES, scope_of  # noqa: F401
 
 #: HLO dtype -> bytes per element (static footprint accounting).
 _DTYPE_BYTES = {
@@ -97,16 +73,6 @@ _DTYPE_BYTES = {
 }
 
 _SHAPE_RE = re.compile(r"^([a-z]\w*)\[([0-9,]*)\]")
-
-def scope_of(op_name: str | None) -> str | None:
-    """Outermost ``ra.*`` scope token of an HLO ``op_name`` path.
-
-    Outermost wins so a wrapping stage owns its helpers: the talker
-    plane's ``ra.talk/ra.cms/...`` classifies as ``ra.talk`` even though
-    the inner scatter is the shared CMS kernel.
-    """
-    m = _SCOPE_RE.search(op_name or "")
-    return m.group(0) if m else None
 
 
 def classify_event_name(name: str, args: dict | None = None) -> str | None:

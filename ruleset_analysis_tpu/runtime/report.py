@@ -19,6 +19,34 @@ from ..errors import AnalysisError
 from ..hostside.oracle import RuleKey
 from ..hostside.pack import PackedRuleset
 
+#: ``totals`` keys that are wall-clock/process observations rather than
+#: answers — the keys every report-identity test strips before comparing
+#: runs bit-for-bit.  ONE list (tests import it; keeping a private copy
+#: in a test module is a registry-auditor finding, verify/registry.py)
+#: so a new volatile block added to the runtime cannot silently break
+#: only SOME identity suites:
+#:
+#:   elapsed_sec/lines_per_sec/compile_sec/sustained_lines_per_sec —
+#:       timings of this particular run
+#:   ingest      pipeline overlap accounting (queue depths, waits)
+#:   throughput  the meter's cumulative split timings
+#:   coalesce    raw/unique compaction accounting (traffic-order shaped)
+#:   autoscale   scale decisions/timings (wall-clock, not answers)
+#:   recovery    elastic re-formation accounting
+#:   devprof     capture-window timings, not answers
+VOLATILE_TOTALS = (
+    "elapsed_sec",
+    "lines_per_sec",
+    "compile_sec",
+    "sustained_lines_per_sec",
+    "ingest",
+    "throughput",
+    "coalesce",
+    "autoscale",
+    "recovery",
+    "devprof",
+)
+
 
 @dataclasses.dataclass
 class Report:

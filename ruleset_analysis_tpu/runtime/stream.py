@@ -339,7 +339,9 @@ class _WireFileSource:
         overflow the uint32 scatter undetected.  Loud refusal with a
         concrete fix beats a silently wrapped register.
         """
-        if ws >= 1 << 32:
+        from ..config import WEIGHTED_CHUNK_WEIGHT_LIMIT
+
+        if ws >= WEIGHTED_CHUNK_WEIGHT_LIMIT:
             from ..errors import AnalysisError
 
             raise AnalysisError(
@@ -1627,37 +1629,30 @@ def _check_weighted_input_config(cfg: AnalysisConfig) -> None:
     """Refuse device formulations that are not weight-linear/exact.
 
     A weighted (RAWIREv3) input reaches the step with weights the config
-    validator never saw, so the two combinations the on-the-fly
-    coalescer refuses at config time must also be refused here:
+    validator never saw, so every entry of the ONE declarative
+    compatibility table (``config.WEIGHTED_INPUT_REFUSALS`` — shared
+    with the config-time ``coalesce`` checks and the static linter,
+    which *derives* the same set from the traced jaxprs) is also
+    refused here, unconditionally: wire weights are unbounded by the
+    stored batch size, so the table's config-time batch bounds do not
+    apply.
 
-    - ``pallas_fused``: its in-VMEM count histogram adds ONE per valid
-      line — a weight-w row would silently count as one line.
-    - ``matmul`` counts: exact only while per-key per-chunk sums stay
-      < 2^24 (f32 integer range); a weighted chunk's summed weights are
-      bounded by the ORIGINAL corpus's lines behind it, not by the
-      stored batch size the formulation's shape guard sees.
-
-    ``update_impl='sorted'`` needs NO entry here: every sorted segment
+    ``update_impl='sorted'`` needs NO entry there: every sorted segment
     reduce is weight-linear (sums of the uint32 weight plane) or
     idempotent by construction (DESIGN §15), so weighted inputs are
     accepted everywhere the default scatter path accepts them —
-    tests/test_sorted_update.py pins the combination.
+    tests/test_sorted_update.py pins the combination, and the linter
+    proves it (tests/test_ralint.py).
     """
+    from ..config import WEIGHTED_INPUT_REFUSALS
     from ..errors import AnalysisError
 
-    if cfg.match_impl == "pallas_fused":
-        raise AnalysisError(
-            "weighted (coalesced) wire inputs are incompatible with the "
-            "experimental pallas_fused kernel (its in-kernel count "
-            "histogram is not weight-linear); use the default match_impl"
-        )
-    if cfg.counts_impl == "matmul":
-        raise AnalysisError(
-            "weighted (coalesced) wire inputs are incompatible with "
-            "counts_impl='matmul' (per-key per-chunk sums can exceed the "
-            "f32-exact range the formulation's shape guard assumes); use "
-            "'scatter' or 'reduce'"
-        )
+    for r in WEIGHTED_INPUT_REFUSALS:
+        if getattr(cfg, r.field) == r.value:
+            raise AnalysisError(
+                "weighted (coalesced) wire inputs are incompatible with "
+                f"{r.field}={r.value!r}: {r.reason}"
+            )
 
 
 def _iter_files(paths: list[str]):

@@ -1,0 +1,41 @@
+"""ralint — the static program-invariant lint plane (DESIGN §18).
+
+The repo's load-bearing register invariants — weight-linearity under
+coalescing (DESIGN §11), scatter OOB/sorted contracts (§15), ``ra.*``
+attribution completeness (§14), and the ``_merge_tail`` merge laws —
+were defended by runtime bit-identity tests and hand-maintained refusal
+lists.  Every new impl axis (``counts_impl x match_impl x update_impl x
+coalesce x topk_every``) multiplies the combinations those hand lists
+must cover.  This package derives the invariants FROM THE TRACED
+PROGRAMS instead, once, statically:
+
+- :mod:`.grid` traces every shipping step program (the full impl grid,
+  v4+v6, flat+stacked) to a closed jaxpr by abstract eval — no device
+  data, no XLA compile;
+- :mod:`.jaxpr_lint` walks each jaxpr and verifies weight-linearity
+  (taint walk from the weight plane to every register sink), scatter
+  safety (``mode=drop``; ``indices_are_sorted`` only downstream of a
+  sort), scope coverage (every register-update primitive attributes to
+  exactly one registered ``ra.*`` stage), and merge-law conformance
+  (every register output reaches the host through its law's collective);
+- :mod:`.registry` audits the repo-level registries that the jaxprs
+  cannot see: fault sites <-> armed call sites <-> test coverage, CLI
+  flags <-> README <-> PARITY, and the VOLATILE totals keys <-> actual
+  report totals producers;
+- :mod:`.report` assembles everything into one report (text or JSON)
+  for the ``lint`` CLI subcommand and ``tools/ralint.py``.
+
+An invariant the walker cannot prove is an ``unprovable`` verdict — a
+typed refusal with today's exact behavior, never a silent pass.
+"""
+
+from .grid import (  # noqa: F401
+    LINT_GEOMETRY,
+    ProgramSpec,
+    fast_grid,
+    shipping_grid,
+    trace_program,
+)
+from .jaxpr_lint import Finding, ProgramLint, lint_program  # noqa: F401
+from .registry import audit_registry  # noqa: F401
+from .report import LintReport, render_text, run_lint  # noqa: F401

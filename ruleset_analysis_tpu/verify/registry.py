@@ -1,0 +1,176 @@
+"""Repo-level registry auditor — the drift the jaxprs cannot see.
+
+Three registries pair a declaration site with scattered consumption
+sites, and nothing structural kept them in sync until now:
+
+- **fault sites** (``runtime/faults.py::SITES``) <-> armed ``fire()``
+  call sites in the package <-> test coverage (a registered site no
+  test ever fires is an untested failure mode; a ``fire()`` naming an
+  unregistered site can never fire at all);
+- **CLI flags** (``cli.make_parser()``) <-> README documentation <->
+  PARITY subcommand rows (an undocumented flag is invisible to
+  operators; README mentions of flags that no longer exist mislead);
+- **VOLATILE totals keys** (``runtime/report.py::VOLATILE_TOTALS`` —
+  the keys report-identity tests strip) <-> the runtime code that
+  actually produces those totals (a volatile key nothing produces is
+  dead weight; a test module keeping its own private list can drift).
+
+Pure stdlib + argparse introspection: no device, no jax import beyond
+what ``cli`` itself pulls in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+
+
+@dataclasses.dataclass(frozen=True)
+class AuditFinding:
+    registry: str  # {"faults", "cli", "volatile"}
+    kind: str
+    subject: str
+    detail: str = ""
+
+
+def _repo_root(explicit: str | None = None) -> str:
+    if explicit:
+        return os.path.abspath(explicit)
+    # ruleset_analysis_tpu/verify/registry.py -> repo root
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _read(path: str) -> str:
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def _py_files(root: str, subdir: str) -> list[str]:
+    out = []
+    base = os.path.join(root, subdir)
+    for dirpath, _dirs, files in os.walk(base):
+        for f in files:
+            if f.endswith(".py"):
+                out.append(os.path.join(dirpath, f))
+    return sorted(out)
+
+
+_FIRE_RE = re.compile(r"""fire\(\s*\n?\s*["']([a-z0-9_.]+)["']""")
+
+
+def audit_faults(root: str | None = None) -> list[AuditFinding]:
+    """SITES <-> armed fire() call sites <-> test coverage."""
+    from ..runtime.faults import SITES
+
+    root = _repo_root(root)
+    findings: list[AuditFinding] = []
+    fired: set[str] = set()
+    for path in _py_files(root, "ruleset_analysis_tpu"):
+        if path.endswith(os.path.join("runtime", "faults.py")):
+            continue
+        for m in _FIRE_RE.finditer(_read(path)):
+            fired.add(m.group(1))
+    tests_text = "".join(_read(p) for p in _py_files(root, "tests"))
+    for site in sorted(SITES):
+        if site not in fired:
+            findings.append(AuditFinding(
+                "faults", "registered-never-armed", site,
+                "no faults.fire() call site names this registered site",
+            ))
+        if site not in tests_text:
+            findings.append(AuditFinding(
+                "faults", "registered-never-tested", site,
+                "no test schedules or references this fault site",
+            ))
+    for site in sorted(fired - set(SITES)):
+        findings.append(AuditFinding(
+            "faults", "armed-unregistered", site,
+            "fire() names a site missing from SITES — it can never fire",
+        ))
+    return findings
+
+
+def _cli_flags():
+    """(subcommand, long-flag) pairs + subcommand list from the parser."""
+    import argparse
+
+    from ..cli import make_parser
+
+    ap = make_parser()
+    subs = next(
+        a for a in ap._actions if isinstance(a, argparse._SubParsersAction)
+    )
+    flags = set()
+    for name, sp in subs.choices.items():
+        for act in sp._actions:
+            for o in act.option_strings:
+                if o.startswith("--") and o != "--help":
+                    flags.add((name, o))
+    return sorted(subs.choices), sorted(flags)
+
+
+def audit_cli(root: str | None = None) -> list[AuditFinding]:
+    """CLI flags <-> README; subcommands <-> README + PARITY."""
+    root = _repo_root(root)
+    findings: list[AuditFinding] = []
+    readme = _read(os.path.join(root, "README.md"))
+    parity = _read(os.path.join(root, "PARITY.md"))
+    subcommands, flags = _cli_flags()
+    for name, flag in flags:
+        if flag not in readme:
+            findings.append(AuditFinding(
+                "cli", "flag-undocumented", f"{name} {flag}",
+                "flag absent from README.md",
+            ))
+    for name in subcommands:
+        if name not in readme:
+            findings.append(AuditFinding(
+                "cli", "subcommand-undocumented", name,
+                "subcommand absent from README.md",
+            ))
+        if name not in parity:
+            findings.append(AuditFinding(
+                "cli", "subcommand-no-parity-row", name,
+                "subcommand absent from PARITY.md",
+            ))
+    return findings
+
+
+_LOCAL_VOLATILE_RE = re.compile(r"^VOLATILE\s*=\s*\(", re.M)
+
+
+def audit_volatile(root: str | None = None) -> list[AuditFinding]:
+    """VOLATILE_TOTALS <-> totals producers <-> per-test-module drift."""
+    from ..runtime.report import VOLATILE_TOTALS
+
+    root = _repo_root(root)
+    findings: list[AuditFinding] = []
+    runtime_text = "".join(
+        _read(p) for p in _py_files(root, "ruleset_analysis_tpu")
+    )
+    for key in VOLATILE_TOTALS:
+        # a volatile key must correspond to a real totals producer
+        # somewhere in the runtime (dict literal key or totals[...] set)
+        if f'"{key}"' not in runtime_text and f"'{key}'" not in runtime_text:
+            findings.append(AuditFinding(
+                "volatile", "volatile-key-never-produced", key,
+                "VOLATILE_TOTALS names a totals key no runtime code "
+                "produces",
+            ))
+    for path in _py_files(root, "tests"):
+        if _LOCAL_VOLATILE_RE.search(_read(path)):
+            findings.append(AuditFinding(
+                "volatile", "local-volatile-list", os.path.basename(path),
+                "test module defines its own VOLATILE tuple instead of "
+                "importing runtime.report.VOLATILE_TOTALS — lists drift",
+            ))
+    return findings
+
+
+def audit_registry(root: str | None = None) -> list[AuditFinding]:
+    """All three audits, in declaration order."""
+    return audit_faults(root) + audit_cli(root) + audit_volatile(root)

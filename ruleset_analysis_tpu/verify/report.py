@@ -1,0 +1,178 @@
+"""Assemble + render the lint report (CLI ``lint`` / tools/ralint.py).
+
+One entry point, :func:`run_lint`, runs the program grid through the
+jaxpr linter, cross-checks the derived weighted-refusal set against the
+declarative table in ``config.py`` (the no-drift guarantee), runs the
+repo registry auditor, and folds everything into a :class:`LintReport`
+that renders as text or JSON.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .grid import ProgramSpec, fast_grid, shipping_grid, trace_program
+from .jaxpr_lint import Finding, ProgramLint, lint_program
+from .registry import AuditFinding, audit_registry
+
+
+def expected_weighted_refusal(spec: ProgramSpec) -> str | None:
+    """The table's verdict for this spec, or None (= weighted accepted).
+
+    The SAME declarative table the runtime refusal path reads
+    (``config.WEIGHTED_INPUT_REFUSALS``), keyed by the spec's effective
+    AnalysisConfig field values.
+    """
+    from ..config import WEIGHTED_INPUT_REFUSALS
+
+    kw = spec.config_kwargs()
+    for r in WEIGHTED_INPUT_REFUSALS:
+        if kw.get(r.field) == r.value:
+            return r.lint_verdict
+    return None
+
+
+#: derived-verdict -> table-verdict vocabulary: the walker says
+#: "unprovable"/"float-bounded"/"gated"/"nonlinear", the table registers
+#: the refusal class it expects the walker to derive.
+_DERIVED_TO_TABLE = {
+    "unprovable": "unprovable",
+    "float-bounded": "float-bounded",
+    "gated": "gated",
+    "nonlinear": "nonlinear",
+}
+
+
+@dataclasses.dataclass
+class LintReport:
+    programs: list  # [ProgramLint]
+    table_drift: list  # [Finding] derived-vs-table mismatches
+    registry: list  # [AuditFinding]
+    grid: str  # "full" | "fast"
+
+    @property
+    def ok(self) -> bool:
+        return (
+            all(p.ok for p in self.programs)
+            and not self.table_drift
+            and not self.registry
+        )
+
+    @property
+    def violations(self) -> int:
+        return sum(
+            1
+            for p in self.programs
+            for f in p.findings
+            if f.severity == "violation"
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "ok": self.ok,
+            "grid": self.grid,
+            "programs": [p.to_dict() for p in self.programs],
+            "table_drift": [dataclasses.asdict(f) for f in self.table_drift],
+            "registry": [dataclasses.asdict(f) for f in self.registry],
+        }
+
+
+def check_table_drift(lints: list) -> list:
+    """Derived weighted-refusal set == the declarative table, exactly.
+
+    Any mismatch — a program the walker cannot prove that the table
+    accepts, or a table refusal the walker proves linear — is drift:
+    either the table is stale or an impl silently changed its math.
+    """
+    drift: list[Finding] = []
+    for pl in lints:
+        derived = pl.weight_verdict
+        expected = expected_weighted_refusal(pl.spec)
+        if expected is None:
+            if derived != "linear":
+                drift.append(Finding(
+                    check="table", kind="underrefusal",
+                    prim=pl.spec.name, stage=None, severity="violation",
+                    detail=(
+                        f"linter derives {derived!r} but "
+                        "config.WEIGHTED_INPUT_REFUSALS accepts this "
+                        "combination for weighted inputs"
+                    ),
+                ))
+        elif _DERIVED_TO_TABLE.get(derived) != expected:
+            drift.append(Finding(
+                check="table", kind="overrefusal" if derived == "linear"
+                else "verdict-mismatch",
+                prim=pl.spec.name, stage=None, severity="violation",
+                detail=(
+                    f"table expects {expected!r} for this combination, "
+                    f"linter derives {derived!r}"
+                ),
+            ))
+    return drift
+
+
+def run_lint(
+    *,
+    full: bool = True,
+    registry: bool = True,
+    repo_root: str | None = None,
+    specs: list | None = None,
+) -> LintReport:
+    """Trace + lint the grid, cross-check the table, audit registries."""
+    if specs is None:
+        specs = shipping_grid() if full else fast_grid()
+    lints = [lint_program(trace_program(s)) for s in specs]
+    drift = check_table_drift(lints)
+    audits = audit_registry(repo_root) if registry else []
+    return LintReport(
+        programs=lints,
+        table_drift=drift,
+        registry=audits,
+        grid="full" if full else "fast",
+    )
+
+
+def render_text(report: LintReport) -> str:
+    out = []
+    n_lin = sum(1 for p in report.programs if p.weight_verdict == "linear")
+    out.append(
+        f"ralint: {len(report.programs)} step programs traced "
+        f"({report.grid} grid, abstract eval only)"
+    )
+    for p in report.programs:
+        viols = [f for f in p.findings if f.severity == "violation"]
+        weighted = [f for f in p.findings if f.severity == "weighted"]
+        mark = "ok " if p.ok else "FAIL"
+        out.append(
+            f"  [{mark}] {p.spec.name:55s} weight={p.weight_verdict:13s} "
+            f"sinks={p.sinks_checked:3d} eqns={p.eqns_walked}"
+        )
+        for f in viols:
+            out.append(
+                f"         VIOLATION {f.check}/{f.kind} at {f.prim}"
+                f"{' [' + f.stage + ']' if f.stage else ''}: {f.detail}"
+            )
+        for f in weighted:
+            out.append(f"         weighted-refusal {f.kind} at {f.prim}")
+    out.append(
+        f"weight-linearity: {n_lin}/{len(report.programs)} programs proven "
+        "linear; the rest are typed weighted-input refusals"
+    )
+    if report.table_drift:
+        out.append("TABLE DRIFT (derived verdicts vs config.WEIGHTED_INPUT_REFUSALS):")
+        for f in report.table_drift:
+            out.append(f"  {f.kind}: {f.prim}: {f.detail}")
+    else:
+        out.append(
+            "refusal table: derived verdicts match "
+            "config.WEIGHTED_INPUT_REFUSALS exactly"
+        )
+    if report.registry:
+        out.append(f"registry audit: {len(report.registry)} finding(s)")
+        for f in report.registry:
+            out.append(f"  [{f.registry}] {f.kind}: {f.subject} — {f.detail}")
+    else:
+        out.append("registry audit: clean (faults / cli+README+PARITY / volatile)")
+    out.append("RESULT: " + ("PASS" if report.ok else "FAIL"))
+    return "\n".join(out)

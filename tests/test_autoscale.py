@@ -51,18 +51,9 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 #: report-totals keys excluded from bit-identity images (the chaos
 #: harness list + the autoscale/world blocks this PR adds: scale
 #: timings are wall-clock, never part of the answer)
-VOLATILE = (
-    "elapsed_sec",
-    "lines_per_sec",
-    "compile_sec",
-    "sustained_lines_per_sec",
-    "ingest",
-    "throughput",
-    "coalesce",
-    "autoscale",
-    "recovery",
-    "devprof",  # capture-window timings, not answers
-)
+# ONE volatile-keys list (runtime/report.py): the registry auditor
+# (verify/registry.py) flags any module keeping a private copy.
+from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS as VOLATILE
 
 
 def report_image(rep) -> dict:

@@ -48,17 +48,9 @@ from ruleset_analysis_tpu.hostside import wire as wire_mod
 from ruleset_analysis_tpu.runtime import faults
 from ruleset_analysis_tpu.runtime.stream import run_stream_file, run_stream_wire
 
-VOLATILE = (
-    "elapsed_sec",
-    "lines_per_sec",
-    "compile_sec",
-    "sustained_lines_per_sec",
-    "ingest",
-    "throughput",
-    "coalesce",  # raw/unique accounting differs from the off baseline
-    "autoscale",  # scale decisions/timings are wall-clock, not answers
-    "devprof",  # capture-window timings, not answers
-)
+# ONE volatile-keys list (runtime/report.py): the registry auditor
+# (verify/registry.py) flags any module keeping a private copy.
+from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS as VOLATILE
 
 CFG6 = """\
 hostname fw1

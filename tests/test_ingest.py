@@ -32,17 +32,9 @@ from ruleset_analysis_tpu.runtime.stream import (
 
 #: totals keys that legitimately differ run to run (timings); everything
 #: else in the report must match bit for bit
-VOLATILE = (
-    "elapsed_sec",
-    "lines_per_sec",
-    "compile_sec",
-    "sustained_lines_per_sec",
-    "ingest",
-    "throughput",
-    "coalesce",  # raw/unique accounting absent from the off baseline
-    "autoscale",  # scale decisions/timings are wall-clock, not answers
-    "devprof",  # capture-window timings, not answers
-)
+# ONE volatile-keys list (runtime/report.py): the registry auditor
+# (verify/registry.py) flags any module keeping a private copy.
+from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS as VOLATILE
 
 
 def report_image(rep) -> dict:

@@ -43,17 +43,9 @@ from ruleset_analysis_tpu.runtime.stream import run_stream, run_stream_wire
 
 #: volatile totals excluded from bit-identity images (same list as the
 #: chaos harness, plus the serve-only window/hll blocks compared apart)
-VOLATILE = (
-    "elapsed_sec",
-    "lines_per_sec",
-    "compile_sec",
-    "sustained_lines_per_sec",
-    "ingest",
-    "throughput",
-    "coalesce",
-    "autoscale",  # scale decisions/timings are wall-clock, not answers
-    "devprof",  # capture-window timings, not answers
-)
+# ONE volatile-keys list (runtime/report.py): the registry auditor
+# (verify/registry.py) flags any module keeping a private copy.
+from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS as VOLATILE
 
 def image(obj) -> dict:
     if not isinstance(obj, dict):

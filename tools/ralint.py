@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""ralint report mode — the static program-invariant lint, standalone.
+
+Usage:
+    python tools/ralint.py [--fast] [--json] [--skip-registry]
+
+Traces every shipping step program (the full impl grid: counts_impl x
+match_impl x update_impl x topk variants, v4+v6, flat+stacked) to a
+closed jaxpr by abstract eval — no device data, no XLA compile — and
+verifies the four invariant families of DESIGN §18:
+
+  1. weight-linearity   taint walk from the weight plane to every
+                        register sink (DESIGN §11); derived refusals
+                        must equal config.WEIGHTED_INPUT_REFUSALS
+  2. scatter safety     mode=drop everywhere; indices_are_sorted only
+                        downstream of a lax.sort (§15)
+  3. scope coverage     every register-update primitive attributes to
+                        exactly one registered ra.* stage (§14)
+  4. merge laws         every register output crosses its law's
+                        collective (add64/add32 -> psum, max -> pmax,
+                        candidates -> all_gather)
+
+plus the repo registry audit (fault sites <-> call sites <-> tests;
+CLI flags <-> README <-> PARITY; VOLATILE totals keys <-> producers).
+
+Runs on CPU in seconds; exit 0 = every invariant proven (or typed-
+refused), 1 = findings.  `make lint` wraps this.  NOTE (tier-1
+calibration): never run this concurrently with the tier-1 gate on a
+1-core container — a parallel python process starves the distributed
+rendezvous tests and fabricates failures.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fast", action="store_true",
+                    help="representative subset instead of the full grid")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--skip-registry", action="store_true")
+    ap.add_argument("--repo-root", default=None)
+    args = ap.parse_args(argv)
+
+    from ruleset_analysis_tpu.verify import render_text, run_lint
+
+    rep = run_lint(
+        full=not args.fast,
+        registry=not args.skip_registry,
+        repo_root=args.repo_root,
+    )
+    print(json.dumps(rep.to_dict(), indent=2) if args.json else render_text(rep))
+    return 0 if rep.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -34,6 +34,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ruleset_analysis_tpu.runtime.devprof import classify_event_name  # noqa: E402
+from ruleset_analysis_tpu.stages import STAGES  # noqa: E402  (the ONE taxonomy)
 
 
 def load_events(path: str) -> list[dict]:
@@ -59,11 +60,17 @@ def attribute(path: str, top: int = 20) -> dict:
     cnt: collections.Counter = collections.Counter()
     scoped_us = 0.0
     total_us = 0.0
+    unregistered: set = set()
     for e in ev:
         if e.get("ph") != "X" or "dur" not in e:
             continue
         stage = classify_event_name(e.get("name", ""), e.get("args"))
         label = stage if stage is not None else e.get("name", "?")[:90]
+        if stage is not None and stage not in STAGES:
+            # syntactically an ra.* scope, but absent from the registered
+            # taxonomy (stages.py) — someone added a scope without
+            # registering it; the static linter flags the same drift
+            unregistered.add(stage)
         key = (names.get(e["pid"], str(e["pid"])), label)
         tot[key] += e["dur"]
         cnt[key] += 1
@@ -75,6 +82,7 @@ def attribute(path: str, top: int = 20) -> dict:
         "events": len(ev),
         "total_us": total_us,
         "scoped_us": scoped_us,
+        "unregistered_stages": sorted(unregistered),
         "rows": [
             {"process": proc, "label": name, "us": d, "count": cnt[(proc, name)]}
             for (proc, name), d in sorted(tot.items(), key=lambda kv: -kv[1])[:top]
@@ -92,6 +100,11 @@ def render(a: dict) -> str:
             else "  no named-scope labels found (pre-scope capture or CPU "
             "thunk names); showing raw event names — use `run "
             "--devprof-out` for semantic attribution on this backend"
+        )
+    if a.get("unregistered_stages"):
+        out.append(
+            "  WARNING: ra.* scopes not in the registered taxonomy "
+            f"(stages.py): {', '.join(a['unregistered_stages'])}"
         )
     for r in a["rows"]:
         out.append(
