@@ -5,7 +5,7 @@
 # parallel python process starves the distributed rendezvous tests and
 # fabricates failures.  Run `make lint`, THEN the gate.
 
-.PHONY: lint lint-fast test
+.PHONY: lint lint-fast test chaos
 
 # Static program-invariant lint (DESIGN §18): abstract-eval traces of
 # the full shipping step grid + the repo registry audit.  No device, no
@@ -20,4 +20,12 @@ lint-fast:
 # The tier-1 suite (see ROADMAP.md for the exact gate invocation).
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+# Seeded chaos subset (DESIGN §9/§19): the tier-1 fault schedules plus
+# the transient retry-recovery schedules and the WAL/degraded-mode
+# suites.  Exit-coded for CI; same 1-core caveat as the gate above.
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_retry.py \
+		tests/test_wal.py -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider

@@ -305,12 +305,20 @@ def _cmd_run(args: argparse.Namespace) -> int:
             mesh_shape=args.mesh,
             mesh_dcn=args.mesh_dcn,
             fault_plan=_resolve_fault_plan(args.fault_plan),
+            retry_policy=args.retry_policy,
             **({"checkpoint_dir": args.checkpoint_dir} if args.checkpoint_dir else {}),
         )
+        if args.retry_policy:
+            # validate eagerly: a malformed --retry-policy must be the
+            # usage error here, not a failure at the first transient
+            from .runtime import retrypolicy
+
+            retrypolicy.parse_spec(args.retry_policy)
         autoscale = _autoscale_config(args)
     except (ValueError, errors.AnalysisError) as e:
-        # AnalysisError here is a malformed --fault-plan: a config
-        # mistake, so the usage exit code — not a runtime failure class
+        # AnalysisError here is a malformed --fault-plan/--retry-policy:
+        # a config mistake, so the usage exit code — not a runtime
+        # failure class
         print(f"error: {e}", file=sys.stderr)
         return 2
     if not args.static_analysis and args.static_witness_budget != 4096:
@@ -356,6 +364,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--experimental-match-impl": bool(args.experimental_match_impl),
             "--elastic": args.elastic,
             "--fault-plan": bool(args.fault_plan),
+            "--retry-policy": bool(args.retry_policy),
             "--coalesce": args.coalesce != "off",
             "--mesh=hybrid": args.mesh != "flat",
             "--autoscale": args.autoscale,
@@ -731,7 +740,12 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             stall_timeout_sec=args.stall_timeout,
             update_impl=args.update_impl,
             fault_plan=_resolve_fault_plan(args.fault_plan),
+            retry_policy=args.retry_policy,
         )
+        if args.retry_policy:
+            from .runtime import retrypolicy
+
+            retrypolicy.parse_spec(args.retry_policy)
         ascfg = _autoscale_config(args)
         mode, length = report_mod.parse_window_spec(args.window)
         scfg = ServeConfig(
@@ -751,6 +765,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             stop_after_sec=args.stop_after,
             static_analysis=args.static_analysis,
             static_witness_budget=args.static_witness_budget,
+            wal=args.wal,
+            wal_dir=args.wal_dir,
+            wal_segment_bytes=args.wal_segment_kb << 10,
+            wal_budget_bytes=args.wal_budget_mb << 20,
         )
     except (ValueError, errors.AnalysisError) as e:
         print(f"error: {e}", file=sys.stderr)
@@ -1220,9 +1238,18 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--fault-plan", default=None, metavar="SPEC",
                    help="ARM deterministic fault injection (testing/chaos "
                         "drills only): 'site@N[,site@N][,seed=S]' fires "
-                        "each named site on its Nth hit, or @FILE holding "
+                        "each named site on its Nth hit — the transient "
+                        "form site@N:k fires k consecutive hits then "
+                        "clears (retry-recovery drills) — or @FILE holding "
                         "the spec; see runtime/faults.py SITES and DESIGN "
-                        "§9 for the registered sites")
+                        "§9/§19 for the registered sites")
+    p.add_argument("--retry-policy", default="", metavar="SPEC",
+                   help="override the typed retry/backoff engine (DESIGN "
+                        "§19): 'site=attempts[/base_sec],...,seed=S' "
+                        "tunes per-site bounds, 'off' collapses every "
+                        "site to a single attempt (A/B measurement); "
+                        "empty = the built-in per-site defaults, which "
+                        "are always armed")
     p.add_argument("--mesh", choices=["flat", "hybrid"], default="flat",
                    help="device mesh topology: flat = one data axis over "
                         "every device; hybrid = the two-level DCN x ICI "
@@ -1449,11 +1476,32 @@ def make_parser() -> argparse.ArgumentParser:
                    metavar="N",
                    help="per-rule witness-grid cap for the serve analyzer "
                         "(see `analyze --witness-budget`)")
+    p.add_argument("--wal", action="store_true",
+                   help="durable ingest write-ahead log (DESIGN §19): "
+                        "every consumed line spools to segmented, CRC'd "
+                        "on-disk records BEFORE window accounting, so "
+                        "serve --resume after a hard kill replays the "
+                        "interrupted window bit-identical over its "
+                        "delivered lines; eviction/corruption losses are "
+                        "exactly counted, never silent")
+    p.add_argument("--wal-dir", default="",
+                   help="WAL directory (default: SERVE_DIR/wal)")
+    p.add_argument("--wal-segment-kb", type=int, default=1024, metavar="KB",
+                   help="bytes per WAL segment before rolling (default "
+                        "1024 KiB)")
+    p.add_argument("--wal-budget-mb", type=int, default=64, metavar="MB",
+                   help="total on-disk WAL budget; past it the oldest "
+                        "segment evicts with its records counted as "
+                        "explicit drops at the next resume (default 64)")
     _add_autoscale_flags(p)
     p.add_argument("--fault-plan", default=None, metavar="SPEC",
                    help="chaos drills: see `run --fault-plan` (adds the "
-                        "listener.drop/listener.stall/reload.midbatch and "
+                        "listener.drop/listener.stall/reload.midbatch, "
+                        "listener.bind.fail/listener.accept.fail/"
+                        "serve.publish.fail/metrics.snapshot.fail, and "
                         "autoscale.decide/autoscale.spawn sites)")
+    p.add_argument("--retry-policy", default="", metavar="SPEC",
+                   help="retry/backoff overrides: see `run --retry-policy`")
     _add_devprof_flags(p)
     p.add_argument("--trace-out", default=None, metavar="DIR",
                    help="record listener/rotation/reload spans (see "

@@ -350,6 +350,21 @@ class ServeConfig:
     static_analysis: bool = False
     #: per-rule witness-grid enumeration cap for the serve analyzer
     static_witness_budget: int = 4096
+    #: durable ingest write-ahead log (runtime/wal.py, DESIGN §19):
+    #: every consumed line appends to a segmented, CRC'd on-disk spool
+    #: BEFORE window accounting, so ``serve --resume`` after a hard kill
+    #: replays the interrupted window bit-identical over its delivered
+    #: lines.  Off by default (the pre-WAL behavior: a hard kill loses
+    #: lines buffered past the last checkpoint).
+    wal: bool = False
+    #: WAL directory (empty = ``serve_dir/wal``)
+    wal_dir: str = ""
+    #: bytes per WAL segment before rolling to a fresh one
+    wal_segment_bytes: int = 1 << 20
+    #: total on-disk WAL budget; exceeding it evicts the OLDEST segment,
+    #: and evicted-but-unreplayed records surface as explicit, exactly-
+    #: counted drops at the next resume (never a silent gap)
+    wal_budget_bytes: int = 64 << 20
 
     def __post_init__(self) -> None:
         if (self.window_lines > 0) == (self.window_sec > 0):
@@ -383,6 +398,25 @@ class ServeConfig:
             raise ValueError(
                 f"static_witness_budget must be >= 1, got "
                 f"{self.static_witness_budget}"
+            )
+        if self.wal_segment_bytes < 4096:
+            raise ValueError(
+                f"wal_segment_bytes must be >= 4096, got "
+                f"{self.wal_segment_bytes}"
+            )
+        if self.wal_budget_bytes < 2 * self.wal_segment_bytes:
+            # the budget must hold at least the rolling segment plus one
+            # sealed predecessor, or every roll would immediately evict
+            raise ValueError(
+                "wal_budget_bytes must be >= 2 * wal_segment_bytes "
+                f"(got {self.wal_budget_bytes} vs segment "
+                f"{self.wal_segment_bytes})"
+            )
+        if (self.wal_dir or self.wal_segment_bytes != 1 << 20
+                or self.wal_budget_bytes != 64 << 20) and not self.wal:
+            raise ValueError(
+                "wal_dir/wal_segment_bytes/wal_budget_bytes require wal=True "
+                "(serve --wal)"
             )
         if self.http != "off":
             host, _, port = self.http.rpartition(":")
@@ -487,11 +521,19 @@ class AnalysisConfig:
     #: collective batch assembly needs one global shape).
     coalesce: str = "off"
     #: Serialized fault-injection schedule (runtime/faults.py;
-    #: ``"site@N,site@N,seed=S"``).  Empty = every site disarmed (the
+    #: ``"site@N,site@N:k,seed=S"`` — the ``:k`` transient form fires k
+    #: consecutive times then clears).  Empty = every site disarmed (the
     #: production state: one None-check per site).  Armed by the drivers
     #: at run start and exported to RA_FAULT_PLAN so spawned workers
     #: (feeder processes, elastic generations) inherit the schedule.
     fault_plan: str = ""
+    #: Retry-policy overrides (runtime/retrypolicy.py, DESIGN §19;
+    #: ``"site=attempts[/base_sec],...,seed=S"`` or ``"off"``).  Empty =
+    #: the built-in per-site defaults (retries are always armed; this
+    #: only tunes them).  Validated at configure time like fault_plan,
+    #: so bad specs fail loudly at run start rather than silently at the
+    #: first transient fault.
+    retry_policy: str = ""
 
     def __post_init__(self) -> None:
         if self.batch_size < 1:

@@ -82,6 +82,16 @@ class AnalyzerContradiction(AnalysisError):
     shadow-verdict -> typed error, never silent")."""
 
 
+class WalQuarantine(AnalysisError):
+    """The serve ingest write-ahead log refused an unusable segment.
+
+    Raised only when the WAL directory itself cannot be opened or
+    created; a CRC-corrupt record inside a segment never raises — the
+    segment is quarantined (renamed aside), the lost records are counted
+    exactly where the seq arithmetic allows, and replay continues with
+    the next segment (DESIGN §19)."""
+
+
 class InjectedFault(AnalysisError):
     """A deterministic fault fired by an armed plan (runtime/faults.py).
 
@@ -89,6 +99,66 @@ class InjectedFault(AnalysisError):
     faulted run ends in a typed abort or a bit-identical report, and an
     injected failure crossing an un-wrapping propagation path must not
     break that invariant by surfacing raw."""
+
+
+# ---------------------------------------------------------------------------
+# Transient-vs-permanent classification (DESIGN §19).  The retry engine
+# (runtime/retrypolicy.py) consults this at every wrapped seam: a
+# TRANSIENT failure is worth re-attempting with backoff (the fault is in
+# the environment and may clear — a flaky transfer, EINTR, a socket in
+# TIME_WAIT, a saturated disk queue); a PERMANENT one never clears by
+# waiting (a typed refusal, a missing file, a permission wall, a
+# programming error) and must escalate immediately.  One table, one
+# function — so the drivers, the listeners, and the checkpoint plane can
+# never disagree about what is worth retrying.
+# ---------------------------------------------------------------------------
+
+import errno as _errno
+
+#: OSError errnos that describe environmental, possibly-clearing faults.
+TRANSIENT_ERRNOS = frozenset(
+    getattr(_errno, name)
+    for name in (
+        "EAGAIN", "EINTR", "EIO", "EBUSY", "ENOBUFS", "ENOMEM",
+        "EADDRINUSE", "ECONNRESET", "ECONNREFUSED", "ECONNABORTED",
+        "ENETDOWN", "ENETUNREACH", "ENETRESET", "EHOSTUNREACH",
+        "ETIMEDOUT", "EPIPE", "ESTALE", "EDQUOT", "ENOSPC",
+    )
+    if hasattr(_errno, name)
+)
+
+#: Substrings of jax/XLA RuntimeError messages that mark environmental
+#: device/runtime faults (gRPC status tokens) rather than program bugs.
+TRANSIENT_XLA_TOKENS = ("RESOURCE_EXHAUSTED", "UNAVAILABLE", "DEADLINE_EXCEEDED")
+
+
+def is_transient(exc: BaseException) -> bool:
+    """True when ``exc`` describes a fault a bounded retry may clear.
+
+    Order matters: InjectedFault (the chaos tier's stand-in for exactly
+    these environmental faults) is transient by definition, every OTHER
+    typed AnalysisError is a deliberate refusal and therefore permanent,
+    and the os-level classes split by errno.  Anything unrecognized is
+    permanent — retrying an unknown failure can only mask a bug.
+    """
+    if isinstance(exc, InjectedFault):
+        return True
+    if isinstance(exc, AnalysisError):
+        return False  # typed refusals (corrupt ckpt, mismatch...) never retry
+    if isinstance(exc, (FileNotFoundError, PermissionError, IsADirectoryError,
+                        NotADirectoryError)):
+        return False
+    if isinstance(exc, (ConnectionError, InterruptedError, BlockingIOError,
+                        TimeoutError)):
+        return True  # includes socket.timeout and ECONNRESET et al.
+    if isinstance(exc, OSError):
+        return exc.errno in TRANSIENT_ERRNOS
+    if isinstance(exc, RuntimeError):
+        # XlaRuntimeError subclasses RuntimeError; only the gRPC-status
+        # environmental classes qualify (a shape error must escalate)
+        msg = str(exc)
+        return any(tok in msg for tok in TRANSIENT_XLA_TOKENS)
+    return False
 
 
 # ---------------------------------------------------------------------------

@@ -65,10 +65,22 @@ def is_manifest_file(path: str) -> bool:
 
 
 def read_manifest(path: str) -> dict:
-    """Load + validate a manifest; shard paths resolve relative to it."""
-    try:
+    """Load + validate a manifest; shard paths resolve relative to it.
+
+    The read IO runs under the central ``wire.read`` retry policy
+    (runtime/retrypolicy.py): a transient open/read fault re-attempts
+    with seeded backoff; a persistent one escalates as the typed
+    AnalysisError below, exactly as before.
+    """
+    from ..runtime import faults, retrypolicy
+
+    def _read():
+        faults.fire("stream.wire.read.fail")
         with open(path, "r", encoding="utf-8") as f:
-            m = json.load(f)
+            return json.load(f)
+
+    try:
+        m = retrypolicy.call("wire.read", _read)
     except (OSError, ValueError) as e:
         raise AnalysisError(f"cannot read manifest {path!r}: {e}") from e
     if m.get("magic") != MANIFEST_MAGIC:

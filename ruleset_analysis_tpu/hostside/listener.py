@@ -33,7 +33,7 @@ import time
 from collections import deque
 
 from ..errors import AnalysisError
-from ..runtime import faults, obs
+from ..runtime import faults, obs, retrypolicy
 
 
 class LineQueue:
@@ -160,13 +160,53 @@ class BaseListener(threading.Thread):
     def _teardown(self) -> None:
         pass
 
+    def _beat(self) -> None:
+        """One receive-loop iteration tick: heartbeat + chaos seam.
+
+        The ``listener.accept.fail`` site fires here so transient
+        receive-loop faults are injectable in every listener kind; the
+        ``listener.accept`` retry policy in :meth:`run` re-enters
+        ``_serve`` on them.
+        """
+        self.beat = time.monotonic()
+        faults.fire("listener.accept.fail")
+
+    def _push_all(self, lines: list[str]) -> None:
+        """Push a split batch; a fault mid-batch counts the unpushed
+        remainder as explicit drops before propagating (the accept
+        retry may resume this listener — no silent gap allowed)."""
+        for i, line in enumerate(lines):
+            try:
+                self._push(line)
+            except BaseException:
+                rest = len(lines) - i - 1
+                if rest and not self.stop_event.is_set():
+                    self.q.note_discarded(rest)
+                raise
+
     # -- shared line path ------------------------------------------------
     def _push(self, line: str) -> None:
-        """Fault-instrumented push: the ONLY way lines enter the queue."""
-        faults.fire("listener.stall", stop=self.stop_event)
-        line = faults.fire(
-            "listener.drop", payload=line, corrupt=lambda _p, _rng: None
-        )
+        """Fault-instrumented push: the ONLY way lines enter the queue.
+
+        A fault that escapes mid-push (a released ``listener.stall``, a
+        transient burst the accept retry will re-enter around) counts
+        its in-flight line as an explicit drop BEFORE propagating — the
+        retry policy may resume this listener, and the resumed stream
+        must never contain a silent gap.
+        """
+        try:
+            faults.fire("listener.stall", stop=self.stop_event)
+            line = faults.fire(
+                "listener.drop", payload=line, corrupt=lambda _p, _rng: None
+            )
+        except BaseException:
+            if not self.stop_event.is_set():
+                self.q.note_discarded()
+                obs.instant(
+                    "listener.drop",
+                    args={"listener": self.label, "cause": "fault"},
+                )
+            raise
         if line is None:
             # the site ate the line: account it as an explicit drop so the
             # window it belonged to reports incomplete, never zero-hit
@@ -178,7 +218,15 @@ class BaseListener(threading.Thread):
 
     def run(self) -> None:
         try:
-            self._serve()
+            # the receive loop runs under the listener.accept retry
+            # policy: a transient fault (classified by errors.is_transient
+            # — an injected listener.accept.fail burst, a recoverable
+            # socket error) re-enters _serve with seeded backoff instead
+            # of killing the listener; exhaustion or a permanent error
+            # records it and marks the listener dead — the serve loop's
+            # existing escalation (windows incomplete; all-dead aborts
+            # typed) takes over from there
+            retrypolicy.call("listener.accept", self._serve, stop=self.stop_event)
         except BaseException as e:  # recorded, surfaced by the serve loop
             if not self.stop_event.is_set():
                 self.error = e
@@ -193,6 +241,32 @@ class BaseListener(threading.Thread):
             self.join(timeout=10.0)
 
 
+def _bind_retry(sock_type: int, host: str, port: int, finish):
+    """Create + bind one socket under the ``listener.bind`` retry policy.
+
+    EADDRINUSE — the TIME_WAIT rebind after a service restart — is the
+    canonical transient here; the policy waits it out with seeded
+    backoff.  A permanent refusal (EACCES on a privileged port) or an
+    exhausted budget escalates the original OSError, which the CLI's
+    construction handler reports as the documented clean bind error.
+    ``finish`` applies kind-specific setup (listen()) before the socket
+    is returned; a failed attempt always closes its socket.
+    """
+
+    def _attempt():
+        faults.fire("listener.bind.fail")
+        s = socket.socket(socket.AF_INET, sock_type)
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind((host, port))
+            return finish(s)
+        except BaseException:
+            s.close()
+            raise
+
+    return retrypolicy.call("listener.bind", _attempt)
+
+
 class UdpSyslogListener(BaseListener):
     """RFC3164-style UDP syslog: one datagram = one log line."""
 
@@ -200,15 +274,15 @@ class UdpSyslogListener(BaseListener):
 
     def __init__(self, q: LineQueue, host: str, port: int):
         super().__init__(q, f"udp-{host}-{port}")
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
+        self._sock = _bind_retry(
+            socket.SOCK_DGRAM, host, port, lambda s: s
+        )
         self._sock.settimeout(0.2)
         self.address = self._sock.getsockname()
 
     def _serve(self) -> None:
         while not self.stop_event.is_set():
-            self.beat = time.monotonic()
+            self._beat()
             try:
                 data, _addr = self._sock.recvfrom(1 << 16)
             except socket.timeout:
@@ -239,10 +313,9 @@ class TcpSyslogListener(BaseListener):
 
     def __init__(self, q: LineQueue, host: str, port: int):
         super().__init__(q, f"tcp-{host}-{port}")
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind((host, port))
-        self._sock.listen(8)
+        self._sock = _bind_retry(
+            socket.SOCK_STREAM, host, port, lambda s: (s.listen(8), s)[1]
+        )
         self._sock.settimeout(0.2)
         self.address = self._sock.getsockname()
         self._conns: list[socket.socket] = []
@@ -252,11 +325,23 @@ class TcpSyslogListener(BaseListener):
 
         sel = selectors.DefaultSelector()
         sel.register(self._sock, selectors.EVENT_READ, ("accept", None))
-        bufs: dict[socket.socket, bytes] = {}
-        skipping: set[socket.socket] = set()
+        # partial-frame buffers persist on the instance: a transient
+        # receive-loop fault re-enters _serve (listener.accept retry) and
+        # must neither drop established connections nor lose their
+        # buffered half-lines
+        bufs: dict[socket.socket, bytes] = getattr(self, "_bufs", {})
+        self._bufs = bufs
+        skipping: set[socket.socket] = getattr(self, "_skipping", set())
+        self._skipping = skipping
+        for conn in self._conns:
+            try:
+                sel.register(conn, selectors.EVENT_READ, ("conn", None))
+                bufs.setdefault(conn, b"")
+            except (ValueError, OSError):
+                pass  # closed mid-retry; the next recv path cleans up
         try:
             while not self.stop_event.is_set():
-                self.beat = time.monotonic()
+                self._beat()
                 for key, _ev in sel.select(timeout=0.2):
                     tag, _ = key.data
                     if tag == "accept":
@@ -307,10 +392,10 @@ class TcpSyslogListener(BaseListener):
                         rest = b""
                         skipping.add(conn)
                     bufs[conn] = rest
-                    for raw in lines:
-                        self._push(
-                            raw.decode("utf-8", errors="replace").rstrip("\r")
-                        )
+                    self._push_all([
+                        raw.decode("utf-8", errors="replace").rstrip("\r")
+                        for raw in lines
+                    ])
         finally:
             sel.close()
 
@@ -364,14 +449,18 @@ class FileTailer(BaseListener):
         return open(self.path, "r", encoding="utf-8", errors="replace")
 
     def _serve(self) -> None:
-        f = None
-        buf = ""
-        skipping = False  # inside an oversized, already-dropped line
+        # Follow state lives on the instance, not in locals: a transient
+        # fault re-enters _serve (listener.accept retry) and must resume
+        # at the current file offset with its partial line intact — a
+        # fresh f=None would reopen at offset 0 (_from_start is True by
+        # then) and re-deliver every line already pushed.
+        if not hasattr(self, "_f"):
+            self._f, self._buf, self._skip = None, "", False
         while not self.stop_event.is_set():
-            self.beat = time.monotonic()
-            if f is None:
+            self._beat()
+            if self._f is None:
                 try:
-                    f = self._open()
+                    self._f = self._open()
                 except OSError:
                     # a file that appears later is NEW traffic: read it
                     # fully (only an already-present spool skips its past)
@@ -379,44 +468,48 @@ class FileTailer(BaseListener):
                     self.stop_event.wait(self.poll_sec)
                     continue
                 if not self._from_start:
-                    f.seek(0, os.SEEK_END)
+                    self._f.seek(0, os.SEEK_END)
                 self._from_start = True  # rotated successors read fully
-            chunk = f.read(1 << 16)
+            chunk = self._f.read(1 << 16)
             if chunk:
-                if skipping:
+                if self._skip:
                     if "\n" not in chunk:
                         continue
                     chunk = chunk.split("\n", 1)[1]
-                    skipping = False
-                buf += chunk
+                    self._skip = False
+                buf = self._buf + chunk
                 *lines, buf = buf.split("\n")
-                for line in lines:
-                    self._push(line.rstrip("\r"))
-                if len(buf) > MAX_LINE_BYTES:
+                self._buf = buf
+                self._push_all([line.rstrip("\r") for line in lines])
+                if len(self._buf) > MAX_LINE_BYTES:
                     self.q.note_discarded()
                     obs.instant(
                         "listener.drop",
                         args={"listener": self.label, "cause": "oversize"},
                     )
-                    buf = ""
-                    skipping = True
+                    self._buf = ""
+                    self._skip = True
                 continue
             # no new data: rotation (new inode) or truncation (shrunk)?
             try:
                 st = os.stat(self.path)
-                rotated = st.st_ino != self._ino(f) or st.st_size < f.tell()
+                rotated = (
+                    st.st_ino != self._ino(self._f)
+                    or st.st_size < self._f.tell()
+                )
             except OSError:
                 rotated = True  # the old file was removed; wait for a new one
             if rotated:
-                if buf:  # final unterminated line of the rotated-out file
-                    self._push(buf)
-                    buf = ""
-                f.close()
-                f = None
+                if self._buf:  # final unterminated line of the old file
+                    self._push(self._buf)
+                    self._buf = ""
+                self._f.close()
+                self._f = None
                 continue
             self.stop_event.wait(self.poll_sec)
-        if f is not None:
-            f.close()
+        if self._f is not None:
+            self._f.close()
+            self._f = None
 
 
 def parse_listen_spec(spec: str) -> tuple[str, str, int | str]:

@@ -368,9 +368,20 @@ def is_wire_file(path: str) -> bool:
 
 
 class _WireFile:
-    """One mmap'd wire file, header-validated."""
+    """One mmap'd wire file, header-validated.
+
+    The open + header read + mmap establishment is the wire path's IO
+    seam: it runs under the central ``wire.read`` retry policy (callers
+    construct through :func:`_open_wire_file`), so a transient storage
+    hiccup at open time re-attempts instead of aborting a resumable run.
+    Typed refusals (bad magic, truncation, fingerprint mismatch) are
+    permanent and escalate unchanged.
+    """
 
     def __init__(self, path: str, fp: bytes | None):
+        from ..runtime import faults
+
+        faults.fire("stream.wire.read.fail")
         self.path = path
         f = open(path, "rb")
         try:
@@ -479,6 +490,13 @@ class _WireFile:
         )
 
 
+def _open_wire_file(path: str, fp: bytes | None) -> "_WireFile":
+    """Construct one _WireFile under the ``wire.read`` retry policy."""
+    from ..runtime import retrypolicy
+
+    return retrypolicy.call("wire.read", lambda: _WireFile(path, fp))
+
+
 class WireReader:
     """mmap-backed batch source over one or more wire files.
 
@@ -501,7 +519,7 @@ class WireReader:
         fp = fingerprint
         if fp is None and packed is not None:
             fp = ruleset_fingerprint(packed)
-        self._files = [_WireFile(p, fp) for p in paths]
+        self._files = [_open_wire_file(p, fp) for p in paths]
         kinds = {f.weighted for f in self._files}
         if len(kinds) > 1:
             for f in self._files:

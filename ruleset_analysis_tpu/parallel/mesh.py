@@ -30,7 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..errors import AnalysisError
-from ..runtime import faults
+from ..runtime import faults, retrypolicy
 
 #: Outer (between-host) axis name of the hybrid topology.
 DCN_AXIS = "dcn"
@@ -101,19 +101,32 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 def shard_batch(mesh: Mesh, batch_np: np.ndarray, axis: str = "data") -> jax.Array:
     """Host [TUPLE_COLS, B] -> device array sharded over the data axes."""
+
     # chaos site: H2D transfer failure.  Reached from both the sync chunk
     # loop and the prefetch producer's pack closure, so one site exercises
     # both propagation paths (direct raise vs. typed re-raise at consume).
-    faults.fire("stream.device_put.fail")
-    return jax.device_put(batch_np, batch_sharding(mesh, axis))
+    # The device_put retry policy wraps the whole attempt: a transient
+    # runtime fault (k consecutive injected fires, a recoverable XLA
+    # status) re-issues the transfer with seeded backoff; exhaustion
+    # escalates the original typed error unchanged.
+    def _put():
+        faults.fire("stream.device_put.fail")
+        return jax.device_put(batch_np, batch_sharding(mesh, axis))
+
+    return retrypolicy.call("device_put", _put)
 
 
 def shard_grouped(mesh: Mesh, grouped_np: np.ndarray, axis: str = "data") -> jax.Array:
     """Host [G, TUPLE_COLS, lane] -> device array, lane axis sharded."""
-    faults.fire("stream.device_put.fail")
-    return jax.device_put(
-        grouped_np, NamedSharding(mesh, P(None, None, data_axes(mesh, axis)))
-    )
+
+    def _put():
+        faults.fire("stream.device_put.fail")
+        return jax.device_put(
+            grouped_np,
+            NamedSharding(mesh, P(None, None, data_axes(mesh, axis))),
+        )
+
+    return retrypolicy.call("device_put", _put)
 
 
 def shard_ring_batch(mesh: Mesh, ring_batch, axis: str = "data") -> jax.Array:
@@ -132,21 +145,28 @@ def shard_ring_batch(mesh: Mesh, ring_batch, axis: str = "data") -> jax.Array:
     """
     from ..hostside import pack as pack_mod
 
-    faults.fire("stream.device_put.fail")
     sharding = batch_sharding(mesh, axis)
     wires = [pack_mod.compact_batch(v) for v in ring_batch.views]
     ring_batch.release()  # compact_batch copied out of the shm slots
     cols = wires[0].shape[0]
     shard_w = wires[0].shape[1]
     global_shape = (cols, shard_w * len(wires))
-    arrs = []
-    for dev, idx in sharding.devices_indices_map(global_shape).items():
-        col = idx[1]
-        start = 0 if col.start is None else int(col.start)
-        arrs.append(jax.device_put(wires[start // shard_w], dev))
-    return jax.make_array_from_single_device_arrays(
-        global_shape, sharding, arrs
-    )
+
+    # retry wraps the per-chip transfer fan-out as one unit: the wires
+    # are host copies (the shm slots are already released), so a second
+    # attempt re-issues every device_put safely
+    def _put():
+        faults.fire("stream.device_put.fail")
+        arrs = []
+        for dev, idx in sharding.devices_indices_map(global_shape).items():
+            col = idx[1]
+            start = 0 if col.start is None else int(col.start)
+            arrs.append(jax.device_put(wires[start // shard_w], dev))
+        return jax.make_array_from_single_device_arrays(
+            global_shape, sharding, arrs
+        )
+
+    return retrypolicy.call("device_put", _put)
 
 
 def pad_batch_size(batch_size: int, mesh: Mesh, axis: str = "data") -> int:

@@ -133,54 +133,80 @@ def _manifest_crc32(manifest: dict) -> int:
 
 
 def save(ckpt_dir: str, snap: Snapshot) -> None:
-    from . import faults, obs
+    from . import faults, obs, retrypolicy
 
     t_save0 = time.perf_counter()
     os.makedirs(ckpt_dir, exist_ok=True)
-    snap_name = f"snap-{snap.n_chunks}"
-    tmp_dir = tempfile.mkdtemp(dir=ckpt_dir, prefix=".tmp-")
-    state_path = os.path.join(tmp_dir, STATE_FILE)
-    with open(state_path, "wb") as f:
-        np.savez(f, **snap.arrays)
-        f.flush()
-        os.fsync(f.fileno())
-    # fault site: crash leaving a half-written register file — the
-    # pointer never moves, so load() must keep serving the prior epoch
-    faults.fire("checkpoint.torn_state", path=state_path)
-    manifest = {
-        "lines_consumed": snap.lines_consumed,
-        "n_chunks": snap.n_chunks,
-        "parsed": snap.parsed,
-        "skipped": snap.skipped,
-        "fingerprint": snap.fingerprint,
-        "tracker": [
-            [acl, list(table.items())] for acl, table in snap.tracker_tables.items()
-        ],
-        # integrity: npz payload CRC + manifest self-CRC, verified on load
-        "state_crc32": _file_crc32(state_path),
-    }
-    if snap.extra is not None:
-        manifest["extra"] = snap.extra
-    manifest["crc32"] = _manifest_crc32(manifest)
-    manifest_path = os.path.join(tmp_dir, MANIFEST_FILE)
-    with open(manifest_path, "w", encoding="utf-8") as f:
-        json.dump(manifest, f)
-        f.flush()
-        os.fsync(f.fileno())
-    faults.fire("checkpoint.torn_manifest", path=manifest_path)
+
+    # The whole write+fsync phase runs under the central checkpoint.save
+    # retry policy: a transient IO fault (torn write, EIO, a momentary
+    # ENOSPC) re-attempts into a FRESH tmp dir — the failed attempt is
+    # removed so retries never leak .tmp- litter — and a persistent one
+    # escalates the original typed error after the policy's bounded
+    # attempts (this absorbs the pre-PR-14 ad-hoc retry loop: attempts
+    # and backoff are now one configurable, observable knob).
+    def _write_tmp() -> str:
+        tmp_dir = tempfile.mkdtemp(dir=ckpt_dir, prefix=".tmp-")
+        try:
+            state_path = os.path.join(tmp_dir, STATE_FILE)
+            with open(state_path, "wb") as f:
+                np.savez(f, **snap.arrays)
+                f.flush()
+                os.fsync(f.fileno())
+            # fault site: crash leaving a half-written register file —
+            # the pointer never moves, so load() keeps the prior epoch
+            faults.fire("checkpoint.torn_state", path=state_path)
+            manifest = {
+                "lines_consumed": snap.lines_consumed,
+                "n_chunks": snap.n_chunks,
+                "parsed": snap.parsed,
+                "skipped": snap.skipped,
+                "fingerprint": snap.fingerprint,
+                "tracker": [
+                    [acl, list(table.items())]
+                    for acl, table in snap.tracker_tables.items()
+                ],
+                # integrity: npz CRC + manifest self-CRC, verified on load
+                "state_crc32": _file_crc32(state_path),
+            }
+            if snap.extra is not None:
+                manifest["extra"] = snap.extra
+            manifest["crc32"] = _manifest_crc32(manifest)
+            manifest_path = os.path.join(tmp_dir, MANIFEST_FILE)
+            with open(manifest_path, "w", encoding="utf-8") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            faults.fire("checkpoint.torn_manifest", path=manifest_path)
+            # Snapshot data and its directory entries must be durable
+            # BEFORE the pointer moves, or a power loss could persist a
+            # pointer to truncated files (the small rename often hits
+            # disk first).
+            _fsync_dir(tmp_dir)
+        except BaseException:
+            _rmtree(tmp_dir)
+            raise
+        return tmp_dir
+
+    tmp_dir = retrypolicy.call("checkpoint.save", _write_tmp)
     # Never delete an existing dir (LATEST may point at it): a same-chunk
     # re-save lands under a fresh name and the old one is pruned only
-    # after the pointer moves.
+    # after the pointer moves.  Bounded by the same policy's attempt
+    # count — a directory that keeps colliding past it is storage gone
+    # mad, not a name race.
+    snap_name = f"snap-{snap.n_chunks}"
     snap_dir = os.path.join(ckpt_dir, snap_name)
-    retry = 0
-    while os.path.exists(snap_dir):
-        retry += 1
+    for retry in range(1, retrypolicy.policy("checkpoint.save").attempts + 1):
+        if not os.path.exists(snap_dir):
+            break
         snap_name = f"snap-{snap.n_chunks}-r{retry}"
         snap_dir = os.path.join(ckpt_dir, snap_name)
-    # Snapshot data and its directory entries must be durable BEFORE the
-    # pointer moves, or a power loss could persist a pointer to truncated
-    # files (the small rename often hits disk first).
-    _fsync_dir(tmp_dir)
+    else:
+        _rmtree(tmp_dir)
+        raise CheckpointCorrupt(
+            f"cannot find a free snapshot name for chunk {snap.n_chunks} "
+            f"in {ckpt_dir!r} (storage litter?); clean the checkpoint dir"
+        )
     os.replace(tmp_dir, snap_dir)
     _fsync_dir(ckpt_dir)
     # publish: the pointer rename is the commit point

@@ -17,8 +17,12 @@ input:
   the BENCH_r06 pipeline-efficiency path).
 
 - **Plans.**  A :class:`FaultPlan` maps sites to :class:`FaultSpec`\\ s
-  (*fire on the Nth hit of this site*).  Plans are deterministic and
-  serializable (``"site@N,site@N;seed=S"``), armable from the CLI
+  (*fire on the Nth hit of this site*; the transient form ``site@N:k``
+  fires on hits N..N+k-1 — k consecutive failures, then the fault
+  clears, which is how the chaos harness proves the retry engine
+  recovers bit-identical rather than merely that aborts are typed).
+  Plans are deterministic and
+  serializable (``"site@N,site@N:k,seed=S"``), armable from the CLI
   (``run --fault-plan``), config (``AnalysisConfig.fault_plan``), or the
   ``RA_FAULT_PLAN`` environment variable — the env var is how a plan
   reaches spawned children (feeder worker processes, elastic generation
@@ -141,15 +145,49 @@ SITES: dict[str, tuple[str, str]] = {
         "in a typed abort or complete as a clean no-trace run with a "
         "bit-identical report — never a hang, a half-written "
         "devprof.json, or a corrupted report"),
+    "stream.wire.read.fail": (
+        "raise", "wire-file / convert-manifest open or header read IO "
+        "fails (cold-NFS hiccup analog); the wire.read retry site "
+        "absorbs a transient burst, a persistent failure escalates to "
+        "the existing typed feed abort"),
+    "listener.bind.fail": (
+        "raise", "a serve listener socket bind fails (TIME_WAIT rebind "
+        "analog); the listener.bind retry site waits it out with "
+        "backoff, persistent failure is the documented clean bind "
+        "error"),
+    "listener.accept.fail": (
+        "raise", "a serve listener's receive loop throws mid-iteration "
+        "(socket/driver hiccup analog); the listener.accept retry site "
+        "re-enters the loop, exhaustion records the error and marks "
+        "the listener dead (windows incomplete, all-dead aborts typed)"),
+    "serve.publish.fail": (
+        "raise", "serve report publication to disk fails (full/readonly "
+        "volume analog); the serve.publish retry site absorbs a "
+        "transient burst, exhaustion DEGRADES the publisher subsystem "
+        "(/health names it, in-memory endpoints keep serving) instead "
+        "of aborting ingest"),
+    "metrics.snapshot.fail": (
+        "raise", "the metrics snapshotter's periodic tick fails "
+        "(unwritable metrics file analog); the tick error is counted "
+        "and the ra-metrics thread keeps running — serve marks the "
+        "metrics subsystem degraded and recovery re-arms it"),
 }
 
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
-    """One scheduled failure: ``site`` fires on its ``at``-th hit."""
+    """One scheduled failure: ``site`` fires on hits ``at..at+count-1``.
+
+    ``count == 1`` is the historical single-shot form; ``count > 1`` is
+    the *transient* mode (``site@N:k`` in the plan grammar): the site
+    fails k consecutive times and then clears — the shape a retry policy
+    must survive, and the shape that proves budget exhaustion when k
+    exceeds the site's attempt bound.
+    """
 
     site: str
     at: int = 1
+    count: int = 1
 
     def __post_init__(self) -> None:
         if self.site not in SITES:
@@ -159,10 +197,17 @@ class FaultSpec:
             )
         if self.at < 1:
             raise AnalysisError(f"fault hit count must be >= 1, got {self.at}")
+        if self.count < 1:
+            raise AnalysisError(
+                f"fault consecutive-fire count must be >= 1, got {self.count}"
+            )
 
     @property
     def action(self) -> str:
         return SITES[self.site][0]
+
+    def fires_on(self, n: int) -> bool:
+        return self.at <= n < self.at + self.count
 
 
 class FaultPlan:
@@ -183,14 +228,17 @@ class FaultPlan:
 
     # -- serialization --------------------------------------------------
     def to_str(self) -> str:
-        parts = [f"{s.site}@{s.at}" for s in self.specs.values()]
+        parts = [
+            f"{s.site}@{s.at}" + (f":{s.count}" if s.count > 1 else "")
+            for s in self.specs.values()
+        ]
         if self.seed:
             parts.append(f"seed={self.seed}")
         return ",".join(parts)
 
     @classmethod
     def parse(cls, text: str) -> "FaultPlan":
-        """Inverse of :meth:`to_str` (``"site@N,site@N,seed=S"``)."""
+        """Inverse of :meth:`to_str` (``"site@N,site@N:k,seed=S"``)."""
         specs: list[FaultSpec] = []
         seed = 0
         for part in text.split(","):
@@ -204,11 +252,14 @@ class FaultPlan:
                     raise AnalysisError(f"bad fault-plan seed {part!r}") from e
                 continue
             site, _, at = part.partition("@")
+            at, _, count = at.partition(":")
             try:
-                specs.append(FaultSpec(site, int(at) if at else 1))
+                specs.append(FaultSpec(
+                    site, int(at) if at else 1, int(count) if count else 1
+                ))
             except ValueError as e:
                 raise AnalysisError(
-                    f"bad fault-plan entry {part!r} (want site@N)"
+                    f"bad fault-plan entry {part!r} (want site@N or site@N:k)"
                 ) from e
         if not specs:
             raise AnalysisError(f"fault plan {text!r} names no sites")
@@ -383,7 +434,7 @@ def fire(
         return payload
     with _lock:
         _hits[site] = n = _hits.get(site, 0) + 1
-    if n != spec.at:
+    if not spec.fires_on(n):
         return payload
     action = spec.action
     # mark the firing on the trace timeline BEFORE acting: the per-event

@@ -190,14 +190,43 @@ class MetricsPlane:
         self._last_t = self._t0
         self._last_lines = 0
         self._stop = threading.Event()
+        # tick-failure accounting (DESIGN §19 degraded mode): a failing
+        # snapshot — unwritable file, injected metrics.snapshot.fail —
+        # must never kill the ra-metrics thread; it is counted, the next
+        # tick retries naturally, and serve marks the metrics subsystem
+        # degraded until a tick succeeds again
+        self.errors = 0
+        self.consec_errors = 0
+        self.last_error = ""
         self._thread = threading.Thread(
             target=self._loop, name="ra-metrics", daemon=True
         )
         self._thread.start()
 
     def _loop(self) -> None:
+        from . import faults
+
         while not self._stop.wait(self.every):
-            self.snapshot()
+            try:
+                faults.fire("metrics.snapshot.fail")
+                self.snapshot()
+            except Exception as e:
+                with self._lock:
+                    self.errors += 1
+                    self.consec_errors += 1
+                    self.last_error = f"{type(e).__name__}: {e}"[:200]
+            else:
+                with self._lock:
+                    self.consec_errors = 0
+
+    def health(self) -> dict:
+        with self._lock:
+            return {
+                "alive": self._thread.is_alive(),
+                "errors": self.errors,
+                "consec_errors": self.consec_errors,
+                "last_error": self.last_error,
+            }
 
     def add_lines(self, n: int) -> None:
         with self._lock:
@@ -549,6 +578,16 @@ def metrics_snapshot() -> dict | None:
 
 def metrics_active() -> bool:
     return _metrics is not None
+
+
+def metrics_health() -> dict | None:
+    """Snapshotter liveness + tick-error counters (None when disarmed).
+
+    Serve's degraded-mode plane polls this: consec_errors > 0 marks the
+    metrics subsystem degraded, a clean tick afterwards re-arms it.
+    """
+    m = _metrics
+    return m.health() if m is not None else None
 
 
 # -- merge -------------------------------------------------------------------

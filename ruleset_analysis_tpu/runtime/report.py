@@ -45,6 +45,7 @@ VOLATILE_TOTALS = (
     "autoscale",
     "recovery",
     "devprof",
+    "degraded",
 )
 
 

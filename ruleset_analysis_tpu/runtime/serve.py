@@ -61,7 +61,8 @@ from ..hostside.listener import LineQueue, ListenerSet
 from ..models import pipeline
 from ..ops.topk import TopKTracker
 from . import checkpoint as ckpt
-from . import devprof, faults, obs
+from . import devprof, faults, obs, retrypolicy
+from .wal import WriteAheadLog
 from .autoscale import PolicyEngine, render_prom, world_ladder
 from .report import diff_report_objs
 
@@ -415,6 +416,22 @@ class ServeDriver:
         self._static_done_t: float | None = None
         self._static_duration = 0.0
         self.drops_restored = 0  # drops from checkpointed history (--resume)
+        # degraded-mode plane (DESIGN §19): non-core subsystem failures
+        # (static analysis, metrics snapshotter, devprof capture, report
+        # publisher) mark the service degraded instead of aborting
+        # ingest; recovery re-arms.  Own lock: _degrade/_recover are
+        # called from paths that already hold _pub_lock.
+        self._deg_lock = threading.Lock()
+        self.degraded: dict[str, str] = {}  # subsystem -> last error
+        self.degraded_events = 0
+        self.recovered_events = 0
+        # durable ingest WAL (DESIGN §19; opened in run() when scfg.wal)
+        self.wal: WriteAheadLog | None = None
+        self._wal_next = 0  # seq of the next line to consume
+        self._wal_resume_seq = 0  # from the restored checkpoint
+        self.wal_replayed = 0
+        self.wal_lost_total = 0  # eviction/quarantine losses (exact)
+        self.wal_lost_unknown = False
         # cumulative incompleteness: EVERY reason a window was marked
         # (dead/stalled listeners included), not just queue drops — the
         # cumulative "unused ever" view must carry the marker whenever
@@ -435,6 +452,51 @@ class ServeDriver:
         srv = self._http
         return tuple(srv.server_address[:2]) if srv is not None else None
 
+    # -- degraded-mode plane (DESIGN §19) ---------------------------------
+    def _degrade(self, subsystem: str, err: BaseException | str) -> None:
+        """Mark a NON-CORE subsystem failed; ingest keeps serving."""
+        msg = (
+            err if isinstance(err, str)
+            else f"{type(err).__name__}: {err}"
+        )[:200]
+        with self._deg_lock:
+            first = subsystem not in self.degraded
+            self.degraded[subsystem] = msg
+            if first:
+                self.degraded_events += 1
+        if first:
+            obs.instant("serve.degraded", args={
+                "subsystem": subsystem, "error": msg,
+            })
+            obs.metric_event("serve.degraded", subsystem=subsystem, error=msg)
+
+    def _recover(self, subsystem: str) -> None:
+        """A later success of a degraded subsystem re-arms it."""
+        with self._deg_lock:
+            was = self.degraded.pop(subsystem, None)
+            if was is not None:
+                self.recovered_events += 1
+        if was is not None:
+            obs.instant("serve.recovered", args={"subsystem": subsystem})
+            obs.metric_event("serve.recovered", subsystem=subsystem)
+
+    def degraded_set(self) -> list[str]:
+        with self._deg_lock:
+            return sorted(self.degraded)
+
+    def _check_metrics_health(self) -> None:
+        """Poll the snapshotter's tick-error counters (cheap; loop tick)."""
+        h = obs.metrics_health()
+        if h is None:
+            return
+        if not h["alive"] or h["consec_errors"] > 0:
+            self._degrade(
+                "metrics",
+                h["last_error"] or "metrics snapshotter thread died",
+            )
+        else:
+            self._recover("metrics")
+
     # -- health / metrics ------------------------------------------------
     def health(self) -> dict:
         q = self.queue.snapshot()
@@ -444,14 +506,25 @@ class ServeDriver:
             # thread); an unlocked sum() here can die mid-iteration
             quarantine_hits = int(sum(self.cum_quarantine.values()))
             ring_windows = self.ring.window_ids()
+        deg_subsystems = self.degraded_set()
+        with self._deg_lock:
+            deg_errors = dict(self.degraded)
         degraded = (
             q["dropped"] > 0
             or self.reload_errors > 0
             or stalled > 0
             or self.listeners.alive() < len(self.listeners.listeners)
+            or bool(deg_subsystems)
         )
         return {
             "status": "degraded" if degraded else "ok",
+            # the degraded SET is enumerable, not just a boolean: an
+            # operator (or the soak harness) reads exactly which
+            # non-core subsystems are down and which recovered
+            "degraded_subsystems": deg_subsystems,
+            **({"degraded_errors": deg_errors} if deg_errors else {}),
+            "degraded_events": self.degraded_events,
+            "recovered_events": self.recovered_events,
             "uptime_sec": round(time.time() - self._t0, 3),
             "windows_published": self.windows_published,
             "lines_total": self.total_lines,
@@ -531,7 +604,24 @@ class ServeDriver:
             "reload_errors_total": self.reload_errors,
             "listeners_alive": self.listeners.alive(),
             "world": self.world,
+            "degraded_subsystems": len(self.degraded_set()),
+            "degraded_events_total": self.degraded_events,
+            "recovered_events_total": self.recovered_events,
         })
+        # per-site retry attempt/recovery/giveup counters (DESIGN §19):
+        # the same numbers the metrics JSONL sampler and the trace's
+        # retry.attempt instants carry — one plane, three views
+        g.update(retrypolicy.gauges())
+        if self.wal is not None:
+            w = self.wal.stats()
+            g.update({
+                "wal_appended_total": w["appended"],
+                "wal_segments": w["segments"],
+                "wal_bytes": w["bytes"],
+                "wal_evicted_records_total": w["evicted_records"],
+                "wal_replayed_total": self.wal_replayed,
+                "wal_lost_total": self.wal_lost_total,
+            })
         # device attribution + live device-memory headroom (DESIGN §14):
         # numeric gauges reach the prom variant too; unsupported memory
         # stats stay explicit nulls in the JSON (prom skips non-numerics)
@@ -630,6 +720,9 @@ class ServeDriver:
         self._published["static"] = obj
         self._static_done_t = time.time()
         self._static_duration = duration
+        # a complete verdict set re-arms a degraded static plane (the
+        # initial analysis failed; a reload's re-analysis succeeded)
+        self._recover("static_analysis")
 
     def _static_side_effects(self, obj: dict, duration: float) -> None:
         """Off-lock tail of a static publish: disk + metrics."""
@@ -695,17 +788,38 @@ class ServeDriver:
         qt = _quarantine_totals(q)
         if qt:
             totals["quarantine"] = qt
+        deg = self.degraded_set()
+        if deg:
+            totals["degraded"] = deg
         return pipeline.finalize(
             pipeline.AnalysisState(**arrays), packed, self.cfg, tracker,
             topk=self.topk, totals=totals, v6_digests=self._v6_digests,
         )
 
     def _write_json(self, name: str, obj: dict) -> None:
+        """Publish one JSON artifact under the serve.publish retry policy.
+
+        The publisher is a NON-CORE subsystem: a transient disk fault
+        retries with backoff, and an exhausted budget (or a permanent
+        error) degrades the publisher — the in-memory endpoints keep
+        serving every report — instead of aborting ingest.  The next
+        successful write re-arms it.
+        """
         path = os.path.join(self.scfg.serve_dir, name)
         tmp = path + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(obj, f, indent=2)
-        os.replace(tmp, path)
+
+        def _write():
+            faults.fire("serve.publish.fail")
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(obj, f, indent=2)
+            os.replace(tmp, path)
+
+        try:
+            retrypolicy.call("serve.publish", _write)
+        except (OSError, AnalysisError) as e:
+            self._degrade("publisher", e)
+            return
+        self._recover("publisher")
 
     # -- the run loop ----------------------------------------------------
     def run(self) -> dict:
@@ -720,6 +834,7 @@ class ServeDriver:
         scfg = self.scfg
         os.makedirs(scfg.serve_dir, exist_ok=True)
         armed_here = faults.arm_spec(self.cfg.fault_plan)
+        retrypolicy.configure(self.cfg.retry_policy)
         aborted: BaseException | None = None
         try:
             # EVERYTHING after arming is inside the try: a setup failure
@@ -791,10 +906,17 @@ class ServeDriver:
             self._fp = self._fingerprint(self.packed)
             if scfg.static_analysis:
                 # initial analysis: a failure here (incl. the
-                # analyze.tile fault site) aborts the service typed —
-                # the endpoint NEVER serves a partial verdict table
-                sa, dur = self._compute_static(self.packed, reuse=None)
-                self._publish_static(self.packed, sa, dur)
+                # analyze.tile fault site) DEGRADES the static plane —
+                # the service keeps ingesting with /health naming the
+                # loss, and the endpoint still NEVER serves a partial
+                # verdict table (there simply is none until a reload's
+                # re-analysis succeeds and re-arms the subsystem)
+                try:
+                    sa, dur = self._compute_static(self.packed, reuse=None)
+                except AnalysisError as e:
+                    self._degrade("static_analysis", e)
+                else:
+                    self._publish_static(self.packed, sa, dur)
 
             # fresh window scaffolding (possibly replaced by resume below)
             self.win_id = 0
@@ -802,11 +924,28 @@ class ServeDriver:
             self.cum_tracker = TopKTracker(self.cfg.sketch.topk_capacity)
             if self.cfg.resume:
                 self._restore_ring()
+            if scfg.wal:
+                self.wal = WriteAheadLog(
+                    scfg.wal_dir or os.path.join(scfg.serve_dir, "wal"),
+                    segment_bytes=scfg.wal_segment_bytes,
+                    budget_bytes=scfg.wal_budget_bytes,
+                )
+                if not self.cfg.resume:
+                    # a fresh (non-resume) run starts a fresh spool: a
+                    # previous analysis's stale tail must neither replay
+                    # nor grow the directory forever
+                    self.wal.reset()
+                self._wal_next = (
+                    self._wal_resume_seq if self.cfg.resume
+                    else self.wal.next_seq
+                )
 
             obs.register_sampler("listener", self._sample_metrics)
             obs.register_sampler("serve", self.metrics_gauges)
             self.listeners.start()
             self._begin_window()
+            if self.wal is not None and self.cfg.resume:
+                self._replay_wal()
             self._start_http()
             self._start_watcher()
             self._install_signals()
@@ -837,12 +976,23 @@ class ServeDriver:
             "quarantine_hits": int(sum(self.cum_quarantine.values())),
             "serve_dir": os.path.abspath(scfg.serve_dir),
             "world": self.world,
+            "degraded": self.degraded_set(),
+            "degraded_events": self.degraded_events,
+            "recovered_events": self.recovered_events,
+            "retry": retrypolicy.counters(),
             **(
                 {"autoscale": self._engine.summary()}
                 if self._engine is not None
                 else {}
             ),
         }
+        if self.wal is not None:
+            summary["wal"] = {
+                **self.wal.stats(),
+                "replayed": self.wal_replayed,
+                "lost": self.wal_lost_total,
+                "lost_unknown": self.wal_lost_unknown,
+            }
         self._write_json("summary.json", summary)
         return summary
 
@@ -886,6 +1036,8 @@ class ServeDriver:
         self.win_pushed = 0  # lines handed to the batcher
         self.win_reloads = 0
         self.win_quarantine: dict[tuple, int] = {}
+        self._win_wal_drops = 0  # WAL eviction/quarantine losses replayed here
+        self._win_wal_unknown = False
         self._buf6 = None
         self._fill6 = 0
         self._win_t0 = time.time()
@@ -995,6 +1147,60 @@ class ServeDriver:
         while self.pending:
             self._drain(self.pending.popleft())
 
+    # -- durable ingest WAL (DESIGN §19) ----------------------------------
+    def _replay_wal(self) -> None:
+        """Replay the spool tail past the restored checkpoint's seq.
+
+        Runs BEFORE live consumption: the interrupted window (and, at a
+        sparser checkpoint cadence, any rotated-but-uncheckpointed
+        windows — ids and boundaries are deterministic) rebuilds from
+        the on-disk records through the NORMAL consume path, so its
+        eventual report is bit-identical to what an uninterrupted run
+        would have published over the same delivered lines.  Eviction
+        gaps and quarantined segments surface as exactly-counted drops
+        with the ``wal_lost`` incomplete reason — never a silent gap.
+        """
+        assert self.wal is not None
+        n = 0
+        noted = 0  # losses already charged to a window
+        with obs.span("serve.wal.replay", from_seq=self._wal_resume_seq):
+            for seq, line in self.wal.replay(self._wal_resume_seq):
+                # charge losses to the window open when they were
+                # OBSERVED (head-eviction gap -> the first replayed
+                # window; a mid-chain quarantine -> the window at that
+                # point), not blanket-attributed at the end
+                if self.wal.replay_lost > noted:
+                    self._note_wal_loss(self.wal.replay_lost - noted, False)
+                    noted = self.wal.replay_lost
+                for ev in self.batcher.push(line):
+                    self._consume_event(ev)
+                self.win_pushed += 1
+                self.lines_consumed_total += 1
+                self._wal_next = seq + 1
+                n += 1
+                if (
+                    self.scfg.window_lines
+                    and self.win_pushed >= self.scfg.window_lines
+                ):
+                    self._rotate()
+        self.wal_replayed = n
+        if self.wal.replay_lost > noted or self.wal.replay_lost_unknown:
+            self._note_wal_loss(
+                self.wal.replay_lost - noted, self.wal.replay_lost_unknown
+            )
+        obs.metric_event(
+            "serve.wal.replay", replayed=n, lost=self.wal.replay_lost,
+            lost_unknown=self.wal.replay_lost_unknown,
+            quarantined=len(self.wal.quarantined),
+        )
+
+    def _note_wal_loss(self, lost: int, unknown: bool) -> None:
+        self._win_wal_drops += lost
+        self.wal_lost_total += lost
+        if unknown:
+            self._win_wal_unknown = True
+            self.wal_lost_unknown = True
+
     # -- rotation + publication ------------------------------------------
     def _window_meta(self, *, partial: bool) -> dict:
         drops = self.queue.snapshot()["dropped"] - self._drops_at_start
@@ -1013,6 +1219,12 @@ class ServeDriver:
             self.cfg.stall_timeout_sec
         ):
             reasons.append("listener_stalled")
+        if self._win_wal_drops or self._win_wal_unknown:
+            # WAL eviction/quarantine losses replayed into this window:
+            # exactly counted where seq arithmetic pins them; "unknown"
+            # marks a corrupt final segment whose tail nothing pins
+            reasons.append("wal_lost")
+            drops += self._win_wal_drops
         packer = self.batcher.packer
         meta = {
             "id": self.win_id,
@@ -1027,6 +1239,10 @@ class ServeDriver:
             "started_unix": round(self._win_t0, 3),
             "ended_unix": round(time.time(), 3),
         }
+        if self._win_wal_drops or self._win_wal_unknown:
+            meta["wal_lost"] = int(self._win_wal_drops)
+            if self._win_wal_unknown:
+                meta["wal_lost_unknown"] = True
         if partial:
             meta["partial"] = True
         if reasons:
@@ -1051,6 +1267,11 @@ class ServeDriver:
         qt = _quarantine_totals(quarantine)
         if qt:
             totals["quarantine"] = qt
+        deg = self.degraded_set()
+        if deg:
+            # the report itself says which non-core subsystems were down
+            # while these counters were earned (volatile for identity)
+            totals["degraded"] = deg
         return totals
 
     def _render_window_obj(self, ep: WindowEpoch) -> dict:
@@ -1075,7 +1296,12 @@ class ServeDriver:
         # early (runtime/devprof.py; the gauges go live next scrape)
         cap = devprof.active_capture()
         if cap is not None:
-            cap.poll()
+            try:
+                cap.poll()
+            except AnalysisError as e:
+                # devprof is non-core: a failed capture parse degrades
+                # the attribution plane, never the ingest it observes
+                self._degrade("devprof", e)
         with obs.span("serve.rotate", window=self.win_id):
             self._flush_inflight()
             meta = self._window_meta(partial=partial)
@@ -1230,6 +1456,9 @@ class ServeDriver:
         qt = _quarantine_totals(q)
         if qt:
             totals["quarantine"] = qt
+        deg = self.degraded_set()
+        if deg:
+            totals["degraded"] = deg
         return pipeline.finalize(
             pipeline.AnalysisState(**self.cum_arrays), self.packed, self.cfg,
             self.cum_tracker, topk=self.topk, totals=totals,
@@ -1288,10 +1517,20 @@ class ServeDriver:
                     "incomplete_windows": list(self.cum_incomplete_windows),
                     "drops": self.drops_restored
                     + int(self.queue.snapshot()["dropped"]),
+                    # seq of the next line to consume: the WAL replay
+                    # cursor a resume starts from (0 when the WAL is off
+                    # — an off->on restart replays nothing, correctly)
+                    "wal_seq": int(self._wal_next),
+                    "wal_lost": int(self.wal_lost_total),
                 }
             },
         )
         ckpt.save(self.scfg.checkpoint_dir or self._default_ckpt_dir(), snap)
+        if self.wal is not None:
+            # the checkpoint now covers every record below _wal_next:
+            # make the spool durable, then release covered segments
+            self.wal.sync()
+            self.wal.gc(self._wal_next)
 
     def _default_ckpt_dir(self) -> str:
         return os.path.join(self.scfg.serve_dir, "ckpt")
@@ -1338,6 +1577,8 @@ class ServeDriver:
             int(w) for w in sv.get("incomplete_windows", [])
         ]
         self.drops_restored = int(sv.get("drops", 0))
+        self._wal_resume_seq = int(sv.get("wal_seq", 0))
+        self.wal_lost_total = int(sv.get("wal_lost", 0))
         for w in sv.get("windows", []):
             meta = w["meta"]
             pfx = f"w{meta['id']:06d}__"
@@ -1689,6 +1930,8 @@ class ServeDriver:
         self.listeners.close()
         if self._watch_thread is not None:
             self._watch_thread.join(timeout=5.0)
+        if self.wal is not None:
+            self.wal.close()
         obs.unregister_sampler("listener")
         obs.unregister_sampler("serve")
 
@@ -1705,6 +1948,7 @@ class ServeDriver:
                 break
             self._maybe_reload()
             self._maybe_autoscale()
+            self._check_metrics_health()
             # wall-clock rotation fires under load too, not just when idle
             if next_rotation is not None and time.monotonic() >= next_rotation:
                 self._rotate()
@@ -1721,6 +1965,11 @@ class ServeDriver:
                 continue
             line = self.queue.pop(timeout=0.1)
             if line is not None:
+                if self.wal is not None:
+                    # durably spool BEFORE window accounting: once this
+                    # returns, a SIGKILL cannot lose the line — resume
+                    # replays it into the same window deterministically
+                    self._wal_next = self.wal.append(line) + 1
                 for ev in self.batcher.push(line):
                     self._consume_event(ev)
                 self.win_pushed += 1

@@ -34,6 +34,18 @@ from . import devprof, faults, obs
 _SENTINEL = object()
 
 
+def _arm_retry(cfg: AnalysisConfig) -> None:
+    """Arm the retry/backoff table for one driver run (DESIGN §19).
+
+    Called at the PUBLIC driver entries, before any source construction
+    — the wire reader's open IO is itself a retry seam, and its attempts
+    must land in this run's freshly-reset counters.
+    """
+    from . import retrypolicy
+
+    retrypolicy.configure(cfg.retry_policy)
+
+
 def chunked(it: Iterable[str], size: int) -> Iterator[list[str]]:
     buf: list[str] = []
     for x in it:
@@ -281,6 +293,7 @@ def run_stream_packed(
     max_chunks: int | None = None,
 ):
     """Analyze pre-packed ``[TUPLE_COLS, n]`` tuple arrays (packed tier)."""
+    _arm_retry(cfg)
     return _run_core(
         packed,
         _PackedSource(arrays),
@@ -506,6 +519,9 @@ def run_stream_wire(
     """
     if isinstance(paths, str):
         paths = [paths]
+    # arm BEFORE the source: the wire reader's open/header IO is itself
+    # a retry seam, and its attempts must land in THIS run's counters
+    _arm_retry(cfg)
     return _run_core(
         packed,
         _WireFileSource(packed, paths),
@@ -751,6 +767,7 @@ def run_stream(
     ``max_chunks`` stops after N chunks (fault-injection in tests; also a
     cheap "analyze a prefix" knob).
     """
+    _arm_retry(cfg)
     return _run_core(
         packed,
         _TextSource(packed, lines),
@@ -804,6 +821,7 @@ def run_stream_file(
     """
     from ..hostside import fastparse
 
+    _arm_retry(cfg)
     if isinstance(paths, str):
         paths = [paths]
     use_native = native if native is not None else fastparse.available()
@@ -916,6 +934,7 @@ def run_stream_file_distributed(
         local_paths = [local_paths]
     from ..hostside.wire import is_wire_file
 
+    _arm_retry(cfg)  # before the source: wire open IO is a retry seam
     n_wire = sum(1 for p in local_paths if is_wire_file(p))
     if n_wire and n_wire < len(local_paths):
         raise AnalysisError(

@@ -1,0 +1,427 @@
+"""Durable serve ingest WAL: segmented, CRC'd on-disk line spool.
+
+The serve checkpoint plane protects *rotated* windows; every line of the
+in-progress window lived only in memory, so a SIGKILL mid-window lost it
+— the interrupted window could never publish.  This module closes that
+gap (DESIGN §19): every line the serve loop consumes appends here BEFORE
+window accounting, and ``serve --resume`` replays the tail past the last
+checkpoint so the interrupted window publishes **bit-identical over its
+delivered lines**.
+
+Design:
+
+- **Segments.**  ``seg-<start_seq>.wal`` files; each holds a 16-byte
+  header (magic + little-endian u64 first-record seq) followed by
+  length-prefixed records (``u32 len | u32 crc32(payload) | payload``).
+  Records are implicitly numbered ``start_seq + index`` — seq arithmetic
+  is what makes every loss *exactly countable*: the records missing
+  between a checkpoint's seq and the first available record is their
+  difference, no side counters to trust.
+
+- **Durability.**  Appends are single ``os.write`` calls on an O_APPEND
+  fd — SIGKILL-safe by construction (the bytes are in the kernel).
+  ``sync()`` fsyncs the open segment for power-loss durability; serve
+  calls it at every ring checkpoint.
+
+- **Bounded disk.**  When live segments exceed ``budget_bytes``, the
+  OLDEST segment is evicted and its record count charged to
+  ``evicted_records`` — an explicit, exact drop class.  A later resume
+  whose checkpoint seq predates the surviving head observes the gap via
+  seq arithmetic and reports it as ``replay_lost`` (never a silent gap).
+
+- **Corruption.**  A record whose CRC fails — or broken framing in a
+  non-final segment — quarantines the segment from that record on: the
+  file is renamed ``*.quarantined``, the remaining records are counted
+  exactly when a successor segment pins the end seq (unknown only for a
+  corrupt FINAL segment's tail), and replay continues with the next
+  segment.  A short record at the very end of the FINAL segment is not
+  corruption: it is the torn tail of the append the kill interrupted,
+  and replay ends cleanly there.
+
+Used by ``runtime/serve.py`` (``serve --wal``); unit-pinned in
+tests/test_wal.py without any device work.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+
+from ..errors import WalQuarantine
+
+MAGIC = b"RAWAL1\x00\x00"  # 8 bytes
+_HDR = struct.Struct("<8sQ")  # magic, start_seq
+_REC = struct.Struct("<II")  # payload len, payload crc32
+HEADER_BYTES = _HDR.size
+#: framing sanity bound: no single syslog line is this big (the listener
+#: tier already drops >1 MiB lines); a larger length word means the
+#: segment's framing is broken, i.e. corruption
+MAX_RECORD_BYTES = 4 << 20
+
+
+def _seg_name(start_seq: int) -> str:
+    return f"seg-{start_seq:020d}.wal"
+
+
+class _Segment:
+    __slots__ = ("path", "start", "count", "bytes")
+
+    def __init__(self, path: str, start: int, count: int, nbytes: int):
+        self.path = path
+        self.start = start
+        self.count = count  # records known to be in the file
+        self.bytes = nbytes
+
+    @property
+    def end(self) -> int:
+        return self.start + self.count
+
+
+class WriteAheadLog:
+    """One serve process's ingest WAL (single-writer, scan-on-open)."""
+
+    def __init__(
+        self,
+        wal_dir: str,
+        *,
+        segment_bytes: int = 1 << 20,
+        budget_bytes: int = 64 << 20,
+    ):
+        if segment_bytes < 4096:
+            raise WalQuarantine(
+                f"wal segment_bytes must be >= 4096, got {segment_bytes}"
+            )
+        if budget_bytes < 2 * segment_bytes:
+            raise WalQuarantine(
+                "wal budget_bytes must be >= 2 * segment_bytes"
+            )
+        self.dir = os.path.abspath(wal_dir)
+        self.segment_bytes = segment_bytes
+        self.budget_bytes = budget_bytes
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+        except OSError as e:
+            raise WalQuarantine(
+                f"cannot create WAL directory {wal_dir!r}: {e}"
+            ) from e
+        self._lock = threading.Lock()
+        self._fd: int | None = None  # open (rolling) segment fd
+        self.appended = 0  # records appended by THIS process
+        self.evicted_segments = 0
+        self.evicted_records = 0
+        #: set by the last replay(): records known lost to eviction /
+        #: quarantine before or during it (exact where seq math allows)
+        self.replay_lost = 0
+        #: True when a corrupt FINAL segment made the tail loss uncountable
+        self.replay_lost_unknown = False
+        self.quarantined: list[str] = []
+        self._segments: list[_Segment] = self._scan()
+        self.next_seq = self._segments[-1].end if self._segments else 0
+
+    # -- scan -------------------------------------------------------------
+    def _scan(self) -> list[_Segment]:
+        """Index existing segments; only the LAST needs a record walk
+        (every earlier segment's count is pinned by its successor's
+        start seq)."""
+        segs: list[_Segment] = []
+        try:
+            names = sorted(
+                n for n in os.listdir(self.dir)
+                if n.startswith("seg-") and n.endswith(".wal")
+            )
+        except OSError as e:
+            raise WalQuarantine(f"cannot scan WAL dir {self.dir!r}: {e}") from e
+        starts = []
+        for n in names:
+            try:
+                starts.append((int(n[4:-4]), n))
+            except ValueError:
+                continue  # foreign file; ignored
+        starts.sort()
+        for i, (start, n) in enumerate(starts):
+            path = os.path.join(self.dir, n)
+            nbytes = os.path.getsize(path)
+            if i + 1 < len(starts):
+                count = starts[i + 1][0] - start
+            else:
+                count = self._count_records(path)
+            segs.append(_Segment(path, start, count, nbytes))
+        return segs
+
+    @staticmethod
+    def _count_records(path: str) -> int:
+        """Record count of the final segment (torn tail tolerated)."""
+        n = 0
+        try:
+            with open(path, "rb") as f:
+                hdr = f.read(HEADER_BYTES)
+                if len(hdr) < HEADER_BYTES or hdr[:8] != MAGIC:
+                    return 0  # quarantined at replay; count unknown
+                while True:
+                    rec = f.read(_REC.size)
+                    if len(rec) < _REC.size:
+                        return n
+                    ln, _crc = _REC.unpack(rec)
+                    if ln > MAX_RECORD_BYTES:
+                        return n  # broken framing; replay quarantines
+                    payload = f.read(ln)
+                    if len(payload) < ln:
+                        return n  # torn tail
+                    n += 1
+        except OSError:
+            return n
+
+    # -- append path ------------------------------------------------------
+    def _open_segment(self) -> None:
+        path = os.path.join(self.dir, _seg_name(self.next_seq))
+        # a leftover zero-record segment (or an unreadable-header file)
+        # may already hold this name; O_APPEND onto it would double the
+        # header, so replace it — it contains no counted records
+        if (
+            self._segments
+            and self._segments[-1].start == self.next_seq
+            and self._segments[-1].count == 0
+        ):
+            self._segments.pop()
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        seg = _Segment(path, self.next_seq, 0, HEADER_BYTES)
+        fd = os.open(seg.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        os.write(fd, _HDR.pack(MAGIC, seg.start))
+        self._fd = fd
+        self._segments.append(seg)
+
+    def append(self, line: str) -> int:
+        """Durably spool one line; returns its seq (kernel-durable: one
+        O_APPEND write — a SIGKILL after return cannot lose it)."""
+        payload = line.encode("utf-8", errors="replace")
+        rec = _REC.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        with self._lock:
+            cur = self._segments[-1] if self._segments else None
+            if (
+                self._fd is None
+                or cur is None
+                or cur.bytes + len(rec) > self.segment_bytes
+            ):
+                self._roll()
+                cur = self._segments[-1]
+            seq = self.next_seq
+            os.write(self._fd, rec)
+            cur.count += 1
+            cur.bytes += len(rec)
+            self.next_seq = seq + 1
+            self.appended += 1
+            self._evict_over_budget()
+        return seq
+
+    def _roll(self) -> None:
+        if self._fd is not None:
+            try:
+                os.fsync(self._fd)
+            except OSError:
+                pass
+            os.close(self._fd)
+            self._fd = None
+        self._open_segment()
+
+    def _evict_over_budget(self) -> None:
+        total = sum(s.bytes for s in self._segments)
+        while total > self.budget_bytes and len(self._segments) > 1:
+            victim = self._segments.pop(0)
+            total -= victim.bytes
+            self.evicted_segments += 1
+            self.evicted_records += victim.count
+            try:
+                os.unlink(victim.path)
+            except OSError:
+                pass
+            from . import obs
+
+            obs.instant("wal.evict", args={
+                "segment": os.path.basename(victim.path),
+                "records": victim.count,
+            })
+
+    def sync(self) -> None:
+        """fsync the rolling segment (power-loss durability point)."""
+        with self._lock:
+            if self._fd is not None:
+                try:
+                    os.fsync(self._fd)
+                except OSError:
+                    pass
+
+    def gc(self, upto_seq: int) -> int:
+        """Drop segments wholly below ``upto_seq`` (checkpoint-covered).
+
+        Returns the records released.  The rolling segment never drops.
+        """
+        freed = 0
+        with self._lock:
+            while len(self._segments) > 1 and self._segments[0].end <= upto_seq:
+                seg = self._segments.pop(0)
+                freed += seg.count
+                try:
+                    os.unlink(seg.path)
+                except OSError:
+                    pass
+        return freed
+
+    # -- replay path ------------------------------------------------------
+    def replay(self, from_seq: int):
+        """Yield ``(seq, line)`` for every record with seq >= from_seq.
+
+        Loss accounting lands on the instance afterwards: ``replay_lost``
+        counts records known missing (evicted head gap + quarantined
+        remainders pinned by a successor's start seq);
+        ``replay_lost_unknown`` flags a corrupt FINAL segment whose tail
+        count nothing pins.  CRC/framing corruption quarantines the
+        segment (renamed ``*.quarantined``) and replay continues — never
+        a crash, never a silent gap.
+        """
+        self.replay_lost = 0
+        self.replay_lost_unknown = False
+        segs = list(self._segments)
+        if not segs:
+            return
+        if from_seq < segs[0].start:
+            # evicted-head gap: exactly this many records are gone
+            self.replay_lost += segs[0].start - from_seq
+            from_seq = segs[0].start
+        for i, seg in enumerate(segs):
+            end = segs[i + 1].start if i + 1 < len(segs) else None
+            if end is not None and end <= from_seq:
+                continue
+            yield from self._replay_segment(seg, from_seq, end)
+
+    def _replay_segment(self, seg: _Segment, from_seq: int, end: int | None):
+        try:
+            f = open(seg.path, "rb")
+        except OSError:
+            self._quarantine(
+                seg, max(seg.start, from_seq), end, "unreadable",
+                countable_final=True,  # the open-time scan counted it
+            )
+            return
+        with f:
+            hdr = f.read(HEADER_BYTES)
+            if len(hdr) < HEADER_BYTES or hdr[:8] != MAGIC or (
+                _HDR.unpack(hdr)[1] != seg.start
+            ):
+                self._quarantine(
+                    seg, max(seg.start, from_seq), end, "bad segment header"
+                )
+                return
+            seq = seg.start
+            while True:
+                rec = f.read(_REC.size)
+                if len(rec) < _REC.size:
+                    if end is not None and (rec or seq < end):
+                        # mid-chain framing damage or a short segment
+                        # whose successor pins more records than it holds
+                        self._quarantine(
+                            seg, max(seq, from_seq), end, "truncated record"
+                        )
+                    return  # clean end / torn tail of the final segment
+                ln, crc = _REC.unpack(rec)
+                if ln > MAX_RECORD_BYTES:
+                    self._quarantine(
+                        seg, max(seq, from_seq), end, "absurd record length"
+                    )
+                    return
+                payload = f.read(ln)
+                if len(payload) < ln:
+                    if end is not None:
+                        self._quarantine(
+                            seg, max(seq, from_seq), end, "truncated payload"
+                        )
+                    return  # torn tail of the final segment
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    # CRC damage leaves the framing intact, so the scan's
+                    # record count still pins the final segment's loss
+                    self._quarantine(
+                        seg, max(seq, from_seq), end, "record CRC mismatch",
+                        countable_final=True,
+                    )
+                    return
+                if seq >= from_seq:
+                    yield seq, payload.decode("utf-8", errors="replace")
+                seq += 1
+
+    def _note_lost(self, seg: _Segment, from_seq: int, end: int | None,
+                   why: str, countable_final: bool) -> None:
+        if end is not None:
+            self.replay_lost += max(0, end - from_seq)
+        elif countable_final and seg.count:
+            # final segment with intact framing: the open-time scan's
+            # record count pins the loss exactly
+            self.replay_lost += max(0, seg.end - from_seq)
+        else:
+            self.replay_lost_unknown = True
+        from . import obs
+
+        obs.instant("wal.quarantine", args={
+            "segment": os.path.basename(seg.path), "reason": why,
+            "lost_from_seq": from_seq,
+        })
+
+    def _quarantine(self, seg: _Segment, from_seq: int, end: int | None,
+                    why: str, countable_final: bool = False) -> None:
+        """Typed quarantine: rename the damaged segment aside, count the
+        loss where seq math pins it, keep replaying the successors."""
+        self._note_lost(seg, from_seq, end, why, countable_final)
+        qpath = seg.path + ".quarantined"
+        try:
+            os.replace(seg.path, qpath)
+        except OSError:
+            qpath = seg.path  # rename failed; leave in place, still counted
+        self.quarantined.append(os.path.basename(qpath))
+        with self._lock:
+            if seg in self._segments:
+                self._segments.remove(seg)
+            if not self._segments:
+                # the writer must not append into a quarantined chain
+                self._fd = None
+
+    # -- lifecycle --------------------------------------------------------
+    def reset(self) -> None:
+        """Delete every segment (a fresh, non-resume serve run starts a
+        fresh log — stale spool from a previous analysis must not grow
+        the dir forever)."""
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+            for seg in self._segments:
+                try:
+                    os.unlink(seg.path)
+                except OSError:
+                    pass
+            self._segments = []
+            self.next_seq = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "next_seq": self.next_seq,
+                "appended": self.appended,
+                "segments": len(self._segments),
+                "bytes": int(sum(s.bytes for s in self._segments)),
+                "evicted_segments": self.evicted_segments,
+                "evicted_records": self.evicted_records,
+                "quarantined": list(self.quarantined),
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                try:
+                    os.fsync(self._fd)
+                except OSError:
+                    pass
+                os.close(self._fd)
+                self._fd = None

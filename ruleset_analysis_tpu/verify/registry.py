@@ -13,7 +13,14 @@ sites, and nothing structural kept them in sync until now:
 - **VOLATILE totals keys** (``runtime/report.py::VOLATILE_TOTALS`` —
   the keys report-identity tests strip) <-> the runtime code that
   actually produces those totals (a volatile key nothing produces is
-  dead weight; a test module keeping its own private list can drift).
+  dead weight; a test module keeping its own private list can drift);
+- **retry sites** (``runtime/retrypolicy.py::RETRY_SITES``) <-> the
+  policy table <-> ``retrypolicy.call()`` call sites <-> chaos
+  coverage: every registered seam must have a policy entry, a
+  transient chaos schedule (``fault_site@N:k`` with single-digit k —
+  below the attempt bound, so the schedule proves RECOVERY), and a
+  permanent-escalation test (``fault_site@N:kk`` with k >= 10 — past
+  any attempt bound, so the schedule proves the typed escalation).
 
 Pure stdlib + argparse introspection: no device, no jax import beyond
 what ``cli`` itself pulls in.
@@ -171,6 +178,75 @@ def audit_volatile(root: str | None = None) -> list[AuditFinding]:
     return findings
 
 
+_RETRY_CALL_RE = re.compile(
+    r"""retrypolicy\.call\(\s*\n?\s*["']([a-z0-9_.]+)["']"""
+)
+
+
+def audit_retry(root: str | None = None) -> list[AuditFinding]:
+    """RETRY_SITES <-> policy table <-> call sites <-> chaos coverage.
+
+    The chaos-coverage convention is positional in the schedule string:
+    ``site@N:k`` with a SINGLE-digit k is a transient schedule (k below
+    every attempt bound — the harness asserts recovery + bit-identity),
+    while k with two or more digits (the suites use ``:99``) is a
+    budget-exhaustion schedule (the harness asserts the escalation
+    stays typed).  Tests therefore declare their schedules as literal
+    strings; this audit greps for them.
+    """
+    from ..runtime.faults import SITES
+    from ..runtime.retrypolicy import DEFAULT_POLICIES, RETRY_SITES
+
+    root = _repo_root(root)
+    findings: list[AuditFinding] = []
+    called: set[str] = set()
+    for path in _py_files(root, "ruleset_analysis_tpu"):
+        if path.endswith(os.path.join("runtime", "retrypolicy.py")):
+            continue
+        for m in _RETRY_CALL_RE.finditer(_read(path)):
+            called.add(m.group(1))
+    tests_text = "".join(_read(p) for p in _py_files(root, "tests"))
+    for site, meta in sorted(RETRY_SITES.items()):
+        if site not in DEFAULT_POLICIES:
+            findings.append(AuditFinding(
+                "retry", "site-without-policy", site,
+                "RETRY_SITES entry has no DEFAULT_POLICIES row",
+            ))
+        if site not in called:
+            findings.append(AuditFinding(
+                "retry", "registered-never-called", site,
+                "no retrypolicy.call() site names this registered seam",
+            ))
+        if meta.fault_site not in SITES:
+            findings.append(AuditFinding(
+                "retry", "fault-site-unregistered", site,
+                f"maps to fault site {meta.fault_site!r} missing from "
+                "faults.SITES",
+            ))
+        fs = re.escape(meta.fault_site)
+        if not re.search(fs + r"@\d+:[1-9](?!\d)", tests_text):
+            findings.append(AuditFinding(
+                "retry", "no-transient-schedule", site,
+                f"no test schedules {meta.fault_site}@N:k (single-digit "
+                "k) — the recovery half of the seam is untested",
+            ))
+        if not re.search(fs + r"@\d+:\d{2,}", tests_text):
+            findings.append(AuditFinding(
+                "retry", "no-escalation-test", site,
+                f"no test schedules {meta.fault_site}@N:kk (k >= 10) — "
+                "budget exhaustion escalating typed is untested",
+            ))
+    for site in sorted(called - set(RETRY_SITES)):
+        findings.append(AuditFinding(
+            "retry", "called-unregistered", site,
+            "retrypolicy.call() names a site missing from RETRY_SITES",
+        ))
+    return findings
+
+
 def audit_registry(root: str | None = None) -> list[AuditFinding]:
-    """All three audits, in declaration order."""
-    return audit_faults(root) + audit_cli(root) + audit_volatile(root)
+    """All four audits, in declaration order."""
+    return (
+        audit_faults(root) + audit_cli(root) + audit_volatile(root)
+        + audit_retry(root)
+    )

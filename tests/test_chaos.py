@@ -348,6 +348,7 @@ def test_fault_plan_round_trip_every_registered_site():
         for at in (1, 3):
             plan = faults.FaultPlan.parse(f"{site}@{at}")
             assert plan.specs[site].at == at
+            assert plan.specs[site].count == 1
             assert faults.FaultPlan.parse(plan.to_str()).to_str() == plan.to_str()
     multi = faults.FaultPlan.parse(
         "ingest.producer.raise@2,stream.wire.corrupt@1,seed=9"
@@ -355,6 +356,24 @@ def test_fault_plan_round_trip_every_registered_site():
     assert set(multi.specs) == {"ingest.producer.raise", "stream.wire.corrupt"}
     assert multi.seed == 9
     assert faults.FaultPlan.parse(multi.to_str()).to_str() == multi.to_str()
+
+
+def test_fault_plan_transient_grammar_round_trip():
+    """``site@N:k`` (ISSUE 14): fire k consecutive hits, then clear."""
+    plan = faults.FaultPlan.parse("stream.device_put.fail@2:3,seed=4")
+    spec = plan.specs["stream.device_put.fail"]
+    assert (spec.at, spec.count) == (2, 3)
+    assert [spec.fires_on(n) for n in range(1, 7)] == [
+        False, True, True, True, False, False,
+    ]
+    assert plan.to_str() == "stream.device_put.fail@2:3,seed=4"
+    assert faults.FaultPlan.parse(plan.to_str()).to_str() == plan.to_str()
+    # single-shot stays the historical serialization (no ':1' noise)
+    assert faults.FaultPlan.parse("listener.drop@5:1").to_str() == "listener.drop@5"
+    with pytest.raises(AnalysisError, match=">= 1"):
+        faults.FaultPlan.parse("listener.drop@5:0")
+    with pytest.raises(AnalysisError, match="site@N"):
+        faults.FaultPlan.parse("listener.drop@5:x")
 
 
 def test_fault_plan_rejects_unknown_site_and_bad_hit():
@@ -750,3 +769,105 @@ def test_chaos_soak_elastic_heartbeat_drop(elastic_corpus, tmp_path_factory):
         (e["firewall"], e["acl"], e["index"]): e["hits"] for e in r["per_rule"]
     }
     assert hits(rep) == hits(ref) and rep["unused"] == ref["unused"]
+
+
+# ---------------------------------------------------------------------------
+# Durable-WAL chaos (ISSUE 14): a seeded hard abort mid-window (a
+# persistently-failing device_put past the retry budget — the on-disk
+# state a SIGKILL leaves) followed by serve --resume.  Invariant: the
+# interrupted window's delivered lines replay from the spool and publish
+# bit-identical, with zero unaccounted drops.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0])
+def test_chaos_serve_wal_hard_abort_resume(seed, serve_chaos_corpus, tmp_path):
+    import threading
+
+    from ruleset_analysis_tpu.config import ServeConfig
+    from ruleset_analysis_tpu.runtime.serve import ServeDriver, window_incomplete
+    from ruleset_analysis_tpu.runtime.stream import run_stream
+    from ruleset_analysis_tpu.runtime.wal import WriteAheadLog
+
+    packed, prefix, lines = serve_chaos_corpus
+    rng = random.Random(seed)
+    # window 0 = 3 full 32-line chunks + a rotation flush = 4 hits; the
+    # seeded hit lands in window 1's chunks, :99 exhausts the budget
+    at = 5 + rng.randrange(2)
+    cfg = _cfg(0, "flat", 0, str(tmp_path / "ck")).replace(
+        batch_size=32, fault_plan=f"stream.device_put.fail@{at}:99"
+    )
+    scfg = ServeConfig(
+        listen=("tcp:127.0.0.1:0",), window_lines=SERVE_W, ring=4,
+        serve_dir=str(tmp_path / "serve"), stop_after_sec=60,
+        reload_watch=False, checkpoint_every_windows=1, http="off",
+        queue_lines=10_000, wal=True,
+    )
+
+    def spin(drv, out):
+        def runner():
+            try:
+                out["summary"] = drv.run()
+            except BaseException as e:
+                out["error"] = e
+        th = threading.Thread(target=runner)
+        th.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and not (
+            "error" in out or drv.listeners.alive()
+        ):
+            time.sleep(0.05)
+        return th
+
+    out: dict = {}
+    drv = ServeDriver(prefix, cfg, scfg, topk=5)
+    th = spin(drv, out)
+    import socket
+
+    s = socket.create_connection(drv.listeners.listeners[0].address)
+    s.sendall(("\n".join(lines[:180]) + "\n").encode())
+    s.close()
+    th.join(timeout=120)
+    assert not th.is_alive(), f"seed {seed}: serve HUNG"
+    assert isinstance(out.get("error"), AnalysisError), out
+    assert drv.windows_published == 1
+
+    wal = WriteAheadLog(os.path.join(scfg.serve_dir, "wal"))
+    delivered = [ln for _s, ln in wal.replay(SERVE_W)]
+    wal.close()
+    assert delivered == lines[SERVE_W:SERVE_W + len(delivered)]
+
+    out2: dict = {}
+    drv2 = ServeDriver(
+        prefix, _cfg(0, "flat", 0, str(tmp_path / "ck")).replace(
+            batch_size=32, resume=True
+        ), scfg, topk=5,
+    )
+    th2 = spin(drv2, out2)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and drv2.wal_replayed != len(delivered):
+        time.sleep(0.05)
+    drv2.stop()
+    th2.join(timeout=120)
+    assert not th2.is_alive() and "error" not in out2, out2.get("error")
+    summary = out2["summary"]
+    assert summary["wal"]["replayed"] == len(delivered)
+    assert summary["wal"]["lost"] == 0
+    base_cfg = _cfg(0, "flat", 0, str(tmp_path / "ckb")).replace(batch_size=32)
+    for wid, seg in ((0, lines[:SERVE_W]), (1, delivered)):
+        with open(
+            os.path.join(scfg.serve_dir, f"window-{wid:06d}.json"),
+            encoding="utf-8",
+        ) as f:
+            rep = json.load(f)
+        got = report_image(rep)
+        want = report_image(run_stream(packed, iter(seg), base_cfg, topk=5))
+        got["totals"].pop("window", None)
+        want["totals"].pop("window", None)
+        assert got == want, f"seed {seed}: window {wid} diverged after replay"
+        inc = window_incomplete(rep)
+        # zero unaccounted drops: any marker must claim no loss
+        assert inc is None or (
+            inc["drops"] == 0 and "wal_lost" not in inc["reasons"]
+        ), inc
+    assert summary["drops"] == 0

@@ -720,8 +720,10 @@ def test_reload_flush_step_failure_aborts_typed(reload_corpus, tmp_path):
     pack.save_packed(old_packed, prefix)
     # hits 1+2 are window 0's 128-line chunk + its 22-line rotation
     # flush; hit 3 is the reload flush of the 100 in-flight window-1
-    # lines (100 < batch 128, so no chunk boundary fires in between)
-    cfg = serve_cfg(fault_plan="stream.device_put.fail@3")
+    # lines (100 < batch 128, so no chunk boundary fires in between).
+    # :99 makes the fault PERSISTENT past the device_put retry budget —
+    # a single fire would now be absorbed by the retry engine.
+    cfg = serve_cfg(fault_plan="stream.device_put.fail@3:99")
     scfg = ServeConfig(
         listen=("tcp:127.0.0.1:0",),
         window_lines=150,
@@ -871,3 +873,266 @@ def test_serve_cli_tail_roundtrip(tmp_path):
     rep = json.load(open(os.path.join(serve_dir, "window-000000.json")))
     assert rep["totals"]["lines_total"] == 100
     assert rep["totals"]["window"]["id"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Durable ingest WAL (ISSUE 14 / DESIGN §19): a hard abort mid-window
+# loses NOTHING that was consumed — serve --resume replays the spool
+# tail and the interrupted window publishes bit-identical over its
+# delivered lines.  The seeded chaos variant lives in test_chaos.py.
+# ---------------------------------------------------------------------------
+
+
+def test_wal_resume_after_hard_abort_bit_identical(corpus, tmp_path):
+    from ruleset_analysis_tpu.runtime.wal import WriteAheadLog
+
+    packed, prefix, lines, _td = corpus
+    lines = lines[:150]  # v4 prefix of the corpus
+    serve_dir = str(tmp_path / "serve")
+    # batch 32 so window 1 dispatches a chunk mid-window; hit 5 is that
+    # chunk (window 0 = 3 full chunks + 1 rotation-flush chunk), and
+    # :99 keeps failing past the device_put retry budget -> the run
+    # dies TYPED mid-window-1 with no graceful shutdown accounting —
+    # the in-process stand-in for a SIGKILL (same on-disk state)
+    cfg = serve_cfg(
+        batch_size=32, fault_plan="stream.device_put.fail@5:99"
+    )
+    scfg = ServeConfig(
+        listen=("tcp:127.0.0.1:0",), window_lines=100, ring=4,
+        serve_dir=serve_dir, stop_after_sec=60, reload_watch=False,
+        checkpoint_every_windows=1, http="off", queue_lines=10_000,
+        wal=True,
+    )
+    drv, th, out = start_serve(prefix, cfg, scfg)
+    send_tcp(drv.listeners.listeners[0].address, lines)
+    th.join(timeout=120)
+    assert not th.is_alive(), "serve hung"
+    assert isinstance(out.get("error"), InjectedFault), out
+    assert drv.windows_published == 1  # window 0 landed + checkpointed
+
+    # ground truth: what the spool durably holds past the checkpoint
+    wal = WriteAheadLog(os.path.join(serve_dir, "wal"))
+    delivered = [line for _seq, line in wal.replay(100)]
+    wal.close()
+    assert delivered, "window 1 consumed lines before the abort"
+    assert delivered == lines[100:100 + len(delivered)]  # prefix, no gap
+
+    # resume: replay the tail, then stop gracefully -> the interrupted
+    # window publishes over exactly the delivered lines
+    cfg2 = serve_cfg(batch_size=32, resume=True)
+    drv2, th2, out2 = start_serve(prefix, cfg2, scfg)
+    try:
+        wait_for(
+            lambda: getattr(drv2, "wal_replayed", 0) == len(delivered),
+            60, "wal replay",
+        )
+    finally:
+        drv2.stop()
+        summary = finish(th2, out2)
+    assert summary["wal"]["replayed"] == len(delivered)
+    assert summary["wal"]["lost"] == 0 and not summary["wal"]["lost_unknown"]
+    assert summary["windows_published"] == 2
+    base_cfg = serve_cfg(batch_size=32)
+    # window 0: restored history, bit-identical to offline lines[:100]
+    w0 = json.load(open(os.path.join(serve_dir, "window-000000.json")))
+    want0 = image(run_stream(packed, iter(lines[:100]), base_cfg, topk=10))
+    assert image(w0) == want0
+    # window 1: the interrupted window, REPLAYED — bit-identical over
+    # the delivered lines, with no incomplete marker (nothing was lost)
+    w1 = json.load(open(os.path.join(serve_dir, "window-000001.json")))
+    want1 = image(run_stream(packed, iter(delivered), base_cfg, topk=10))
+    assert image(w1) == want1
+    # the stop-time partial window may carry the usual shutdown marker
+    # (ingress closes before the final rotate) — but it must claim ZERO
+    # loss: nothing dropped, nothing wal_lost (full replay)
+    inc = window_incomplete(w1)
+    if inc is not None:
+        assert inc["drops"] == 0 and "wal_lost" not in inc["reasons"], inc
+    assert summary["drops"] == 0
+
+
+def test_wal_eviction_gap_marks_window_incomplete(corpus, tmp_path):
+    """A resume whose checkpoint seq predates the surviving WAL head
+    (budget eviction while down) publishes the replayed window with the
+    wal_lost incomplete reason and the EXACT gap count."""
+    from ruleset_analysis_tpu.runtime.wal import WriteAheadLog
+
+    packed, prefix, lines, _td = corpus
+    lines = lines[:80]
+    serve_dir = str(tmp_path / "serve")
+    scfg = ServeConfig(
+        listen=("tcp:127.0.0.1:0",), window_lines=100, ring=4,
+        serve_dir=serve_dir, stop_after_sec=60, reload_watch=False,
+        checkpoint_every_windows=1, http="off", queue_lines=10_000,
+        wal=True, wal_segment_bytes=4096, wal_budget_bytes=8192,
+    )
+    cfg = serve_cfg(batch_size=32, fault_plan="stream.device_put.fail@1:99")
+    drv, th, out = start_serve(prefix, cfg, scfg)
+    send_tcp(drv.listeners.listeners[0].address, lines)
+    th.join(timeout=120)
+    assert isinstance(out.get("error"), InjectedFault), out
+
+    # simulate eviction while down: the tiny budget already evicted, or
+    # we force it by appending junk traffic directly to the spool
+    wal = WriteAheadLog(
+        os.path.join(serve_dir, "wal"), segment_bytes=4096, budget_bytes=8192
+    )
+    consumed = wal.next_seq
+    for i in range(400):  # push the spool far past its budget
+        wal.append(f"evict-filler {i} {'x' * 80}")
+    assert wal.evicted_records > 0
+    wal.close()
+
+    cfg2 = serve_cfg(batch_size=32, resume=True)
+    drv2, th2, out2 = start_serve(prefix, cfg2, scfg)
+    try:
+        wait_for(
+            lambda: getattr(drv2, "wal_replayed", 0) > 0, 60, "wal replay"
+        )
+    finally:
+        drv2.stop()
+        summary = finish(th2, out2)
+    w = summary["wal"]
+    assert w["lost"] > 0 and not w["lost_unknown"]
+    # exact accounting: replayed + lost == everything ever spooled
+    assert w["replayed"] + w["lost"] == consumed + 400
+    w0 = json.load(open(os.path.join(serve_dir, "window-000000.json")))
+    inc = window_incomplete(w0)
+    assert inc and "wal_lost" in inc["reasons"]
+    assert inc["drops"] >= w["lost"]
+
+
+# ---------------------------------------------------------------------------
+# Degraded-mode serving (ISSUE 14): non-core failures mark the service
+# degraded — /health enumerates the set, reports carry totals.degraded,
+# recovery re-arms — while ingest keeps serving.
+# ---------------------------------------------------------------------------
+
+
+def test_publisher_degrades_and_recovers(corpus, tmp_path):
+    """serve.publish.fail@1:99 (past the retry budget): disk publication
+    degrades, in-memory endpoints keep serving, and the next successful
+    write re-arms the publisher."""
+    from ruleset_analysis_tpu.runtime import faults
+
+    packed, prefix, lines, _td = corpus
+    lines = lines[:200]
+    scfg = ServeConfig(
+        listen=("tcp:127.0.0.1:0",), window_lines=100, ring=4,
+        serve_dir=str(tmp_path / "serve"), stop_after_sec=60,
+        reload_watch=False, checkpoint_every_windows=0, http="off",
+        queue_lines=10_000,
+    )
+    cfg = serve_cfg(fault_plan="serve.publish.fail@1:99")
+    drv, th, out = start_serve(prefix, cfg, scfg)
+    try:
+        addr = drv.listeners.listeners[0].address
+        send_tcp(addr, lines[:100])
+        wait_for(lambda: drv.windows_published >= 1, 60, "window 0")
+        # degraded, not dead: the window exists in memory, not on disk
+        assert "publisher" in drv.health()["degraded_subsystems"]
+        assert drv.window_report(0) is not None
+        assert not os.path.exists(
+            os.path.join(scfg.serve_dir, "window-000000.json")
+        )
+        # ingest is alive and the window report carries totals.degraded
+        assert drv.window_report(0)["totals"]["degraded"] == ["publisher"]
+        # the fault clears (transient outage ends): next publish re-arms
+        faults.disarm()
+        send_tcp(addr, lines[100:200])
+        wait_for(lambda: drv.windows_published >= 2, 60, "window 1")
+        wait_for(
+            lambda: "publisher" not in drv.health()["degraded_subsystems"],
+            30, "publisher recovery",
+        )
+        assert os.path.exists(
+            os.path.join(scfg.serve_dir, "window-000001.json")
+        )
+    finally:
+        drv.stop()
+        summary = finish(th, out)
+    assert summary["degraded"] == []
+    assert summary["degraded_events"] >= 1
+    assert summary["recovered_events"] >= 1
+
+
+def test_publisher_transient_retry_recovers_silently(corpus, tmp_path):
+    """serve.publish.fail@2:2 (below the attempt bound): the retry
+    absorbs the burst — files land on disk, nothing ever degrades."""
+    from ruleset_analysis_tpu.runtime import retrypolicy
+
+    packed, prefix, lines, _td = corpus
+    scfg = ServeConfig(
+        listen=("tcp:127.0.0.1:0",), window_lines=100, ring=4,
+        serve_dir=str(tmp_path / "serve"), stop_after_sec=60,
+        reload_watch=False, checkpoint_every_windows=0, http="off",
+        queue_lines=10_000,
+    )
+    cfg = serve_cfg(fault_plan="serve.publish.fail@2:2")
+    drv, th, out = start_serve(prefix, cfg, scfg)
+    try:
+        send_tcp(drv.listeners.listeners[0].address, lines[:100])
+        wait_for(lambda: drv.windows_published >= 1, 60, "window 0")
+    finally:
+        drv.stop()
+        summary = finish(th, out)
+    assert summary["degraded"] == []
+    assert summary["retry"].get("serve.publish", {}).get("recoveries", 0) >= 1
+    assert os.path.exists(os.path.join(scfg.serve_dir, "window-000000.json"))
+
+
+def test_degraded_static_and_metrics_recover(corpus, tmp_path):
+    """Injected static-analysis + metrics failures leave ingest serving:
+    /health enumerates the degraded set, window reports carry
+    totals.degraded, and recovery (fault clears; reload re-analyzes)
+    re-arms both subsystems."""
+    from ruleset_analysis_tpu.runtime import faults, obs
+
+    packed, prefix, lines, _td = corpus
+    lines = lines[:200]
+    scfg = ServeConfig(
+        listen=("tcp:127.0.0.1:0",), window_lines=100, ring=4,
+        serve_dir=str(tmp_path / "serve"), stop_after_sec=90,
+        reload_watch=False, checkpoint_every_windows=0, http="off",
+        queue_lines=10_000, static_analysis=True,
+    )
+    cfg = serve_cfg(
+        fault_plan="analyze.tile@1,metrics.snapshot.fail@1:99"
+    )
+    obs.start_metrics(str(tmp_path / "m.jsonl"), every_sec=0.05)
+    try:
+        drv, th, out = start_serve(prefix, cfg, scfg)
+        try:
+            addr = drv.listeners.listeners[0].address
+            send_tcp(addr, lines[:100])
+            wait_for(lambda: drv.windows_published >= 1, 60, "window 0")
+            wait_for(
+                lambda: {"static_analysis", "metrics"}
+                <= set(drv.health()["degraded_subsystems"]),
+                30, "degraded set",
+            )
+            h = drv.health()
+            assert h["status"] == "degraded"
+            # ingest kept serving: the window report exists and says
+            # which subsystems were down while it was earned
+            w0 = drv.window_report(0)
+            assert w0 is not None
+            assert set(w0["totals"]["degraded"]) >= {"static_analysis"}
+            assert drv.published("static") is None  # no partial table, ever
+            # recovery: the faults clear; a reload re-analyzes (static)
+            # and the snapshotter's next clean tick re-arms (metrics)
+            faults.disarm()
+            drv.request_reload()
+            wait_for(
+                lambda: not drv.health()["degraded_subsystems"],
+                60, "recovery re-arms",
+            )
+            assert drv.published("static") is not None
+        finally:
+            drv.stop()
+            summary = finish(th, out)
+    finally:
+        obs.shutdown(merge=False)
+    assert summary["degraded"] == []
+    assert summary["degraded_events"] >= 2
+    assert summary["recovered_events"] >= 2

@@ -1,0 +1,195 @@
+"""Durable serve ingest WAL (runtime/wal.py, ISSUE 14 / DESIGN §19).
+
+Pure host-side unit pins — no jax, no device.  The serve-level story
+(hard-kill resume replaying the interrupted window bit-identical) lives
+in tests/test_serve.py and a seeded chaos schedule in test_chaos.py;
+this file pins the on-disk format's three load-bearing properties:
+
+- **round trip**: append N, replay from any seq -> exactly the suffix,
+  in order, byte-identical;
+- **budget eviction**: disk stays bounded and the eviction loss is
+  EXACTLY countable at replay via seq arithmetic (no side counters);
+- **corruption**: a CRC/framing-damaged segment is a typed quarantine
+  (renamed aside, exact loss count where a successor pins it, replay
+  continues) — never a crash, never a silent gap.
+"""
+
+import os
+import struct
+
+from ruleset_analysis_tpu.runtime import wal as wal_mod
+from ruleset_analysis_tpu.runtime.wal import HEADER_BYTES, WriteAheadLog
+
+
+def _fill(d, n, *, segment=4096, budget=1 << 20, width=100):
+    w = WriteAheadLog(str(d), segment_bytes=segment, budget_bytes=budget)
+    for i in range(n):
+        assert w.append(f"{'x' * width} {i}") == i
+    w.close()
+    return w
+
+
+def _segments(d):
+    return sorted(n for n in os.listdir(d) if n.endswith(".wal"))
+
+
+def test_round_trip_and_suffix_replay(tmp_path):
+    _fill(tmp_path, 50)
+    w = WriteAheadLog(str(tmp_path), segment_bytes=4096, budget_bytes=1 << 20)
+    assert w.next_seq == 50  # scan-on-open recovers the append cursor
+    for start in (0, 17, 49, 50):
+        got = list(w.replay(start))
+        assert [s for s, _ in got] == list(range(start, 50))
+        assert all(line == f"{'x' * 100} {s}" for s, line in got)
+        assert w.replay_lost == 0 and not w.replay_lost_unknown
+    w.close()
+
+
+def test_append_resumes_after_reopen(tmp_path):
+    _fill(tmp_path, 10)
+    w = WriteAheadLog(str(tmp_path), segment_bytes=4096, budget_bytes=1 << 20)
+    assert w.append("late line") == 10
+    w.close()
+    w2 = WriteAheadLog(str(tmp_path), segment_bytes=4096, budget_bytes=1 << 20)
+    got = list(w2.replay(9))
+    assert [s for s, _ in got] == [9, 10]
+    assert got[-1][1] == "late line"
+    w2.close()
+
+
+def test_torn_tail_is_clean_end_not_corruption(tmp_path):
+    """A SIGKILL mid-append leaves a short final record: replay must end
+    cleanly there (the interrupted append never 'happened'), with zero
+    loss and zero quarantine."""
+    _fill(tmp_path, 20)
+    last = os.path.join(str(tmp_path), _segments(tmp_path)[-1])
+    with open(last, "ab") as f:
+        f.write(struct.pack("<II", 40, 0) + b"only-part-of")  # torn record
+    w = WriteAheadLog(str(tmp_path), segment_bytes=4096, budget_bytes=1 << 20)
+    assert w.next_seq == 20  # the torn record does not count
+    got = list(w.replay(0))
+    assert [s for s, _ in got] == list(range(20))
+    assert w.replay_lost == 0 and not w.quarantined
+    w.close()
+
+
+def test_budget_eviction_exact_drop_accounting(tmp_path):
+    """Disk budget eviction: bytes stay bounded, and the replay-visible
+    gap equals the evicted record count EXACTLY (seq arithmetic, the
+    acceptance criterion's 'exact drop accounting')."""
+    w = _fill(tmp_path, 500, segment=4096, budget=8192)
+    assert w.evicted_segments > 0
+    st = w.stats()
+    assert st["bytes"] <= 8192
+    w2 = WriteAheadLog(str(tmp_path), segment_bytes=4096, budget_bytes=8192)
+    got = list(w2.replay(0))
+    first = got[0][0]
+    # the gap [0, first) is exactly the evicted records — nothing else
+    assert w2.replay_lost == first == w.evicted_records
+    assert [s for s, _ in got] == list(range(first, 500))
+    w2.close()
+
+
+def test_crc_corruption_quarantines_segment_exact_loss(tmp_path):
+    """Flip one payload byte mid-chain: that segment quarantines from
+    the damaged record on (renamed *.quarantined), the loss is pinned
+    exactly by the successor's start seq, and replay CONTINUES with the
+    next segment — replayed + lost == appended."""
+    _fill(tmp_path, 300)
+    segs = _segments(tmp_path)
+    assert len(segs) >= 3
+    victim = os.path.join(str(tmp_path), segs[1])
+    with open(victim, "r+b") as f:
+        f.seek(HEADER_BYTES + 8 + 20)  # into record 0's payload
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    w = WriteAheadLog(str(tmp_path), segment_bytes=4096, budget_bytes=1 << 20)
+    got = list(w.replay(0))
+    assert len(got) + w.replay_lost == 300
+    assert w.replay_lost > 0 and not w.replay_lost_unknown
+    assert w.quarantined == [segs[1] + ".quarantined"]
+    assert os.path.exists(victim + ".quarantined")
+    assert not os.path.exists(victim)
+    # the surviving seqs are a prefix + a suffix with ONE gap — never a
+    # silently renumbered stream
+    seqs = [s for s, _ in got]
+    gaps = [
+        (a, b) for a, b in zip(seqs, seqs[1:]) if b != a + 1
+    ]
+    assert len(gaps) == 1
+    a, b = gaps[0]
+    assert b - a - 1 == w.replay_lost
+    w.close()
+
+
+def test_final_segment_crc_damage_is_countable(tmp_path):
+    """CRC damage in the FINAL segment leaves framing intact, so the
+    open-time scan still pins the exact loss (no 'unknown')."""
+    _fill(tmp_path, 300)
+    segs = _segments(tmp_path)
+    victim = os.path.join(str(tmp_path), segs[-1])
+    with open(victim, "r+b") as f:
+        f.seek(HEADER_BYTES + 8 + 3)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    w = WriteAheadLog(str(tmp_path), segment_bytes=4096, budget_bytes=1 << 20)
+    got = list(w.replay(0))
+    assert len(got) + w.replay_lost == 300
+    assert not w.replay_lost_unknown
+    w.close()
+
+
+def test_framing_damage_in_final_segment_marks_unknown(tmp_path):
+    """A corrupted LENGTH word in the final segment breaks framing: the
+    tail count is genuinely unknowable, and the WAL says so explicitly
+    (replay_lost_unknown) instead of inventing a number."""
+    _fill(tmp_path, 300)
+    segs = _segments(tmp_path)
+    victim = os.path.join(str(tmp_path), segs[-1])
+    with open(victim, "r+b") as f:
+        f.seek(HEADER_BYTES)  # record 0's length word
+        f.write(struct.pack("<I", 0xFFFFFFFF))
+    w = WriteAheadLog(str(tmp_path), segment_bytes=4096, budget_bytes=1 << 20)
+    list(w.replay(0))
+    assert w.replay_lost_unknown
+    assert any(n.endswith(".quarantined") for n in os.listdir(tmp_path))
+    w.close()
+
+
+def test_gc_releases_checkpoint_covered_segments_only(tmp_path):
+    w = _fill(tmp_path, 200, segment=4096, budget=1 << 20)
+    w2 = WriteAheadLog(str(tmp_path), segment_bytes=4096, budget_bytes=1 << 20)
+    before = len(_segments(tmp_path))
+    w2.gc(upto_seq=100)
+    after = len(_segments(tmp_path))
+    assert after < before
+    # everything >= 100 must still replay (the uncheckpointed tail)
+    got = list(w2.replay(100))
+    assert [s for s, _ in got] == list(range(100, 200))
+    assert w2.replay_lost == 0
+    w2.close()
+    assert w.appended == 200
+
+
+def test_reset_starts_fresh(tmp_path):
+    _fill(tmp_path, 30)
+    w = WriteAheadLog(str(tmp_path), segment_bytes=4096, budget_bytes=1 << 20)
+    w.reset()
+    assert w.next_seq == 0 and not _segments(tmp_path)
+    assert w.append("fresh") == 0
+    w.close()
+
+
+def test_unwritable_dir_is_typed(tmp_path):
+    import pytest
+
+    from ruleset_analysis_tpu.errors import AnalysisError, WalQuarantine
+
+    blocker = tmp_path / "file"
+    blocker.write_text("not a dir")
+    with pytest.raises(WalQuarantine) as ei:
+        WriteAheadLog(str(blocker / "wal"))
+    assert isinstance(ei.value, AnalysisError)
+    assert wal_mod.MAGIC.startswith(b"RAWAL1")

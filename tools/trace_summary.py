@@ -32,6 +32,10 @@ answers the attribution question directly from the timeline:
   occupancy %, the top stage by time, and the unattributed fraction,
   read from the ``devprof.summary`` instant the capture emits onto the
   obs timeline (the full table lives in the capture's devprof.json).
+- **retries** — the transient-fault survival plane (DESIGN §19):
+  per-site retry attempts with their summed backoff, recoveries, and
+  giveups, from the ``retry.attempt``/``retry.recovered``/
+  ``retry.giveup`` instants the policy engine emits.
 
 ``bench_suite.py obs`` imports :func:`summarize` to record stage
 attribution in its artifact; tests assert the merged traces of chaos
@@ -269,6 +273,39 @@ def summarize(path: str, top: int = 5) -> dict:
                 else None
             ),
         }
+    # retry attribution (DESIGN §19): every retry decision is an instant
+    # with its site/attempt/delay, recoveries and giveups likewise — so
+    # "what did the survival plane absorb, and what escalated" is
+    # answerable from the trace alone
+    retries = None
+    retry_by_site: dict[str, dict] = {}
+    for e in events:
+        if e.get("ph") != "i" or not isinstance(e.get("args"), dict):
+            continue
+        name = e.get("name", "")
+        if name not in ("retry.attempt", "retry.recovered", "retry.giveup"):
+            continue
+        site = e["args"].get("site", "?")
+        s = retry_by_site.setdefault(
+            site, {"attempts": 0, "recoveries": 0, "giveups": 0,
+                   "backoff_sec": 0.0}
+        )
+        if name == "retry.attempt":
+            s["attempts"] += 1
+            s["backoff_sec"] = round(
+                s["backoff_sec"] + float(e["args"].get("delay_sec", 0.0)), 4
+            )
+        elif name == "retry.recovered":
+            s["recoveries"] += 1
+        else:
+            s["giveups"] += 1
+    if retry_by_site:
+        retries = {
+            "sites": dict(sorted(retry_by_site.items())),
+            "attempts": sum(s["attempts"] for s in retry_by_site.values()),
+            "recoveries": sum(s["recoveries"] for s in retry_by_site.values()),
+            "giveups": sum(s["giveups"] for s in retry_by_site.values()),
+        }
     return {
         "path": path,
         "events": len(events),
@@ -291,6 +328,7 @@ def summarize(path: str, top: int = 5) -> dict:
         **({"autoscale": autoscale} if autoscale else {}),
         **({"feed": feed} if feed else {}),
         **({"devprof": devprof} if devprof else {}),
+        **({"retries": retries} if retries else {}),
     }
 
 
@@ -391,6 +429,18 @@ def render(s: dict) -> str:
         out.append(line)
         for name, pct in dp.get("stage_pct", {}).items():
             out.append(f"    {pct:6.2f}%  {name}")
+    if s.get("retries"):
+        r = s["retries"]
+        out.append(
+            f"  retries: {r['attempts']} attempt(s), {r['recoveries']} "
+            f"recovery(ies), {r['giveups']} giveup(s)"
+        )
+        for site, st in r["sites"].items():
+            out.append(
+                f"    {site}: {st['attempts']} retry(ies) "
+                f"({st['backoff_sec']:.3f}s backoff), "
+                f"{st['recoveries']} recovered, {st['giveups']} gave up"
+            )
     if s["instants"]:
         marks = ", ".join(f"{k} x{v}" for k, v in sorted(s["instants"].items()))
         out.append(f"  instants: {marks}")
