@@ -5,7 +5,7 @@
 # parallel python process starves the distributed rendezvous tests and
 # fabricates failures.  Run `make lint`, THEN the gate.
 
-.PHONY: lint lint-fast test chaos
+.PHONY: lint lint-fast test chaos postmortem
 
 # Static program-invariant lint (DESIGN §18): abstract-eval traces of
 # the full shipping step grid + the repo registry audit.  No device, no
@@ -29,3 +29,11 @@ chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_retry.py \
 		tests/test_wal.py -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# Doctor acceptance path (DESIGN §20): chaos-killed runs must leave a
+# complete postmortem bundle the doctor can diagnose (failing stage +
+# fired site), clean exits must leave none, and the serve /metrics
+# latency histograms must agree between JSON and prom.  Exit-coded.
+postmortem:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_flightrec.py -q \
+		-m 'not slow' --continue-on-collection-errors -p no:cacheprovider

@@ -1404,11 +1404,9 @@ def bench_steptrace() -> dict:
     n = chunks * batch
     steps = min(4, chunks - 2)
     packed = _setup()
-    volatile = (
-        "elapsed_sec", "lines_per_sec", "compile_sec",
-        "sustained_lines_per_sec", "ingest", "throughput", "coalesce",
-        "autoscale", "devprof",
-    )
+    from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS
+
+    volatile = VOLATILE_TOTALS
 
     def image(rep: dict) -> dict:
         rep = json.loads(json.dumps(rep))
@@ -1567,11 +1565,9 @@ def bench_stepvariants() -> dict:
     n = chunks * batch
     cap_steps, cap_warmup = 2, 2
     packed = _setup()
-    volatile = (
-        "elapsed_sec", "lines_per_sec", "compile_sec",
-        "sustained_lines_per_sec", "ingest", "throughput", "coalesce",
-        "autoscale", "devprof",
-    )
+    from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS
+
+    volatile = VOLATILE_TOTALS
 
     def image(rep) -> dict:
         j = json.loads(rep.to_json())
@@ -2870,6 +2866,160 @@ def bench_retrysoak() -> dict:
     }
 
 
+def bench_blackbox() -> dict:
+    """ISSUE 15: flight-recorder overhead guard + postmortem acceptance.
+
+    Three parts:
+
+    1. **Recorder overhead** — the production text path with the
+       always-on flight recorder armed (a blackbox dir, the default)
+       vs ``--blackbox off``, 5 interleaved pairs through the REAL
+       CLI, compile excluded by a warmup run.  Sustained ratio
+       (median over median) must be >= 0.98 (the PR 4 <2%%
+       observability budget) and the reports must be BIT-IDENTICAL
+       (VOLATILE-stripped) — both asserted in-bench.
+
+    2. **Histogram-arm overhead** — the latency histograms are
+       unconditionally armed (one ``record`` per committed batch /
+       consumed line), so their cost is priced directly: ns per record
+       x the production batch cadence -> overhead fraction.
+
+    3. **Postmortem acceptance** — a chaos-killed run with NO
+       trace/metrics flags leaves a merged ``postmortem.json`` from
+       which ``doctor`` names the failing stage and the fired fault
+       site; a clean run leaves nothing.  Asserted in-bench (the same
+       contract tests/test_flightrec.py pins in tier-1).
+    """
+    import os
+    import tempfile
+
+    import jax
+
+    from ruleset_analysis_tpu import cli
+    from ruleset_analysis_tpu.hostside import aclparse
+    from ruleset_analysis_tpu.hostside import pack as pack_mod
+    from ruleset_analysis_tpu.hostside import synth
+    from ruleset_analysis_tpu.runtime import flightrec
+    from ruleset_analysis_tpu.runtime.metrics import LatencyHistogram
+    from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS
+
+    n_lines = int(float(os.environ.get("RA_BLACKBOX_LINES", "120000")))
+    cfg_text = synth.synth_config(n_acls=2, rules_per_acl=10, seed=0)
+    packed = pack_mod.pack_rulesets([aclparse.parse_asa_config(cfg_text, "fw1")])
+    t = _tuples(packed, n_lines, seed=11)
+    lines = synth.render_syslog(packed, t, seed=11)
+
+    def image(rep: dict) -> dict:
+        rep = json.loads(json.dumps(rep))
+        for k in VOLATILE_TOTALS:
+            rep["totals"].pop(k, None)
+        return rep
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "rules")
+        pack_mod.save_packed(packed, prefix)
+        log = os.path.join(d, "fw1.log")
+        with open(log, "w", encoding="utf-8") as f:
+            f.write("\n".join(lines) + "\n")
+
+        def run_cli(extra: list[str], out: str) -> tuple[int, dict | None]:
+            rc = cli.main([
+                "run", "--ruleset", prefix, "--logs", log,
+                "--batch-size", str(1 << 14), "--cms-width", str(1 << 12),
+                "--cms-depth", "2", "--hll-p", "6",
+                "--json", "--out", out, *extra,
+            ])
+            # a fresh recorder per run: cli arming is per-invocation
+            flightrec._reset_for_tests()
+            if rc == 0:
+                with open(out, "r", encoding="utf-8") as f:
+                    return rc, json.load(f)
+            return rc, None
+
+        bb = os.path.join(d, "bb")
+        on_flags = ["--blackbox-dir", bb]
+        off_flags = ["--blackbox", "off"]
+        run_cli(off_flags, os.path.join(d, "warm.json"))  # compile warmup
+        on_rates, off_rates = [], []
+        rep_on = rep_off = None
+        for i in range(5):  # interleaved pairs (1-core noise)
+            t0 = time.perf_counter()
+            _, rep_on = run_cli(on_flags, os.path.join(d, f"on{i}.json"))
+            on_rates.append(n_lines / (time.perf_counter() - t0))
+            t0 = time.perf_counter()
+            _, rep_off = run_cli(off_flags, os.path.join(d, f"off{i}.json"))
+            off_rates.append(n_lines / (time.perf_counter() - t0))
+        # ratio of medians: per-sample jitter on this container is ~±8%,
+        # so a best-of-N comparison flakes around the 2% budget; the
+        # median of 5 interleaved samples per arm is stable
+        med = lambda xs: sorted(xs)[len(xs) // 2]
+        ratio = med(on_rates) / med(off_rates)
+        assert ratio >= 0.98, f"recorder-on/off sustained ratio {ratio:.4f} < 0.98"
+        identical = image(rep_on) == image(rep_off)
+        assert identical, "blackbox-armed report diverged from disarmed"
+        # clean exits left NO forensics behind
+        leftovers = sorted(os.listdir(bb)) if os.path.isdir(bb) else []
+        assert not leftovers, f"clean runs left forensics: {leftovers}"
+
+        # -- histogram-arm overhead (the always-on record path) ----------
+        h = LatencyHistogram()
+        reps = 200_000
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            h.record(1.5e-3)
+        ns_per_record = (time.perf_counter() - t0) / reps * 1e9
+        # one record per committed batch on the ingest path: overhead
+        # fraction at the measured sustained cadence
+        batches_per_sec = max(off_rates) / (1 << 14)
+        hist_overhead_frac = ns_per_record * 1e-9 * batches_per_sec
+
+        # -- postmortem acceptance ---------------------------------------
+        bb2 = os.path.join(d, "bb2")
+        rc, _ = run_cli(
+            ["--blackbox-dir", bb2, "--fault-plan", "ingest.producer.raise@3"],
+            os.path.join(d, "crash.json"),
+        )
+        assert rc != 0, "chaos run must abort typed"
+        bundle = flightrec.load_bundle(bb2)
+        sites = bundle["analysis"]["fault_sites_fired"]
+        assert sites.get("ingest.producer.raise"), sites
+        diags = flightrec.diagnose(bundle, exit_code=rc)
+        assert diags and "fault plan" in diags[0]["cause"]
+        acceptance = {
+            "exit_code": rc,
+            "trigger": bundle["trigger"],
+            "failing_stage": bundle["analysis"]["failing_stage"],
+            "fault_sites_fired": sites,
+            "doctor_top_cause": diags[0]["cause"],
+            "shards": len(bundle["shards"]),
+        }
+
+    guards = {
+        "recorder_on_over_off_ge_0p98": ratio >= 0.98,
+        "report_bit_identical": identical,
+        "clean_exit_leaves_none": not leftovers,
+        "postmortem_names_fired_site": True,  # asserted above
+    }
+    return {
+        "bench": "blackbox",
+        "metric": "blackbox_on_over_off_rate_ratio",
+        "value": round(ratio, 4),
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "devices": len(jax.devices()),
+            "lines": n_lines,
+            "rates_on": [round(r, 1) for r in on_rates],
+            "rates_off": [round(r, 1) for r in off_rates],
+            "histogram_ns_per_record": round(ns_per_record, 1),
+            "histogram_overhead_frac_at_batch_cadence": round(
+                hist_overhead_frac, 8
+            ),
+            "acceptance": acceptance,
+            "guards": guards,
+        },
+    }
+
+
 BENCHES = {
     "stage": bench_stage,
     "exact": bench_exact,
@@ -2891,6 +3041,7 @@ BENCHES = {
     "feedscale": bench_feedscale,
     "rulescale": bench_rulescale,
     "retrysoak": bench_retrysoak,
+    "blackbox": bench_blackbox,
     "v6": bench_v6,
     "v6recall": bench_v6recall,
 }
@@ -2903,7 +3054,7 @@ BENCHES = {
 DEFAULT_BENCHES = [
     n for n in BENCHES
     if n not in ("sustained", "servesoak", "autoscale", "feedscale",
-                 "retrysoak")
+                 "retrysoak", "blackbox")
 ]
 
 

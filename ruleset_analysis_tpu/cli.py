@@ -268,6 +268,33 @@ def _add_devprof_flags(p) -> None:
                         f"attribution (default {DevprofConfig.warmup})")
 
 
+def _resolve_blackbox(args, default_dir: str) -> str:
+    """``--blackbox``/``--blackbox-dir`` -> the armed directory ('' = off).
+
+    Always-on by default (DESIGN §20): a production run needs no flag to
+    get crash forensics.  ``--blackbox off`` disarms; ``RA_BLACKBOX=off``
+    disarms only the DEFAULT (an explicit ``--blackbox-dir`` still arms
+    — test harnesses set the env so incidental CLI invocations don't
+    write forensics into the working tree).  Raises AnalysisError on the
+    contradictory ``--blackbox off --blackbox-dir D``.
+    """
+    import os
+
+    from .runtime import flightrec
+
+    if args.blackbox == "off":
+        if args.blackbox_dir:
+            raise errors.AnalysisError(
+                "--blackbox-dir contradicts --blackbox off (drop one)"
+            )
+        return ""
+    if not args.blackbox_dir and os.environ.get(
+        flightrec.KILL_SWITCH, ""
+    ).strip().lower() in ("off", "0"):
+        return ""
+    return args.blackbox_dir or default_dir
+
+
 def _iter_log_lines(paths: list[str]):
     for path in paths:
         if path == "-":
@@ -279,6 +306,16 @@ def _iter_log_lines(paths: list[str]):
 
 def _cmd_run(args: argparse.Namespace) -> int:
     try:
+        import os as _os
+
+        # the flight recorder's default home is BESIDE the checkpoint
+        # dir ("out/ckpt" -> "out/blackbox"): forensics live where the
+        # run's other durable state already lives
+        ckpt_dir = args.checkpoint_dir or AnalysisConfig.checkpoint_dir
+        blackbox_dir = _resolve_blackbox(
+            args,
+            _os.path.join(_os.path.dirname(ckpt_dir) or ".", "blackbox"),
+        ) if args.backend == "tpu" else ""
         cfg = AnalysisConfig(
             backend=args.backend,
             batch_size=args.batch_size,
@@ -306,6 +343,7 @@ def _cmd_run(args: argparse.Namespace) -> int:
             mesh_dcn=args.mesh_dcn,
             fault_plan=_resolve_fault_plan(args.fault_plan),
             retry_policy=args.retry_policy,
+            blackbox_dir=blackbox_dir,
             **({"checkpoint_dir": args.checkpoint_dir} if args.checkpoint_dir else {}),
         )
         if args.retry_policy:
@@ -371,6 +409,8 @@ def _cmd_run(args: argparse.Namespace) -> int:
             "--devprof-out": bool(args.devprof_out),
             "--update-impl=sorted": args.update_impl != "scatter",
             "--topk-every": args.topk_every != 1,
+            "--blackbox-dir": bool(args.blackbox_dir),
+            "--blackbox=off": args.blackbox == "off",
         }
         # --prefetch-depth is deliberately NOT rejected: like
         # --batch-size it is a tpu-path tuning knob the oracle ignores,
@@ -606,20 +646,40 @@ def _cmd_run(args: argparse.Namespace) -> int:
                 return 2
             # the supervisor process hosts fault sites of its own (the
             # autoscale decide/actuate seam); workers re-arm the same
-            # spec idempotently from the job config
+            # spec idempotently from the job config.  The supervisor
+            # also OWNS the blackbox dir: it arms first (pruning stale
+            # shards), and the spawned generation workers join via the
+            # exported RA_BLACKBOX_DIR without pruning.
+            if cfg.blackbox_dir:
+                from .runtime import flightrec as _flightrec
+
+                _flightrec.arm(cfg.blackbox_dir, role="elastic-supervisor")
             armed_here = faults.arm_spec(cfg.fault_plan)
             try:
                 rc, result_path = sup.run()
             except _AErr as e:
                 # a typed runtime abort (e.g. an injected autoscale
                 # fault at the decide/actuate seam) exits with its
-                # documented failure-class code, never a traceback
+                # documented failure-class code, never a traceback.
+                # Note the abort so the finalize in main()'s finally
+                # merges the generation workers' shards instead of
+                # treating the return as a clean exit and pruning them.
+                from .runtime import flightrec as _flightrec
+
+                _flightrec.note_abort(e, errors.exit_code_for(e))
                 print(f"error: {e}", file=sys.stderr)
                 return errors.exit_code_for(e)
             finally:
                 if armed_here:
                     faults.disarm()
             if rc != 0 or result_path is None:
+                if rc != 0:
+                    # a failure the supervisor reported by exit code
+                    # alone (no exception reached us): the finalize in
+                    # main()'s finally still merges the postmortem
+                    from .runtime import flightrec as _flightrec
+
+                    _flightrec.note_failure(rc)
                 return rc
             with open(result_path, "r", encoding="utf-8") as f:
                 rep_obj = json_mod.load(f)
@@ -726,6 +786,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
               file=sys.stderr)
         return 2
     try:
+        import os as _os
+
         cfg = AnalysisConfig(
             backend="tpu",
             batch_size=args.batch_size,
@@ -741,6 +803,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             update_impl=args.update_impl,
             fault_plan=_resolve_fault_plan(args.fault_plan),
             retry_policy=args.retry_policy,
+            # beside the serve dir, like the ring checkpoint (DESIGN §20)
+            blackbox_dir=_resolve_blackbox(
+                args, _os.path.join(args.serve_dir, "blackbox")
+            ),
         )
         if args.retry_policy:
             from .runtime import retrypolicy
@@ -1136,6 +1202,67 @@ def _cmd_synth(args: argparse.Namespace) -> int:
     return 0
 
 
+def _add_blackbox_flags(p) -> None:
+    p.add_argument("--blackbox", choices=["on", "off"], default="on",
+                   help="always-on flight recorder (DESIGN §20): every "
+                        "process keeps a bounded in-memory ring of recent "
+                        "telemetry (spans, fault/retry/degraded instants, "
+                        "metrics snapshots, commit cursors); a typed "
+                        "abort, watchdog stall, unhandled crash, or "
+                        "SIGQUIT dumps per-PID shards merged into "
+                        "postmortem.json — a clean exit leaves nothing. "
+                        "Default on (no per-event file I/O; <2%% budget, "
+                        "BENCH_BLACKBOX artifact)")
+    p.add_argument("--blackbox-dir", default=None, metavar="DIR",
+                   help="crash-forensics directory (default: a 'blackbox' "
+                        "dir beside the checkpoint/serve dir); exported "
+                        "as RA_BLACKBOX_DIR so spawned feeder/elastic "
+                        "workers dump sibling shards; diagnose a bundle "
+                        "with `ruleset-analyze doctor`")
+
+
+def _cmd_doctor(args: argparse.Namespace) -> int:
+    """Postmortem bundle + exit code -> ranked human-readable diagnosis.
+
+    The first-response runbook for exit codes 3-7: reads the
+    ``postmortem.json`` a crashed run's flight recorder merged and names
+    the failing stage, the fired fault sites, and the next action.
+    """
+    import json as json_mod
+
+    from .runtime import flightrec
+
+    try:
+        bundle = flightrec.load_bundle(args.bundle)
+    except (OSError, ValueError) as e:
+        print(f"error: unreadable postmortem bundle: {e}", file=sys.stderr)
+        return 1
+    diags = flightrec.diagnose(bundle, exit_code=args.exit_code)
+    if args.json:
+        payload = json_mod.dumps(
+            {
+                "trigger": bundle.get("trigger"),
+                "exit_code": (
+                    args.exit_code if args.exit_code is not None
+                    else bundle.get("exit_code")
+                ),
+                "error": bundle.get("error"),
+                "error_type": bundle.get("error_type"),
+                "failing_stage": bundle.get("analysis", {}).get("failing_stage"),
+                "diagnosis": diags,
+            },
+            indent=2,
+        )
+    else:
+        payload = flightrec.render_diagnosis(bundle, diags)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(payload + "\n")
+    else:
+        print(payload)
+    return 0
+
+
 def make_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="ruleset-analyze")
     sub = ap.add_subparsers(dest="cmd", required=True)
@@ -1344,9 +1471,27 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--static-witness-budget", type=int, default=4096,
                    metavar="N",
                    help="per-rule witness-grid cap for --static-analysis")
+    _add_blackbox_flags(p)
     p.add_argument("--json", action="store_true")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_run)
+
+    p = sub.add_parser(
+        "doctor",
+        help="diagnose a crashed run: postmortem.json (the flight "
+             "recorder's merged crash bundle) + exit code -> ranked "
+             "causes with next actions — the first-response runbook for "
+             "exit codes 3-7",
+    )
+    p.add_argument("bundle",
+                   help="postmortem.json path, or the blackbox directory "
+                        "holding one")
+    p.add_argument("--exit-code", type=int, default=None, metavar="RC",
+                   help="the run's CLI exit code (default: the code "
+                        "recorded in the bundle)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--out", default=None)
+    p.set_defaults(fn=_cmd_doctor)
 
     p = sub.add_parser(
         "analyze",
@@ -1494,6 +1639,7 @@ def make_parser() -> argparse.ArgumentParser:
                         "segment evicts with its records counted as "
                         "explicit drops at the next resume (default 64)")
     _add_autoscale_flags(p)
+    _add_blackbox_flags(p)
     p.add_argument("--fault-plan", default=None, metavar="SPEC",
                    help="chaos drills: see `run --fault-plan` (adds the "
                         "listener.drop/listener.stall/reload.midbatch, "
@@ -1632,8 +1778,33 @@ def _finalize_obs() -> None:
         )
 
 
+def _finalize_blackbox() -> None:
+    """Dump + merge the flight recorder on abort; prune on a clean exit.
+
+    Runs from ``main``'s finally: by now the error handlers have noted
+    any typed abort (and an unhandled exception is still in flight on
+    ``sys.exc_info``), so an aborted run leaves ONE ``postmortem.json``
+    and a clean run leaves nothing (DESIGN §20).
+    """
+    from .runtime import flightrec
+
+    try:
+        pm = flightrec.finalize()
+    except Exception as e:  # forensics must never mask the run's rc
+        print(f"warning: postmortem merge failed: {e}", file=sys.stderr)
+        return
+    if pm:
+        print(
+            f"postmortem: {pm} (diagnose with `ruleset-analyze doctor "
+            f"{pm}`)",
+            file=sys.stderr,
+        )
+
+
 def main(argv: list[str] | None = None) -> int:
     args = make_parser().parse_args(argv)
+    from .runtime import flightrec
+
     try:
         return args.fn(args)
     except aclparse.AclParseError as e:
@@ -1644,7 +1815,9 @@ def main(argv: list[str] | None = None) -> int:
         # codes"): supervisors/operators branch on corrupt checkpoint vs
         # resume mismatch vs feed failure vs stall vs reform budget
         print(f"error: {e}", file=sys.stderr)
-        return errors.exit_code_for(e)
+        rc = errors.exit_code_for(e)
+        flightrec.note_abort(e, rc)
+        return rc
     except ValueError as e:
         # User-reachable library validation (corrupt packed-ruleset files,
         # bad distributed divisibility, malformed wire arrays) surfaces as
@@ -1669,6 +1842,10 @@ def main(argv: list[str] | None = None) -> int:
         return 0
     finally:
         _finalize_obs()
+        # AFTER obs: a dump's sampler snapshot may read gauges the
+        # metrics close would otherwise race; an unhandled exception is
+        # still on sys.exc_info here, so finalize sees it
+        _finalize_blackbox()
 
 
 if __name__ == "__main__":

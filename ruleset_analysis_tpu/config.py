@@ -527,6 +527,14 @@ class AnalysisConfig:
     #: at run start and exported to RA_FAULT_PLAN so spawned workers
     #: (feeder processes, elastic generations) inherit the schedule.
     fault_plan: str = ""
+    #: Flight-recorder crash-forensics directory (runtime/flightrec.py,
+    #: DESIGN §20).  Non-empty = the always-on in-memory telemetry ring
+    #: is armed for this run and a typed abort / stall / unhandled crash
+    #: dumps per-PID shards here, merged into ``postmortem.json``
+    #: (exported to RA_BLACKBOX_DIR so spawned workers participate).
+    #: Empty = disarmed (the bare-library default; the CLI defaults it
+    #: to a ``blackbox`` dir beside the checkpoint/serve dir).
+    blackbox_dir: str = ""
     #: Retry-policy overrides (runtime/retrypolicy.py, DESIGN §19;
     #: ``"site=attempts[/base_sec],...,seed=S"`` or ``"off"``).  Empty =
     #: the built-in per-site defaults (retries are always armed; this

@@ -182,6 +182,20 @@ EXIT_STALL = 6
 #: elastic re-formation budget exhausted (--max-reforms)
 EXIT_REFORM_BUDGET = 7
 
+#: Human names for the documented codes — the ``doctor`` tool's first
+#: lookup (exit codes 3-7 each map to a runbook entry in its diagnosis;
+#: see tools/doctor.py and README "Exit codes").
+EXIT_CODE_NAMES = {
+    EXIT_OK: "ok",
+    EXIT_ANALYSIS: "analysis-error",
+    EXIT_USAGE: "usage",
+    EXIT_CHECKPOINT_CORRUPT: "checkpoint-corrupt",
+    EXIT_CHECKPOINT_MISMATCH: "checkpoint-mismatch",
+    EXIT_FEED: "feed-failure",
+    EXIT_STALL: "stall",
+    EXIT_REFORM_BUDGET: "reform-budget-exhausted",
+}
+
 
 def exit_code_for(exc: BaseException) -> int:
     """Map a typed runtime error to its documented CLI exit code.

@@ -191,6 +191,12 @@ def _worker(packed_blob, paths, rows_cap, rows6_cap, shm_name, task_q, done_q):
                 (idx, slot, lines, packer.parsed - p0, packer.skipped - s0, n6)
             )
     finally:
+        # seal this worker's flight ring (no-op disarmed): if the RUN
+        # aborts — e.g. a sibling was SIGKILL'd — the supervising merge
+        # reads the survivors' telemetry; a clean run prunes every seal
+        from ..runtime import flightrec
+
+        flightrec.seal()
         for f in files.values():
             f.close()
         shm.close()
@@ -482,6 +488,10 @@ def _ring_worker(packed_blob, paths, rows_cap_shard, rows6_cap_shard,
                  n6)
             )
     finally:
+        # worker-exit seal, exactly like the queue-tier worker above
+        from ..runtime import flightrec
+
+        flightrec.seal()
         for f in files.values():
             f.close()
         shm.close()

@@ -50,7 +50,10 @@ class LineQueue:
         if capacity < 1:
             raise AnalysisError(f"listener queue capacity must be >= 1, got {capacity}")
         self.capacity = capacity
-        self._q: deque[str] = deque()
+        #: (line, receipt time.monotonic()) pairs: the receipt stamp is
+        #: where the serve tier's ingest->publish latency histogram
+        #: starts its clock (DESIGN §20)
+        self._q: deque[tuple[str, float]] = deque()
         self._lock = threading.Lock()
         self._ready = threading.Condition(self._lock)
         self.received = 0  # lines handed to put() (drops included)
@@ -58,12 +61,13 @@ class LineQueue:
         self.forced_drops = 0  # listener.drop fault firings (subset of dropped)
 
     def put(self, line: str) -> bool:
+        t = time.monotonic()
         with self._lock:
             self.received += 1
             if len(self._q) >= self.capacity:
                 self.dropped += 1
                 return False
-            self._q.append(line)
+            self._q.append((line, t))
             self._ready.notify()
             return True
 
@@ -96,6 +100,11 @@ class LineQueue:
             return n
 
     def pop(self, timeout: float = 0.2) -> str | None:
+        got = self.pop_ts(timeout)
+        return got[0] if got is not None else None
+
+    def pop_ts(self, timeout: float = 0.2) -> tuple[str, float] | None:
+        """Next line WITH its receipt timestamp (``time.monotonic()``)."""
         with self._ready:
             if not self._q:
                 self._ready.wait(timeout)

@@ -938,8 +938,15 @@ def _start_supervisor_watchdog() -> None:
 
 def _worker_main(elastic_dir: str, tag: int, gen: int) -> int:
     # trace shard arming is inherited via RA_TRACE_DIR (supervisor env);
-    # the label names this generation worker's track in the merged view
+    # the label names this generation worker's track in the merged view.
+    # The flight recorder arms the same way (RA_BLACKBOX_DIR, inside
+    # note_role's lazy env check): a generation worker that dies typed
+    # dumps its ring via the excepthook, and a clean generation seals at
+    # exit so a later supervisor abort can still merge its telemetry.
     obs.note_role(f"elastic-worker-{tag}-gen{gen}")
+    from . import flightrec
+
+    flightrec.cursor(elastic_gen=gen, elastic_tag=tag)
     _start_supervisor_watchdog()
     with open(
         os.path.join(elastic_dir, "members", f"{tag}.job.json"),
@@ -1028,15 +1035,18 @@ def _worker_main(elastic_dir: str, tag: int, gen: int) -> int:
         die_after_batches=die,
         pace_sec=pace,
     )
-    report, regs = run_stream_file_distributed(
-        packed,
-        [],
-        cfg,
-        native=job["native"],
-        topk=job["topk"],
-        return_state=True,
-        elastic=spec,
-    )
+    try:
+        report, regs = run_stream_file_distributed(
+            packed,
+            [],
+            cfg,
+            native=job["native"],
+            topk=job["topk"],
+            return_state=True,
+            elastic=spec,
+        )
+    finally:
+        flightrec.seal()
     if rank == 0 and job["out"]:
         np.savez(job["out"] + ".npz", **regs)
         _atomic_write_json(job["out"] + ".json", report.to_json())

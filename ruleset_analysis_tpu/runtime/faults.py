@@ -453,6 +453,13 @@ def fire(
         # cannot resume half-done
         raise InjectedFault(f"injected stall released: {site} (hit {n})")
     if action == "crash":
+        # the flight recorder's LAST chance: os._exit skips every
+        # excepthook/finally, so the ring (which already holds the
+        # fault.<site> instant flushed above) dumps here or never —
+        # exactly what a merged postmortem needs to name the dead worker
+        from . import flightrec
+
+        flightrec.dump("crash", error=f"injected crash: {site} (hit {n})")
         os._exit(crash_rc)
     if action == "torn":
         if path is not None:

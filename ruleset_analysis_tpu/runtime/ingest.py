@@ -49,7 +49,8 @@ import time
 import numpy as np
 
 from ..errors import AnalysisError, IngestError, StallError
-from . import faults, obs
+from . import faults, flightrec, obs
+from .metrics import LatencyHistogram
 
 _END = ("end", None)
 
@@ -156,8 +157,10 @@ class _Pump:
                         cat="ingest",
                     )
                 owner.stats.produce_sec += time.perf_counter() - t0
+                # t0 rides the item: the consumer records produce->commit
+                # latency into the batch-e2e histogram at receipt
                 if not self._put(
-                    ("item", (batch, n_raw, parsed, skipped, v6, cur))
+                    ("item", (batch, n_raw, parsed, skipped, v6, cur, t0))
                 ):
                     return
         except BaseException as e:  # re-raised typed at the consumer
@@ -221,7 +224,7 @@ class _Pump:
                         f"ingest producer failed: "
                         f"{type(payload).__name__}: {payload}"
                     ) from payload
-                batch, n_raw, parsed, skipped, v6, cur = payload
+                batch, n_raw, parsed, skipped, v6, cur, t_prod = payload
                 owner.packer.parsed = parsed
                 owner.packer.skipped = skipped
                 if v6 is not None and len(v6):
@@ -229,6 +232,16 @@ class _Pump:
                 if cur is not None:
                     owner._cursor_rows = cur
                 owner.stats.batches += 1
+                # batch end-to-end latency, produce-start -> commit (the
+                # moment the driver receives it): the ingest half of the
+                # latency SLO plane (DESIGN §20)
+                owner.latency.record(t1 - t_prod)
+                # flight-recorder cursors: a crash dump names the last
+                # COMMITTED batch (one dict update when armed)
+                flightrec.cursor(
+                    committed_batches=owner.stats.batches,
+                    committed_parsed=parsed,
+                )
                 yield batch, n_raw
         finally:
             self.shutdown()
@@ -291,6 +304,10 @@ class PrefetchingSource:
         )
         self.packer = _Counters()
         self.stats = IngestStats()
+        #: produce->commit batch latency (log2 buckets, u64 counts —
+        #: mergeable by addition); summarized into report totals.latency
+        #: and every metrics snapshot
+        self.latency = LatencyHistogram()
         self._staged6: list = []
         self._pumps: list[_Pump] = []
         self.yields_wire = getattr(inner, "yields_wire", False)
@@ -376,9 +393,15 @@ class PrefetchingSource:
     def ingest_stats(self) -> dict:
         return {"prefetch_depth": self.depth, **self.stats.to_dict()}
 
+    def latency_summary(self) -> dict:
+        """Report-facing ``totals.latency`` patch ({} before any batch)."""
+        if self.latency.count == 0:
+            return {}
+        return {"batch_e2e": self.latency.summary()}
+
     def _sample_metrics(self) -> dict:
         """Live snapshot of the bounded queue + overlap accounting."""
-        return {
+        out = {
             "prefetch_depth": self.depth,
             "queue_depth": sum(p.q.qsize() for p in self._pumps),
             "batches": self.stats.batches,
@@ -386,6 +409,9 @@ class PrefetchingSource:
             "backpressure_sec": round(self.stats.backpressure_sec, 3),
             "starved_sec": round(self.stats.starved_sec, 3),
         }
+        if self.latency.count:
+            out.update(self.latency.gauges("latency_batch_e2e_"))
+        return out
 
     def close(self) -> None:
         obs.unregister_sampler("ingest")

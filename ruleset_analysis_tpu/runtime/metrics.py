@@ -13,10 +13,164 @@ metrics JSONL — at a disarmed cost of one None-check per site.
 
 from __future__ import annotations
 
+import math
 import sys
+import threading
 import time
 
 from . import obs
+
+# ---------------------------------------------------------------------------
+# Fixed-bucket latency histograms (DESIGN §20).  Log2 bucket bounds with
+# u64 counts: mergeable across processes/windows by plain addition (the
+# same merge-law discipline as the device registers — associative,
+# commutative, order-free), so a fleet's histograms sum into one without
+# any resampling.  One schema everywhere: report ``totals.latency``,
+# metrics JSONL snapshots, and serve ``/metrics`` in BOTH the JSON gauge
+# form (p50/p90/p99) and the Prometheus histogram exposition
+# (``_bucket``/``_sum``/``_count`` with cumulative ``le`` labels).
+# ---------------------------------------------------------------------------
+
+#: Upper bucket bounds in seconds: 1 µs * 2^i for i in 0..33 (~2.4 h),
+#: plus an implicit +Inf overflow bucket.  Fixed for every histogram so
+#: counts merge positionally.
+LATENCY_BUCKET_BOUNDS: tuple[float, ...] = tuple(
+    (1 << i) * 1e-6 for i in range(34)
+)
+
+
+class LatencyHistogram:
+    """Log2-bucket latency histogram with u64 counts.
+
+    ``record`` is O(1) (a bit_length + one increment under a short
+    lock); quantiles are conservative — they report the UPPER bound of
+    the bucket containing the target rank, so a published p99 is always
+    >= the true p99 (never a flattering under-estimate).  Samples
+    landing past the last finite bound count in the overflow bucket and
+    clamp quantiles to the largest finite bound.
+    """
+
+    N = len(LATENCY_BUCKET_BOUNDS)
+
+    def __init__(self):
+        self.counts: list[int] = [0] * (self.N + 1)  # +1 = +Inf overflow
+        self.sum_sec = 0.0
+        self.count = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def bucket_index(sec: float) -> int:
+        """Smallest i with bounds[i] >= sec (N = the +Inf overflow)."""
+        if sec <= 1e-6:
+            return 0
+        us = int(math.ceil(sec * 1e6))
+        i = (us - 1).bit_length()
+        return min(i, LatencyHistogram.N)
+
+    def record(self, sec: float, n: int = 1) -> None:
+        """Add ``n`` samples of ``sec`` (n > 1 = decimated sampling)."""
+        if sec < 0:
+            sec = 0.0  # monotonic sources cannot go negative; belt+braces
+        i = self.bucket_index(sec)
+        with self._lock:
+            self.counts[i] += n
+            self.sum_sec += sec * n
+            self.count += n
+
+    def merge(self, other: "LatencyHistogram") -> None:
+        """Positional count addition — the histogram merge law."""
+        with other._lock:
+            counts = list(other.counts)
+            s, c = other.sum_sec, other.count
+        with self._lock:
+            for i, v in enumerate(counts):
+                self.counts[i] += v
+            self.sum_sec += s
+            self.count += c
+
+    def _quantile_locked(self, p: float) -> float:
+        if self.count == 0:
+            return 0.0
+        rank = max(1, math.ceil(p * self.count))
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += c
+            if cum >= rank:
+                return LATENCY_BUCKET_BOUNDS[min(i, self.N - 1)]
+        return LATENCY_BUCKET_BOUNDS[-1]
+
+    def quantile(self, p: float) -> float:
+        with self._lock:
+            return self._quantile_locked(p)
+
+    def summary(self) -> dict:
+        """Report/totals image: counts + the SLO percentiles."""
+        with self._lock:
+            return {
+                "count": self.count,
+                "sum_sec": round(self.sum_sec, 6),
+                "p50_sec": self._quantile_locked(0.50),
+                "p90_sec": self._quantile_locked(0.90),
+                "p99_sec": self._quantile_locked(0.99),
+            }
+
+    def gauges(self, prefix: str) -> dict:
+        """Flat numeric gauges (serve /metrics JSON + prom gauge render)."""
+        s = self.summary()
+        return {f"{prefix}{k}": v for k, v in s.items()}
+
+    def render_prom(self, name: str) -> str:
+        """Prometheus histogram exposition (text format 0.0.4).
+
+        Cumulative ``le`` buckets ending at ``+Inf``, plus ``_sum`` and
+        ``_count`` — derived from the SAME counts as :meth:`summary`,
+        so a scraper's bucket-derived p99 equals the JSON gauge exactly.
+        """
+        with self._lock:
+            counts = list(self.counts)
+            total = self.count
+            sum_sec = self.sum_sec
+        lines = [f"# TYPE {name} histogram"]
+        cum = 0
+        for i, bound in enumerate(LATENCY_BUCKET_BOUNDS):
+            cum += counts[i]
+            # repr round-trips exactly: a scraper re-parsing the le label
+            # recovers the identical float bound the JSON quantiles use
+            lines.append(f'{name}_bucket{{le="{bound!r}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{name}_sum {sum_sec:.9g}")
+        lines.append(f"{name}_count {total}")
+        return "\n".join(lines) + "\n"
+
+
+def quantile_from_prom(text: str, name: str, p: float) -> float | None:
+    """p-quantile from a Prometheus histogram exposition (tests/audit).
+
+    Same conservative bucket-upper-bound rule as
+    :meth:`LatencyHistogram.quantile`, so the prom and JSON renderings
+    of one histogram must agree exactly — the drift check
+    ``verify/registry.py::audit_observability`` enforces.
+    """
+    buckets: list[tuple[float, int]] = []
+    count = None
+    for line in text.splitlines():
+        if line.startswith(f"{name}_bucket{{le=\""):
+            le, _, cum = line[len(f"{name}_bucket{{le=\""):].partition('"} ')
+            buckets.append(
+                (math.inf if le == "+Inf" else float(le), int(cum))
+            )
+        elif line.startswith(f"{name}_count "):
+            count = int(line.split()[1])
+    if count is None or not buckets:
+        return None
+    if count == 0:
+        return 0.0
+    rank = max(1, math.ceil(p * count))
+    finite = [b for b, _ in buckets if b != math.inf]
+    for bound, cum in buckets:
+        if cum >= rank:
+            return min(bound, finite[-1]) if finite else bound
+    return finite[-1] if finite else None
 
 
 class ThroughputMeter:
@@ -109,16 +263,16 @@ class DispatchTimer:
         two dispatches: one None-check.
         """
         lst = self._t.setdefault(kind, [])
-        tr = obs.active_tracer()
-        if len(lst) >= 2 and tr is None:
+        rec = obs.recording()  # tracer shard OR flight-recorder ring
+        if len(lst) >= 2 and not rec:
             return fn(*args)
         t0 = time.perf_counter()
         out = fn(*args)
         t1 = time.perf_counter()
         if len(lst) < 2:
             lst.append(t1 - t0)
-        if tr is not None:
-            tr.complete(
+        if rec:
+            obs.complete(
                 "step.dispatch", t0, t1, cat="step", args={"kind": kind}
             )
         return out

@@ -184,9 +184,12 @@ class MetricsPlane:
         self._f = open(self.path, "a", encoding="utf-8")
         self.every = max(0.05, float(every_sec))
         self._lock = threading.Lock()
-        self._samplers: dict[str, object] = {}
         self._lines = 0
-        self._t0 = time.perf_counter()
+        # interval/rate derivations run on the MONOTONIC clock: the
+        # snapshot's "t" stamp stays wall-clock for correlation, but an
+        # NTP step must never produce a negative or inflated lines/s
+        # line in the JSONL (ISSUE 15 satellite)
+        self._t0 = time.monotonic()
         self._last_t = self._t0
         self._last_lines = 0
         self._stop = threading.Event()
@@ -233,12 +236,10 @@ class MetricsPlane:
             self._lines += n
 
     def register(self, name: str, fn) -> None:
-        with self._lock:
-            self._samplers[name] = fn
+        _register_sampler_impl(name, fn)
 
     def unregister(self, name: str) -> None:
-        with self._lock:
-            self._samplers.pop(name, None)
+        _unregister_sampler_impl(name)
 
     def _write(self, rec: dict) -> None:
         line = json.dumps(rec, separators=(",", ":"))
@@ -248,18 +249,24 @@ class MetricsPlane:
                 return
             f.write(line + "\n")
             f.flush()
+        # the flight recorder keeps the most recent snapshots in its ring
+        # so a crash dump carries the last gauge readings (DESIGN §20)
+        fr = _flight
+        if fr is not None:
+            fr.instant(f"metrics.{rec.get('kind', 'snapshot')}", rec)
 
     def event(self, kind: str, fields: dict) -> None:
         self._write({"kind": kind, "t": round(time.time(), 3), **fields})
 
     def snapshot(self, kind: str = "snapshot") -> dict:
-        now = time.perf_counter()
+        now = time.monotonic()
         with self._lock:
             lines = self._lines
             dt_inst = now - self._last_t
             d_lines = lines - self._last_lines
             self._last_t, self._last_lines = now, lines
-            samplers = list(self._samplers.items())
+        with _samplers_lock:
+            samplers = list(_samplers.items())
         rec = {
             "kind": kind,
             "t": round(time.time(), 3),
@@ -320,6 +327,49 @@ _metrics: MetricsPlane | None = None
 _env_checked = False
 _env_exported = False
 _role = ""
+
+#: Flight-recorder tap (runtime/flightrec.py): when the always-on black
+#: box is armed, every span/instant also lands in its in-memory ring —
+#: NO file I/O, strictly cheaper than the armed trace plane.  Disarmed
+#: cost: one module-global None check per event.
+_flight = None
+
+#: Module-level sampler registry.  Registration is independent of the
+#: metrics plane's arming so (a) components register once and both the
+#: JSONL snapshotter AND a flight-recorder crash dump read live gauges,
+#: and (b) a sampler registered before --metrics-out arms still lands in
+#: the first snapshot.  Callers unregister at component teardown.
+_samplers: dict[str, object] = {}
+_samplers_lock = threading.Lock()
+
+
+def _set_flight(rec) -> None:
+    """Install (or clear) the flight-recorder ring tap (flightrec.arm)."""
+    global _flight
+    _flight = rec
+
+
+def _register_sampler_impl(name: str, fn) -> None:
+    with _samplers_lock:
+        _samplers[name] = fn
+
+
+def _unregister_sampler_impl(name: str) -> None:
+    with _samplers_lock:
+        _samplers.pop(name, None)
+
+
+def sampler_snapshot() -> dict:
+    """One guarded reading of every live sampler (flight-recorder dumps)."""
+    with _samplers_lock:
+        items = list(_samplers.items())
+    out: dict = {}
+    for name, fn in items:
+        try:
+            out[name] = fn()
+        except Exception as e:  # a broken gauge must not block forensics
+            out[name] = f"<sampler error: {type(e).__name__}: {e}>"
+    return out
 
 
 def start_trace(trace_dir: str, *, role: str = "main", export_env: bool = True) -> Tracer:
@@ -442,6 +492,12 @@ def _check_env() -> Tracer | None:
         if _env_checked:
             return _tracer
         _env_checked = True
+    # the flight recorder inherits RA_BLACKBOX_DIR through the same
+    # once-per-process gate (workers call note_role -> active_tracer on
+    # entry, so their rings arm before their first telemetry event)
+    from . import flightrec
+
+    flightrec.maybe_arm_from_env()
     d = os.environ.get(ENV_VAR, "")
     if d:
         try:
@@ -474,6 +530,19 @@ def note_role(role: str) -> None:
     tr = active_tracer()
     if tr is not None:
         tr.set_role(role)
+    fr = _flight
+    if fr is not None:
+        fr.role = role
+
+
+def recording() -> bool:
+    """True when ANY event sink is live (tracer or flight-recorder ring).
+
+    The guard for call sites that measure endpoints themselves (e.g.
+    ``metrics.DispatchTimer``): they must keep timing when the always-on
+    black box is the only consumer.
+    """
+    return active_tracer() is not None or _flight is not None
 
 
 def complete(
@@ -483,22 +552,27 @@ def complete(
     tr = active_tracer()
     if tr is not None:
         tr.complete(name, t0_pc, t1_pc, cat, args)
+    fr = _flight
+    if fr is not None:
+        fr.span(name, t0_pc, t1_pc, args)
 
 
 def instant(name: str, args: dict | None = None) -> None:
     tr = active_tracer()
     if tr is not None:
         tr.instant(name, args)
+    fr = _flight
+    if fr is not None:
+        fr.instant(name, args)
 
 
 def timed(name: str, fn, *args, **span_args):
     """Run ``fn(*args)`` under a span; zero-wrapping when disarmed."""
-    tr = active_tracer()
-    if tr is None:
+    if not recording():
         return fn(*args)
     t0 = time.perf_counter()
     out = fn(*args)
-    tr.complete(name, t0, time.perf_counter(), args=span_args or None)
+    complete(name, t0, time.perf_counter(), args=span_args or None)
     return out
 
 
@@ -516,28 +590,27 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    __slots__ = ("_tr", "_name", "_args", "_t0")
+    __slots__ = ("_name", "_args", "_t0")
 
-    def __init__(self, tr: Tracer, name: str, args: dict | None):
-        self._tr, self._name, self._args = tr, name, args
+    def __init__(self, name: str, args: dict | None):
+        self._name, self._args = name, args
 
     def __enter__(self):
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
-        self._tr.complete(
-            self._name, self._t0, time.perf_counter(), args=self._args
-        )
+        # module-level complete() fans out to BOTH sinks (tracer shard
+        # and flight-recorder ring), whichever subset is armed at exit
+        complete(self._name, self._t0, time.perf_counter(), args=self._args)
         return False
 
 
 def span(name: str, **args):
     """``with obs.span("stage.name"): ...`` — a shared no-op when disarmed."""
-    tr = active_tracer()
-    if tr is None:
+    if active_tracer() is None and _flight is None:
         return _NULL_SPAN
-    return _Span(tr, name, args or None)
+    return _Span(name, args or None)
 
 
 # -- metrics module surface --------------------------------------------------
@@ -558,16 +631,18 @@ def metric_event(kind: str, **fields) -> None:
 
 
 def register_sampler(name: str, fn) -> None:
-    """Expose a live gauge callback (``fn() -> dict``) to snapshots."""
-    m = _metrics
-    if m is not None:
-        m.register(name, fn)
+    """Expose a live gauge callback (``fn() -> dict``) to snapshots.
+
+    Always registered (module-level registry), independent of the
+    metrics plane's arming: the JSONL snapshotter reads whatever is live
+    when it ticks, and a flight-recorder crash dump reads the same
+    registry — one gauge surface, two consumers.
+    """
+    _register_sampler_impl(name, fn)
 
 
 def unregister_sampler(name: str) -> None:
-    m = _metrics
-    if m is not None:
-        m.unregister(name)
+    _unregister_sampler_impl(name)
 
 
 def metrics_snapshot() -> dict | None:

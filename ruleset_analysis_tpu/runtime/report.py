@@ -46,6 +46,7 @@ VOLATILE_TOTALS = (
     "recovery",
     "devprof",
     "degraded",
+    "latency",
 )
 
 

@@ -61,7 +61,8 @@ from ..hostside.listener import LineQueue, ListenerSet
 from ..models import pipeline
 from ..ops.topk import TopKTracker
 from . import checkpoint as ckpt
-from . import devprof, faults, obs, retrypolicy
+from . import devprof, faults, flightrec, obs, retrypolicy
+from .metrics import LatencyHistogram
 from .wal import WriteAheadLog
 from .autoscale import PolicyEngine, render_prom, world_ladder
 from .report import diff_report_objs
@@ -432,6 +433,11 @@ class ServeDriver:
         self.wal_replayed = 0
         self.wal_lost_total = 0  # eviction/quarantine losses (exact)
         self.wal_lost_unknown = False
+        # end-to-end latency SLO plane (DESIGN §20): listener receipt ->
+        # window publish, log2 buckets merged across windows by addition
+        # (lat_cum answers "is the service meeting its SLO" from
+        # /metrics; the per-window histogram lands in totals.latency)
+        self.lat_cum = LatencyHistogram()
         # cumulative incompleteness: EVERY reason a window was marked
         # (dead/stalled listeners included), not just queue drops — the
         # cumulative "unused ever" view must carry the marker whenever
@@ -608,6 +614,12 @@ class ServeDriver:
             "degraded_events_total": self.degraded_events,
             "recovered_events_total": self.recovered_events,
         })
+        # end-to-end latency SLO gauges (DESIGN §20): p50/p90/p99 of the
+        # cumulative receipt->publish histogram.  The prom variant ALSO
+        # renders the full bucket histogram (render_latency_prom) — both
+        # derive from the same counts, so a scraper's bucket-computed
+        # p99 equals these gauges exactly
+        g.update(self.lat_cum.gauges("latency_ingest_to_publish_"))
         # per-site retry attempt/recovery/giveup counters (DESIGN §19):
         # the same numbers the metrics JSONL sampler and the trace's
         # retry.attempt instants carry — one plane, three views
@@ -651,6 +663,13 @@ class ServeDriver:
                 "autoscale_budget_left": eng.budget_left,
             })
         return g
+
+    def render_latency_prom(self) -> str:
+        """Prometheus HISTOGRAM exposition of the cumulative
+        receipt->publish latency (``_bucket``/``_sum``/``_count`` with
+        cumulative ``le`` labels), appended to the gauge rendering on
+        ``/metrics?format=prom``."""
+        return self.lat_cum.render_prom("ra_serve_ingest_to_publish_seconds")
 
     # -- report access (HTTP + tests) ------------------------------------
     def published(self, name: str) -> dict | None:
@@ -835,6 +854,11 @@ class ServeDriver:
         os.makedirs(scfg.serve_dir, exist_ok=True)
         armed_here = faults.arm_spec(self.cfg.fault_plan)
         retrypolicy.configure(self.cfg.retry_policy)
+        if self.cfg.blackbox_dir:
+            # always-on flight recorder (DESIGN §20): the ring runs for
+            # the service's lifetime; a typed abort / stall / crash
+            # dumps it beside the serve dir for the doctor
+            flightrec.arm(self.cfg.blackbox_dir, role="serve")
         aborted: BaseException | None = None
         try:
             # EVERYTHING after arming is inside the try: a setup failure
@@ -1041,6 +1065,19 @@ class ServeDriver:
         self._buf6 = None
         self._fill6 = 0
         self._win_t0 = time.time()
+        # interval math runs on the monotonic clock (an NTP step must
+        # never produce a negative window rate); the wall stamps above
+        # stay for operator correlation only
+        self._win_t0_mono = time.monotonic()
+        # receipt timestamps of this window's consumed lines, decimated
+        # by powers of two past the cap so memory stays bounded on huge
+        # wall-clock windows (each retained stamp then counts for
+        # ``stride`` lines in the histogram — counts stay representative)
+        self._win_lat = LatencyHistogram()
+        self._win_receipts: list[float] = []
+        self._recv_stride = 1
+        self._recv_i = 0
+        flightrec.cursor(window=self.win_id)
         # the drop baseline carries over from the previous window's close
         # (when there is one) so a drop landing DURING rotation/publish
         # still charges to exactly one window, never the gap between two
@@ -1052,6 +1089,21 @@ class ServeDriver:
             self.listeners.alive() == len(self.listeners.listeners)
         )
         self._win_saw_stall = False
+
+    #: receipt stamps retained per window before stride decimation
+    _RECEIPT_CAP = 1 << 16
+
+    def _note_receipt(self, t_recv: float) -> None:
+        """Retain one consumed line's receipt stamp for the window's
+        ingest->publish latency histogram (stride-decimated, bounded)."""
+        if self._recv_i % self._recv_stride == 0:
+            self._win_receipts.append(t_recv)
+            if len(self._win_receipts) >= self._RECEIPT_CAP:
+                # halve retention, double the stride: deterministic,
+                # bounded, and each stamp's histogram weight doubles
+                self._win_receipts = self._win_receipts[::2]
+                self._recv_stride *= 2
+        self._recv_i += 1
 
     def _drain(self, out: pipeline.ChunkOut) -> None:
         self.tracker.offer_chunk(
@@ -1174,6 +1226,10 @@ class ServeDriver:
                     noted = self.wal.replay_lost
                 for ev in self.batcher.push(line):
                     self._consume_event(ev)
+                # replayed lines' true receipt stamps died with the
+                # previous process; the replay instant is the honest
+                # (conservative) receipt stand-in
+                self._note_receipt(time.monotonic())
                 self.win_pushed += 1
                 self.lines_consumed_total += 1
                 self._wal_next = seq + 1
@@ -1238,6 +1294,10 @@ class ServeDriver:
             "reloads": self.win_reloads,
             "started_unix": round(self._win_t0, 3),
             "ended_unix": round(time.time(), 3),
+            # monotonic-derived: the window's lines/s can never go
+            # negative or inflate across an NTP step (the wall stamps
+            # above are correlation aids, not interval sources)
+            "elapsed_sec": round(time.monotonic() - self._win_t0_mono, 4),
         }
         if self._win_wal_drops or self._win_wal_unknown:
             meta["wal_lost"] = int(self._win_wal_drops)
@@ -1251,8 +1311,17 @@ class ServeDriver:
             meta["incomplete"] = {"drops": int(drops), "reasons": reasons}
         return meta
 
-    def _window_totals(self, meta: dict, quarantine: dict[tuple, int]) -> dict:
-        elapsed = max(meta["ended_unix"] - meta["started_unix"], 0.0)
+    def _window_totals(
+        self,
+        meta: dict,
+        quarantine: dict[tuple, int],
+        latency: dict | None = None,
+    ) -> dict:
+        # monotonic-derived where available (live rotations); restored
+        # epochs predate the stamp and fall back to the wall difference
+        elapsed = meta.get(
+            "elapsed_sec", max(meta["ended_unix"] - meta["started_unix"], 0.0)
+        )
         totals = {
             "lines_total": meta["lines"],
             "lines_matched": meta["parsed"],
@@ -1264,6 +1333,10 @@ class ServeDriver:
             ),
             "window": meta,
         }
+        if latency:
+            # receipt->publish percentiles for THIS window (DESIGN §20;
+            # VOLATILE for identity like every timing total)
+            totals["latency"] = {"ingest_to_publish": latency}
         qt = _quarantine_totals(quarantine)
         if qt:
             totals["quarantine"] = qt
@@ -1304,6 +1377,19 @@ class ServeDriver:
                 self._degrade("devprof", e)
         with obs.span("serve.rotate", window=self.win_id):
             self._flush_inflight()
+            # the publish instant of this window's latency clock: every
+            # retained receipt stamp becomes one stride-weighted sample
+            # (receipt -> the rotation that makes the line's effect
+            # visible in a published report)
+            t_pub = time.monotonic()
+            for t_recv in self._win_receipts:
+                self._win_lat.record(
+                    max(t_pub - t_recv, 0.0), n=self._recv_stride
+                )
+            self.lat_cum.merge(self._win_lat)
+            win_latency = (
+                self._win_lat.summary() if self._win_lat.count else None
+            )
             meta = self._window_meta(partial=partial)
             arrays = pipeline.state_to_host(self.state)
             ep = WindowEpoch(
@@ -1315,7 +1401,9 @@ class ServeDriver:
             rep = pipeline.finalize(
                 pipeline.AnalysisState(**arrays), self.packed, self.cfg,
                 self.tracker, topk=self.topk,
-                totals=self._window_totals(meta, self.win_quarantine),
+                totals=self._window_totals(
+                    meta, self.win_quarantine, latency=win_latency
+                ),
                 v6_digests=self._v6_digests,
             )
             # strict contradiction check only when every counter in this
@@ -1354,6 +1442,10 @@ class ServeDriver:
             self.win_id += 1
             self._begin_window()
             self.windows_published += 1
+            flightrec.cursor(
+                windows_published=self.windows_published,
+                wal_seq=int(self._wal_next),
+            )
             obs.metric_event(
                 "serve.window", id=meta["id"], lines=meta["lines"],
                 chunks=meta["chunks"], drops=meta["drops"],
@@ -1453,6 +1545,10 @@ class ServeDriver:
                 "reasons": reasons,
                 "windows": list(self.cum_incomplete_windows),
             }
+        if self.lat_cum.count:
+            # the service-lifetime SLO distribution (merged window
+            # histograms — positional count addition, DESIGN §20)
+            totals["latency"] = {"ingest_to_publish": self.lat_cum.summary()}
         qt = _quarantine_totals(q)
         if qt:
             totals["quarantine"] = qt
@@ -1963,8 +2059,9 @@ class ServeDriver:
                 if scfg.max_windows and self.windows_published >= scfg.max_windows:
                     break
                 continue
-            line = self.queue.pop(timeout=0.1)
-            if line is not None:
+            got = self.queue.pop_ts(timeout=0.1)
+            if got is not None:
+                line, t_recv = got
                 if self.wal is not None:
                     # durably spool BEFORE window accounting: once this
                     # returns, a SIGKILL cannot lose the line — resume
@@ -1972,6 +2069,7 @@ class ServeDriver:
                     self._wal_next = self.wal.append(line) + 1
                 for ev in self.batcher.push(line):
                     self._consume_event(ev)
+                self._note_receipt(t_recv)
                 self.win_pushed += 1
                 self.lines_consumed_total += 1
                 # lines-mode rotation: deterministic, replayable windows
@@ -2069,7 +2167,8 @@ def _make_http_handler():
                             200,
                             render_prom(
                                 drv.metrics_gauges(), prefix="ra_serve_"
-                            ),
+                            )
+                            + drv.render_latency_prom(),
                             "text/plain; version=0.0.4; charset=utf-8",
                         )
                     return self._send(

@@ -39,11 +39,16 @@ def _arm_retry(cfg: AnalysisConfig) -> None:
 
     Called at the PUBLIC driver entries, before any source construction
     — the wire reader's open IO is itself a retry seam, and its attempts
-    must land in this run's freshly-reset counters.
+    must land in this run's freshly-reset counters.  The flight
+    recorder (DESIGN §20) arms here too when the config names a
+    blackbox directory, so library callers get the same always-on
+    forensics the CLI wires up.
     """
-    from . import retrypolicy
+    from . import flightrec, retrypolicy
 
     retrypolicy.configure(cfg.retry_policy)
+    if cfg.blackbox_dir:
+        flightrec.arm(cfg.blackbox_dir, role="main")
 
 
 def chunked(it: Iterable[str], size: int) -> Iterator[list[str]]:
@@ -1595,6 +1600,12 @@ def run_stream_file_distributed(
         stats_fn = getattr(source, "ingest_stats", None)
         if stats_fn is not None:
             totals["ingest"] = stats_fn()
+        lat_fn = getattr(source, "latency_summary", None)
+        if lat_fn is not None:
+            lat = lat_fn()
+            if lat:
+                # produce->commit batch-latency percentiles (DESIGN §20)
+                totals["latency"] = lat
         if elastic is not None:
             # which generation of the elastic cluster produced the report
             totals["elastic_epoch"] = elastic.epoch
@@ -2217,6 +2228,12 @@ def _run_core_impl(
     if stats_fn is not None:
         # per-stage overlap accounting: parse-starved vs device-bound
         totals["ingest"] = stats_fn()
+    lat_fn = getattr(source, "latency_summary", None)
+    if lat_fn is not None:
+        lat = lat_fn()
+        if lat:
+            # produce->commit batch-latency percentiles (DESIGN §20)
+            totals["latency"] = lat
     if coal is not None:
         # raw-vs-unique accounting + the auto decision, in the report so
         # artifacts can state the compaction ratio a run actually saw

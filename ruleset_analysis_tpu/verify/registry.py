@@ -244,9 +244,124 @@ def audit_retry(root: str | None = None) -> list[AuditFinding]:
     return findings
 
 
+_DUMP_RE = re.compile(
+    r"""flightrec\.(?:dump|seal)\(\s*\n?\s*["']([a-z-]+)["']"""
+)
+
+
+def audit_observability(root: str | None = None) -> list[AuditFinding]:
+    """Flight-recorder triggers <-> dump sites <-> tests; gauge parity.
+
+    Two halves (ISSUE 15 satellite):
+
+    1. **Dump triggers.**  Every literal trigger passed to
+       ``flightrec.dump``/``seal`` in the package must be registered in
+       ``flightrec.TRIGGERS``; the error-classified triggers
+       (abort/stall/unhandled) are verified FUNCTIONALLY through
+       ``classify`` so a registry/classifier drift fails here; and every
+       registered trigger must appear in the test suite — an untested
+       crash path fails ``make lint`` instead of failing an operator.
+
+    2. **Gauge/histogram parity.**  The serve ``/metrics`` endpoint
+       renders the SAME latency histogram as JSON percentile gauges and
+       as a Prometheus histogram; this audit drives a synthetic
+       histogram through both renderings and fails on any divergence
+       (missing percentile keys, non-cumulative buckets, a
+       bucket-derived p99 that disagrees with the JSON gauge, or a
+       numeric gauge the prom gauge rendering drops).
+    """
+    from ..errors import AnalysisError, StallError
+    from ..runtime import flightrec
+    from ..runtime.autoscale import render_prom
+    from ..runtime.metrics import LatencyHistogram, quantile_from_prom
+
+    root = _repo_root(root)
+    findings: list[AuditFinding] = []
+
+    # -- half 1: triggers ------------------------------------------------
+    dumped: set[str] = set()
+    for path in _py_files(root, "ruleset_analysis_tpu"):
+        if path.endswith(os.path.join("runtime", "flightrec.py")):
+            continue
+        for m in _DUMP_RE.finditer(_read(path)):
+            dumped.add(m.group(1))
+    for trig in sorted(dumped - set(flightrec.TRIGGERS)):
+        findings.append(AuditFinding(
+            "observability", "dump-trigger-unregistered", trig,
+            "flightrec.dump()/seal() names a trigger missing from "
+            "TRIGGERS — the dump would raise instead of recording",
+        ))
+    for exc, want in (
+        (StallError("x"), "stall"),
+        (AnalysisError("x"), "abort"),
+        (ValueError("x"), "unhandled"),
+    ):
+        got = flightrec.classify(exc)
+        if got != want or got not in flightrec.TRIGGERS:
+            findings.append(AuditFinding(
+                "observability", "classifier-registry-drift",
+                type(exc).__name__,
+                f"classify() maps to {got!r}; expected registered "
+                f"trigger {want!r}",
+            ))
+    tests_text = "".join(_read(p) for p in _py_files(root, "tests"))
+    for trig in sorted(flightrec.TRIGGERS):
+        if f'"{trig}"' not in tests_text and f"'{trig}'" not in tests_text:
+            findings.append(AuditFinding(
+                "observability", "trigger-never-tested", trig,
+                "no test exercises or references this dump trigger",
+            ))
+
+    # -- half 2: gauge/histogram parity ----------------------------------
+    hist = LatencyHistogram()
+    for us in (3, 40, 40, 500, 2_000, 2_000, 2_000, 70_000, 900_000, 12_000_000):
+        hist.record(us * 1e-6)
+    gauges = hist.gauges("latency_probe_")
+    for key in ("latency_probe_p50_sec", "latency_probe_p90_sec",
+                "latency_probe_p99_sec", "latency_probe_count"):
+        if key not in gauges:
+            findings.append(AuditFinding(
+                "observability", "latency-gauge-missing", key,
+                "histogram gauges() dropped a required /metrics key",
+            ))
+    prom_gauges = render_prom(gauges, prefix="ra_serve_")
+    for key, v in gauges.items():
+        if isinstance(v, (int, float)) and f"ra_serve_{key}" not in prom_gauges:
+            findings.append(AuditFinding(
+                "observability", "gauge-prom-drift", key,
+                "a numeric /metrics JSON gauge is absent from the "
+                "Prometheus gauge rendering",
+            ))
+    name = "ra_probe_seconds"
+    prom = hist.render_prom(name)
+    cums = [
+        int(line.rsplit(" ", 1)[1])
+        for line in prom.splitlines()
+        if line.startswith(f"{name}_bucket")
+    ]
+    if any(b < a for a, b in zip(cums, cums[1:])):
+        findings.append(AuditFinding(
+            "observability", "histogram-not-cumulative", name,
+            "prom bucket counts must be non-decreasing in le order",
+        ))
+    if not cums or cums[-1] != hist.count or f"{name}_count {hist.count}" not in prom:
+        findings.append(AuditFinding(
+            "observability", "histogram-count-drift", name,
+            "prom +Inf bucket / _count disagree with the histogram count",
+        ))
+    for p, key in ((0.5, "p50_sec"), (0.9, "p90_sec"), (0.99, "p99_sec")):
+        if quantile_from_prom(prom, name, p) != gauges[f"latency_probe_{key}"]:
+            findings.append(AuditFinding(
+                "observability", "histogram-quantile-drift", key,
+                "the prom-bucket-derived quantile disagrees with the "
+                "JSON gauge of the same histogram",
+            ))
+    return findings
+
+
 def audit_registry(root: str | None = None) -> list[AuditFinding]:
-    """All four audits, in declaration order."""
+    """All five audits, in declaration order."""
     return (
         audit_faults(root) + audit_cli(root) + audit_volatile(root)
-        + audit_retry(root)
+        + audit_retry(root) + audit_observability(root)
     )

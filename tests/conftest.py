@@ -49,6 +49,11 @@ def pytest_configure(config):
 
 if not _needs_reexec():
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # Disable the flight recorder's DEFAULT-on CLI arming (DESIGN §20)
+    # so incidental cli.main() invocations across the suite don't write
+    # out/blackbox forensics into the working tree; tests that exercise
+    # the recorder pass an explicit --blackbox-dir, which overrides this.
+    os.environ.setdefault("RA_BLACKBOX", "off")
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (

@@ -155,10 +155,9 @@ def test_threaded_feeder_report_equals_process_tier(corpus):
     thr = run_stream_file(packed, paths, cfg, feed_workers=3, feed_mode="thread")
     prc = run_stream_file(packed, paths, cfg, feed_workers=3, feed_mode="process")
     jt, jp = json.loads(thr.to_json()), json.loads(prc.to_json())
-    for k in (
-        "elapsed_sec", "lines_per_sec", "compile_sec",
-        "sustained_lines_per_sec", "ingest", "throughput",
-    ):
+    from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS
+
+    for k in VOLATILE_TOTALS:
         jt["totals"].pop(k, None)
         jp["totals"].pop(k, None)
     assert jt == jp
@@ -273,11 +272,10 @@ def test_feeder_v6_plane_byte_identical_to_sequential(corpus6, tier):
 def _stripped(rep):
     import json
 
+    from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS
+
     j = json.loads(rep.to_json())
-    for k in (
-        "elapsed_sec", "lines_per_sec", "compile_sec",
-        "sustained_lines_per_sec", "ingest", "throughput",
-    ):
+    for k in VOLATILE_TOTALS:
         j["totals"].pop(k, None)
     return j
 

@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Crash-forensics doctor: postmortem bundle + exit code -> diagnosis.
+
+Usage:
+    python tools/doctor.py POSTMORTEM.json [--exit-code RC] [--json]
+
+The standalone twin of ``ruleset-analyze doctor`` (the logic lives in
+``ruleset_analysis_tpu/runtime/flightrec.py::diagnose``; this wrapper
+exists so a crashed box with only the repo checkout — no installed
+entry point — can still be diagnosed).  Reads the ``postmortem.json``
+an aborted run's flight recorder merged (``--blackbox-dir``, DESIGN
+§20), ranks the likely causes against the documented exit-code classes
+(README "Exit codes", 3-7), and prints the operator's next action.
+
+For the timeline view of the same bundle, ``tools/trace_summary.py``
+accepts a postmortem bundle directly and renders its ``blackbox`` block
+(final-window stage occupancy, dump trigger, cursor positions).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ruleset_analysis_tpu.runtime import flightrec  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="ranked diagnosis from a crashed run's postmortem "
+        "bundle (the first-response runbook for exit codes 3-7)"
+    )
+    ap.add_argument("bundle", help="postmortem.json, or the blackbox dir")
+    ap.add_argument("--exit-code", type=int, default=None, metavar="RC",
+                    help="the run's CLI exit code (default: from the bundle)")
+    ap.add_argument("--json", action="store_true", help="machine output")
+    args = ap.parse_args(argv)
+    try:
+        bundle = flightrec.load_bundle(args.bundle)
+    except Exception as e:  # unreadable/foreign file: a clean error line
+        print(f"error: unreadable postmortem bundle: {e}", file=sys.stderr)
+        return 1
+    diags = flightrec.diagnose(bundle, exit_code=args.exit_code)
+    if args.json:
+        print(json.dumps({
+            "trigger": bundle.get("trigger"),
+            "exit_code": (
+                args.exit_code if args.exit_code is not None
+                else bundle.get("exit_code")
+            ),
+            "failing_stage": bundle.get("analysis", {}).get("failing_stage"),
+            "diagnosis": diags,
+        }, indent=2))
+    else:
+        print(flightrec.render_diagnosis(bundle, diags))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

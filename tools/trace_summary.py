@@ -54,18 +54,70 @@ import sys
 STALL_NAMES = ("ingest.starved", "ingest.backpressure")
 
 
-def _load_events(path: str) -> list[dict]:
+def _load_events(path: str) -> tuple[list[dict], dict | None]:
+    """Events + the postmortem bundle when ``path`` is one.
+
+    A flight-recorder ``postmortem.json`` (runtime/flightrec.py, DESIGN
+    §20) holds per-PID ring shards of Chrome-trace-shaped events, so the
+    SAME occupancy/stall/instant machinery below reads a crash bundle —
+    the ``blackbox`` block carries the bundle-only facts (dump trigger,
+    cursors, failing stage).
+    """
     opener = gzip.open if path.endswith(".gz") else open
     with opener(path, "rt", encoding="utf-8") as f:
         data = json.load(f)
+    if isinstance(data, dict) and data.get("kind") == "ra-postmortem":
+        events = [
+            e
+            for shard in data.get("shards", [])
+            for e in shard.get("ring_events", [])
+        ]
+        return events, data
     if isinstance(data, dict):
-        return data.get("traceEvents", [])
-    return data  # bare event-array form is also valid Chrome JSON
+        return data.get("traceEvents", []), None
+    return data, None  # bare event-array form is also valid Chrome JSON
+
+
+def _blackbox_block(bundle: dict) -> dict:
+    """The postmortem-only facts: trigger, cursors, final-window view."""
+    a = bundle.get("analysis", {})
+    return {
+        "trigger": bundle.get("trigger"),
+        "exit_code": bundle.get("exit_code"),
+        "error": bundle.get("error"),
+        "error_type": bundle.get("error_type"),
+        "failing_stage": a.get("failing_stage"),
+        "fault_sites_fired": a.get("fault_sites_fired") or {},
+        "shards": [
+            {
+                "role": s.get("role"),
+                "pid": s.get("pid"),
+                "trigger": s.get("trigger"),
+                "ring_events": len(s.get("ring_events", [])),
+                "ring_total": s.get("ring_total"),
+                # the final ring window's per-stage busy % — what the
+                # process was doing in its last recorded seconds
+                "stage_occupancy_pct": next(
+                    (
+                        p.get("stage_occupancy_pct")
+                        for p in a.get("per_shard", [])
+                        if p.get("pid") == s.get("pid")
+                    ),
+                    {},
+                ),
+                "cursors": s.get("cursors", {}),
+            }
+            for s in bundle.get("shards", [])
+        ],
+        "queue_depths": a.get("queue_depths") or {},
+        "retries": a.get("retries") or {},
+        "degraded": a.get("degraded") or [],
+    }
 
 
 def summarize(path: str, top: int = 5) -> dict:
     """Machine-readable attribution for one merged trace file."""
-    events = _load_events(path)
+    events, bundle = _load_events(path)
     spans = [e for e in events if e.get("ph") == "X" and "ts" in e]
     instants = collections.Counter(
         e.get("name", "?") for e in events if e.get("ph") == "i"
@@ -82,6 +134,7 @@ def summarize(path: str, top: int = 5) -> dict:
             "stages": {},
             "top_stalls": [],
             "instants": dict(instants),
+            **({"blackbox": _blackbox_block(bundle)} if bundle else {}),
         }
     t_min = min(e["ts"] for e in spans)
     t_max = max(e["ts"] + e.get("dur", 0) for e in spans)
@@ -329,6 +382,7 @@ def summarize(path: str, top: int = 5) -> dict:
         **({"feed": feed} if feed else {}),
         **({"devprof": devprof} if devprof else {}),
         **({"retries": retries} if retries else {}),
+        **({"blackbox": _blackbox_block(bundle)} if bundle else {}),
     }
 
 
@@ -441,6 +495,35 @@ def render(s: dict) -> str:
                 f"({st['backoff_sec']:.3f}s backoff), "
                 f"{st['recoveries']} recovered, {st['giveups']} gave up"
             )
+    if s.get("blackbox"):
+        bb = s["blackbox"]
+        out.append(
+            f"  blackbox: trigger={bb['trigger']} exit_code={bb['exit_code']}"
+            f" error={bb.get('error_type')}: {bb.get('error')}"
+        )
+        out.append(f"    failing stage: {bb.get('failing_stage')}")
+        if bb.get("fault_sites_fired"):
+            fired = ", ".join(
+                f"{k} x{v}" for k, v in sorted(bb["fault_sites_fired"].items())
+            )
+            out.append(f"    fault sites fired: {fired}")
+        for sh in bb.get("shards", []):
+            out.append(
+                f"    shard [{sh.get('role')} pid {sh.get('pid')}] "
+                f"trigger={sh.get('trigger')} "
+                f"({sh.get('ring_events')} of {sh.get('ring_total')} ring "
+                f"events retained)"
+            )
+            occ = sh.get("stage_occupancy_pct") or {}
+            for name, pct in list(occ.items())[:4]:
+                out.append(f"      {pct:6.2f}%  {name}")
+            if sh.get("cursors"):
+                cur = ", ".join(
+                    f"{k}={v}" for k, v in sorted(sh["cursors"].items())
+                )
+                out.append(f"      cursors: {cur}")
+        if bb.get("degraded"):
+            out.append(f"    degraded: {'; '.join(bb['degraded'])}")
     if s["instants"]:
         marks = ", ".join(f"{k} x{v}" for k, v in sorted(s["instants"].items()))
         out.append(f"  instants: {marks}")
