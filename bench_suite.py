@@ -3020,6 +3020,182 @@ def bench_blackbox() -> dict:
     }
 
 
+def bench_tenant() -> dict:
+    """ISSUE 16: one packed N-tenant serve process vs N sequential
+    solo serves — the committed evidence is BENCH_TENANT_r18_cpu.json.
+
+    N small tenants with DISTINCT geometries (each its own key
+    universe) share one rule-rung bucket, so the packed process
+    compiles ONE tenant step for all of them while every solo process
+    compiles its own flat step, builds its own mesh, and stands up its
+    own serve machinery.  Measures wall-clock for the same traffic
+    (N x L lines, one window each) both ways; the ratio
+    (sequential-solo total / packed) must be >= 2.0 at N=16 — asserted
+    in-bench, like the blackbox budget.  Per-tenant window reports are
+    spot-checked bit-identical to the solo runs (the full sweep lives
+    in tests/test_tenancy.py).
+    """
+    import os
+    import shutil
+    import socket
+    import tempfile
+    import threading
+
+    import jax
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, ServeConfig
+    from ruleset_analysis_tpu.hostside import aclparse, synth
+    from ruleset_analysis_tpu.hostside import pack as pack_mod
+    from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS
+    from ruleset_analysis_tpu.runtime.serve import ServeDriver
+    from ruleset_analysis_tpu.runtime.tenantserve import TenantServeDriver
+
+    n_tenants = int(os.environ.get("RA_TENANTS", "16"))
+    n_lines = int(os.environ.get("RA_TENANT_LINES", "100"))
+    run_cfg = dict(batch_size=128, prefetch_depth=0)
+
+    def image(rep: dict) -> dict:
+        rep = json.loads(json.dumps(rep))
+        for k in VOLATILE_TOTALS:
+            rep["totals"].pop(k, None)
+        rep["totals"].pop("window", None)
+        rep["totals"].pop("tenant", None)
+        return rep
+
+    def drive(drv, feed, n_listeners):
+        out: dict = {}
+
+        def runner():
+            try:
+                out["summary"] = drv.run()
+            except BaseException as e:
+                out["error"] = e
+
+        th = threading.Thread(target=runner)
+        th.start()
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if out.get("error") is not None:
+                break
+            if drv.listeners.alive() == n_listeners:
+                break
+            time.sleep(0.02)
+        if "error" not in out:
+            feed(drv)
+        th.join(timeout=600)
+        if "error" in out:
+            raise out["error"]
+        return out["summary"]
+
+    td = tempfile.mkdtemp(prefix="ra-bench-tenant-")
+    try:
+        tenants: dict[str, tuple[str, list[str]]] = {}
+        rows = []
+        for i in range(n_tenants):
+            name = f"t{i:02d}"
+            cfg_text = synth.synth_config(
+                n_acls=2, rules_per_acl=6 + i, seed=10 + i, v6_fraction=0.0
+            )
+            packed = pack_mod.pack_rulesets(
+                [aclparse.parse_asa_config(cfg_text, f"fw{i}")]
+            )
+            prefix = os.path.join(td, f"rules{i}")
+            pack_mod.save_packed(packed, prefix)
+            t = _tuples(packed, n_lines, seed=20 + i)
+            lines = synth.render_syslog(packed, t, seed=30 + i)
+            tenants[name] = (prefix, lines)
+            rows.append({
+                "name": name, "ruleset": prefix,
+                "listen": ["tcp:127.0.0.1:0"],
+            })
+        manifest = os.path.join(td, "manifest.json")
+        with open(manifest, "w", encoding="utf-8") as f:
+            json.dump({"tenants": rows}, f)
+
+        log(f"tenant: packed serve, {n_tenants} tenants x {n_lines} lines")
+        t0 = time.perf_counter()
+        pdir = os.path.join(td, "packed")
+        scfg = ServeConfig(
+            listen=(), window_lines=n_lines, ring=4, serve_dir=pdir,
+            max_windows=n_tenants, http="off", checkpoint_every_windows=0,
+        )
+        drv = TenantServeDriver(manifest, AnalysisConfig(**run_cfg), scfg)
+
+        def feed_all(d):
+            by_tenant = {
+                ln.q.tenant: ln.address for ln in d.listeners.listeners
+            }
+            for name, (_prefix, lines) in sorted(tenants.items()):
+                s = socket.create_connection(tuple(by_tenant[name]))
+                s.sendall(("\n".join(lines) + "\n").encode())
+                s.close()
+
+        summary = drive(drv, feed_all, n_tenants)
+        packed_wall = time.perf_counter() - t0
+        assert summary["windows_published"] == n_tenants, summary
+        assert summary["lines_unrouted"] == 0, summary
+
+        solo_walls = []
+        for name, (prefix, lines) in sorted(tenants.items()):
+            log(f"tenant: solo serve {name}")
+            t0 = time.perf_counter()
+            sdir = os.path.join(td, f"solo-{name}")
+            sscfg = ServeConfig(
+                listen=("tcp:127.0.0.1:0",), window_lines=n_lines, ring=4,
+                serve_dir=sdir, max_windows=1, http="off",
+                checkpoint_every_windows=0,
+            )
+            sdrv = ServeDriver(prefix, AnalysisConfig(**run_cfg), sscfg)
+
+            def feed_one(d, _lines=lines):
+                s = socket.create_connection(
+                    tuple(d.listeners.listeners[0].address)
+                )
+                s.sendall(("\n".join(_lines) + "\n").encode())
+                s.close()
+
+            drive(sdrv, feed_one, 1)
+            solo_walls.append(time.perf_counter() - t0)
+        solo_total = sum(solo_walls)
+        ratio = solo_total / packed_wall
+
+        identical = 0
+        for name in sorted(tenants):
+            with open(os.path.join(
+                pdir, "t", name, "window-000000.json"
+            ), encoding="utf-8") as f:
+                a = json.load(f)
+            with open(os.path.join(
+                td, f"solo-{name}", "window-000000.json"
+            ), encoding="utf-8") as f:
+                b = json.load(f)
+            assert image(a) == image(b), f"{name} diverged from solo"
+            identical += 1
+        assert ratio >= 2.0, (
+            f"packed {n_tenants}-tenant serve is only {ratio:.2f}x "
+            f"sequential solo (packed {packed_wall:.1f}s vs "
+            f"solo {solo_total:.1f}s); want >= 2.0x"
+        )
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+    return {
+        "bench": "tenant",
+        "metric": "solo_sequential_over_packed_wall_ratio",
+        "value": round(ratio, 2),
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "devices": len(jax.devices()),
+            "tenants": n_tenants,
+            "lines_per_tenant": n_lines,
+            "packed_wall_sec": round(packed_wall, 2),
+            "solo_total_wall_sec": round(solo_total, 2),
+            "solo_wall_sec": [round(w, 2) for w in solo_walls],
+            "reports_bit_identical": identical,
+            "guards": {"ratio_ge_2": True, "bit_identical_all": True},
+        },
+    }
+
+
 BENCHES = {
     "stage": bench_stage,
     "exact": bench_exact,
@@ -3042,6 +3218,7 @@ BENCHES = {
     "rulescale": bench_rulescale,
     "retrysoak": bench_retrysoak,
     "blackbox": bench_blackbox,
+    "tenant": bench_tenant,
     "v6": bench_v6,
     "v6recall": bench_v6recall,
 }
@@ -3049,12 +3226,13 @@ BENCHES = {
 
 #: a bare `python bench_suite.py` runs these; `sustained` (≥1e8 lines —
 #: minutes of wall time by design), `servesoak` and `autoscale` (paced
-#: live-service soaks with sockets + threads) and `feedscale` (worker
-#: fleets of spawned processes) are explicit-only
+#: live-service soaks with sockets + threads), `feedscale` (worker
+#: fleets of spawned processes) and `tenant` (17 full serve drivers
+#: with live sockets) are explicit-only
 DEFAULT_BENCHES = [
     n for n in BENCHES
     if n not in ("sustained", "servesoak", "autoscale", "feedscale",
-                 "retrysoak", "blackbox")
+                 "retrysoak", "blackbox", "tenant")
 ]
 
 

@@ -785,6 +785,10 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         print("error: --static-witness-budget requires --static-analysis",
               file=sys.stderr)
         return 2
+    if bool(args.ruleset) == bool(args.tenants):
+        print("error: serve needs exactly one of --ruleset or "
+              "--tenants MANIFEST", file=sys.stderr)
+        return 2
     try:
         import os as _os
 
@@ -868,7 +872,28 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         # construction binds the listener sockets: a privileged port or
         # an address in use must be the documented clean error, not a
         # traceback
-        driver = ServeDriver(args.ruleset, cfg, scfg, topk=args.topk, ascfg=ascfg)
+        if args.tenants:
+            if ascfg is not None:
+                print("error: --autoscale does not combine with --tenants "
+                      "(the tenancy plane packs many rulesets onto one "
+                      "fixed mesh)", file=sys.stderr)
+                return 2
+            from .runtime.tenantserve import TenantServeDriver
+
+            try:
+                driver = TenantServeDriver(
+                    args.tenants, cfg, scfg, topk=args.topk
+                )
+            except errors.AnalysisError as e:
+                # bad manifest / unsupported combination (e.g. --resume
+                # with --tenants): typed refusal, exit 2.  A bad
+                # --ruleset stays on main()'s typed-load path (exit 1).
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+        else:
+            driver = ServeDriver(
+                args.ruleset, cfg, scfg, topk=args.topk, ascfg=ascfg
+            )
     except OSError as e:
         print(f"error: cannot bind --listen/--http: {e}", file=sys.stderr)
         return 2
@@ -1547,8 +1572,20 @@ def make_parser() -> argparse.ArgumentParser:
              "JSON endpoint; SIGHUP (or a watched ruleset-file change) "
              "hot-reloads the rule tensor with counter migration",
     )
-    p.add_argument("--ruleset", required=True, help="packed ruleset path prefix "
-                   "(re-read on reload)")
+    p.add_argument("--ruleset", default=None, help="packed ruleset path prefix "
+                   "(re-read on reload); exactly one of --ruleset/--tenants")
+    p.add_argument("--tenants", default=None, metavar="MANIFEST",
+                   help="multi-tenant mode (runtime/tenantserve.py): a JSON "
+                        "manifest of tenants ({'tenants': [{'name', "
+                        "'ruleset', 'listen': [...], 'hosts': [...], "
+                        "'default': bool}]}) hosts MANY rulesets on one "
+                        "mesh — per-tenant windows/reports under "
+                        "SERVE_DIR/t/<name>/, per-tenant HTTP routes "
+                        "(/tenants, /t/<name>/report...), tenant-labeled "
+                        "/metrics, and per-tenant hot reload that never "
+                        "pauses other tenants; lines route by @tenant "
+                        "tag > per-tenant listener > syslog hostname > "
+                        "manifest default")
     p.add_argument("--listen", action="append", default=[], metavar="SPEC",
                    help="ingress (repeatable): udp:HOST:PORT, "
                         "tcp:HOST:PORT (newline-framed), or tail:PATH "

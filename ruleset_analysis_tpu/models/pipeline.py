@@ -520,6 +520,21 @@ class DeviceRulesetStacked(NamedTuple):
     deny_key: jax.Array  # [n_acls] uint32
 
 
+class DeviceRulesetTenant(NamedTuple):
+    """Device-resident TENANT-stacked rule tensors (one packing bucket).
+
+    Many tenants' independently-packed rulesets, each padded to the
+    bucket's rule/ACL rungs (runtime/tenancy.py ladder) and stacked on a
+    leading tenant axis.  Each tenant keeps its OWN key/gid universe —
+    the step dynamically slices one tenant's plane out, runs the
+    unchanged flat core, and writes the plane back, so per-tenant
+    registers are bit-identical to a solo run of that tenant.
+    """
+
+    rules_t: jax.Array  # [T, R_pad, RULE_COLS] uint32, R_pad % rule_block == 0
+    deny_key_t: jax.Array  # [T, A_pad] uint32
+
+
 def ship_ruleset_stacked(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> DeviceRulesetStacked:
     from ..hostside.pack import stack_rules
 

@@ -30,7 +30,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..config import AnalysisConfig
 from ..models.pipeline import (
     AnalysisState, ChunkOut, DeviceRuleset, DeviceRuleset6,
-    DeviceRulesetStacked, V6_ACL_TAG, batch_cols, batch_cols6,
+    DeviceRulesetStacked, DeviceRulesetTenant, V6_ACL_TAG,
+    batch_cols, batch_cols6,
 )
 from ..ops import cms as cms_ops
 from ..ops import counts as count_ops
@@ -354,6 +355,68 @@ def _local_shard_step6(
     return _core6(state, ruleset6, cols, valid, salt, **kw)
 
 
+def _core_tenant(
+    state: AnalysisState,  # leaves carry a leading [T] tenant axis
+    ruleset: DeviceRulesetTenant,
+    cols: dict,  # unpacked field columns (batch_cols) — ONE tenant's lines
+    valid: jax.Array,  # [b] u32 weight plane
+    tid: jax.Array,  # i32 scalar tenant index into the bucket stack
+    salt: jax.Array,  # u32 scalar (per-tenant chunk counter), replicated
+    *,
+    axis: str,
+    n_keys: int,  # the BUCKET's padded key universe (R_pad + A_pad)
+    topk_k: int,
+    exact_counts: bool,
+    rule_block: int,
+    topk_sample_shift: int = 0,
+    counts_impl: str = "scatter",
+    update_impl: str = "scatter",
+    topk_every: int = 1,
+) -> tuple[AnalysisState, ChunkOut]:
+    # Tenant-sliced twin of _core_flat (ISSUE 16): every register plane
+    # carries a leading tenant axis; the step dynamically slices tenant
+    # `tid`'s plane + rule tensor out of the bucket stack, runs the
+    # UNCHANGED flat core on it, and scatters the plane back.  The merge
+    # laws are untouched (the collectives act on the sliced plane), so a
+    # tenant's slice evolves bit-identically to a solo run with the same
+    # chunk boundaries and salts — the tenancy property test pins it.
+    # dynamic_slice is not a scope-required primitive in the jaxpr lint
+    # plane, and the weight plane threads through _core_flat verbatim,
+    # so the tenant programs prove weight-linear exactly like flat ones.
+    with jax.named_scope("ra.tenant_slice"):
+        rules = lax.dynamic_index_in_dim(ruleset.rules_t, tid, 0, keepdims=False)
+        deny = lax.dynamic_index_in_dim(ruleset.deny_key_t, tid, 0, keepdims=False)
+        plane = AnalysisState(*(
+            lax.dynamic_index_in_dim(x, tid, 0, keepdims=False) for x in state
+        ))
+    plane, out = _core_flat(
+        plane, DeviceRuleset(rules=rules, deny_key=deny, rules_fm=None),
+        cols, valid, salt,
+        axis=axis, n_keys=n_keys, topk_k=topk_k, exact_counts=exact_counts,
+        rule_block=rule_block, match_impl="xla",
+        topk_sample_shift=topk_sample_shift, counts_impl=counts_impl,
+        update_impl=update_impl, topk_every=topk_every,
+    )
+    with jax.named_scope("ra.tenant_unslice"):
+        new_state = AnalysisState(*(
+            lax.dynamic_update_index_in_dim(big, small, tid, 0)
+            for big, small in zip(state, plane)
+        ))
+    return new_state, out
+
+
+def _local_shard_step_tenant(
+    state: AnalysisState,
+    ruleset: DeviceRulesetTenant,
+    batch: jax.Array,  # [TUPLE_COLS or WIRE_COLS, B/n] local shard
+    tid: jax.Array,  # i32 scalar, replicated
+    salt: jax.Array,  # u32 scalar, replicated
+    **kw,
+) -> tuple[AnalysisState, ChunkOut]:
+    cols, valid = batch_cols(batch)
+    return _core_tenant(state, ruleset, cols, valid, tid, salt, **kw)
+
+
 #: Post-unpack shard-step bodies by program kind — what the static lint
 #: plane traces (verify/grid.py).  The shipping steps above are thin
 #: unpack wrappers around exactly these functions, so a lint verdict on
@@ -362,6 +425,7 @@ CORES = {
     "flat": _core_flat,
     "stacked": _core_stacked,
     "v6": _core6,
+    "tenant": _core_tenant,
 }
 
 
@@ -602,6 +666,85 @@ def make_parallel_step6(
         cfg.exact_counts,
         rule_block,
         None,
+        cfg.sketch.topk_sample_shift,
+        cfg.counts_impl,
+        cfg.update_impl,
+        cfg.sketch.topk_every,
+    )
+
+
+@functools.lru_cache(maxsize=16)
+def _cached_tenant_step(
+    mesh: Mesh,
+    axis,
+    n_keys: int,
+    topk_k: int,
+    exact_counts: bool,
+    rule_block: int,
+    topk_sample_shift: int,
+    counts_impl: str,
+    update_impl: str,
+    topk_every: int,
+):
+    kwargs = dict(
+        axis=axis,
+        n_keys=n_keys,
+        topk_k=topk_k,
+        exact_counts=exact_counts,
+        rule_block=rule_block,
+        topk_sample_shift=topk_sample_shift,
+        counts_impl=counts_impl,
+        update_impl=update_impl,
+        topk_every=topk_every,
+    )
+    local = functools.partial(_local_shard_step_tenant, **kwargs)
+    sharded = _shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(), P(), P(None, axis), P(), P()),
+        out_specs=(P(), P()),
+    )
+    jfn = jax.jit(sharded, donate_argnums=(0,))
+
+    def step(state, ruleset, batch, tid: int | jax.Array, salt: int | jax.Array = 0):
+        tid = jnp.asarray(tid, dtype=jnp.int32)
+        salt = jnp.asarray(salt, dtype=_U32)
+        cap = devprof.active_capture()
+        if cap is not None:
+            return cap.dispatch(
+                "step.tenant", jfn, (state, ruleset, batch, tid, salt)
+            )
+        return jfn(state, ruleset, batch, tid, salt)
+
+    return step
+
+
+def make_tenant_step(
+    mesh: Mesh,
+    cfg: AnalysisConfig,
+    n_keys: int,
+    rule_block: int = RULE_BLOCK,
+):
+    """Build the jitted multi-tenant step for `mesh` (one packing bucket).
+
+    ``step(state, ruleset, batch, tid, salt)``: tenant-stacked state and
+    rule tensors replicated, ONE tenant's batch sharded on the data axis,
+    the tenant index ``tid`` a traced scalar.  Deliberately NEVER
+    ruleset-specialized (unlike :func:`_make_step`): the rule stack is a
+    traced argument, so hot-reloading one tenant — a value change in one
+    slice of the stack — reuses the same executable.  Constant-baking
+    would force a full recompile of the shared program on every
+    single-tenant reload, stalling every other tenant in the bucket,
+    which is exactly the isolation guarantee the tenancy plane makes.
+    Results are bit-identical either way (see _make_step docstring).
+    """
+    return _cached_tenant_step(
+        mesh,
+        _mesh_axes(mesh),
+        n_keys,
+        cfg.sketch.topk_chunk_candidates,
+        cfg.exact_counts,
+        rule_block,
         cfg.sketch.topk_sample_shift,
         cfg.counts_impl,
         cfg.update_impl,

@@ -410,6 +410,42 @@ def render_prom(gauges: dict, *, prefix: str = "ra_") -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_prom_labeled(
+    per_label: dict[str, dict],
+    *,
+    prefix: str = "ra_",
+    label: str = "tenant",
+) -> str:
+    """Labeled twin of :func:`render_prom` for per-tenant gauge families.
+
+    ``per_label`` maps one label value (tenant name) to that tenant's
+    flat numeric gauge dict; every gauge key becomes ONE metric family
+    with one ``{label="value"}`` series per tenant — so a scraper sums
+    or compares tenants without string-parsing metric names.  Same
+    skip-non-numeric / bool-as-int rules as the flat rendering; the
+    labeled drift audit (verify/registry.py) holds both renderings to
+    the same JSON source.
+    """
+    families: dict[str, list[str]] = {}
+    for value in sorted(per_label):
+        for key in sorted(per_label[value]):
+            v = per_label[value][key]
+            if isinstance(v, bool):
+                v = int(v)
+            if not isinstance(v, (int, float)):
+                continue
+            name = prefix + "".join(c if c.isalnum() else "_" for c in str(key))
+            body = f"{v:g}" if isinstance(v, float) else f"{v}"
+            families.setdefault(name, []).append(
+                f'{name}{{{label}="{value}"}} {body}'
+            )
+    lines: list[str] = []
+    for name in sorted(families):
+        lines.append(f"# TYPE {name} gauge")
+        lines.extend(families[name])
+    return "\n".join(lines) + "\n"
+
+
 # ---------------------------------------------------------------------------
 # Elastic actuation: the leader supervisor's per-generation controller.
 # ---------------------------------------------------------------------------

@@ -124,6 +124,10 @@ SITES: dict[str, tuple[str, str]] = {
     "reload.midbatch": (
         "raise", "a live ruleset reload fails mid-swap; the old rule "
         "tensor and counters must stay intact (atomic reload)"),
+    "tenancy.reload.restack": (
+        "raise", "a tenant bucket restack (stack-depth rung growth at "
+        "install/reload) fails mid-copy; the old stacks and every other "
+        "tenant's live registers must stay intact"),
     "autoscale.decide": (
         "raise", "the autoscale policy engine fails at the moment a "
         "scale decision is issued (decide->actuate seam); the run must "

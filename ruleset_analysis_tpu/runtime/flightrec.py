@@ -545,6 +545,14 @@ def _shard_analysis(shard: dict) -> dict:
             fault_sites[name[len("fault."):]] = (
                 fault_sites.get(name[len("fault."):], 0) + 1
             )
+    # tenant activity on the final ring window (multi-tenant serve tags
+    # rotate/reload/window events with args.tenant): ranks which lane
+    # was hot when the process died
+    tenant_events: dict[str, int] = {}
+    for e in events:
+        t = (e.get("args") or {}).get("tenant")
+        if isinstance(t, str):
+            tenant_events[t] = tenant_events.get(t, 0) + 1
     last = events[-1] if events else None
     return {
         "role": shard.get("role"),
@@ -552,6 +560,7 @@ def _shard_analysis(shard: dict) -> dict:
         "trigger": shard.get("trigger"),
         "stage_occupancy_pct": stage_occupancy(events),
         "fault_sites_fired": fault_sites,
+        "tenant_events": tenant_events,
         "last_event": (
             {"name": last.get("name"), "ph": last.get("ph")} if last else None
         ),
@@ -611,12 +620,15 @@ def merge(
             shards.append(shard)
     per_shard = [_shard_analysis(s) for s in shards]
     fault_sites: dict[str, int] = {}
+    tenant_events: dict[str, int] = {}
     retries: dict[str, dict] = {}
     queue_depths: dict[str, dict] = {}
     degraded: list[str] = []
     for shard, analysis in zip(shards, per_shard):
         for site, n in analysis["fault_sites_fired"].items():
             fault_sites[site] = fault_sites.get(site, 0) + n
+        for t, n in analysis["tenant_events"].items():
+            tenant_events[t] = tenant_events.get(t, 0) + n
         for site, c in (shard.get("retry") or {}).items():
             agg = retries.setdefault(
                 site, {"attempts": 0, "recoveries": 0, "giveups": 0}
@@ -658,6 +670,7 @@ def merge(
             "failing_stage": _failing_stage(shards),
             "per_shard": per_shard,
             "fault_sites_fired": fault_sites,
+            "tenant_events": tenant_events,
             "retries": retries,
             "queue_depths": queue_depths,
             "degraded": degraded,
@@ -820,6 +833,30 @@ def diagnose(bundle: dict, exit_code: int | None = None) -> list[dict]:
             f"failing stage: {stage}",
             "the error text is the contract; the ring's final events "
             "and cursors show exactly what committed before the abort",
+        )
+    if a.get("tenant_events"):
+        # multi-tenant serve: rank lanes by final-ring activity so the
+        # operator knows WHOSE traffic/reload the process died under —
+        # the cursors' last tenant names the in-flight lane exactly
+        ranked_t = sorted(
+            a["tenant_events"].items(), key=lambda kv: -kv[1]
+        )[:5]
+        cursor_tenant = next(
+            (
+                s.get("cursors", {}).get("tenant")
+                for s in a.get("per_shard", [])
+                if s.get("cursors", {}).get("tenant")
+            ),
+            None,
+        )
+        add(
+            "multi-tenant service: per-tenant activity ranking",
+            "final-ring events by tenant: "
+            + ", ".join(f"{t} x{n}" for t, n in ranked_t)
+            + (f"; cursor tenant: {cursor_tenant}" if cursor_tenant else ""),
+            "the top-ranked tenant's window/reload was in flight at the "
+            "dump; check its serve_dir/t/<name>/ reports and its "
+            "last_reload_error in /health before blaming the shared tier",
         )
     if a.get("retries"):
         tot = sum(r.get("attempts", 0) for r in a["retries"].values())

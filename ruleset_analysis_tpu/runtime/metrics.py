@@ -119,48 +119,70 @@ class LatencyHistogram:
         s = self.summary()
         return {f"{prefix}{k}": v for k, v in s.items()}
 
-    def render_prom(self, name: str) -> str:
+    def render_prom(self, name: str, labels: dict | None = None) -> str:
         """Prometheus histogram exposition (text format 0.0.4).
 
         Cumulative ``le`` buckets ending at ``+Inf``, plus ``_sum`` and
         ``_count`` — derived from the SAME counts as :meth:`summary`,
         so a scraper's bucket-derived p99 equals the JSON gauge exactly.
+        ``labels`` (e.g. ``{"tenant": "acme"}``) prefix the ``le`` label
+        on every bucket and brace the ``_sum``/``_count`` series — the
+        multi-tenant serve /metrics renders one labeled histogram per
+        tenant this way, and the labeled parity audit replays them
+        through :func:`quantile_from_prom` with the same labels.
         """
         with self._lock:
             counts = list(self.counts)
             total = self.count
             sum_sec = self.sum_sec
+        lab = _prom_labels(labels)
+        pre = f"{lab}," if lab else ""
+        suf = f"{{{lab}}}" if lab else ""
         lines = [f"# TYPE {name} histogram"]
         cum = 0
         for i, bound in enumerate(LATENCY_BUCKET_BOUNDS):
             cum += counts[i]
             # repr round-trips exactly: a scraper re-parsing the le label
             # recovers the identical float bound the JSON quantiles use
-            lines.append(f'{name}_bucket{{le="{bound!r}"}} {cum}')
-        lines.append(f'{name}_bucket{{le="+Inf"}} {total}')
-        lines.append(f"{name}_sum {sum_sec:.9g}")
-        lines.append(f"{name}_count {total}")
+            lines.append(f'{name}_bucket{{{pre}le="{bound!r}"}} {cum}')
+        lines.append(f'{name}_bucket{{{pre}le="+Inf"}} {total}')
+        lines.append(f"{name}_sum{suf} {sum_sec:.9g}")
+        lines.append(f"{name}_count{suf} {total}")
         return "\n".join(lines) + "\n"
 
 
-def quantile_from_prom(text: str, name: str, p: float) -> float | None:
+def _prom_labels(labels: dict | None) -> str:
+    """``k="v"`` label-pair body (no braces), sorted for determinism."""
+    if not labels:
+        return ""
+    return ",".join(f'{k}="{labels[k]}"' for k in sorted(labels))
+
+
+def quantile_from_prom(
+    text: str, name: str, p: float, labels: dict | None = None
+) -> float | None:
     """p-quantile from a Prometheus histogram exposition (tests/audit).
 
     Same conservative bucket-upper-bound rule as
     :meth:`LatencyHistogram.quantile`, so the prom and JSON renderings
     of one histogram must agree exactly — the drift check
-    ``verify/registry.py::audit_observability`` enforces.
+    ``verify/registry.py::audit_observability`` enforces.  ``labels``
+    selects one labeled series out of a multi-tenant exposition (must
+    match the ``render_prom(labels=...)`` that produced it).
     """
+    lab = _prom_labels(labels)
+    bucket_pre = f'{name}_bucket{{{lab},le="' if lab else f'{name}_bucket{{le="'
+    count_pre = f"{name}_count{{{lab}}} " if lab else f"{name}_count "
     buckets: list[tuple[float, int]] = []
     count = None
     for line in text.splitlines():
-        if line.startswith(f"{name}_bucket{{le=\""):
-            le, _, cum = line[len(f"{name}_bucket{{le=\""):].partition('"} ')
+        if line.startswith(bucket_pre):
+            le, _, cum = line[len(bucket_pre):].partition('"} ')
             buckets.append(
                 (math.inf if le == "+Inf" else float(le), int(cum))
             )
-        elif line.startswith(f"{name}_count "):
-            count = int(line.split()[1])
+        elif line.startswith(count_pre):
+            count = int(line.rsplit(" ", 1)[1])
     if count is None or not buckets:
         return None
     if count == 0:

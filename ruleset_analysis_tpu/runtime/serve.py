@@ -63,7 +63,7 @@ from ..ops.topk import TopKTracker
 from . import checkpoint as ckpt
 from . import devprof, faults, flightrec, obs, retrypolicy
 from .metrics import LatencyHistogram
-from .wal import WriteAheadLog
+from .wal import DEFAULT_TENANT, WriteAheadLog
 from .autoscale import PolicyEngine, render_prom, world_ladder
 from .report import diff_report_objs
 
@@ -126,6 +126,11 @@ class MigrationMap:
     gid_map: dict[int, int | None]  # old acl gid -> new gid (None = gone)
     old_n_keys: int
     new_n_keys: int
+    #: which tenant's key space this map rewrites (DEFAULT_TENANT for the
+    #: single-tenant service) — multi-tenant reloads migrate ONE lane's
+    #: ring/cumulative images, and the stamp keeps a misdirected
+    #: migration diagnosable in traces and tests
+    tenant: str = DEFAULT_TENANT
 
     @property
     def identity(self) -> bool:
@@ -136,7 +141,11 @@ class MigrationMap:
         )
 
 
-def build_migration(old: pack_mod.PackedRuleset, new: pack_mod.PackedRuleset) -> MigrationMap:
+def build_migration(
+    old: pack_mod.PackedRuleset,
+    new: pack_mod.PackedRuleset,
+    tenant: str = DEFAULT_TENANT,
+) -> MigrationMap:
     from collections import defaultdict, deque as _dq
 
     def ident(m):
@@ -155,7 +164,7 @@ def build_migration(old: pack_mod.PackedRuleset, new: pack_mod.PackedRuleset) ->
     gid_map = {
         gid: new.acl_gid.get(name) for name, gid in old.acl_gid.items()
     }
-    return MigrationMap(key_map, gid_map, old.n_keys, new.n_keys)
+    return MigrationMap(key_map, gid_map, old.n_keys, new.n_keys, tenant)
 
 
 def migrate_arrays(
@@ -1216,7 +1225,10 @@ class ServeDriver:
         n = 0
         noted = 0  # losses already charged to a window
         with obs.span("serve.wal.replay", from_seq=self._wal_resume_seq):
-            for seq, line in self.wal.replay(self._wal_resume_seq):
+            # tenant keys in the records are the tenancy plane's concern
+            # (runtime/tenantserve.py); the single-tenant driver replays
+            # every delivered line regardless of key
+            for seq, line, _tenant in self.wal.replay(self._wal_resume_seq):
                 # charge losses to the window open when they were
                 # OBSERVED (head-eviction gap -> the first replayed
                 # window; a mid-chain quarantine -> the window at that

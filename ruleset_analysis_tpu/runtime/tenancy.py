@@ -1,0 +1,649 @@
+"""Tenancy plane: many tenants' rulesets on one mesh (ISSUE 16).
+
+The paper's analysis semantics are embarrassingly parallel across
+independent rulesets, so one serve process can host thousands of
+firewall fleets ("tenants") on the same device mesh.  Three pieces:
+
+- **Packing ladder + registry.**  Each tenant keeps its OWN key/gid
+  universe (concatenating key spaces would move every CMS/HLL hash
+  position and break bit-identity with solo runs).  Tenants are
+  bucketed by their rule-count/ACL-count RUNGS — the same
+  geometric-ladder trick runtime/coalesce.py uses for batch shapes — and
+  each bucket stacks its members' padded rule tensors and register
+  planes on a leading tenant axis.  One compiled step per bucket
+  geometry serves every tenant in it.
+
+- **Engine.**  :class:`TenantEngine` owns the per-bucket device stacks
+  and dispatches one tenant's batch per device step
+  (``parallel/step.py::make_tenant_step``): the step dynamically slices
+  tenant ``tid``'s plane, runs the UNCHANGED flat core, and writes the
+  plane back — so each tenant's registers evolve bit-identically to a
+  solo run with the same chunk boundaries and salts (property-tested).
+  The step is never ruleset-specialized: hot-reloading one tenant is a
+  value update in one slice of a traced argument, no recompile, no
+  stall for the others.
+
+- **Router.**  Host-side: every ingested line is tagged with a tenant
+  id by (in precedence order) an explicit ``@tenant <name> `` line
+  prefix, the listener it arrived on, the syslog hostname map, or the
+  manifest's default tenant.
+
+The serve integration (per-tenant windows/reports/quarantine/reload,
+fairness accounting, labeled /metrics) lives in runtime/tenantserve.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+import time
+
+import numpy as np
+
+from ..errors import AnalysisError
+from ..hostside.listener import LineQueue
+from ..hostside.pack import PackedRuleset
+from ..ops.match import RULE_BLOCK
+from .wal import DEFAULT_TENANT
+
+__all__ = [
+    "DEFAULT_TENANT", "TENANT_TAG_PREFIX", "TenantSpec", "load_manifest",
+    "rule_rung", "acl_rung", "tenant_rung", "bucket_key",
+    "TenantRouter", "TenantLineQueue", "TenantTap", "TenantEngine",
+]
+
+#: Explicit in-band routing tag: a line beginning ``@tenant <name> `` is
+#: routed to ``<name>`` with the tag stripped before parsing.  Wins over
+#: listener binding and hostname mapping (an operator-injected override).
+TENANT_TAG_PREFIX = "@tenant "
+
+#: Tenant names travel in WAL records, prom labels, file paths, and URL
+#: segments — keep them boring.  Bounded well under the WAL's 255-byte
+#: record key limit.
+_NAME_RE = re.compile(r"^[a-z0-9][a-z0-9_.-]{0,62}$")
+
+
+def check_tenant_name(name: str) -> str:
+    if not _NAME_RE.match(name or ""):
+        raise AnalysisError(
+            f"invalid tenant name {name!r}: want ^[a-z0-9][a-z0-9_.-]{{0,62}}$"
+        )
+    return name
+
+
+# ---------------------------------------------------------------------------
+# Manifest
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's manifest row (``serve --tenants manifest.json``)."""
+
+    name: str
+    ruleset: str  # packed-ruleset path prefix (pack.load_packed)
+    listen: tuple[str, ...] = ()  # listener specs bound to THIS tenant
+    hosts: tuple[str, ...] = ()  # syslog hostnames routed to this tenant
+    default: bool = False  # route otherwise-unmatched lines here
+
+
+def load_manifest(path: str) -> list[TenantSpec]:
+    """Parse + validate a tenants manifest.
+
+    ``{"tenants": [{"name": ..., "ruleset": ..., "listen": [...],
+    "hosts": [...], "default": bool}, ...]}``.  Typed refusals for the
+    ambiguities that would silently misroute: duplicate names, a
+    hostname claimed by two tenants, more than one default.
+    """
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        raise AnalysisError(f"cannot read tenants manifest {path!r}: {e}") from e
+    rows = doc.get("tenants") if isinstance(doc, dict) else None
+    if not isinstance(rows, list) or not rows:
+        raise AnalysisError(
+            f"tenants manifest {path!r} must hold a non-empty 'tenants' list"
+        )
+    specs: list[TenantSpec] = []
+    seen_names: set[str] = set()
+    seen_hosts: dict[str, str] = {}
+    defaults: list[str] = []
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict) or "name" not in row or "ruleset" not in row:
+            raise AnalysisError(
+                f"tenants[{i}] must be an object with 'name' and 'ruleset'"
+            )
+        name = check_tenant_name(str(row["name"]))
+        if name in seen_names:
+            raise AnalysisError(f"duplicate tenant name {name!r} in manifest")
+        seen_names.add(name)
+        hosts = tuple(str(h) for h in row.get("hosts", ()))
+        for h in hosts:
+            if h in seen_hosts:
+                raise AnalysisError(
+                    f"hostname {h!r} claimed by tenants {seen_hosts[h]!r} "
+                    f"and {name!r} — routing would be ambiguous"
+                )
+            seen_hosts[h] = name
+        if row.get("default"):
+            defaults.append(name)
+        specs.append(TenantSpec(
+            name=name,
+            ruleset=str(row["ruleset"]),
+            listen=tuple(str(s) for s in row.get("listen", ())),
+            hosts=hosts,
+            default=bool(row.get("default", False)),
+        ))
+    if len(defaults) > 1:
+        raise AnalysisError(
+            f"manifest declares {len(defaults)} default tenants "
+            f"({', '.join(defaults)}); at most one is allowed"
+        )
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Packing ladder (coalesce.py's geometric-rung trick, applied to rules)
+# ---------------------------------------------------------------------------
+
+
+def rule_rung(n_rules: int, rule_block: int = RULE_BLOCK) -> int:
+    """Smallest ``rule_block * 2**i`` >= ``n_rules`` (geometric ladder).
+
+    Bounding the distinct rule paddings bounds the distinct compiled
+    step programs — exactly why coalesce buckets batch shapes.  Rungs
+    stay RULE_BLOCK multiples so the bucket's stacked tensor feeds the
+    unchanged blocked match kernel.
+    """
+    r = rule_block
+    while r < max(n_rules, 1):
+        r *= 2
+    return r
+
+
+def acl_rung(n_acls: int) -> int:
+    """Smallest power of two >= ``n_acls`` (deny-key plane rung)."""
+    a = 1
+    while a < max(n_acls, 1):
+        a *= 2
+    return a
+
+
+def tenant_rung(n_tenants: int) -> int:
+    """Smallest power of two >= ``n_tenants`` (stack depth rung): a
+    tenant joining a bucket restacks at most O(log T) times ever."""
+    t = 1
+    while t < max(n_tenants, 1):
+        t *= 2
+    return t
+
+
+def bucket_key(packed: PackedRuleset, rule_block: int = RULE_BLOCK) -> tuple[int, int]:
+    """(rule rung, ACL rung) — the bucket a packed ruleset lands in."""
+    return rule_rung(packed.rules.shape[0], rule_block), acl_rung(packed.n_acls)
+
+
+# ---------------------------------------------------------------------------
+# Host-side routing
+# ---------------------------------------------------------------------------
+
+
+class TenantRouter:
+    """Line -> tenant id, by explicit tag > listener > hostname > default.
+
+    Pure host-side string work; the device step never sees routing.
+    Unroutable lines return ``(None, line)`` and the caller accounts
+    them (``lines_unrouted_total``) — routing must never silently guess.
+    """
+
+    def __init__(self, specs: list[TenantSpec]):
+        self.names = [s.name for s in specs]
+        self._known = set(self.names)
+        self._host_map = {
+            h: s.name for s in specs for h in s.hosts
+        }
+        self.default = next((s.name for s in specs if s.default), None)
+
+    def route(self, line: str, listener_tenant: str | None = None
+              ) -> tuple[str | None, str]:
+        """Resolve one raw line; returns (tenant | None, line-sans-tag)."""
+        if line.startswith(TENANT_TAG_PREFIX):
+            rest = line[len(TENANT_TAG_PREFIX):]
+            name, sep, body = rest.partition(" ")
+            if sep and name in self._known:
+                return name, body
+            return None, line  # tagged for a tenant this process lacks
+        if listener_tenant is not None:
+            return listener_tenant, line
+        host = self._syslog_host(line)
+        if host is not None:
+            hit = self._host_map.get(host)
+            if hit is not None:
+                return hit, line
+        return self.default, line
+
+    @staticmethod
+    def _syslog_host(line: str):
+        # the SAME token the parser resolves as the firewall name
+        # (hostside/syslog.py::_TAG_RE group 1), so hostname routing and
+        # gid resolution can never disagree about who sent the line
+        from ..hostside.syslog import _TAG_RE
+
+        m = _TAG_RE.search(line)
+        return m.group(1) if m else None
+
+
+class TenantLineQueue(LineQueue):
+    """LineQueue whose entries carry the ingress tenant tag.
+
+    Listeners bound to a tenant enqueue through a :class:`TenantTap`
+    (the tag rides WITH the line, so routing never races the queue);
+    untagged listeners enqueue with ``tag=None`` and the router decides
+    at consume time.  Drop/receipt accounting is inherited unchanged —
+    one shared bounded queue is the fairness boundary, and the per-
+    tenant consume counters in tenantserve expose who filled it.
+    """
+
+    def put(self, line: str, tag: str | None = None) -> bool:  # type: ignore[override]
+        t = time.monotonic()
+        with self._lock:
+            self.received += 1
+            if len(self._q) >= self.capacity:
+                self.dropped += 1
+                return False
+            self._q.append((line, t, tag))  # type: ignore[arg-type]
+            self._ready.notify()
+            return True
+
+    def pop_ts(self, timeout: float = 0.2):
+        got = self.pop_tagged(timeout)
+        return None if got is None else (got[0], got[1])
+
+    def pop_tagged(self, timeout: float = 0.2) -> tuple[str, float, str | None] | None:
+        """Next line WITH receipt stamp AND ingress tenant tag."""
+        with self._ready:
+            if not self._q:
+                self._ready.wait(timeout)
+            if not self._q:
+                return None
+            return self._q.popleft()  # type: ignore[return-value]
+
+
+class TenantTap:
+    """Per-listener queue adapter stamping a fixed tenant tag.
+
+    Listeners only ever call ``put`` / ``note_forced_drop`` /
+    ``note_discarded`` (hostside/listener.py), so this duck-typed shim
+    is the entire ingress-side routing hook: one shared queue, per-
+    listener provenance.
+    """
+
+    def __init__(self, q: TenantLineQueue, tenant: str | None):
+        self.q = q
+        self.tenant = tenant
+
+    def put(self, line: str) -> bool:
+        return self.q.put(line, self.tenant)
+
+    def note_forced_drop(self) -> None:
+        self.q.note_forced_drop()
+
+    def note_discarded(self, n: int = 1) -> None:
+        self.q.note_discarded(n)
+
+
+# ---------------------------------------------------------------------------
+# Engine: per-bucket device stacks + the tenant step dispatch
+# ---------------------------------------------------------------------------
+
+
+def _pad_rules_to(rules: np.ndarray, r_pad: int) -> np.ndarray:
+    from ..hostside.pack import NO_ACL, R_ACL, RULE_COLS
+
+    out = np.zeros((r_pad, RULE_COLS), dtype=np.uint32)
+    out[:, R_ACL] = NO_ACL  # padding rows can never match any line
+    out[: rules.shape[0]] = rules
+    return out
+
+
+def _pad_deny_to(deny_key: np.ndarray, a_pad: int) -> np.ndarray:
+    out = np.zeros(a_pad, dtype=np.uint32)
+    out[: deny_key.shape[0]] = deny_key.astype(np.uint32)
+    return out
+
+
+class _Bucket:
+    """One (rule rung, ACL rung) bucket: stacked tensors + its step."""
+
+    __slots__ = (
+        "r_pad", "a_pad", "t_pad", "names", "rules_t", "deny_t", "state",
+        "step",
+    )
+
+    def __init__(self, r_pad: int, a_pad: int):
+        self.r_pad = r_pad
+        self.a_pad = a_pad
+        self.t_pad = 0
+        self.names: list[str | None] = []  # slot -> tenant (None = free)
+        self.rules_t = None  # jax [T, r_pad, RULE_COLS]
+        self.deny_t = None  # jax [T, a_pad]
+        self.state = None  # AnalysisState, leaves [T, ...]
+        self.step = None
+
+    @property
+    def n_keys(self) -> int:
+        """The bucket's padded key universe (every member's rule keys
+        and deny keys index strictly below it)."""
+        return self.r_pad + self.a_pad
+
+
+class TenantEngine:
+    """Device-side tenancy: bucketed rule/register stacks, one step each.
+
+    ``run_batch(name, batch, salt)`` steps ONE tenant's packed batch;
+    callers (the tenant serve driver) interleave tenants freely because
+    every register plane is tenant-sliced and the merge laws are
+    unchanged.  Hot reload (:meth:`reload_tenant`) updates one slice of
+    a traced rule argument — same executable, other tenants unaffected.
+    """
+
+    def __init__(
+        self,
+        mesh,
+        cfg,
+        rulesets: dict[str, PackedRuleset],
+        rule_block: int = RULE_BLOCK,
+    ):
+        if not rulesets:
+            raise AnalysisError("TenantEngine needs at least one tenant")
+        self.mesh = mesh
+        self.cfg = cfg
+        self.rule_block = rule_block
+        self.packed: dict[str, PackedRuleset] = {}
+        self.buckets: dict[tuple[int, int], _Bucket] = {}
+        self._slot: dict[str, tuple[tuple[int, int], int]] = {}
+        # Batch construction: the final rung of every bucket is known up
+        # front, so assemble each bucket's stacks host-side (numpy) and
+        # ship them in ONE transfer per bucket.  Installing tenants one
+        # at a time instead costs a per-slot ``.at[tid].set`` program
+        # PER tenant (tid is baked into the jaxpr) — dozens of tiny XLA
+        # compiles that dominate cold-start at 16+ tenants.
+        import jax.numpy as jnp
+
+        from ..hostside.pack import NO_ACL, R_ACL, RULE_COLS
+
+        by_bucket: dict[tuple[int, int], list[tuple[str, PackedRuleset]]] = {}
+        for name in sorted(rulesets):
+            nm = check_tenant_name(name)
+            self._check_v4_only(nm, rulesets[name])
+            bkey = bucket_key(rulesets[name], rule_block)
+            by_bucket.setdefault(bkey, []).append((nm, rulesets[name]))
+        for bkey in sorted(by_bucket):
+            members = by_bucket[bkey]
+            bucket = _Bucket(*bkey)
+            self.buckets[bkey] = bucket
+            bucket.t_pad = tenant_rung(len(members))
+            self._check_budget(bkey, bucket.t_pad)
+            rules_np = np.zeros(
+                (bucket.t_pad, bucket.r_pad, RULE_COLS), dtype=np.uint32
+            )
+            rules_np[:, :, R_ACL] = NO_ACL
+            deny_np = np.zeros((bucket.t_pad, bucket.a_pad), dtype=np.uint32)
+            for tid, (nm, packed) in enumerate(members):
+                rules_np[tid] = _pad_rules_to(packed.rules, bucket.r_pad)
+                deny_np[tid] = _pad_deny_to(packed.deny_key, bucket.a_pad)
+                bucket.names.append(nm)
+                self.packed[nm] = packed
+                self._slot[nm] = (bkey, tid)
+            bucket.rules_t = jnp.asarray(rules_np)
+            bucket.deny_t = jnp.asarray(deny_np)
+            bucket.state = self._zeros_stack(bucket)
+
+    # -- assembly ---------------------------------------------------------
+    def _check_v4_only(self, name: str, packed: PackedRuleset) -> None:
+        if packed.rules6 is not None and packed.rules6.shape[0] > 0:
+            raise AnalysisError(
+                f"tenant {name!r}: IPv6 ACE rows are not supported on the "
+                "tenancy plane yet (single-tenant serve handles v6); "
+                "ROADMAP scope bound"
+            )
+
+    def _check_budget(self, bkey: tuple[int, int], t_pad: int) -> None:
+        from ..models.pipeline import register_bytes
+
+        n_keys = bkey[0] + bkey[1]
+        per = sum(register_bytes(n_keys, self.cfg).values())
+        budget = self.cfg.register_memory_budget_bytes
+        if per * t_pad > budget:
+            raise AnalysisError(
+                f"tenant bucket {bkey} x {t_pad} slots needs "
+                f"{per * t_pad} register bytes > budget {budget}; "
+                "lower --hll-p/--cms-width or raise --register-budget-mb"
+            )
+
+    def _zeros_stack(self, bucket: _Bucket):
+        import jax.numpy as jnp
+
+        from ..models.pipeline import AnalysisState, init_state_host
+
+        plane = init_state_host(bucket.n_keys, self.cfg)
+        return AnalysisState(*(
+            jnp.zeros((bucket.t_pad, *leaf.shape), dtype=leaf.dtype)
+            for leaf in plane
+        ))
+
+    def _install(self, name: str, packed: PackedRuleset) -> None:
+        """Place a tenant into its bucket (fresh zero register plane)."""
+        self._check_v4_only(name, packed)
+        import jax.numpy as jnp
+
+        bkey = bucket_key(packed, self.rule_block)
+        bucket = self.buckets.get(bkey)
+        if bucket is None:
+            bucket = _Bucket(*bkey)
+            self.buckets[bkey] = bucket
+        try:
+            tid = bucket.names.index(None)  # reuse a freed slot
+        except ValueError:
+            tid = len(bucket.names)
+            if tid >= bucket.t_pad:  # grow the stack one rung
+                new_t = tenant_rung(tid + 1)
+                self._check_budget(bkey, new_t)
+                self._restack(bucket, new_t)
+            bucket.names.append(None)
+        rules = jnp.asarray(_pad_rules_to(packed.rules, bucket.r_pad))
+        deny = jnp.asarray(_pad_deny_to(packed.deny_key, bucket.a_pad))
+        bucket.rules_t = bucket.rules_t.at[tid].set(rules)
+        bucket.deny_t = bucket.deny_t.at[tid].set(deny)
+        bucket.names[tid] = name
+        self.packed[name] = packed
+        self._slot[name] = (bkey, tid)
+        self.zero_tenant(name)
+
+    def _restack(self, bucket: _Bucket, new_t: int) -> None:
+        """Grow a bucket's stacks to ``new_t`` slots (value-preserving).
+
+        Pure array concatenation — no other tenant's slice moves, no
+        flush, no recompile of OTHER buckets; the bucket's own step
+        recompiles once for the new stack depth (the geometric rung
+        bounds that to O(log T) compiles over the bucket's lifetime).
+        """
+        import jax.numpy as jnp
+
+        from ..hostside.pack import NO_ACL, R_ACL, RULE_COLS
+        from ..models.pipeline import AnalysisState
+        from . import faults
+
+        # chaos seam: a mid-restack failure must leave the old stacks
+        # (and every other tenant's live registers) fully intact
+        faults.fire("tenancy.reload.restack")
+        old_t = bucket.t_pad
+        bucket.t_pad = new_t
+        if old_t == 0:
+            pad_rules = np.zeros((new_t, bucket.r_pad, RULE_COLS), dtype=np.uint32)
+            pad_rules[:, :, R_ACL] = NO_ACL
+            bucket.rules_t = jnp.asarray(pad_rules)
+            bucket.deny_t = jnp.zeros((new_t, bucket.a_pad), dtype=jnp.uint32)
+            bucket.state = self._zeros_stack(bucket)
+            return
+        grow = new_t - old_t
+        pad_rules = np.zeros((grow, bucket.r_pad, RULE_COLS), dtype=np.uint32)
+        pad_rules[:, :, R_ACL] = NO_ACL
+        bucket.rules_t = jnp.concatenate(
+            [bucket.rules_t, jnp.asarray(pad_rules)], axis=0
+        )
+        bucket.deny_t = jnp.concatenate(
+            [bucket.deny_t, jnp.zeros((grow, bucket.a_pad), dtype=jnp.uint32)],
+            axis=0,
+        )
+        bucket.state = AnalysisState(*(
+            jnp.concatenate(
+                [leaf, jnp.zeros((grow, *leaf.shape[1:]), dtype=leaf.dtype)],
+                axis=0,
+            )
+            for leaf in bucket.state
+        ))
+        bucket.step = None  # stack depth changed; rebuild lazily
+
+    # -- introspection ----------------------------------------------------
+    def tenants(self) -> list[str]:
+        return sorted(self._slot)
+
+    def bucket_of(self, name: str) -> _Bucket:
+        return self.buckets[self._slot[name][0]]
+
+    def slot_of(self, name: str) -> int:
+        return self._slot[name][1]
+
+    def describe(self) -> dict:
+        """Registry image for /tenants + the flight recorder cursor."""
+        return {
+            "tenants": {
+                name: {
+                    "bucket": list(bkey), "slot": tid,
+                    "n_rules": int(self.packed[name].rules.shape[0]),
+                    "n_keys": int(self.packed[name].n_keys),
+                }
+                for name, (bkey, tid) in sorted(self._slot.items())
+            },
+            "buckets": {
+                f"{r}x{a}": {
+                    "rule_rung": r, "acl_rung": a, "slots": b.t_pad,
+                    "occupied": sum(1 for n in b.names if n is not None),
+                }
+                for (r, a), b in sorted(self.buckets.items())
+            },
+        }
+
+    # -- the hot path -----------------------------------------------------
+    def run_batch(self, name: str, batch: np.ndarray, salt: int = 0):
+        """Step one tenant's working batch ``[TUPLE_COLS, B]``; returns
+        the host-bound ChunkOut (top-K candidates) for the caller's
+        tracker.  The bucket's register stack updates in place."""
+        from ..hostside import pack as pack_mod
+        from ..parallel import mesh as mesh_lib
+        from ..parallel.step import make_tenant_step
+
+        bkey, tid = self._slot[name]
+        bucket = self.buckets[bkey]
+        if bucket.step is None:
+            bucket.step = make_tenant_step(
+                self.mesh, self.cfg, bucket.n_keys, self.rule_block
+            )
+        wire = pack_mod.compact_batch(batch)
+        dev = mesh_lib.shard_batch(self.mesh, wire)
+        ruleset = self._device_ruleset(bucket)
+        bucket.state, out = bucket.step(bucket.state, ruleset, dev, tid, salt)
+        return out
+
+    @staticmethod
+    def _device_ruleset(bucket: _Bucket):
+        from ..models.pipeline import DeviceRulesetTenant
+
+        return DeviceRulesetTenant(
+            rules_t=bucket.rules_t, deny_key_t=bucket.deny_t
+        )
+
+    # -- per-tenant register plane I/O ------------------------------------
+    def host_arrays(self, name: str) -> dict[str, np.ndarray]:
+        """Fetch ONE tenant's register plane, sliced to ITS key universe
+        (bit-identical to a solo run's state_to_host)."""
+        import jax
+
+        from ..models.pipeline import AnalysisState
+
+        bkey, tid = self._slot[name]
+        bucket = self.buckets[bkey]
+        k = self.packed[name].n_keys
+        out = {}
+        for field, leaf in zip(AnalysisState._fields, bucket.state):
+            arr = np.asarray(jax.device_get(leaf[tid]))
+            if field in ("counts_lo", "counts_hi", "hll"):
+                arr = arr[:k].copy()
+            out[field] = arr
+        return out
+
+    def set_arrays(self, name: str, arrays: dict[str, np.ndarray]) -> None:
+        """Write a tenant's register plane back (checkpoint restore /
+        post-migration reload), padding key-indexed files to the rung."""
+        import jax.numpy as jnp
+
+        from ..models.pipeline import AnalysisState
+
+        bkey, tid = self._slot[name]
+        bucket = self.buckets[bkey]
+        leaves = []
+        for field, leaf in zip(AnalysisState._fields, bucket.state):
+            arr = np.asarray(arrays[field], dtype=np.uint32)
+            if field in ("counts_lo", "counts_hi", "hll"):
+                pad = np.zeros(leaf.shape[1:], dtype=np.uint32)
+                pad[: arr.shape[0]] = arr
+                arr = pad
+            leaves.append(leaf.at[tid].set(jnp.asarray(arr)))
+        bucket.state = AnalysisState(*leaves)
+
+    def zero_tenant(self, name: str) -> None:
+        """Zero one tenant's register plane (window rotation)."""
+        from ..models.pipeline import AnalysisState
+
+        bkey, tid = self._slot[name]
+        bucket = self.buckets[bkey]
+        bucket.state = AnalysisState(*(
+            leaf.at[tid].set(0) for leaf in bucket.state
+        ))
+
+    # -- reload -----------------------------------------------------------
+    def reload_tenant(self, name: str, packed: PackedRuleset) -> None:
+        """Atomically swap one tenant's rule tensor (register plane is
+        the CALLER's to migrate via host_arrays/set_arrays around this).
+
+        Same rungs: an in-place slice update of the traced rule stack —
+        the compiled step is untouched, so no other tenant even
+        observes the reload.  Rung change: the tenant moves buckets
+        (its old slot frees); only the destination bucket's step can
+        (re)compile, and only when the move grows a stack.
+        """
+        import jax.numpy as jnp
+
+        self._check_v4_only(name, packed)
+        if name not in self._slot:
+            raise AnalysisError(f"unknown tenant {name!r}")
+        old_key, tid = self._slot[name]
+        new_key = bucket_key(packed, self.rule_block)
+        if new_key == old_key:
+            bucket = self.buckets[old_key]
+            rules = jnp.asarray(_pad_rules_to(packed.rules, bucket.r_pad))
+            deny = jnp.asarray(_pad_deny_to(packed.deny_key, bucket.a_pad))
+            bucket.rules_t = bucket.rules_t.at[tid].set(rules)
+            bucket.deny_t = bucket.deny_t.at[tid].set(deny)
+            self.packed[name] = packed
+            return
+        # bucket move: free the old slot, install into the new rung
+        old_bucket = self.buckets[old_key]
+        self.zero_tenant(name)
+        old_bucket.names[tid] = None
+        del self._slot[name]
+        del self.packed[name]
+        self._install(name, packed)

@@ -1,0 +1,1366 @@
+"""Multi-tenant serve: thousands of rulesets on one mesh (ISSUE 16).
+
+:class:`TenantServeDriver` is the tenancy-plane twin of
+``serve.py::ServeDriver``: one process, one mesh, one listener queue —
+but N independent tenants, each with its OWN ruleset, register plane,
+window clock, report ring, quarantine bucket, and latency histogram.
+It composes the single-tenant service's building blocks rather than
+forking them:
+
+- **Ingest.**  One shared :class:`~.tenancy.TenantLineQueue`; listeners
+  bound to a tenant in the manifest enqueue through a
+  :class:`~.tenancy.TenantTap` (provenance rides with the line), shared
+  listeners enqueue untagged and the :class:`~.tenancy.TenantRouter`
+  resolves at consume time (explicit ``@tenant`` tag > listener >
+  syslog hostname > manifest default).  Unroutable lines are counted
+  (``lines_unrouted_total``), never guessed.  With ``--wal`` every
+  routed line spools durably WITH its tenant key (wal.py record v2).
+
+- **Device.**  :class:`~.tenancy.TenantEngine` owns the bucketed rule /
+  register stacks and one never-specialized compiled step per bucket
+  geometry; this driver interleaves tenants' batches freely because
+  every register plane is tenant-sliced (per-tenant reports are
+  bit-identical to solo runs — property-tested).
+
+- **Windows.**  Each tenant rotates on its OWN clock: lines-mode
+  counts that tenant's lines; wall-mode staggers the lanes across the
+  cadence so N publishes never stampede one instant.  Rotation pulls
+  ONE tenant's plane to host, publishes under ``serve_dir/t/<name>/``,
+  and zeroes only that tenant's slice.
+
+- **Hot reload, isolated.**  ``request_reload(name)`` re-packs ONE
+  tenant: its inflight batch flushes, its static verdicts re-compute,
+  its registers/ring/trackers migrate through the same MigrationMap
+  machinery (stamped with the tenant key), and the engine swaps one
+  slice of a traced rule stack — no recompile, no flush, no paused
+  window for any other tenant (pinned by test).  A failed reload is
+  atomic per tenant: that lane keeps its old ruleset and counters.
+
+- **Fairness + SLO.**  The shared queue is the fairness boundary:
+  per-tenant routed/consumed counters and share fractions are first-
+  class ``/metrics`` gauges (JSON and Prometheus ``{tenant=...}``
+  labels via ``autoscale.render_prom_labeled``), so a noisy tenant
+  starving the ring is visible, not silent.  Ingest->publish latency
+  keeps one log2-bucket histogram PER TENANT plus the aggregate, with
+  p50/p90/p99 gauges derived from the same counts the prom buckets
+  expose.
+
+Deliberate scope bounds (typed refusals, not silent downgrades):
+``--resume``/ring checkpointing, ``--autoscale``, IPv6 tenant rules,
+and stacked/coalesced layouts stay single-tenant features for now.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..config import AnalysisConfig, ServeConfig
+from ..errors import AnalysisError, FeedWorkerError, StallError
+from ..hostside import pack as pack_mod
+from ..hostside.listener import ListenerSet, make_listener
+from ..models import pipeline
+from ..ops.topk import TopKTracker
+from . import devprof, faults, flightrec, obs, retrypolicy
+from .autoscale import render_prom, render_prom_labeled
+from .metrics import LatencyHistogram
+from .report import diff_report_objs
+from .serve import (
+    WindowEpoch,
+    WindowRing,
+    _merge_quarantine,
+    _quarantine_totals,
+    build_migration,
+    merge_register_arrays,
+    migrate_arrays,
+    migrate_tracker_tables,
+    zero_arrays,
+)
+from .tenancy import (
+    TenantEngine,
+    TenantLineQueue,
+    TenantRouter,
+    TenantTap,
+    load_manifest,
+)
+from .wal import WriteAheadLog
+
+
+class _ReloadFlushError(Exception):
+    """A step failure while flushing the reloading lane's inflight batch
+    — the analysis is broken, not the reload; re-raised as the cause."""
+
+
+class _Lane:
+    """One tenant's host-side serve state (windows, ring, counters).
+
+    The device-side twin is the tenant's slice in the engine's bucket
+    stacks; everything here is plain host bookkeeping, so lanes are
+    fully independent — the isolation guarantee falls out of the
+    structure instead of needing locks per field.
+    """
+
+    def __init__(self, spec, packed):
+        self.name = spec.name
+        self.spec = spec
+        self.packed = packed
+        self.ring: WindowRing | None = None  # sized in run()
+        self.published: dict[str, dict] = {}
+        self.window_reports: dict[int, dict] = {}
+        self.cum_arrays: dict[str, np.ndarray] | None = None
+        self.cum_tracker: TopKTracker | None = None
+        self.cum_quarantine: dict[tuple, int] = {}
+        self.cum_incomplete_reasons: list[str] = []
+        self.cum_incomplete_windows: list[int] = []
+        self.lat_cum = LatencyHistogram()
+        self.windows_published = 0
+        self.total_lines = 0
+        self.total_parsed = 0
+        self.total_skipped = 0
+        self.total_chunks = 0
+        self.routed_total = 0  # lines routed here (incl. not-yet-windowed)
+        self.talker_entries_dropped = 0
+        self.reloads = 0
+        self.reload_errors = 0
+        self.last_reload_error = ""
+        # static-analysis plane (per tenant: a reload re-verdicts ONLY
+        # its own lane)
+        self.sa = None
+        self.static_obj: dict | None = None
+        self.static_done_t: float | None = None
+        self.static_duration = 0.0
+        # window-local fields are (re)set by _begin_window
+        self.win_id = 0
+        self.next_rotation: float | None = None
+
+
+class TenantServeDriver:
+    """The multi-tenant always-on service (one process, one mesh).
+
+    Same lifecycle contract as ``ServeDriver``: construction loads and
+    validates everything host-side (manifest, packed rulesets, listener
+    and HTTP binds), the blocking :meth:`run` owns the device loop, and
+    tests drive it from a thread through the listeners / HTTP endpoint.
+    """
+
+    def __init__(
+        self,
+        manifest_path: str,
+        cfg: AnalysisConfig,
+        scfg: ServeConfig,
+        *,
+        topk: int = 10,
+        mesh=None,
+    ):
+        if cfg.layout != "flat":
+            raise AnalysisError(
+                "serve --tenants supports layout='flat' only (the stacked "
+                "group buffer has no window boundary semantics)"
+            )
+        if cfg.coalesce != "off":
+            raise AnalysisError(
+                "serve --tenants does not support --coalesce; the tenancy "
+                "plane applies the geometric ladder to RULE shapes instead"
+            )
+        if cfg.resume:
+            raise AnalysisError(
+                "serve --tenants does not support --resume yet: the ring "
+                "checkpoint format is single-tenant (ROADMAP scope bound); "
+                "drop --resume"
+            )
+        self.manifest_path = manifest_path
+        self.cfg = cfg
+        self.scfg = scfg
+        self.topk = topk
+        self._mesh_arg = mesh
+        self.specs = load_manifest(manifest_path)
+        self.router = TenantRouter(self.specs)
+        self.lanes: dict[str, _Lane] = {}
+        for spec in self.specs:
+            try:
+                packed = pack_mod.load_packed(spec.ruleset)
+            except OSError as e:
+                raise AnalysisError(
+                    f"tenant {spec.name!r}: cannot read packed ruleset "
+                    f"{spec.ruleset!r}: {e}"
+                ) from e
+            self.lanes[spec.name] = _Lane(spec, packed)
+        self.queue = TenantLineQueue(scfg.queue_lines)
+        # one ListenerSet over one shared queue; tenant-bound listeners
+        # enqueue through a TenantTap so provenance rides with the line
+        self.listeners = ListenerSet(self.queue, [])
+        def _add(spec_str: str, tenant: str | None) -> None:
+            ln = make_listener(TenantTap(self.queue, tenant), spec_str)
+            # tenant provenance + index in the label: endpoint.json and
+            # /health key addresses by label, and two port-0 binds would
+            # otherwise collide
+            ln.label = (
+                f"{ln.label}#{len(self.listeners.listeners)}"
+                f"@{tenant or 'shared'}"
+            )
+            self.listeners.listeners.append(ln)
+
+        try:
+            for spec_str in scfg.listen:
+                _add(spec_str, None)
+            for spec in self.specs:
+                for spec_str in spec.listen:
+                    _add(spec_str, spec.name)
+        except BaseException:
+            self.listeners.close()
+            raise
+        if not self.listeners.listeners:
+            raise AnalysisError(
+                "serve --tenants needs at least one listener: --listen or a "
+                "per-tenant 'listen' in the manifest"
+            )
+        self._reload_req = threading.Event()
+        self._reload_names: deque[str] = deque()  # empty + event set = all
+        self._reload_lock = threading.Lock()
+        self._stop_req = threading.Event()
+        self._pub_lock = threading.Lock()
+        self._deg_lock = threading.Lock()
+        self.degraded: dict[str, str] = {}
+        self.degraded_events = 0
+        self.recovered_events = 0
+        self._http = None
+        if scfg.http != "off":
+            host, _, port = scfg.http.rpartition(":")
+            try:
+                self._http = _make_tenant_http_server((host, int(port)), self)
+            except BaseException:
+                self.listeners.close()
+                raise
+        self._http_thread = None
+        self._watch_thread = None
+        self._old_signals: dict = {}
+        # service-wide counters
+        self.windows_published = 0
+        self.reloads = 0
+        self.reload_errors = 0
+        self.lines_consumed_total = 0
+        self.lines_unrouted_total = 0
+        self.total_lines = 0
+        self.lat_cum = LatencyHistogram()  # aggregate across tenants
+        self.wal: WriteAheadLog | None = None
+        self.world = 0  # mesh extent, set in run()
+        self._t0 = time.time()
+
+    # -- public control surface -------------------------------------------
+    def request_reload(self, tenant: str | None = None) -> None:
+        """Queue a hot reload: one tenant, or every tenant (SIGHUP)."""
+        with self._reload_lock:
+            if tenant is not None:
+                self._reload_names.append(tenant)
+        self._reload_req.set()
+
+    def stop(self) -> None:
+        self._stop_req.set()
+
+    @property
+    def http_address(self) -> tuple[str, int] | None:
+        srv = self._http
+        return tuple(srv.server_address[:2]) if srv is not None else None
+
+    # -- degraded-mode plane (serve.py discipline, per-tenant subsystems) --
+    def _degrade(self, subsystem: str, err) -> None:
+        with self._deg_lock:
+            if subsystem not in self.degraded:
+                self.degraded_events += 1
+                obs.instant(
+                    "serve.degraded",
+                    args={"subsystem": subsystem, "error": str(err)[:200]},
+                )
+            self.degraded[subsystem] = f"{type(err).__name__}: {err}" if isinstance(
+                err, BaseException
+            ) else str(err)
+
+    def _recover(self, subsystem: str) -> None:
+        with self._deg_lock:
+            if subsystem in self.degraded:
+                del self.degraded[subsystem]
+                self.recovered_events += 1
+                obs.instant("serve.recovered", args={"subsystem": subsystem})
+
+    def degraded_set(self) -> list[str]:
+        with self._deg_lock:
+            return sorted(self.degraded)
+
+    def _check_metrics_health(self) -> None:
+        h = obs.metrics_health()
+        if h is None:
+            return
+        if not h["alive"] or h["consec_errors"] > 0:
+            self._degrade(
+                "metrics", h["last_error"] or "metrics snapshotter thread died"
+            )
+        else:
+            self._recover("metrics")
+
+    # -- run --------------------------------------------------------------
+    def run(self) -> dict:
+        """Serve until stopped; returns a summary dict (also written to
+        ``serve_dir/summary.json``)."""
+        from ..parallel import mesh as mesh_lib
+
+        scfg = self.scfg
+        os.makedirs(scfg.serve_dir, exist_ok=True)
+        armed_here = faults.arm_spec(self.cfg.fault_plan)
+        retrypolicy.configure(self.cfg.retry_policy)
+        if self.cfg.blackbox_dir:
+            flightrec.arm(self.cfg.blackbox_dir, role="serve")
+        aborted: BaseException | None = None
+        try:
+            mesh = self._mesh_arg or mesh_lib.make_mesh(
+                axis=self.cfg.mesh_axis,
+                topology=self.cfg.mesh_shape,
+                dcn=self.cfg.mesh_dcn,
+            )
+            self.mesh = mesh
+            self.world = mesh_lib.data_extent(mesh)
+            self.batch_size = mesh_lib.pad_batch_size(
+                self.cfg.batch_size, mesh, self.cfg.mesh_axis
+            )
+            self.engine = TenantEngine(
+                mesh, self.cfg, {n: l.packed for n, l in self.lanes.items()}
+            )
+            flightrec.cursor(tenants=len(self.lanes))
+            for lane in self.lanes.values():
+                lane.ring = WindowRing(scfg.ring)
+                lane.cum_arrays = zero_arrays(lane.packed.n_keys, self.cfg)
+                lane.cum_tracker = TopKTracker(self.cfg.sketch.topk_capacity)
+                if scfg.static_analysis:
+                    # initial analysis failures degrade ONE tenant's
+                    # static plane; every other lane publishes verdicts
+                    try:
+                        sa, dur = self._compute_static(lane.packed, reuse=None)
+                    except AnalysisError as e:
+                        self._degrade(f"static_analysis:{lane.name}", e)
+                    else:
+                        self._publish_static(lane, sa, dur)
+            if scfg.wal:
+                self.wal = WriteAheadLog(
+                    scfg.wal_dir or os.path.join(scfg.serve_dir, "wal"),
+                    segment_bytes=scfg.wal_segment_bytes,
+                    budget_bytes=scfg.wal_budget_bytes,
+                )
+                # no --resume on the tenancy plane yet: every run starts
+                # a fresh spool (the record-v2 tenant key is exercised by
+                # the wal-level replay tests)
+                self.wal.reset()
+            obs.register_sampler("listener", self._sample_metrics)
+            obs.register_sampler("serve", self.metrics_gauges)
+            self.listeners.start()
+            now = time.monotonic()
+            n = len(self.lanes)
+            for i, name in enumerate(sorted(self.lanes)):
+                lane = self.lanes[name]
+                self._begin_window(lane)
+                if scfg.window_sec:
+                    # stagger first rotations across the cadence so N
+                    # tenants never publish (and fsync) the same instant
+                    lane.next_rotation = (
+                        now + scfg.window_sec * (1.0 + i / n)
+                    )
+            self._start_http()
+            self._start_watcher()
+            self._install_signals()
+            self._write_json("", "endpoint.json", {
+                "pid": os.getpid(),
+                "http": list(self.http_address) if self.http_address else None,
+                "listeners": self.listeners.addresses(),
+                "serve_dir": os.path.abspath(scfg.serve_dir),
+                "tenants": sorted(self.lanes),
+            })
+            self._loop()
+        except BaseException as e:
+            aborted = e
+            raise
+        finally:
+            try:
+                self._teardown(aborted)
+            finally:
+                if armed_here:
+                    faults.disarm()
+        summary = {
+            "tenants": {
+                name: {
+                    "windows_published": lane.windows_published,
+                    "lines_total": lane.total_lines,
+                    "reloads": lane.reloads,
+                    "reload_errors": lane.reload_errors,
+                    "quarantine_hits": int(sum(lane.cum_quarantine.values())),
+                }
+                for name, lane in sorted(self.lanes.items())
+            },
+            "windows_published": self.windows_published,
+            "lines_total": self.total_lines,
+            "lines_unrouted": self.lines_unrouted_total,
+            "drops": self.queue.snapshot()["dropped"],
+            "reloads": self.reloads,
+            "reload_errors": self.reload_errors,
+            "serve_dir": os.path.abspath(scfg.serve_dir),
+            "world": self.world,
+            "degraded": self.degraded_set(),
+            "degraded_events": self.degraded_events,
+            "recovered_events": self.recovered_events,
+            "retry": retrypolicy.counters(),
+        }
+        if self.wal is not None:
+            summary["wal"] = self.wal.stats()
+        self._write_json("", "summary.json", summary)
+        return summary
+
+    # -- static analysis (per tenant) -------------------------------------
+    def _compute_static(self, packed, reuse):
+        from . import staticanalysis
+
+        t0 = time.monotonic()
+        with obs.span("serve.static_analysis"):
+            sa = staticanalysis.analyze_ruleset(
+                packed,
+                witness_budget=self.scfg.static_witness_budget,
+                reuse=reuse,
+            )
+        return sa, time.monotonic() - t0
+
+    def _publish_static(self, lane: _Lane, sa, duration: float) -> None:
+        obj = sa.to_obj(lane.packed)
+        with self._pub_lock:
+            self._install_static(lane, sa, obj, duration)
+        self._write_json(lane.name, "static.json", obj)
+
+    def _install_static(self, lane: _Lane, sa, obj, duration: float) -> None:
+        """Caller holds ``_pub_lock`` (same joint-swap rule as serve.py)."""
+        lane.sa = sa
+        lane.static_obj = obj
+        lane.published["static"] = obj
+        lane.static_done_t = time.time()
+        lane.static_duration = duration
+        self._recover(f"static_analysis:{lane.name}")
+
+    def _attach_static(self, lane: _Lane, obj: dict, *, strict: bool) -> dict:
+        if lane.static_obj is None:
+            return obj
+        from . import staticanalysis
+
+        return staticanalysis.attach_static_obj(
+            obj, lane.static_obj, strict=strict
+        )
+
+    # -- window lifecycle (per lane) --------------------------------------
+    def _begin_window(self, lane: _Lane) -> None:
+        from .stream import LineBatcher
+
+        packer = pack_mod.LinePacker(lane.packed)
+        # the tenancy plane is v4-only (engine refuses rules6 rows), so
+        # the batcher's v6 staging is permanently empty
+        lane.batcher = LineBatcher(packer, False, [], {}, self.batch_size)
+        lane.tracker = TopKTracker(self.cfg.sketch.topk_capacity)
+        lane.pending = deque()
+        lane.n_chunks = 0  # window-local candidate-table salt
+        lane.win_lines = 0
+        lane.win_pushed = 0
+        lane.win_reloads = 0
+        lane.win_quarantine = {}
+        lane._win_t0 = time.time()
+        lane._win_t0_mono = time.monotonic()
+        lane._win_lat = LatencyHistogram()
+        lane._win_receipts = []
+        lane._recv_stride = 1
+        lane._recv_i = 0
+        base = getattr(lane, "_next_drops_base", None)
+        lane._drops_at_start = (
+            base if base is not None else self.queue.snapshot()["dropped"]
+        )
+        lane._listeners_ok_at_start = (
+            self.listeners.alive() == len(self.listeners.listeners)
+        )
+        lane._win_saw_stall = False
+
+    _RECEIPT_CAP = 4096
+
+    def _note_receipt(self, lane: _Lane, t_recv: float) -> None:
+        if lane._recv_i % lane._recv_stride == 0:
+            lane._win_receipts.append(t_recv)
+            if len(lane._win_receipts) >= self._RECEIPT_CAP:
+                lane._win_receipts = lane._win_receipts[::2]
+                lane._recv_stride *= 2
+        lane._recv_i += 1
+
+    def _drain(self, lane: _Lane, out: pipeline.ChunkOut) -> None:
+        lane.tracker.offer_chunk(
+            np.asarray(out.cand_acl),
+            np.asarray(out.cand_src),
+            np.asarray(out.cand_est),
+        )
+
+    def _consume_event(self, lane: _Lane, ev) -> None:
+        batch_np, n_raw = ev
+        if batch_np is None:
+            lane.win_lines += n_raw
+            obs.add_lines(n_raw)
+            return
+        out = self.engine.run_batch(lane.name, batch_np, salt=lane.n_chunks)
+        lane.pending.append(out)
+        if len(lane.pending) > 2:
+            self._drain(lane, lane.pending.popleft())
+        lane.n_chunks += 1
+        lane.win_lines += n_raw
+        obs.add_lines(n_raw)
+
+    def _flush_inflight(self, lane: _Lane) -> None:
+        """Step ONE lane's consumed-but-unstepped tail (rotation/reload
+        barrier for that lane only — no other tenant flushes)."""
+        tail = lane.batcher.flush()
+        if tail is not None:
+            self._consume_event(lane, tail)
+        while lane.pending:
+            self._drain(lane, lane.pending.popleft())
+
+    def _window_meta(self, lane: _Lane, *, partial: bool) -> dict:
+        drops = self.queue.snapshot()["dropped"] - lane._drops_at_start
+        lane._next_drops_base = lane._drops_at_start + drops
+        listeners_ok = (
+            self.listeners.alive() == len(self.listeners.listeners)
+        )
+        reasons = []
+        if drops > 0:
+            # the queue is SHARED: a drop may be any tenant's line, so
+            # every window the drop overlaps carries the marker — a
+            # shared-fate bound is honest, a per-tenant guess is not
+            reasons.append("dropped_lines")
+        if lane._listeners_ok_at_start and not listeners_ok:
+            reasons.append("listener_died")
+        if not lane._listeners_ok_at_start:
+            reasons.append("listener_down")
+        if lane._win_saw_stall or self.listeners.stalled(
+            self.cfg.stall_timeout_sec
+        ):
+            reasons.append("listener_stalled")
+        packer = lane.batcher.packer
+        meta = {
+            "id": lane.win_id,
+            "tenant": lane.name,
+            "mode": "lines" if self.scfg.window_lines else "sec",
+            "length": self.scfg.window_lines or self.scfg.window_sec,
+            "lines": lane.win_lines,
+            "parsed": packer.parsed,
+            "skipped": packer.skipped,
+            "chunks": lane.n_chunks,
+            "drops": int(drops),
+            "reloads": lane.win_reloads,
+            "started_unix": round(lane._win_t0, 3),
+            "ended_unix": round(time.time(), 3),
+            "elapsed_sec": round(time.monotonic() - lane._win_t0_mono, 4),
+        }
+        if partial:
+            meta["partial"] = True
+        if reasons:
+            meta["incomplete"] = {"drops": int(drops), "reasons": reasons}
+        return meta
+
+    def _window_totals(self, lane: _Lane, meta: dict, quarantine,
+                       latency=None) -> dict:
+        elapsed = meta.get(
+            "elapsed_sec", max(meta["ended_unix"] - meta["started_unix"], 0.0)
+        )
+        totals = {
+            "lines_total": meta["lines"],
+            "lines_matched": meta["parsed"],
+            "lines_skipped": meta["skipped"],
+            "chunks": meta["chunks"],
+            "elapsed_sec": round(elapsed, 4),
+            "lines_per_sec": (
+                round(meta["lines"] / elapsed, 1) if elapsed > 0 else 0.0
+            ),
+            "tenant": lane.name,
+            "window": meta,
+        }
+        if latency:
+            totals["latency"] = {"ingest_to_publish": latency}
+        qt = _quarantine_totals(quarantine)
+        if qt:
+            totals["quarantine"] = qt
+        deg = self.degraded_set()
+        if deg:
+            totals["degraded"] = deg
+        return totals
+
+    def _rotate(self, lane: _Lane, *, partial: bool = False) -> None:
+        with obs.span("serve.rotate", window=lane.win_id, tenant=lane.name):
+            self._flush_inflight(lane)
+            t_pub = time.monotonic()
+            for t_recv in lane._win_receipts:
+                lane._win_lat.record(
+                    max(t_pub - t_recv, 0.0), n=lane._recv_stride
+                )
+            lane.lat_cum.merge(lane._win_lat)
+            self.lat_cum.merge(lane._win_lat)
+            win_latency = (
+                lane._win_lat.summary() if lane._win_lat.count else None
+            )
+            meta = self._window_meta(lane, partial=partial)
+            # ONE tenant's plane comes to host; every other tenant's
+            # slice stays on device, untouched
+            arrays = self.engine.host_arrays(lane.name)
+            ep = WindowEpoch(
+                arrays=arrays,
+                meta=meta,
+                tracker_tables=lane.tracker.tables(),
+                quarantine=dict(lane.win_quarantine),
+            )
+            rep = pipeline.finalize(
+                pipeline.AnalysisState(**arrays), lane.packed, self.cfg,
+                lane.tracker, topk=self.topk,
+                totals=self._window_totals(
+                    lane, meta, lane.win_quarantine, latency=win_latency
+                ),
+                v6_digests={},
+            )
+            rep_obj = self._attach_static(
+                lane,
+                json.loads(rep.to_json()),
+                strict=meta.get("reloads", 0) == 0 and self.cfg.exact_counts,
+            )
+            if meta.get("incomplete"):
+                lane.cum_incomplete_windows.append(meta["id"])
+                for r in meta["incomplete"]["reasons"]:
+                    if r not in lane.cum_incomplete_reasons:
+                        lane.cum_incomplete_reasons.append(r)
+            with self._pub_lock:
+                lane.ring.push(ep)
+                prev = lane.published.get("report")
+                _merge_quarantine(lane.cum_quarantine, lane.win_quarantine)
+            lane.cum_arrays = merge_register_arrays([lane.cum_arrays, arrays])
+            for acl, table in ep.tracker_tables.items():
+                for src, est in table.items():
+                    lane.cum_tracker.offer(int(acl), int(src), int(est))
+            lane.total_lines += meta["lines"]
+            lane.total_parsed += meta["parsed"]
+            lane.total_skipped += meta["skipped"]
+            lane.total_chunks += meta["chunks"]
+            self.total_lines += meta["lines"]
+            # zero ONLY this tenant's register slice, open its next window
+            self.engine.zero_tenant(lane.name)
+            lane.win_id += 1
+            self._begin_window(lane)
+            lane.windows_published += 1
+            self.windows_published += 1
+            flightrec.cursor(
+                tenant=lane.name,
+                window=meta["id"],
+                windows_published=self.windows_published,
+            )
+            obs.metric_event(
+                "serve.window", tenant=lane.name, id=meta["id"],
+                lines=meta["lines"], chunks=meta["chunks"],
+                drops=meta["drops"],
+            )
+            self._publish(lane, rep_obj, prev, meta)
+
+    def _publish(self, lane: _Lane, rep_obj, prev, meta) -> None:
+        with obs.span("serve.publish", window=meta["id"], tenant=lane.name):
+            cum_obj = self._attach_static(
+                lane,
+                json.loads(self._render_cumulative(lane).to_json()),
+                strict=False,
+            )
+            diff_obj = None
+            if prev is not None:
+                diff_obj = diff_report_objs(prev, rep_obj, top=self.topk)
+                diff_obj["windows"] = [
+                    prev["totals"].get("window", {}).get("id"), meta["id"],
+                ]
+                diff_obj["tenant"] = lane.name
+            with self._pub_lock:
+                lane.published["report"] = rep_obj
+                lane.published["cumulative"] = cum_obj
+                if diff_obj is not None:
+                    lane.published["diff"] = diff_obj
+                lane.window_reports[meta["id"]] = rep_obj
+                live = set(lane.ring.window_ids())
+                evicted = [w for w in lane.window_reports if w not in live]
+                for wid in evicted:
+                    del lane.window_reports[wid]
+            for wid in evicted:
+                for fname in (f"window-{wid:06d}.json", f"diff-{wid:06d}.json"):
+                    try:
+                        os.remove(os.path.join(
+                            self.scfg.serve_dir, "t", lane.name, fname
+                        ))
+                    except OSError:
+                        pass
+            self._write_json(lane.name, f"window-{meta['id']:06d}.json", rep_obj)
+            self._write_json(lane.name, "latest.json", rep_obj)
+            self._write_json(lane.name, "cumulative.json", cum_obj)
+            if diff_obj is not None:
+                self._write_json(
+                    lane.name, f"diff-{meta['id']:06d}.json", diff_obj
+                )
+
+    def _render_cumulative(self, lane: _Lane):
+        q = lane.cum_quarantine
+        totals = {
+            "lines_total": lane.total_lines,
+            "lines_matched": lane.total_parsed,
+            "lines_skipped": lane.total_skipped,
+            "chunks": lane.total_chunks,
+            "tenant": lane.name,
+            "window": {
+                "cumulative_windows": lane.windows_published + 1,
+                "reloads": lane.reloads,
+                **(
+                    {"incomplete": {
+                        "windows": list(lane.cum_incomplete_windows),
+                        "reasons": list(lane.cum_incomplete_reasons),
+                    }}
+                    if lane.cum_incomplete_windows
+                    else {}
+                ),
+            },
+        }
+        qt = _quarantine_totals(q)
+        if qt:
+            totals["quarantine"] = qt
+        return pipeline.finalize(
+            pipeline.AnalysisState(**lane.cum_arrays), lane.packed, self.cfg,
+            lane.cum_tracker, topk=self.topk, totals=totals, v6_digests={},
+        )
+
+    def window_report(self, tenant: str, wid: int) -> dict | None:
+        lane = self.lanes.get(tenant)
+        if lane is None:
+            return None
+        with self._pub_lock:
+            return lane.window_reports.get(wid)
+
+    def published(self, tenant: str, name: str) -> dict | None:
+        lane = self.lanes.get(tenant)
+        if lane is None:
+            return None
+        with self._pub_lock:
+            return lane.published.get(name)
+
+    # -- hot reload (one tenant; others untouched) -------------------------
+    def _maybe_reload(self) -> None:
+        if not self._reload_req.is_set():
+            return
+        self._reload_req.clear()
+        with self._reload_lock:
+            names = list(self._reload_names) or sorted(self.lanes)
+            self._reload_names.clear()
+        for name in names:
+            lane = self.lanes.get(name)
+            if lane is None:
+                continue
+            with obs.span("serve.reload", tenant=name):
+                try:
+                    self._do_reload(lane)
+                except _ReloadFlushError as e:
+                    raise e.__cause__
+                except (AnalysisError, ValueError, OSError) as e:
+                    # atomic PER TENANT: this lane keeps its old tensor
+                    # and counters; every other lane never even sees it
+                    lane.reload_errors += 1
+                    lane.last_reload_error = str(e)
+                    self.reload_errors += 1
+                    obs.instant("serve.reload.failed", args={
+                        "tenant": name, "error": str(e)[:200],
+                    })
+
+    def _do_reload(self, lane: _Lane) -> None:
+        old_packed = lane.packed
+        new_packed = pack_mod.load_packed(lane.spec.ruleset)
+        # fault site FIRST (serve.py discipline): a reload dying mid-swap
+        # leaves this tenant — and trivially all others — intact
+        faults.fire("reload.midbatch")
+        mig = build_migration(old_packed, new_packed, tenant=lane.name)
+        sa_new = dur_new = None
+        if self.scfg.static_analysis:
+            # re-verdict ONLY this tenant (signature reuse against its
+            # own previous run); an analyze failure aborts THIS reload
+            sa_new, dur_new = self._compute_static(new_packed, reuse=lane.sa)
+        # flush ONLY this lane's inflight tail through the OLD ruleset —
+        # no other tenant's batcher or window clock is touched
+        try:
+            self._flush_inflight(lane)
+        except Exception as e:
+            raise _ReloadFlushError() from e
+        from .stream import LineBatcher
+
+        old_packer = lane.batcher.packer
+        packer = pack_mod.LinePacker(new_packed)
+        packer.parsed, packer.skipped = old_packer.parsed, old_packer.skipped
+        batcher = LineBatcher(packer, False, [], {}, self.batch_size)
+        live_arrays = None
+        q: dict[tuple, int] = {}
+        if not mig.identity:
+            live_arrays, q = migrate_arrays(
+                self.engine.host_arrays(lane.name), mig, old_packed, self.cfg
+            )
+        sa_obj_new = (
+            sa_new.to_obj(new_packed) if sa_new is not None else None
+        )
+        # ONE publish-locked swap for THIS lane: ring epochs, cumulative
+        # image, live slice, rule tensor, batcher, and static verdicts
+        # move together (an HTTP render never pairs old with new)
+        with self._pub_lock:
+            if not mig.identity:
+                _merge_quarantine(lane.win_quarantine, q)
+                for ep in lane.ring.epochs:
+                    ep_arrays, ep_q = migrate_arrays(
+                        ep.arrays, mig, old_packed, self.cfg
+                    )
+                    ep.arrays = ep_arrays
+                    _merge_quarantine(ep.quarantine, ep_q)
+                    ep.meta["migrated"] = ep.meta.get("migrated", 0) + 1
+                    new_tables, dropped = migrate_tracker_tables(
+                        ep.tracker_tables, mig
+                    )
+                    ep.tracker_tables = new_tables
+                    lane.talker_entries_dropped += dropped
+                lane.cum_arrays, cq = migrate_arrays(
+                    lane.cum_arrays, mig, old_packed, self.cfg
+                )
+                _merge_quarantine(lane.cum_quarantine, cq)
+                cum_tables, cdrop = migrate_tracker_tables(
+                    lane.cum_tracker.tables(), mig
+                )
+                lane.talker_entries_dropped += cdrop
+                lane.cum_tracker = TopKTracker(self.cfg.sketch.topk_capacity)
+                for acl, table in cum_tables.items():
+                    for src, est in table.items():
+                        lane.cum_tracker.offer(acl, src, est)
+                win_tables, wdrop = migrate_tracker_tables(
+                    lane.tracker.tables(), mig
+                )
+                lane.talker_entries_dropped += wdrop
+                lane.tracker = TopKTracker(self.cfg.sketch.topk_capacity)
+                for acl, table in win_tables.items():
+                    for src, est in table.items():
+                        lane.tracker.offer(acl, src, est)
+            # the engine swap: same rung = one slice of a traced arg
+            # (no recompile anywhere); rung change = bucket move (only
+            # the destination bucket's step may compile)
+            self.engine.reload_tenant(lane.name, new_packed)
+            if not mig.identity:
+                self.engine.set_arrays(lane.name, live_arrays)
+            lane.packed = new_packed
+            lane.batcher = batcher
+            if sa_new is not None:
+                self._install_static(lane, sa_new, sa_obj_new, dur_new)
+        if sa_new is not None:
+            self._write_json(lane.name, "static.json", sa_obj_new)
+        lane.reloads += 1
+        lane.win_reloads += 1
+        self.reloads += 1
+        flightrec.cursor(tenant=lane.name, reloads=self.reloads)
+        obs.instant("serve.reload.ok", args={
+            "tenant": lane.name,
+            "n_keys": new_packed.n_keys,
+            "migrated": not mig.identity,
+        })
+
+    # -- health / metrics --------------------------------------------------
+    def health(self) -> dict:
+        q = self.queue.snapshot()
+        stalled = len(self.listeners.stalled(self.cfg.stall_timeout_sec))
+        deg_subsystems = self.degraded_set()
+        with self._deg_lock:
+            deg_errors = dict(self.degraded)
+        degraded = (
+            q["dropped"] > 0
+            or self.reload_errors > 0
+            or stalled > 0
+            or self.listeners.alive() < len(self.listeners.listeners)
+            or bool(deg_subsystems)
+        )
+        return {
+            "status": "degraded" if degraded else "ok",
+            "degraded_subsystems": deg_subsystems,
+            **({"degraded_errors": deg_errors} if deg_errors else {}),
+            "degraded_events": self.degraded_events,
+            "recovered_events": self.recovered_events,
+            "uptime_sec": round(time.time() - self._t0, 3),
+            "windows_published": self.windows_published,
+            "lines_total": self.total_lines,
+            "lines_unrouted": self.lines_unrouted_total,
+            "queue": q,
+            "listeners": {
+                "n": len(self.listeners.listeners),
+                "alive": self.listeners.alive(),
+                "stalled": stalled,
+                "addresses": self.listeners.addresses(),
+            },
+            "reloads": self.reloads,
+            "reload_errors": self.reload_errors,
+            "window": {
+                "mode": "lines" if self.scfg.window_lines else "sec",
+                "length": self.scfg.window_lines or self.scfg.window_sec,
+                "ring": self.scfg.ring,
+            },
+            "world": self.world,
+            "tenants": {
+                name: {
+                    "current_window": {
+                        "id": lane.win_id,
+                        "pushed": getattr(lane, "win_pushed", 0),
+                    },
+                    "windows_published": lane.windows_published,
+                    "lines_total": lane.total_lines,
+                    "routed_total": lane.routed_total,
+                    "reloads": lane.reloads,
+                    "reload_errors": lane.reload_errors,
+                    **(
+                        {"last_reload_error": lane.last_reload_error}
+                        if lane.last_reload_error
+                        else {}
+                    ),
+                    "ruleset": {
+                        "n_rules": lane.packed.n_rules,
+                        "n_acls": lane.packed.n_acls,
+                        "n_keys": lane.packed.n_keys,
+                    },
+                }
+                for name, lane in sorted(self.lanes.items())
+            },
+        }
+
+    def tenants_obj(self) -> dict:
+        """The /tenants endpoint: the engine's packing-registry image
+        plus per-lane service counters."""
+        return {
+            "engine": self.engine.describe(),
+            "routing": {
+                "default": self.router.default,
+                "unrouted_total": self.lines_unrouted_total,
+            },
+            "fairness": self.fairness(),
+        }
+
+    def fairness(self) -> dict:
+        """Who filled the shared queue: per-tenant consumed shares.
+
+        The accounting HALF of fairness — the bound queue is the
+        mechanism; these counters make a noisy tenant visible before it
+        silently starves the ring (ISSUE 16)."""
+        total = max(self.lines_consumed_total, 1)
+        shares = {
+            name: round(lane.routed_total / total, 4)
+            for name, lane in sorted(self.lanes.items())
+        }
+        return {
+            "lines_consumed_total": self.lines_consumed_total,
+            "lines_unrouted_total": self.lines_unrouted_total,
+            "shares": shares,
+            "max_share": max(shares.values()) if shares else 0.0,
+            "min_share": min(shares.values()) if shares else 0.0,
+        }
+
+    def _sample_metrics(self) -> dict:
+        return {
+            **self.listeners.sample_metrics(),
+            "windows_published": self.windows_published,
+            "reloads": self.reloads,
+            "lines_total": self.total_lines,
+        }
+
+    def per_tenant_gauges(self) -> dict[str, dict]:
+        """Numeric gauges per tenant — ONE source for the JSON
+        ``/metrics`` `tenants` block and the Prometheus
+        ``{tenant="..."}`` labeled series (``render_prom_labeled``)."""
+        fairness = self.fairness()
+        out = {}
+        for name, lane in sorted(self.lanes.items()):
+            g = {
+                "lines_routed_total": lane.routed_total,
+                "lines_windowed_total": lane.total_lines,
+                "windows_published": lane.windows_published,
+                "reloads_total": lane.reloads,
+                "reload_errors_total": lane.reload_errors,
+                "queue_share": fairness["shares"].get(name, 0.0),
+            }
+            g.update(lane.lat_cum.gauges("latency_ingest_to_publish_"))
+            out[name] = g
+        return out
+
+    def metrics_gauges(self) -> dict:
+        q = self.queue.snapshot()
+        g = {
+            "queue_depth": q["depth"],
+            "queue_capacity": q["capacity"],
+            "lines_received_total": q["received"],
+            "drops_total": q["dropped"],
+            "lines_consumed_total": self.lines_consumed_total,
+            "lines_unrouted_total": self.lines_unrouted_total,
+            "lines_windowed_total": self.total_lines,
+            "windows_published": self.windows_published,
+            "reloads_total": self.reloads,
+            "reload_errors_total": self.reload_errors,
+            "listeners_alive": self.listeners.alive(),
+            "tenants_hosted": len(self.lanes),
+            "world": self.world,
+            "degraded_subsystems": len(self.degraded_set()),
+            "degraded_events_total": self.degraded_events,
+            "recovered_events_total": self.recovered_events,
+            "fairness_max_share": self.fairness()["max_share"],
+        }
+        g.update(self.lat_cum.gauges("latency_ingest_to_publish_"))
+        g.update(retrypolicy.gauges())
+        if self.wal is not None:
+            w = self.wal.stats()
+            g.update({
+                "wal_appended_total": w["appended"],
+                "wal_segments": w["segments"],
+                "wal_bytes": w["bytes"],
+                "wal_evicted_records_total": w["evicted_records"],
+            })
+        g.update(devprof.gauges())
+        g.update(devprof.device_memory_gauges())
+        return g
+
+    def render_prom_all(self) -> str:
+        """The full Prometheus exposition: service gauges, per-tenant
+        labeled gauges, the aggregate latency histogram, and one labeled
+        histogram per tenant — every series derives from the same counts
+        the JSON endpoint serves (drift-checked by verify/registry.py)."""
+        parts = [
+            render_prom(self.metrics_gauges(), prefix="ra_serve_"),
+            render_prom_labeled(
+                self.per_tenant_gauges(), prefix="ra_serve_tenant_",
+                label="tenant",
+            ),
+            self.lat_cum.render_prom("ra_serve_ingest_to_publish_seconds"),
+        ]
+        for name, lane in sorted(self.lanes.items()):
+            parts.append(lane.lat_cum.render_prom(
+                "ra_serve_tenant_ingest_to_publish_seconds",
+                labels={"tenant": name},
+            ))
+        return "".join(parts)
+
+    # -- service plumbing --------------------------------------------------
+    def _write_json(self, tenant: str, name: str, obj: dict) -> None:
+        """Publish one JSON artifact (under ``serve_dir/t/<tenant>/``
+        when a tenant is named) with serve.py's degraded-publisher
+        semantics."""
+        d = (
+            os.path.join(self.scfg.serve_dir, "t", tenant)
+            if tenant
+            else self.scfg.serve_dir
+        )
+        path = os.path.join(d, name)
+        tmp = path + ".tmp"
+
+        def _write():
+            faults.fire("serve.publish.fail")
+            os.makedirs(d, exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(obj, f, indent=2)
+            os.replace(tmp, path)
+
+        try:
+            retrypolicy.call("serve.publish", _write)
+        except (OSError, AnalysisError) as e:
+            self._degrade("publisher", e)
+            return
+        self._recover("publisher")
+
+    def _start_http(self) -> None:
+        if self._http is None:
+            return
+        self._http_thread = threading.Thread(
+            target=self._http.serve_forever, name="ra-serve-http", daemon=True
+        )
+        self._http_thread.start()
+
+    def _start_watcher(self) -> None:
+        if not self.scfg.reload_watch:
+            return
+
+        def mtimes(lane: _Lane) -> tuple:
+            out = []
+            for suffix in (".npz", ".json"):
+                try:
+                    st = os.stat(lane.spec.ruleset + suffix)
+                    out.append((st.st_mtime_ns, st.st_size))
+                except OSError:
+                    out.append(None)
+            return tuple(out)
+
+        def watch():
+            # serve.py's debounced pair-watch, per tenant: each tenant's
+            # stable mtime change queues a reload of THAT tenant only
+            last = {n: mtimes(l) for n, l in self.lanes.items()}
+            pending: dict[str, tuple | None] = {}
+            while not self._stop_req.wait(self.scfg.reload_poll_sec):
+                for name, lane in self.lanes.items():
+                    cur = mtimes(lane)
+                    if cur == last[name]:
+                        pending[name] = None
+                        continue
+                    if any(m is None for m in cur):
+                        continue
+                    if cur == pending.get(name):
+                        last[name] = cur
+                        pending[name] = None
+                        self.request_reload(name)
+                    else:
+                        pending[name] = cur
+
+        self._watch_thread = threading.Thread(
+            target=watch, name="ra-serve-reload-watch", daemon=True
+        )
+        self._watch_thread.start()
+
+    def _install_signals(self) -> None:
+        import signal
+
+        if threading.current_thread() is not threading.main_thread():
+            return
+        wanted = {
+            getattr(signal, "SIGHUP", None): lambda *_: self.request_reload(),
+            signal.SIGINT: lambda *_: self._stop_req.set(),
+            signal.SIGTERM: lambda *_: self._stop_req.set(),
+        }
+        for sig, handler in wanted.items():
+            if sig is None:
+                continue
+            try:
+                self._old_signals[sig] = signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
+
+    def _teardown(self, aborted: BaseException | None) -> None:
+        import signal
+
+        self._stop_req.set()
+        for sig, old in self._old_signals.items():
+            try:
+                signal.signal(sig, old)
+            except (ValueError, OSError):
+                pass
+        self._old_signals = {}
+        if self._http is not None:
+            if self._http_thread is not None:
+                self._http.shutdown()
+                self._http.server_close()
+                self._http_thread.join(timeout=5.0)
+            else:
+                self._http.server_close()
+        self.listeners.close()
+        if self._watch_thread is not None:
+            self._watch_thread.join(timeout=5.0)
+        if self.wal is not None:
+            self.wal.close()
+        obs.unregister_sampler("listener")
+        obs.unregister_sampler("serve")
+
+    # -- the run loop ------------------------------------------------------
+    def _route(self, line: str, tag: str | None) -> tuple[str | None, str]:
+        tenant, body = self.router.route(line, tag)
+        if tenant is None or tenant not in self.lanes:
+            self.lines_unrouted_total += 1
+            return None, body
+        return tenant, body
+
+    def _loop(self) -> None:
+        scfg = self.scfg
+        t0 = time.monotonic()
+        while True:
+            if self._stop_req.is_set():
+                break
+            if scfg.stop_after_sec and time.monotonic() - t0 >= scfg.stop_after_sec:
+                break
+            self._maybe_reload()
+            self._check_metrics_health()
+            if scfg.window_sec:
+                # per-lane wall clocks: one lane's slow rotation (or
+                # reload) delays only its own cadence, never another's
+                now = time.monotonic()
+                for name in sorted(self.lanes):
+                    lane = self.lanes[name]
+                    if lane.next_rotation is not None and now >= lane.next_rotation:
+                        self._rotate(lane)
+                        lane.next_rotation += scfg.window_sec
+                        now2 = time.monotonic()
+                        while lane.next_rotation <= now2:
+                            lane.next_rotation += scfg.window_sec
+                if scfg.max_windows and self.windows_published >= scfg.max_windows:
+                    break
+            got = self.queue.pop_tagged(timeout=0.1)
+            if got is not None:
+                line, t_recv, tag = got
+                tenant, body = self._route(line, tag)
+                if tenant is None:
+                    continue
+                lane = self.lanes[tenant]
+                if self.wal is not None:
+                    # durably spool WITH the tenant key (record v2),
+                    # BEFORE window accounting (serve.py discipline)
+                    self.wal.append(body, tenant=tenant)
+                for ev in lane.batcher.push(body):
+                    self._consume_event(lane, ev)
+                self._note_receipt(lane, t_recv)
+                lane.win_pushed += 1
+                lane.routed_total += 1
+                self.lines_consumed_total += 1
+                if scfg.window_lines and lane.win_pushed >= scfg.window_lines:
+                    self._rotate(lane)
+                    if scfg.max_windows and self.windows_published >= scfg.max_windows:
+                        break
+                continue
+            # idle tick: listener liveness + wedge watchdog (shared tier)
+            if self.listeners.alive() == 0 and len(self.queue) == 0:
+                err = self.listeners.first_error()
+                if err is not None:
+                    raise FeedWorkerError(
+                        f"every serve listener died; first error: "
+                        f"{type(err).__name__}: {err}"
+                    ) from err
+                break
+            stalled = self.listeners.stalled(self.cfg.stall_timeout_sec)
+            if stalled:
+                for lane in self.lanes.values():
+                    lane._win_saw_stall = True
+                if len(stalled) == self.listeners.alive() and len(self.queue) == 0:
+                    names = ", ".join(ln.label for ln in stalled)
+                    raise StallError(
+                        f"every live serve listener stalled (no heartbeat "
+                        f"for {self.cfg.stall_timeout_sec:g}s): {names}"
+                    )
+        # bounded shutdown: stop ingress, count the backlog as drops,
+        # publish every lane's final partial window
+        self.listeners.close()
+        undelivered = self.queue.discard_remaining()
+        for name in sorted(self.lanes):
+            lane = self.lanes[name]
+            if (
+                lane.win_pushed
+                or lane.batcher.raw
+                or lane.pending
+                or lane.win_lines
+                or undelivered
+            ):
+                self._rotate(lane, partial=True)
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint (per-tenant routes under /t/<name>/...).
+# ---------------------------------------------------------------------------
+
+
+def _make_tenant_http_handler():
+    from http.server import BaseHTTPRequestHandler
+
+    class Handler(BaseHTTPRequestHandler):
+        server_version = "ra-serve-tenants/1"
+
+        def log_message(self, *a):  # silence per-request stderr noise
+            pass
+
+        def _send(self, code: int, obj) -> None:
+            body = json.dumps(obj, indent=2).encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_text(self, code: int, text: str, ctype: str) -> None:
+            body = text.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            drv: TenantServeDriver = self.server.driver
+            raw_path, _, query = self.path.partition("?")
+            path = raw_path.rstrip("/") or "/"
+            try:
+                if path == "/health":
+                    return self._send(200, drv.health())
+                if path == "/metrics":
+                    if "format=prom" in query:
+                        return self._send_text(
+                            200, drv.render_prom_all(),
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    return self._send(200, {
+                        **drv._sample_metrics(),
+                        **drv.metrics_gauges(),
+                        "tenants": drv.per_tenant_gauges(),
+                        "fairness": drv.fairness(),
+                    })
+                if path == "/tenants":
+                    return self._send(200, drv.tenants_obj())
+                if path.startswith("/t/"):
+                    parts = path.split("/")  # /t/<name>/report[...]
+                    name = parts[2] if len(parts) > 2 else ""
+                    if name not in drv.lanes:
+                        return self._send(404, {
+                            "error": f"unknown tenant {name!r}",
+                            "tenants": sorted(drv.lanes),
+                        })
+                    sub = "/".join(parts[3:])
+                    if sub == "report":
+                        obj = drv.published(name, "report")
+                        return self._send(200, obj) if obj else self._send(
+                            404, {"error": "no window published yet"}
+                        )
+                    if sub == "report/cumulative":
+                        obj = drv.published(name, "cumulative")
+                        return self._send(200, obj) if obj else self._send(
+                            404, {"error": "no window published yet"}
+                        )
+                    if sub == "report/static":
+                        obj = drv.published(name, "static")
+                        return self._send(200, obj) if obj else self._send(
+                            404,
+                            {"error": "static analysis disabled "
+                                      "(serve --static-analysis) or not yet run"},
+                        )
+                    if sub == "diff":
+                        obj = drv.published(name, "diff")
+                        return self._send(200, obj) if obj else self._send(
+                            404, {"error": "fewer than two windows published"}
+                        )
+                    if sub.startswith("report/window/"):
+                        try:
+                            wid = int(sub.rsplit("/", 1)[1])
+                        except ValueError:
+                            return self._send(400, {"error": "bad window id"})
+                        obj = drv.window_report(name, wid)
+                        return self._send(200, obj) if obj else self._send(
+                            404, {"error": f"window {wid} not in the ring"}
+                        )
+                return self._send(404, {
+                    "error": "unknown path",
+                    "endpoints": [
+                        "/health", "/metrics", "/tenants",
+                        "/t/<name>/report", "/t/<name>/report/cumulative",
+                        "/t/<name>/report/static",
+                        "/t/<name>/report/window/<id>", "/t/<name>/diff",
+                    ],
+                })
+            except BrokenPipeError:
+                pass
+
+    return Handler
+
+
+def _make_tenant_http_server(addr, driver):
+    from http.server import ThreadingHTTPServer
+
+    srv = ThreadingHTTPServer(addr, _make_tenant_http_handler())
+    srv.daemon_threads = True
+    srv.driver = driver
+    return srv

@@ -51,7 +51,16 @@ import zlib
 
 from ..errors import WalQuarantine
 
-MAGIC = b"RAWAL1\x00\x00"  # 8 bytes
+MAGIC = b"RAWAL1\x00\x00"  # 8 bytes — v1: payload IS the line
+#: v2 (ISSUE 16): payload = u8 tenant-key length | tenant utf-8 | line
+#: utf-8.  The version is per SEGMENT (header magic), so a pre-tenancy
+#: spool and the segments a tenant-aware process appends after it replay
+#: as one chain; v1 records decode with the default tenant key.
+MAGIC2 = b"RAWAL2\x00\x00"
+#: tenant key of every record written before the tenancy plane existed,
+#: and of single-tenant serve processes after it (runtime/tenancy.py
+#: re-exports this as the registry's default tenant name)
+DEFAULT_TENANT = "default"
 _HDR = struct.Struct("<8sQ")  # magic, start_seq
 _REC = struct.Struct("<II")  # payload len, payload crc32
 HEADER_BYTES = _HDR.size
@@ -157,7 +166,7 @@ class WriteAheadLog:
         try:
             with open(path, "rb") as f:
                 hdr = f.read(HEADER_BYTES)
-                if len(hdr) < HEADER_BYTES or hdr[:8] != MAGIC:
+                if len(hdr) < HEADER_BYTES or hdr[:8] not in (MAGIC, MAGIC2):
                     return 0  # quarantined at replay; count unknown
                 while True:
                     rec = f.read(_REC.size)
@@ -191,14 +200,26 @@ class WriteAheadLog:
             pass
         seg = _Segment(path, self.next_seq, 0, HEADER_BYTES)
         fd = os.open(seg.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-        os.write(fd, _HDR.pack(MAGIC, seg.start))
+        os.write(fd, _HDR.pack(MAGIC2, seg.start))
         self._fd = fd
         self._segments.append(seg)
 
-    def append(self, line: str) -> int:
+    def append(self, line: str, tenant: str = DEFAULT_TENANT) -> int:
         """Durably spool one line; returns its seq (kernel-durable: one
-        O_APPEND write — a SIGKILL after return cannot lose it)."""
-        payload = line.encode("utf-8", errors="replace")
+        O_APPEND write — a SIGKILL after return cannot lose it).
+
+        ``tenant`` is the routing key the record replays under (v2
+        format); single-tenant serve never passes it and spools under
+        :data:`DEFAULT_TENANT`.
+        """
+        tkey = tenant.encode("utf-8", errors="replace")
+        if len(tkey) > 255:
+            raise WalQuarantine(
+                f"tenant key exceeds 255 bytes: {tenant[:64]!r}..."
+            )
+        payload = (
+            bytes((len(tkey),)) + tkey + line.encode("utf-8", errors="replace")
+        )
         rec = _REC.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
         with self._lock:
             cur = self._segments[-1] if self._segments else None
@@ -273,7 +294,12 @@ class WriteAheadLog:
 
     # -- replay path ------------------------------------------------------
     def replay(self, from_seq: int):
-        """Yield ``(seq, line)`` for every record with seq >= from_seq.
+        """Yield ``(seq, line, tenant)`` for every record, seq >= from_seq.
+
+        ``tenant`` is the record's routing key: v2 segments carry it in
+        every record; records in v1 (pre-tenancy) segments replay under
+        :data:`DEFAULT_TENANT` — the backward-compat contract the
+        tenancy tests pin.
 
         Loss accounting lands on the instance afterwards: ``replay_lost``
         counts records known missing (evicted head gap + quarantined
@@ -309,13 +335,14 @@ class WriteAheadLog:
             return
         with f:
             hdr = f.read(HEADER_BYTES)
-            if len(hdr) < HEADER_BYTES or hdr[:8] != MAGIC or (
+            if len(hdr) < HEADER_BYTES or hdr[:8] not in (MAGIC, MAGIC2) or (
                 _HDR.unpack(hdr)[1] != seg.start
             ):
                 self._quarantine(
                     seg, max(seg.start, from_seq), end, "bad segment header"
                 )
                 return
+            v2 = hdr[:8] == MAGIC2
             seq = seg.start
             while True:
                 rec = f.read(_REC.size)
@@ -349,7 +376,26 @@ class WriteAheadLog:
                     )
                     return
                 if seq >= from_seq:
-                    yield seq, payload.decode("utf-8", errors="replace")
+                    if v2:
+                        tlen = payload[0] if payload else 0
+                        if 1 + tlen > len(payload):
+                            # CRC passed, so this is a writer bug, not
+                            # disk damage — still a typed quarantine
+                            self._quarantine(
+                                seg, max(seq, from_seq), end,
+                                "bad tenant framing",
+                            )
+                            return
+                        tenant = payload[1:1 + tlen].decode(
+                            "utf-8", errors="replace"
+                        )
+                        line = payload[1 + tlen:].decode(
+                            "utf-8", errors="replace"
+                        )
+                    else:
+                        tenant = DEFAULT_TENANT
+                        line = payload.decode("utf-8", errors="replace")
+                    yield seq, line, tenant
                 seq += 1
 
     def _note_lost(self, seg: _Segment, from_seq: int, end: int | None,

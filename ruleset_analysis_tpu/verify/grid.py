@@ -41,6 +41,7 @@ LINT_GEOMETRY = dict(
     topk_k=8,
     groups=2,  # stacked: ACL groups
     lane=128,  # stacked: per-group lane width
+    tenants=4,  # tenant: bucket stack depth (leading register axis)
 )
 
 
@@ -48,7 +49,7 @@ LINT_GEOMETRY = dict(
 class ProgramSpec:
     """One shipping step-program coordinate in the impl grid."""
 
-    kind: str  # {"flat", "stacked", "v6"}
+    kind: str  # {"flat", "stacked", "v6", "tenant"}
     match_impl: str = "xla"
     counts_impl: str = "scatter"
     update_impl: str = "scatter"
@@ -106,7 +107,7 @@ _TOPK_VARIANTS = ((1, 0), (4, 0), (1, 2))
 def shipping_grid() -> list[ProgramSpec]:
     """Every shipping step program: the full impl grid, all kinds."""
     specs: list[ProgramSpec] = []
-    for kind in ("flat", "stacked", "v6"):
+    for kind in ("flat", "stacked", "v6", "tenant"):
         match_impls = (
             ("xla", "pallas", "pallas_fused") if kind == "flat" else ("xla",)
         )
@@ -140,6 +141,9 @@ def fast_grid() -> list[ProgramSpec]:
         ProgramSpec(kind="stacked", topk_sample_shift=2),
         ProgramSpec(kind="v6", update_impl="sorted"),
         ProgramSpec(kind="flat", exact_counts=False),
+        # tenant-sliced register planes: dynamic slice/update around the
+        # flat core — one program pins the wrapper's lint verdict
+        ProgramSpec(kind="tenant"),
     ]
 
 
@@ -172,10 +176,13 @@ def _sds(shape, dtype=None):
 
 
 def _abstract_args(spec: ProgramSpec):
-    """(state, ruleset, cols, valid, salt) ShapeDtypeStructs for `spec`."""
+    """(state, ruleset, cols, valid[, tid], salt) ShapeDtypeStructs for
+    `spec` — the weight plane (``valid``) is ALWAYS args[3], which is
+    what trace_program's marker flatten relies on."""
     from ..hostside.pack import RULE6_COLS, RULE_COLS
     from ..models.pipeline import (
         AnalysisState, DeviceRuleset, DeviceRuleset6, DeviceRulesetStacked,
+        DeviceRulesetTenant,
     )
 
     g = LINT_GEOMETRY
@@ -187,6 +194,25 @@ def _abstract_args(spec: ProgramSpec):
         talk_cms=_sds((g["cms_depth"], g["cms_width"])),
     )
     salt = _sds(())
+    if spec.kind == "tenant":
+        import jax.numpy as jnp
+
+        t = g["tenants"]
+        state = AnalysisState(
+            counts_lo=_sds((t, g["n_keys"])),
+            counts_hi=_sds((t, g["n_keys"])),
+            cms=_sds((t, g["cms_depth"], g["cms_width"])),
+            hll=_sds((t, g["n_keys"], g["hll_m"])),
+            talk_cms=_sds((t, g["cms_depth"], g["cms_width"])),
+        )
+        ruleset = DeviceRulesetTenant(
+            rules_t=_sds((t, g["rules"], RULE_COLS)),
+            deny_key_t=_sds((t, g["n_acls"])),
+        )
+        cols = {k: _sds((g["batch"],)) for k in _V4_FIELDS}
+        valid = _sds((g["batch"],))
+        tid = _sds((), jnp.int32)
+        return state, ruleset, cols, valid, tid, salt
     if spec.kind == "flat":
         rules_fm = (
             _sds((RULE_COLS, g["rules"]))
@@ -237,6 +263,8 @@ def _core_kwargs(spec: ProgramSpec) -> dict:
     )
     if spec.kind == "flat":
         kw["match_impl"] = spec.match_impl
+    # the tenant core runs _core_flat on the sliced plane with the XLA
+    # match fixed (make_tenant_step never specializes); no extra kwarg
     return kw
 
 

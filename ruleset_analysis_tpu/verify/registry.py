@@ -359,9 +359,140 @@ def audit_observability(root: str | None = None) -> list[AuditFinding]:
     return findings
 
 
+def audit_tenancy(root: str | None = None) -> list[AuditFinding]:
+    """Tenancy-plane drift: step-core grid, labeled series, WAL format.
+
+    Three halves (ISSUE 16 satellite):
+
+    1. **Core/grid registry.**  Every step core in
+       ``parallel/step.py::CORES`` must appear as a program kind in the
+       lint grid (``verify/grid.py::shipping_grid``) and vice versa — a
+       core the jaxpr linter never traces ships unverified; a grid kind
+       with no core can never have been a shipping program.
+
+    2. **Labeled-series parity.**  The multi-tenant ``/metrics``
+       endpoint renders per-tenant JSON gauge blocks AND
+       tenant-labeled Prometheus series from the same numbers; this
+       audit drives synthetic per-tenant gauges + histograms through
+       both renderings and fails on a dropped labeled series, a label
+       collision between tenants, or a labeled-bucket quantile that
+       disagrees with the JSON gauge.
+
+    3. **WAL record-format compat.**  The tenancy plane bumped the WAL
+       segment format (v2 carries the tenant key per record); this
+       audit round-trips a v2 record functionally and hand-writes a v1
+       segment to prove pre-tenancy spools still replay — under
+       ``DEFAULT_TENANT`` — instead of quarantining.
+    """
+    import struct
+    import tempfile
+    import zlib
+
+    from ..parallel.step import CORES
+    from ..runtime.autoscale import render_prom_labeled
+    from ..runtime.metrics import LatencyHistogram, quantile_from_prom
+    from ..runtime import wal as wal_mod
+
+    root = _repo_root(root)
+    findings: list[AuditFinding] = []
+
+    # -- half 1: CORES <-> lint-grid kinds -------------------------------
+    from .grid import shipping_grid
+
+    grid_kinds = {s.kind for s in shipping_grid()}
+    for kind in sorted(set(CORES) - grid_kinds):
+        findings.append(AuditFinding(
+            "tenancy", "core-not-in-lint-grid", kind,
+            "parallel/step.py::CORES entry has no shipping_grid() "
+            "program — the jaxpr linter never traces it",
+        ))
+    for kind in sorted(grid_kinds - set(CORES)):
+        findings.append(AuditFinding(
+            "tenancy", "grid-kind-without-core", kind,
+            "lint grid names a program kind missing from CORES",
+        ))
+
+    # -- half 2: tenant-labeled series parity ----------------------------
+    per_tenant = {
+        "acme": {"lines_routed_total": 7, "windows_published": 2},
+        "globex": {"lines_routed_total": 11, "windows_published": 3},
+    }
+    labeled = render_prom_labeled(per_tenant, prefix="ra_serve_tenant_",
+                                  label="tenant")
+    for tenant, gauges in per_tenant.items():
+        for key, v in gauges.items():
+            want = f'ra_serve_tenant_{key}{{tenant="{tenant}"}} {v}'
+            if want not in labeled:
+                findings.append(AuditFinding(
+                    "tenancy", "labeled-gauge-drift", f"{tenant}/{key}",
+                    "a per-tenant JSON gauge is absent from the "
+                    "tenant-labeled Prometheus rendering",
+                ))
+    hists = {}
+    for i, tenant in enumerate(("acme", "globex")):
+        h = LatencyHistogram()
+        for us in (5, 90 * (i + 1), 4_000, 250_000 * (i + 1)):
+            h.record(us * 1e-6)
+        hists[tenant] = h
+    name = "ra_serve_tenant_probe_seconds"
+    text = "".join(
+        h.render_prom(name, labels={"tenant": t}) for t, h in hists.items()
+    )
+    for tenant, h in hists.items():
+        g = h.gauges("latency_probe_")
+        for p, key in ((0.5, "p50_sec"), (0.99, "p99_sec")):
+            got = quantile_from_prom(text, name, p,
+                                     labels={"tenant": tenant})
+            if got != g[f"latency_probe_{key}"]:
+                findings.append(AuditFinding(
+                    "tenancy", "labeled-quantile-drift",
+                    f"{tenant}/{key}",
+                    "the labeled prom-bucket quantile disagrees with "
+                    "the same tenant's JSON gauge — label selection "
+                    "is picking up another tenant's buckets",
+                ))
+
+    # -- half 3: WAL v1 -> v2 record-format compatibility ----------------
+    if wal_mod.MAGIC == wal_mod.MAGIC2:
+        findings.append(AuditFinding(
+            "tenancy", "wal-magic-collision", "MAGIC2",
+            "the v2 segment magic must differ from v1",
+        ))
+    with tempfile.TemporaryDirectory(prefix="ra-audit-wal-") as td:
+        w = wal_mod.WriteAheadLog(td)
+        w.append("alpha line", tenant="acme")
+        w.append("beta line")
+        w.close()
+        got = [(line, tenant) for _seq, line, tenant in
+               wal_mod.WriteAheadLog(td).replay(0)]
+        if got != [("alpha line", "acme"),
+                   ("beta line", wal_mod.DEFAULT_TENANT)]:
+            findings.append(AuditFinding(
+                "tenancy", "wal-v2-roundtrip-drift", "replay",
+                f"v2 append/replay lost the tenant key: {got!r}",
+            ))
+    with tempfile.TemporaryDirectory(prefix="ra-audit-wal1-") as td:
+        # hand-written v1 segment: payload IS the line, no tenant byte
+        payload = b"legacy line"
+        rec = struct.pack("<II", len(payload),
+                          zlib.crc32(payload) & 0xFFFFFFFF) + payload
+        with open(os.path.join(td, f"seg-{0:020d}.wal"), "wb") as f:
+            f.write(struct.pack("<8sQ", wal_mod.MAGIC, 0) + rec)
+        got = [(line, tenant) for _seq, line, tenant in
+               wal_mod.WriteAheadLog(td).replay(0)]
+        if got != [("legacy line", wal_mod.DEFAULT_TENANT)]:
+            findings.append(AuditFinding(
+                "tenancy", "wal-v1-compat-drift", "replay",
+                "a pre-tenancy (v1) segment must replay under "
+                f"DEFAULT_TENANT; got {got!r}",
+            ))
+    return findings
+
+
 def audit_registry(root: str | None = None) -> list[AuditFinding]:
-    """All five audits, in declaration order."""
+    """All six audits, in declaration order."""
     return (
         audit_faults(root) + audit_cli(root) + audit_volatile(root)
         + audit_retry(root) + audit_observability(root)
+        + audit_tenancy(root)
     )

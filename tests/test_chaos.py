@@ -833,7 +833,7 @@ def test_chaos_serve_wal_hard_abort_resume(seed, serve_chaos_corpus, tmp_path):
     assert drv.windows_published == 1
 
     wal = WriteAheadLog(os.path.join(scfg.serve_dir, "wal"))
-    delivered = [ln for _s, ln in wal.replay(SERVE_W)]
+    delivered = [ln for _s, ln, _t in wal.replay(SERVE_W)]
     wal.close()
     assert delivered == lines[SERVE_W:SERVE_W + len(delivered)]
 

@@ -137,7 +137,7 @@ def test_full_grid_enumerates_all_shipping_combos():
         for s in grid
     )
     # every kind and impl axis is represented
-    assert {s.kind for s in grid} == {"flat", "stacked", "v6"}
+    assert {s.kind for s in grid} == {"flat", "stacked", "v6", "tenant"}
     assert {s.counts_impl for s in grid} == {"scatter", "matmul", "reduce"}
     assert {s.update_impl for s in grid} == {"scatter", "sorted"}
 

@@ -912,7 +912,7 @@ def test_wal_resume_after_hard_abort_bit_identical(corpus, tmp_path):
 
     # ground truth: what the spool durably holds past the checkpoint
     wal = WriteAheadLog(os.path.join(serve_dir, "wal"))
-    delivered = [line for _seq, line in wal.replay(100)]
+    delivered = [line for _seq, line, _t in wal.replay(100)]
     wal.close()
     assert delivered, "window 1 consumed lines before the abort"
     assert delivered == lines[100:100 + len(delivered)]  # prefix, no gap

@@ -39,8 +39,8 @@ def test_round_trip_and_suffix_replay(tmp_path):
     assert w.next_seq == 50  # scan-on-open recovers the append cursor
     for start in (0, 17, 49, 50):
         got = list(w.replay(start))
-        assert [s for s, _ in got] == list(range(start, 50))
-        assert all(line == f"{'x' * 100} {s}" for s, line in got)
+        assert [s for s, _, _t in got] == list(range(start, 50))
+        assert all(line == f"{'x' * 100} {s}" for s, line, _t in got)
         assert w.replay_lost == 0 and not w.replay_lost_unknown
     w.close()
 
@@ -52,7 +52,7 @@ def test_append_resumes_after_reopen(tmp_path):
     w.close()
     w2 = WriteAheadLog(str(tmp_path), segment_bytes=4096, budget_bytes=1 << 20)
     got = list(w2.replay(9))
-    assert [s for s, _ in got] == [9, 10]
+    assert [s for s, _, _t in got] == [9, 10]
     assert got[-1][1] == "late line"
     w2.close()
 
@@ -68,7 +68,7 @@ def test_torn_tail_is_clean_end_not_corruption(tmp_path):
     w = WriteAheadLog(str(tmp_path), segment_bytes=4096, budget_bytes=1 << 20)
     assert w.next_seq == 20  # the torn record does not count
     got = list(w.replay(0))
-    assert [s for s, _ in got] == list(range(20))
+    assert [s for s, _, _t in got] == list(range(20))
     assert w.replay_lost == 0 and not w.quarantined
     w.close()
 
@@ -86,7 +86,7 @@ def test_budget_eviction_exact_drop_accounting(tmp_path):
     first = got[0][0]
     # the gap [0, first) is exactly the evicted records — nothing else
     assert w2.replay_lost == first == w.evicted_records
-    assert [s for s, _ in got] == list(range(first, 500))
+    assert [s for s, _, _t in got] == list(range(first, 500))
     w2.close()
 
 
@@ -113,7 +113,7 @@ def test_crc_corruption_quarantines_segment_exact_loss(tmp_path):
     assert not os.path.exists(victim)
     # the surviving seqs are a prefix + a suffix with ONE gap — never a
     # silently renumbered stream
-    seqs = [s for s, _ in got]
+    seqs = [s for s, _, _t in got]
     gaps = [
         (a, b) for a, b in zip(seqs, seqs[1:]) if b != a + 1
     ]
@@ -167,7 +167,7 @@ def test_gc_releases_checkpoint_covered_segments_only(tmp_path):
     assert after < before
     # everything >= 100 must still replay (the uncheckpointed tail)
     got = list(w2.replay(100))
-    assert [s for s, _ in got] == list(range(100, 200))
+    assert [s for s, _, _t in got] == list(range(100, 200))
     assert w2.replay_lost == 0
     w2.close()
     assert w.appended == 200
