@@ -5,7 +5,7 @@
 # parallel python process starves the distributed rendezvous tests and
 # fabricates failures.  Run `make lint`, THEN the gate.
 
-.PHONY: lint lint-fast test chaos postmortem
+.PHONY: lint lint-fast test chaos postmortem servescale
 
 # Static program-invariant lint (DESIGN §18): abstract-eval traces of
 # the full shipping step grid + the repo registry audit.  No device, no
@@ -29,6 +29,15 @@ chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_retry.py \
 		tests/test_wal.py -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# Multi-host serve scaling acceptance (DESIGN §22): 1-host vs 2-host
+# loopback soak over the same corpus — merged distributed windows must
+# be bit-identical to the single-host replay of the union, with zero
+# silent drops and a whole-host-kill chaos leg.  Writes the
+# SERVESCALE_r19_cpu.json evidence artifact shape.  Same 1-core caveat:
+# never run concurrently with the tier-1 gate.
+servescale:
+	JAX_PLATFORMS=cpu python bench_suite.py servescale
 
 # Doctor acceptance path (DESIGN §20): chaos-killed runs must leave a
 # complete postmortem bundle the doctor can diagnose (failing stage +

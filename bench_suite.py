@@ -3196,6 +3196,411 @@ def bench_tenant() -> dict:
     }
 
 
+def bench_servescale() -> dict:
+    """Multi-host distributed serve scale-out (ISSUE 17, DESIGN §22).
+
+    Three legs, one corpus, process-mode workers throughout:
+
+    1. **Solo reference** — a single ``ServeDriver`` replays the union
+       corpus (per-window: host 0's slice then host 1's slice) at the
+       aggregate offered rate.  Its window reports are the bit-identity
+       baseline and its sustained rate the per-host ceiling.
+    2. **2-host distributed** — ``DistServeDriver`` with two spawned
+       worker processes, each fed its own slice at the per-host rate.
+       Asserted in-bench: every merged window report AND the cumulative
+       report are bit-identical (VOLATILE-stripped) to the solo run —
+       registers, per-rule hits, unique-source counts, and the
+       unused-rule deletion candidates — with the talkers section's
+       heavy-hitter prefix pinned exactly (its deep tail is sampled-
+       candidate CMS output, approximate by design; tier-1 pins FULL
+       identity, talkers included, at complete candidate coverage);
+       zero drops on either side; and the rank-0
+       merge+publish stage — the only serialized cross-host work —
+       costs <= 0.2 of the ingest wall, which is exactly the condition
+       under which N dedicated host cores sustain >= 0.8*N x the
+       single-host rate.
+    3. **Whole-host chaos** — a fresh 2-host run SIGKILLs host 1
+       mid-window: the service must keep publishing every window, name
+       ``host_died:1`` in the incomplete markers of the affected
+       windows, and lose none of host 0's delivered lines.
+
+    The artifact states the HONEST aggregate: on this 1-core container
+    both "hosts" timeshare one CPU, so the >= 0.8*N claim is the
+    measured solo rate x N x (1 - measured merge overhead fraction) —
+    the same per-core extrapolation discipline as FEEDSCALE_r14.
+
+    ``RA_SERVESCALE_LINES`` (default 24k; 3 windows) and
+    ``RA_SERVESCALE_RATE`` (default 4k lines/s offered PER HOST) size
+    the soak.
+    """
+    import os
+    import socket
+    import tempfile
+    import threading
+
+    import jax
+
+    from ruleset_analysis_tpu.config import (
+        AnalysisConfig,
+        DistServeConfig,
+        ServeConfig,
+    )
+    from ruleset_analysis_tpu.hostside import aclparse, synth
+    from ruleset_analysis_tpu.hostside import pack as pack_mod
+    from ruleset_analysis_tpu.runtime.distserve import DistServeDriver
+    from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS
+    from ruleset_analysis_tpu.runtime.serve import ServeDriver
+    from ruleset_analysis_tpu.runtime.stream import run_stream
+
+    n_hosts = 2
+    windows = 3
+    rate = float(os.environ.get("RA_SERVESCALE_RATE", "4000"))
+    wl = int(float(os.environ.get("RA_SERVESCALE_LINES", "24000"))) // (
+        n_hosts * windows
+    )
+    total = wl * n_hosts * windows
+    BATCH = 4096
+
+    cfg_text = synth.synth_config(n_acls=2, rules_per_acl=10, seed=0)
+    packed = pack_mod.pack_rulesets([aclparse.parse_asa_config(cfg_text, "fw1")])
+    t = _tuples(packed, total, seed=7)
+    lines = synth.render_syslog(packed, t, seed=7)
+    # union order IS the solo replay order; host r's stream is the
+    # concatenation of its per-window slices, so merged window w and
+    # solo window w cover the same lines
+    host_stream = {
+        r: [
+            ln
+            for w in range(windows)
+            for ln in lines[(w * n_hosts + r) * wl:(w * n_hosts + r + 1) * wl]
+        ]
+        for r in range(n_hosts)
+    }
+
+    def image(rep: dict) -> dict:
+        rep = json.loads(json.dumps(rep))
+        for k in VOLATILE_TOTALS:
+            rep["totals"].pop(k, None)
+        # window/chunk metadata names hosts and batch segmentation —
+        # layout, not analysis content.  talkers compared separately:
+        # the section is a sampled-candidate CMS summary whose deep
+        # tail is approximate BY DESIGN (per-chunk slot-limited
+        # sampling differs with chunk boundaries), so the register-law
+        # identity covers everything else bit-exactly while the talker
+        # check pins the heavy-hitter prefix.  Full identity including
+        # talkers is pinned at the tier-1 geometry (tests/
+        # test_distserve.py), where candidate coverage is complete.
+        rep["totals"].pop("window", None)
+        rep["totals"].pop("chunks", None)
+        rep.pop("talkers", None)
+        return rep
+
+    def talker_heads(rep: dict, k: int = 3) -> dict:
+        return {
+            acl: rows[:k] for acl, rows in (rep.get("talkers") or {}).items()
+        }
+
+    def read_json(path):
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+    def paced_send(addr, seg, rate, *, swallow=False):
+        try:
+            s = socket.create_connection(tuple(addr))
+            t0 = time.perf_counter()
+            sent = 0
+            for i in range(0, len(seg), 500):
+                burst = seg[i:i + 500]
+                s.sendall(("\n".join(burst) + "\n").encode())
+                sent += len(burst)
+                lag = sent / rate - (time.perf_counter() - t0)
+                if lag > 0:
+                    time.sleep(lag)
+            s.close()
+        except OSError:
+            if not swallow:
+                raise
+
+    def wait_for(pred, timeout, what):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        raise RuntimeError(f"servescale: timed out waiting for {what}")
+
+    def run_driver(drv):
+        out: dict = {}
+
+        def runner():
+            try:
+                out["summary"] = drv.run()
+            except BaseException as e:  # surfaced by the caller
+                out["error"] = e
+
+        th = threading.Thread(target=runner)
+        th.start()
+        return th, out
+
+    def host_tcp(drv, r):
+        with drv._lock:
+            h = drv.hosts.get(r)
+            addrs = dict(h.addresses) if h else {}
+        for lbl, ad in addrs.items():
+            if lbl.startswith("tcp"):
+                return tuple(ad)
+        return None
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "rules")
+        pack_mod.save_packed(packed, prefix)
+
+        # warm the jit caches at the exact serve geometry so the solo
+        # leg prices the serve loop, not XLA compiles (spawned workers
+        # compile in their own processes; the oversized listener queue
+        # absorbs that stall without drops)
+        warm_cfg = AnalysisConfig(batch_size=BATCH, prefetch_depth=0)
+        run_stream(packed, iter(lines[:64]), warm_cfg)
+
+        # ---- leg 1: solo reference over the union ----
+        solo_dir = os.path.join(d, "solo")
+        solo_drv = ServeDriver(
+            prefix,
+            AnalysisConfig(batch_size=BATCH, prefetch_depth=0),
+            ServeConfig(
+                listen=("tcp:127.0.0.1:0",), window_lines=n_hosts * wl,
+                serve_dir=solo_dir, max_windows=windows, http="off",
+                checkpoint_every_windows=0, reload_watch=False,
+                queue_lines=1 << 18,
+            ),
+        )
+        th, out = run_driver(solo_drv)
+        ep_path = os.path.join(solo_dir, "endpoint.json")
+        wait_for(lambda: os.path.exists(ep_path), 120, "solo endpoint")
+        (solo_addr,) = read_json(ep_path)["listeners"].values()
+        wall_start = time.time()
+        paced_send(solo_addr, lines, n_hosts * rate)
+        th.join(timeout=600)
+        if th.is_alive() or "error" in out:
+            raise RuntimeError(f"servescale: solo leg failed: {out.get('error')}")
+        # the sustained clock stops at the LAST window's publication
+        solo_elapsed = max(
+            os.path.getmtime(
+                os.path.join(solo_dir, f"window-{windows - 1:06d}.json")
+            ) - wall_start,
+            1e-3,
+        )
+        solo_sum = out["summary"]
+        assert solo_sum["drops"] == 0, f"solo dropped {solo_sum['drops']}"
+        solo_rate = total / solo_elapsed
+        log(f"servescale: solo {solo_rate:,.0f} lines/s over {total} lines")
+
+        # ---- leg 2: 2-host distributed at the same per-host rate ----
+        dist_dir = os.path.join(d, "dist")
+        dist_drv = DistServeDriver(
+            prefix,
+            AnalysisConfig(
+                batch_size=BATCH, prefetch_depth=0, mesh_shape="hybrid"
+            ),
+            ServeConfig(
+                listen=("tcp:127.0.0.1:0",), window_lines=wl,
+                serve_dir=dist_dir, max_windows=windows, http="off",
+                checkpoint_every_windows=0, reload_watch=False,
+                queue_lines=1 << 18,
+            ),
+            DistServeConfig(hosts=n_hosts, workers="process"),
+        )
+        merge_times: list[float] = []
+        orig_pub = dist_drv._publish_window
+
+        def timed_pub(*a, **k):
+            tp = time.perf_counter()
+            r = orig_pub(*a, **k)
+            merge_times.append(time.perf_counter() - tp)
+            return r
+
+        dist_drv._publish_window = timed_pub
+        th, out = run_driver(dist_drv)
+        wait_for(
+            lambda: out.get("error")
+            or all(host_tcp(dist_drv, r) for r in range(n_hosts)),
+            300, "distributed host listeners",
+        )
+        if "error" in out:
+            raise RuntimeError(f"servescale: dist leg failed: {out['error']}")
+        t_ingest0 = time.perf_counter()
+        senders = [
+            threading.Thread(
+                target=paced_send,
+                args=(host_tcp(dist_drv, r), host_stream[r], rate),
+            )
+            for r in range(n_hosts)
+        ]
+        for s in senders:
+            s.start()
+        for s in senders:
+            s.join()
+        th.join(timeout=600)
+        if th.is_alive() or "error" in out:
+            raise RuntimeError(f"servescale: dist leg failed: {out.get('error')}")
+        ingest_wall = max(time.perf_counter() - t_ingest0, 1e-3)
+        dist_sum = out["summary"]
+        assert dist_sum["drops"] == 0, f"dist dropped {dist_sum['drops']}"
+        assert dist_sum["lines_total"] == total, (
+            f"dist published {dist_sum['lines_total']} of {total} lines"
+        )
+        assert dist_sum["dead_hosts"] == [], dist_sum["dead_hosts"]
+
+        identical = 0
+        for w in range(windows):
+            a = read_json(os.path.join(dist_dir, f"window-{w:06d}.json"))
+            b = read_json(os.path.join(solo_dir, f"window-{w:06d}.json"))
+            assert image(a) == image(b), (
+                f"merged window {w} diverged from the solo replay"
+            )
+            assert talker_heads(a) == talker_heads(b), (
+                f"merged window {w} heavy-hitter talkers diverged"
+            )
+            identical += 1
+        cum_a = read_json(os.path.join(dist_dir, "cumulative.json"))
+        cum_b = read_json(os.path.join(solo_dir, "cumulative.json"))
+        cum_same = image(cum_a) == image(cum_b) and (
+            talker_heads(cum_a) == talker_heads(cum_b)
+        )
+        assert cum_same, "cumulative report diverged from the solo replay"
+
+        merge_wall = sum(merge_times)
+        merge_frac = merge_wall / ingest_wall
+        assert merge_frac <= 0.2, (
+            f"rank-0 merge+publish is {merge_frac:.1%} of the ingest wall "
+            "(> 20%); the 0.8*N scaling floor does not hold"
+        )
+        # N dedicated host cores ingest at ~solo_rate each; rank 0's
+        # merge is the only serialized stage, so the honest aggregate
+        # is N x solo x (1 - merge_frac) >= 0.8 * N * solo
+        extrapolated = n_hosts * solo_rate * (1.0 - merge_frac)
+        assert extrapolated >= 0.8 * n_hosts * solo_rate
+
+        # ---- leg 3: whole-host SIGKILL chaos ----
+        chaos_dir = os.path.join(d, "chaos")
+        chaos_drv = DistServeDriver(
+            prefix,
+            AnalysisConfig(
+                batch_size=BATCH, prefetch_depth=0, mesh_shape="hybrid"
+            ),
+            ServeConfig(
+                listen=("tcp:127.0.0.1:0",), window_lines=wl,
+                serve_dir=chaos_dir, max_windows=windows, http="off",
+                checkpoint_every_windows=0, reload_watch=False,
+                queue_lines=1 << 18,
+            ),
+            DistServeConfig(hosts=n_hosts, workers="process"),
+        )
+        th, out = run_driver(chaos_drv)
+        wait_for(
+            lambda: out.get("error")
+            or all(host_tcp(chaos_drv, r) for r in range(n_hosts)),
+            300, "chaos host listeners",
+        )
+        if "error" in out:
+            raise RuntimeError(f"servescale: chaos leg failed: {out['error']}")
+        h0 = threading.Thread(
+            target=paced_send,
+            args=(host_tcp(chaos_drv, 0), host_stream[0], rate),
+        )
+        h0.start()
+        # host 1 gets 1.5 windows' worth, then dies mid-window — but
+        # only after its window-0 epoch reached rank 0, so the kill
+        # lands in window 1, not in a still-compiling first batch
+        paced_send(
+            host_tcp(chaos_drv, 1), host_stream[1][:wl + wl // 2], rate,
+            swallow=True,
+        )
+        wait_for(
+            lambda: out.get("error") or chaos_drv.hosts[1].last_wid >= 0,
+            300, "host 1's first epoch",
+        )
+        chaos_drv.kill_host(1)
+        h0.join()
+        th.join(timeout=600)
+        if th.is_alive() or "error" in out:
+            raise RuntimeError(
+                f"servescale: chaos leg failed: {out.get('error')}"
+            )
+        chaos_sum = out["summary"]
+        assert chaos_sum["dead_hosts"] == [1], chaos_sum["dead_hosts"]
+        assert chaos_sum["windows_published"] == windows, chaos_sum
+        assert chaos_sum["drops"] == 0, f"chaos dropped {chaos_sum['drops']}"
+        # every line host 0 delivered is published, plus host 1's
+        # completed window 0 — a dead peer degrades the merge, it does
+        # not silently shrink survivors
+        assert chaos_sum["lines_total"] >= windows * wl + wl, (
+            chaos_sum["lines_total"]
+        )
+        died_marks = 0
+        for w in range(windows):
+            meta = read_json(
+                os.path.join(chaos_dir, f"window-{w:06d}.json")
+            )["totals"]["window"]
+            reasons = (meta.get("incomplete") or {}).get("reasons", [])
+            if any(r.startswith("host_died:1") for r in reasons):
+                died_marks += 1
+        assert died_marks >= 1, "no window names the killed host"
+
+    sustained_1core = round(total / ingest_wall, 1)
+    return {
+        "bench": "servescale",
+        "metric": "servescale_extrapolated_aggregate_lines_per_sec",
+        "value": round(extrapolated, 1),
+        "unit": "lines/sec",
+        "vs_baseline": round(extrapolated / solo_rate, 3),  # x single host
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "devices": len(jax.devices()),
+            "hosts": n_hosts,
+            "workers": "process",
+            "windows": windows,
+            "lines_total": total,
+            "offered_rate_per_host_lines_per_sec": rate,
+            "solo_sustained_lines_per_sec": round(solo_rate, 1),
+            "dist_1core_timeshared_lines_per_sec": sustained_1core,
+            "merge_publish_wall_sec": round(merge_wall, 3),
+            "merge_publish_per_window_ms": [
+                round(x * 1e3, 1) for x in merge_times
+            ],
+            "merge_overhead_frac": round(merge_frac, 4),
+            "windows_bit_identical": identical,
+            "cumulative_bit_identical": cum_same,
+            "chaos": {
+                "killed_host": 1,
+                "dead_hosts": chaos_sum["dead_hosts"],
+                "windows_published": chaos_sum["windows_published"],
+                "windows_naming_dead_host": died_marks,
+                "lines_published": chaos_sum["lines_total"],
+                "drops": chaos_sum["drops"],
+            },
+            "extrapolation": (
+                "both hosts timeshare one CPU core here, so the "
+                "aggregate is stated as solo_rate x hosts x (1 - "
+                "merge_overhead_frac): per-host ingest is share-nothing "
+                "(own listener, queue, feeder, registers) and the "
+                "measured rank-0 merge+publish stage is the only "
+                "serialized cross-host work"
+            ),
+            "guards": {
+                "bit_identical_all_windows": True,
+                "talker_heads_identical": True,
+                "cumulative_bit_identical": True,
+                "zero_drops_both_runs": True,
+                "merge_overhead_le_0p2": True,
+                "extrapolated_ge_0p8N": True,
+                "chaos_names_dead_host": True,
+                "chaos_zero_silent_drops": True,
+            },
+        },
+    }
+
+
 BENCHES = {
     "stage": bench_stage,
     "exact": bench_exact,
@@ -3219,6 +3624,7 @@ BENCHES = {
     "retrysoak": bench_retrysoak,
     "blackbox": bench_blackbox,
     "tenant": bench_tenant,
+    "servescale": bench_servescale,
     "v6": bench_v6,
     "v6recall": bench_v6recall,
 }
@@ -3227,12 +3633,13 @@ BENCHES = {
 #: a bare `python bench_suite.py` runs these; `sustained` (≥1e8 lines —
 #: minutes of wall time by design), `servesoak` and `autoscale` (paced
 #: live-service soaks with sockets + threads), `feedscale` (worker
-#: fleets of spawned processes) and `tenant` (17 full serve drivers
-#: with live sockets) are explicit-only
+#: fleets of spawned processes), `tenant` (17 full serve drivers
+#: with live sockets) and `servescale` (three paced multi-process
+#: distributed-serve soaks) are explicit-only
 DEFAULT_BENCHES = [
     n for n in BENCHES
     if n not in ("sustained", "servesoak", "autoscale", "feedscale",
-                 "retrysoak", "blackbox", "tenant")
+                 "retrysoak", "blackbox", "tenant", "servescale")
 ]
 
 

@@ -789,11 +789,23 @@ def _cmd_serve(args: argparse.Namespace) -> int:
         print("error: serve needs exactly one of --ruleset or "
               "--tenants MANIFEST", file=sys.stderr)
         return 2
+    if not args.distributed:
+        for flag, dflt in (
+            ("dist_hosts", 2), ("dist_min_hosts", 1),
+            ("dist_max_hosts", 0), ("dist_workers", "process"),
+            ("dist_merge_bind", "127.0.0.1:0"),
+            ("dist_merge_timeout", 120.0), ("dist_respawn", False),
+        ):
+            if getattr(args, flag) != dflt:
+                print(f"error: --{flag.replace('_', '-')} requires "
+                      "--distributed", file=sys.stderr)
+                return 2
     try:
         import os as _os
 
         cfg = AnalysisConfig(
             backend="tpu",
+            mesh_shape=args.mesh,
             batch_size=args.batch_size,
             sketch=SketchConfig(
                 cms_width=args.cms_width,
@@ -840,6 +852,19 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             wal_segment_bytes=args.wal_segment_kb << 10,
             wal_budget_bytes=args.wal_budget_mb << 20,
         )
+        dscfg = None
+        if args.distributed:
+            from .config import DistServeConfig
+
+            dscfg = DistServeConfig(
+                hosts=args.dist_hosts,
+                min_hosts=args.dist_min_hosts,
+                max_hosts=args.dist_max_hosts,
+                workers=args.dist_workers,
+                merge_bind=args.dist_merge_bind,
+                merge_timeout_sec=args.dist_merge_timeout,
+                respawn=args.dist_respawn,
+            )
     except (ValueError, errors.AnalysisError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -882,12 +907,26 @@ def _cmd_serve(args: argparse.Namespace) -> int:
 
             try:
                 driver = TenantServeDriver(
-                    args.tenants, cfg, scfg, topk=args.topk
+                    args.tenants, cfg, scfg, topk=args.topk,
+                    distributed=dscfg,
                 )
             except errors.AnalysisError as e:
                 # bad manifest / unsupported combination (e.g. --resume
                 # with --tenants): typed refusal, exit 2.  A bad
                 # --ruleset stays on main()'s typed-load path (exit 1).
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+        elif args.distributed:
+            from .runtime.distserve import DistServeDriver
+
+            try:
+                driver = DistServeDriver(
+                    args.ruleset, cfg, scfg, dscfg,
+                    topk=args.topk, ascfg=ascfg,
+                )
+            except errors.AnalysisError as e:
+                # unsupported combination (--mesh flat, --static-analysis)
+                # or an unreadable ruleset: typed refusal, exit 2
                 print(f"error: {e}", file=sys.stderr)
                 return 2
         else:
@@ -1675,6 +1714,47 @@ def make_parser() -> argparse.ArgumentParser:
                    help="total on-disk WAL budget; past it the oldest "
                         "segment evicts with its records counted as "
                         "explicit drops at the next resume (default 64)")
+    p.add_argument("--mesh", choices=["flat", "hybrid"], default="flat",
+                   help="device mesh topology (parallel/mesh.py); "
+                        "--distributed requires 'hybrid' (the host tier "
+                        "IS the outer dcn axis, DESIGN §22)")
+    p.add_argument("--distributed", action="store_true",
+                   help="multi-host serve (runtime/distserve.py, DESIGN "
+                        "§22): each host runs its own listener tier + "
+                        "WAL + local mesh ingesting into host-local "
+                        "registers; window epochs merge across hosts at "
+                        "rank 0 under the register merge laws, so the "
+                        "published report is bit-identical to a single-"
+                        "host replay of the union of all hosts' "
+                        "delivered lines.  Rank 0 owns publication, "
+                        "HTTP, and the merged-ring checkpoint; listener "
+                        "ports offset by host rank")
+    p.add_argument("--dist-hosts", type=int, default=2, metavar="N",
+                   help="ingest hosts to launch (default 2)")
+    p.add_argument("--dist-min-hosts", type=int, default=1, metavar="N",
+                   help="host-tier autoscale ladder floor (default 1)")
+    p.add_argument("--dist-max-hosts", type=int, default=0, metavar="N",
+                   help="host-tier ladder ceiling (0 = --dist-hosts). "
+                        "Part of the checkpoint resume identity: any "
+                        "live host count resumes any other under the "
+                        "SAME ceiling")
+    p.add_argument("--dist-workers", choices=["process", "thread"],
+                   default="process",
+                   help="host worker isolation (process = one OS process "
+                        "per host, the production mode; thread = "
+                        "in-process, the deterministic test mode)")
+    p.add_argument("--dist-merge-bind", default="127.0.0.1:0",
+                   metavar="HOST:PORT",
+                   help="rank-0 merge-plane bind for process workers "
+                        "(port 0 = ephemeral, recorded in endpoint.json)")
+    p.add_argument("--dist-merge-timeout", type=float, default=120.0,
+                   metavar="SEC",
+                   help="max wait for a live host's epoch past a "
+                        "window's first arrival before publishing "
+                        "without it (named host_missing; default 120)")
+    p.add_argument("--dist-respawn", action="store_true",
+                   help="respawn a dead host at the merge frontier; its "
+                        "WAL replays the lost tail on rejoin")
     _add_autoscale_flags(p)
     _add_blackbox_flags(p)
     p.add_argument("--fault-plan", default=None, metavar="SPEC",

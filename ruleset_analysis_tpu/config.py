@@ -427,6 +427,92 @@ class ServeConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class DistServeConfig:
+    """Configuration of the multi-host distributed serve tier
+    (runtime/distserve.py, DESIGN §22).
+
+    ``serve --distributed`` runs one ingest worker per *host*: each host
+    owns its own listeners, LineQueue, feeder tier, WAL spool, and
+    flight-recorder ring, and accumulates windows into host-local
+    register planes.  At every window rotation each host ships its
+    epoch to rank 0 over the host-tier control plane (the ``("dcn",
+    data)`` axis realized host-side: loopback TCP between processes on
+    one machine, DCN between machines), where the epochs merge under
+    the ``_merge_tail`` laws (add64/add32/max) — bit-identical to a
+    single-host replay of the union of all hosts' delivered lines.
+    Rank 0 owns publication (window/cumulative/diff JSON + HTTP).
+
+    The host ladder runs ``min_hosts..max_hosts``; the ring-checkpoint
+    fingerprint pins ``max_hosts`` (the ladder maximum, PR 7's divisor
+    discipline lifted to the host tier) so a checkpoint taken at any
+    world size resumes at any other.
+    """
+
+    #: number of ingest hosts to start with
+    hosts: int = 2
+    #: host-tier autoscale ladder bounds (actuated only when the serve
+    #: run also passes --autoscale; max_hosts always pins the
+    #: checkpoint fingerprint)
+    min_hosts: int = 1
+    max_hosts: int = 0  # 0 = hosts (no headroom to scale out into)
+    #: worker isolation: "process" (true multi-core scaling, the
+    #: production mode) or "thread" (in-process workers sharing one
+    #: device pool — the deterministic test mode)
+    workers: str = "process"
+    #: rank-0 merge-plane bind (port 0 = ephemeral, recorded in
+    #: serve_dir/endpoint.json)
+    merge_bind: str = "127.0.0.1:0"
+    #: how long rank 0 waits for a LIVE host's epoch before publishing
+    #: the window without it (the window is then marked incomplete
+    #: naming the missing host — never a hang, never a silent zero-hit)
+    merge_timeout_sec: float = 120.0
+    #: respawn a host that died unexpectedly (SIGKILL, OOM): the new
+    #: process replays its predecessor's WAL tail past the last merged
+    #: seq, so the rejoined host loses nothing that was spooled
+    respawn: bool = False
+
+    def __post_init__(self) -> None:
+        if self.hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {self.hosts}")
+        if self.min_hosts < 1:
+            raise ValueError(f"min_hosts must be >= 1, got {self.min_hosts}")
+        if self.max_hosts < 0:
+            raise ValueError(f"max_hosts must be >= 0, got {self.max_hosts}")
+        eff_max = self.max_hosts or self.hosts
+        if not self.min_hosts <= self.hosts <= eff_max:
+            raise ValueError(
+                f"hosts {self.hosts} must lie within "
+                f"[min_hosts {self.min_hosts}, max_hosts {eff_max}]"
+            )
+        if self.workers not in ("process", "thread"):
+            raise ValueError(
+                f"workers must be 'process' or 'thread', got {self.workers!r}"
+            )
+        host, _, port = self.merge_bind.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(
+                f"merge_bind must be HOST:PORT, got {self.merge_bind!r}"
+            )
+        if self.merge_timeout_sec <= 0:
+            raise ValueError(
+                f"merge_timeout_sec must be > 0, got {self.merge_timeout_sec}"
+            )
+
+    @property
+    def ladder_max(self) -> int:
+        """The host-tier ladder maximum the checkpoint fingerprint pins."""
+        return self.max_hosts or self.hosts
+
+    def to_dict(self) -> dict:
+        """JSON-serializable image (supervisor -> spawned worker handoff)."""
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "DistServeConfig":
+        return DistServeConfig(**d)
+
+
+@dataclasses.dataclass(frozen=True)
 class AnalysisConfig:
     """Everything the runtime needs to run one analysis job."""
 

@@ -559,6 +559,28 @@ def make_listener(q: LineQueue, spec: str) -> BaseListener:
     return FileTailer(q, str(arg), from_start=(kind == "tail0"))
 
 
+def offset_listen_spec(spec: str, rank: int) -> str:
+    """Per-host variant of one ``--listen`` spec (distributed serve).
+
+    Each host of a ``serve --distributed`` deployment owns its own
+    ingress, so a shared spec must fan out without colliding: fixed
+    socket ports offset by ``rank`` (``tcp:H:6514`` -> ``tcp:H:6516``
+    on host 2 — the conventional per-member port block), ephemeral
+    port 0 stays 0 (every host binds its own, recorded per host in
+    ``endpoint.json``), and tail paths gain a ``.host<rank>`` suffix
+    (two tailers on one spool would double-count every line).
+    Validates via :func:`parse_listen_spec`, so a bad spec fails at
+    supervisor construction, not inside the Nth spawned worker.
+    """
+    kind, host, arg = parse_listen_spec(spec)
+    if rank < 0:
+        raise AnalysisError(f"listener host rank must be >= 0, got {rank}")
+    if kind in ("udp", "tcp"):
+        port = int(arg)
+        return spec if port == 0 else f"{kind}:{host}:{port + rank}"
+    return spec if rank == 0 else f"{kind}:{arg}.host{rank}"
+
+
 class ListenerSet:
     """The ingress fleet: one queue, N listeners, liveness + gauges."""
 

@@ -198,3 +198,85 @@ def sum_across_processes(values: dict[str, int]) -> dict[str, int]:
         jax.process_count(), len(keys)
     ).sum(axis=0)
     return {k: int(v) for k, v in zip(keys, summed)}
+
+
+# ---------------------------------------------------------------------------
+# Host-tier epoch wire format (runtime/distserve.py, DESIGN §22).
+#
+# The distributed serve deployment realizes the hybrid mesh's outer
+# ("dcn") axis HOST-SIDE: each host accumulates a window into its own
+# register planes, and at rotation ships the epoch to rank 0 over a
+# control-plane socket (loopback TCP between co-located processes, DCN
+# between machines).  A jax.distributed collective would be the obvious
+# alternative — but a dead host poisons every surviving peer's pending
+# collective, and the serve contract is the opposite: survivors keep
+# publishing (degraded, typed WindowIncomplete) when a whole host dies.
+# Host-side merge under the proven _merge_tail laws (add64/add32/max)
+# keeps the published reports bit-identical to the collective reduction
+# AND to a single-host replay of the union of delivered lines, while a
+# host's death costs a timeout, never a hang.
+# ---------------------------------------------------------------------------
+
+
+def pack_epoch_payload(
+    arrays: dict[str, np.ndarray], extra: dict
+) -> bytes:
+    """One host's rotated window -> self-delimiting CRC'd wire bytes.
+
+    Layout: ``RAEP1`` magic, u32 JSON length, u32 npz length, u32
+    CRC32 over both bodies, JSON (meta/tables/accounting), npz (the
+    register arrays).  The CRC catches a torn or interleaved write on
+    the host-tier socket the way the WAL and checkpoint planes catch
+    torn files — a corrupt epoch must be a typed refusal at the merge
+    tier, never silently-wrong published counters.
+    """
+    import io
+    import json as _json
+    import struct
+    import zlib
+
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.ascontiguousarray(v) for k, v in arrays.items()})
+    npz = buf.getvalue()
+    meta = _json.dumps(extra, separators=(",", ":")).encode("utf-8")
+    crc = zlib.crc32(meta)
+    crc = zlib.crc32(npz, crc) & 0xFFFFFFFF
+    return (
+        b"RAEP1"
+        + struct.pack("<III", len(meta), len(npz), crc)
+        + meta
+        + npz
+    )
+
+
+def unpack_epoch_payload(payload: bytes) -> tuple[dict[str, np.ndarray], dict]:
+    """Inverse of :func:`pack_epoch_payload`; typed on any corruption."""
+    import io
+    import json as _json
+    import struct
+    import zlib
+
+    from ..errors import AnalysisError
+
+    if len(payload) < 17 or payload[:5] != b"RAEP1":
+        raise AnalysisError(
+            "host-tier epoch payload lacks the RAEP1 magic (torn frame "
+            "or a foreign writer on the merge socket)"
+        )
+    n_meta, n_npz, crc = struct.unpack("<III", payload[5:17])
+    body = payload[17:]
+    if len(body) != n_meta + n_npz:
+        raise AnalysisError(
+            f"host-tier epoch payload truncated: header promises "
+            f"{n_meta + n_npz} body bytes, got {len(body)}"
+        )
+    meta, npz = body[:n_meta], body[n_meta:]
+    got = zlib.crc32(npz, zlib.crc32(meta)) & 0xFFFFFFFF
+    if got != crc:
+        raise AnalysisError(
+            f"host-tier epoch payload CRC mismatch (want {crc:#x}, got "
+            f"{got:#x}): refusing to merge a corrupt epoch"
+        )
+    with np.load(io.BytesIO(npz)) as z:
+        arrays = {k: z[k] for k in z.files}
+    return arrays, _json.loads(meta.decode("utf-8"))

@@ -52,6 +52,16 @@ def make_mesh(
     exercise geometry).  Device order is preserved (row-major reshape),
     which is what keeps batch slice placement identical to the flat
     mesh.
+
+    ``serve --distributed`` (runtime/distserve.py, DESIGN §22) realizes
+    the hybrid topology ACROSS processes instead of within one: each
+    ingest host runs its own flat mesh (this function's ``topology=
+    "flat"`` over its local devices — the inner ICI axis), and the
+    outer ``dcn`` axis becomes the host tier itself, reduced host-side
+    at rank 0 under the same associative merge laws the in-mesh
+    ``("dcn", data)`` collective would apply.  That trade is deliberate:
+    a dead host degrades the merge (typed, named, recoverable) instead
+    of poisoning a pending cross-host collective.
     """
     devs = np.asarray(devices if devices is not None else jax.devices())
     if topology == "flat":

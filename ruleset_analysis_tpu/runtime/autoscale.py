@@ -107,6 +107,21 @@ def world_ladder(min_world: int, max_world: int, *, divisors_of: int = 0) -> lis
     return rungs
 
 
+def host_ladder(min_hosts: int, max_hosts: int) -> list[int]:
+    """Allowed HOST counts of the distributed serve tier (DESIGN §22).
+
+    The device-tier ladder restricts worlds to divisors of the padded
+    batch geometry; the host tier has no such constraint — every host
+    runs its own full (flat) mesh and the cross-host register merge is
+    world-size-independent (the ``_merge_tail`` laws are associative),
+    so any contiguous rung count is reachable.  The checkpoint
+    fingerprint pins ``max_hosts`` (the ladder maximum), which is what
+    lets a merged-ring checkpoint taken at any host count resume at any
+    other on the same ladder.
+    """
+    return world_ladder(min_hosts, max_hosts)
+
+
 @dataclasses.dataclass
 class ScaleDecision:
     """One policy decision, evidence attached (obs + report facing)."""

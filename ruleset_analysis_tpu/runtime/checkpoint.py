@@ -63,6 +63,16 @@ def fingerprint(
     keep talker tables bit-identical to an uninterrupted run.  ``lane`` is
     the resolved per-ACL lane width when the stream runs the stacked
     layout (0 for flat) — layouts must not cross-resume.
+
+    Elastic tiers pin a LADDER MAXIMUM here, never the live world size:
+    the elastic batch plane passes its world-ladder max (runtime/
+    elastic.py), and the distributed serve tier passes its host-ladder
+    max (``DistServeConfig.ladder_max``, runtime/distserve.py) — merged
+    registers are world-size-independent under the merge laws, so a
+    snapshot taken at any rung must resume at any other rung of the
+    SAME ladder.  What must still be refused is a changed ceiling:
+    resizing the ladder itself re-partitions what the fingerprint's
+    geometry terms mean, so it is part of the resume identity.
     """
     h = hashlib.sha256()
     h.update(np.ascontiguousarray(packed.rules).tobytes())

@@ -553,6 +553,15 @@ def _shard_analysis(shard: dict) -> dict:
         t = (e.get("args") or {}).get("tenant")
         if isinstance(t, str):
             tenant_events[t] = tenant_events.get(t, 0) + 1
+    # host activity mirrors tenant activity for the distributed serve
+    # tier (runtime/distserve.py tags spawn/retire/death/late-epoch
+    # instants with args.host): ranks which ingest host was implicated
+    # when the process died, across every per-host shard of the bundle
+    host_events: dict[str, int] = {}
+    for e in events:
+        h = (e.get("args") or {}).get("host")
+        if isinstance(h, (int, str)) and not isinstance(h, bool):
+            host_events[str(h)] = host_events.get(str(h), 0) + 1
     last = events[-1] if events else None
     return {
         "role": shard.get("role"),
@@ -561,6 +570,7 @@ def _shard_analysis(shard: dict) -> dict:
         "stage_occupancy_pct": stage_occupancy(events),
         "fault_sites_fired": fault_sites,
         "tenant_events": tenant_events,
+        "host_events": host_events,
         "last_event": (
             {"name": last.get("name"), "ph": last.get("ph")} if last else None
         ),
@@ -621,6 +631,8 @@ def merge(
     per_shard = [_shard_analysis(s) for s in shards]
     fault_sites: dict[str, int] = {}
     tenant_events: dict[str, int] = {}
+    host_events: dict[str, int] = {}
+    dead_hosts: set[str] = set()
     retries: dict[str, dict] = {}
     queue_depths: dict[str, dict] = {}
     degraded: list[str] = []
@@ -629,6 +641,13 @@ def merge(
             fault_sites[site] = fault_sites.get(site, 0) + n
         for t, n in analysis["tenant_events"].items():
             tenant_events[t] = tenant_events.get(t, 0) + n
+        for h, n in analysis["host_events"].items():
+            host_events[h] = host_events.get(h, 0) + n
+        # the supervisor's cursor carries the authoritative dead set;
+        # union across shards so a rank-0 dump and a surviving host's
+        # seal agree on who died
+        for h in (shard.get("cursors") or {}).get("dead_hosts", []) or []:
+            dead_hosts.add(str(h))
         for site, c in (shard.get("retry") or {}).items():
             agg = retries.setdefault(
                 site, {"attempts": 0, "recoveries": 0, "giveups": 0}
@@ -671,6 +690,8 @@ def merge(
             "per_shard": per_shard,
             "fault_sites_fired": fault_sites,
             "tenant_events": tenant_events,
+            "host_events": host_events,
+            "dead_hosts": sorted(dead_hosts, key=lambda h: (len(h), h)),
             "retries": retries,
             "queue_depths": queue_depths,
             "degraded": degraded,
@@ -740,6 +761,25 @@ def diagnose(bundle: dict, exit_code: int | None = None) -> list[dict]:
             f"fault site instant(s) on the ring: {fired}",
             "this failure was INJECTED (chaos drill); replay with the "
             "same --fault-plan spec to reproduce exactly",
+        )
+    dead_hosts = a.get("dead_hosts") or []
+    if dead_hosts:
+        named = ", ".join(f"host {h}" for h in dead_hosts)
+        he = a.get("host_events") or {}
+        hot = ", ".join(
+            f"host {h} x{n}"
+            for h, n in sorted(he.items(), key=lambda kv: -kv[1])[:4]
+        )
+        add(
+            "a distributed-serve ingest host died mid-window "
+            f"({named})",
+            f"rank 0's cursor names dead host(s) {dead_hosts}"
+            + (f"; host-tagged ring events: {hot}" if hot else ""),
+            "windows overlapping the death carry a typed incomplete "
+            "marker naming the host (host_died:<rank>) — their zero-hit "
+            "rules are NOT deletion evidence; the host's WAL replays its "
+            "tail on rejoin (--dist-respawn), and the per-host shard "
+            "blackbox-*.json in this bundle holds its final ring",
         )
     stage = a.get("failing_stage")
     trigger = bundle.get("trigger")

@@ -680,6 +680,16 @@ class ServeDriver:
         ``/metrics?format=prom``."""
         return self.lat_cum.render_prom("ra_serve_ingest_to_publish_seconds")
 
+    def render_labeled_prom(self) -> str:
+        """Labeled Prometheus families appended to ``/metrics?format=prom``.
+
+        The single-host service has none; the distributed rank-0 driver
+        (runtime/distserve.py) overrides this with host-labeled series
+        rendered from the SAME per-host JSON gauge blocks — the parity
+        the registry audit (verify/registry.py::audit_distserve) pins.
+        """
+        return ""
+
     # -- report access (HTTP + tests) ------------------------------------
     def published(self, name: str) -> dict | None:
         with self._pub_lock:
@@ -1462,12 +1472,28 @@ class ServeDriver:
                 "serve.window", id=meta["id"], lines=meta["lines"],
                 chunks=meta["chunks"], drops=meta["drops"],
             )
+            # host-tier hook: the distributed ingest worker overrides
+            # this to ship the closed epoch to rank 0's merge plane
+            # (runtime/distserve.py); the single-host service keeps
+            # everything local.  AFTER local accounting, BEFORE the
+            # (slow) publish phase, so the merge tier is never gated on
+            # this host's disk
+            self._emit_epoch(ep)
             self._publish(rep_obj, prev, meta)
             if (
                 self.scfg.checkpoint_every_windows
                 and self.windows_published % self.scfg.checkpoint_every_windows == 0
             ):
                 self._save_ring_ckpt()
+
+    def _emit_epoch(self, ep: WindowEpoch) -> None:
+        """A closed window leaves the driver (no-op hook).
+
+        ``serve --distributed`` host workers override this to hand the
+        epoch — arrays, tracker tables, accounting meta, WAL cursor —
+        to the cross-host merge tier.  The base service is its own merge
+        tier (the ring push above already happened), so nothing to do.
+        """
 
     def _publish(self, rep_obj: dict, prev: dict | None, meta: dict) -> None:
         with obs.span("serve.publish", window=meta["id"]):
@@ -2180,7 +2206,8 @@ def _make_http_handler():
                             render_prom(
                                 drv.metrics_gauges(), prefix="ra_serve_"
                             )
-                            + drv.render_latency_prom(),
+                            + drv.render_latency_prom()
+                            + drv.render_labeled_prom(),
                             "text/plain; version=0.0.4; charset=utf-8",
                         )
                     return self._send(

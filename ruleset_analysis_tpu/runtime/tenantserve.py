@@ -156,7 +156,16 @@ class TenantServeDriver:
         *,
         topk: int = 10,
         mesh=None,
+        distributed=None,
     ):
+        if distributed is not None:
+            raise AnalysisError(
+                "serve --tenants and --distributed do not compose yet: "
+                "the tenancy plane multiplexes rulesets on ONE mesh while "
+                "the host tier shards ingest of ONE ruleset across many "
+                "(DESIGN §22 scope bound); run one distributed service "
+                "per tenant, or drop --distributed"
+            )
         if cfg.layout != "flat":
             raise AnalysisError(
                 "serve --tenants supports layout='flat' only (the stacked "

@@ -8,6 +8,15 @@ window accounting, and ``serve --resume`` replays the tail past the last
 checkpoint so the interrupted window publishes **bit-identical over its
 delivered lines**.
 
+The distributed service (``serve --distributed``, DESIGN §22) keeps one
+WAL **per ingest host** under ``serve_dir/host-<rank>/wal`` — spools are
+strictly host-local (a host appends only its own listeners' lines), so
+a whole-host SIGKILL replays exactly that host's tail on rejoin, and
+rank 0 tracks two cursors per host: the seq covered by *received*
+epochs (the rejoin replay point) and the seq covered by *published*
+windows (what the merged-ring checkpoint records — a supervisor death
+must re-merge pending-but-unpublished epochs from the spool).
+
 Design:
 
 - **Segments.**  ``seg-<start_seq>.wal`` files; each holds a 16-byte

@@ -489,10 +489,76 @@ def audit_tenancy(root: str | None = None) -> list[AuditFinding]:
     return findings
 
 
+def audit_distserve(root: str | None = None) -> list[AuditFinding]:
+    """Distributed-serve parity: host-labeled prom == JSON gauges.
+
+    Rank 0's ``/metrics`` serves per-host JSON gauge blocks AND
+    host-labeled Prometheus families from ONE source
+    (``DistServeDriver.host_gauges``); this audit drives a supervisor
+    with synthetic host states — one live, one dead, float and negative
+    gauges included — through BOTH renderings via the real methods and
+    fails on a JSON gauge missing from the labeled text, a value
+    disagreement, or a label collision between hosts (ISSUE 17
+    satellite).
+    """
+    import threading
+
+    from ..runtime.distserve import DistServeDriver, _Host
+
+    findings: list[AuditFinding] = []
+    drv = DistServeDriver.__new__(DistServeDriver)
+    drv._lock = threading.Lock()
+    drv.hosts = {}
+    h0 = _Host(0, 0)
+    h0.gauges = {
+        "lines_per_sec": 1234.5, "queue_depth": 17, "drops_total": 0,
+    }
+    h0.last_wid = 4
+    h1 = _Host(1, 0)
+    h1.gauges = {
+        "lines_per_sec": 0.0, "queue_depth": 0, "drops_total": 3,
+    }
+    h1.dead = True
+    h1.dead_reason = "audit probe"
+    h1.degraded = ["wal"]
+    drv.hosts = {0: h0, 1: h1}
+
+    js = drv.host_gauges()
+    prom = drv.render_labeled_prom()
+    if set(js) != {"0", "1"}:
+        findings.append(AuditFinding(
+            "distserve", "host-block-drift", ",".join(sorted(js)),
+            "host_gauges() must key one block per host rank",
+        ))
+    for host, gauges in js.items():
+        for key, v in gauges.items():
+            if isinstance(v, bool):
+                v = int(v)
+            if not isinstance(v, (int, float)):
+                continue
+            body = f"{v:g}" if isinstance(v, float) else f"{v}"
+            want = f'ra_serve_host_{key}{{host="{host}"}} {body}'
+            if want not in prom.splitlines():
+                findings.append(AuditFinding(
+                    "distserve", "labeled-gauge-drift", f"{host}/{key}",
+                    "a per-host JSON gauge is absent from (or disagrees "
+                    "with) the host-labeled Prometheus rendering",
+                ))
+    # the dead/live flags must disagree BETWEEN the two hosts — a label
+    # collision (both series under one host value) would make them agree
+    if js["0"]["dead"] == js["1"]["dead"] or js["0"]["live"] == js["1"]["live"]:
+        findings.append(AuditFinding(
+            "distserve", "label-collision", "dead/live",
+            "live and dead hosts render identical flags — per-host "
+            "blocks are not independent",
+        ))
+    return findings
+
+
 def audit_registry(root: str | None = None) -> list[AuditFinding]:
-    """All six audits, in declaration order."""
+    """All seven audits, in declaration order."""
     return (
         audit_faults(root) + audit_cli(root) + audit_volatile(root)
         + audit_retry(root) + audit_observability(root)
-        + audit_tenancy(root)
+        + audit_tenancy(root) + audit_distserve(root)
     )

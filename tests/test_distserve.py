@@ -1,0 +1,637 @@
+"""Multi-host distributed serve: per-host ingest, cross-host merge.
+
+Pins the DESIGN §22 invariants (ISSUE 17):
+
+- **Merge fidelity**: the window report rank 0 publishes from N hosts'
+  epochs is bit-identical — registers, per-rule hits, unique-source
+  counts, unused-rule deletion candidates, AND talkers — to a
+  single-host replay of the union of the hosts' delivered lines (per
+  window: host 0's slice then host 1's), at a geometry where candidate
+  coverage is complete.
+- **Typed degradation**: a host that dies mid-window yields a published
+  window carrying ``host_died:<rank>`` in its incomplete marker — never
+  a hang, never a silent zero-hit window — and the service keeps
+  serving from the survivors.
+- **Elastic resume**: the ring-checkpoint fingerprint pins the host
+  LADDER MAXIMUM, so a checkpoint taken at any world size resumes at
+  any other on the same ladder, and a changed ceiling is a typed
+  refusal.
+- **Host-tier wire**: epochs cross the merge plane as CRC'd RAEP1
+  payloads; corruption is a typed refusal at the merge tier, never
+  silently-wrong published counters.
+
+Everything here runs thread-mode workers (in-process, shared jit
+caches); the process-mode SIGKILL chaos and WAL-rejoin side is
+``slow``-marked (spawned interpreters recompile from scratch, which
+does not fit the tier-1 wall budget).
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import (
+    AnalysisConfig,
+    DistServeConfig,
+    ServeConfig,
+)
+from ruleset_analysis_tpu.errors import AnalysisError
+from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+from ruleset_analysis_tpu.hostside.listener import offset_listen_spec
+from ruleset_analysis_tpu.parallel.distributed import (
+    pack_epoch_payload,
+    unpack_epoch_payload,
+)
+from ruleset_analysis_tpu.runtime import flightrec
+from ruleset_analysis_tpu.runtime.autoscale import host_ladder
+from ruleset_analysis_tpu.runtime.distserve import DistServeDriver
+from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS as VOLATILE
+from ruleset_analysis_tpu.runtime.serve import ServeDriver
+
+
+def image(obj) -> dict:
+    if not isinstance(obj, dict):
+        obj = json.loads(obj.to_json())
+    obj = json.loads(json.dumps(obj))
+    for k in VOLATILE:
+        obj["totals"].pop(k, None)
+    # host fan-out changes batch segmentation and the window meta
+    # (per-host blocks), not analysis content
+    obj["totals"].pop("window", None)
+    obj["totals"].pop("chunks", None)
+    return obj
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """v4+v6 packed ruleset + 800 mixed lines, host-sliced."""
+    td = tmp_path_factory.mktemp("distserve")
+    cfg_text = synth.synth_config(
+        n_acls=2, rules_per_acl=8, seed=0, v6_fraction=0.25
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    prefix = str(td / "rules")
+    pack.save_packed(packed, prefix)
+    t = synth.synth_tuples(packed, 600, seed=1)
+    lines = synth.render_syslog(packed, t, seed=1)
+    t6 = synth.synth_tuples6(packed, 200, seed=2)
+    lines += synth.render_syslog6(packed, t6, seed=3)
+    return packed, prefix, lines, str(td)
+
+
+RUN_CFG = dict(batch_size=128, prefetch_depth=0)
+
+#: per-host window length; the solo replay uses N_HOSTS * WL
+WL = 200
+
+
+def dist_cfg(**kw) -> AnalysisConfig:
+    return AnalysisConfig(**{**RUN_CFG, "mesh_shape": "hybrid", **kw})
+
+
+def dist_scfg(serve_dir, **kw) -> ServeConfig:
+    return ServeConfig(**{
+        "listen": ("tcp:127.0.0.1:0",), "window_lines": WL,
+        "serve_dir": str(serve_dir), "http": "off",
+        "checkpoint_every_windows": 0, "reload_watch": False,
+        **kw,
+    })
+
+
+def host_slices(lines, n_hosts, windows, wl=WL):
+    """Union order -> per-host streams (window w: host 0's slice, then
+    host 1's, ...), so merged window w and solo window w cover the same
+    lines."""
+    return {
+        r: [
+            ln
+            for w in range(windows)
+            for ln in lines[(w * n_hosts + r) * wl:(w * n_hosts + r + 1) * wl]
+        ]
+        for r in range(n_hosts)
+    }
+
+
+def start_dist(prefix, cfg, scfg, dscfg, **kw):
+    drv = DistServeDriver(prefix, cfg, scfg, dscfg, **kw)
+    out: dict = {}
+
+    def runner():
+        try:
+            out["summary"] = drv.run()
+        except BaseException as e:  # surfaced by finish_dist()
+            out["error"] = e
+
+    th = threading.Thread(target=runner, daemon=True)
+    th.start()
+    return drv, th, out
+
+
+def finish_dist(th, out, timeout=240):
+    th.join(timeout=timeout)
+    assert not th.is_alive(), "distributed serve hung"
+    if "error" in out:
+        raise out["error"]
+    return out["summary"]
+
+
+def host_tcp(drv, rank):
+    with drv._lock:
+        h = drv.hosts.get(rank)
+        addrs = dict(h.addresses) if h else {}
+    for lbl, ad in addrs.items():
+        if lbl.startswith("tcp"):
+            return tuple(ad)
+    return None
+
+
+def wait_for(pred, timeout=120, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def wait_hosts_up(drv, out, n_hosts, timeout=120):
+    wait_for(
+        lambda: out.get("error")
+        or all(host_tcp(drv, r) for r in range(n_hosts)),
+        timeout, "host listeners",
+    )
+    if "error" in out:
+        raise out["error"]
+
+
+def send_tcp(addr, lines):
+    s = socket.create_connection(addr)
+    s.sendall(("\n".join(lines) + "\n").encode())
+    s.close()
+
+
+def read_json(path):
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Config + wire plumbing (no device work).
+# ---------------------------------------------------------------------------
+
+def test_distserve_config_validation():
+    assert DistServeConfig().ladder_max == 2
+    assert DistServeConfig(hosts=2, max_hosts=5).ladder_max == 5
+    with pytest.raises(ValueError):
+        DistServeConfig(hosts=0)
+    with pytest.raises(ValueError):
+        DistServeConfig(workers="fiber")
+    with pytest.raises(ValueError):
+        DistServeConfig(merge_bind="nocolon")
+    with pytest.raises(ValueError):
+        DistServeConfig(merge_timeout_sec=0)
+    with pytest.raises(ValueError):
+        DistServeConfig(hosts=4, max_hosts=3)
+    with pytest.raises(ValueError):
+        DistServeConfig(hosts=1, min_hosts=2, max_hosts=4)
+
+
+def test_host_ladder_contiguous():
+    assert host_ladder(1, 4) == [1, 2, 3, 4]
+    assert host_ladder(3, 3) == [3]
+    with pytest.raises(AnalysisError):
+        host_ladder(5, 2)
+
+
+def test_offset_listen_spec():
+    assert offset_listen_spec("tcp:0.0.0.0:6514", 2) == "tcp:0.0.0.0:6516"
+    assert offset_listen_spec("udp:127.0.0.1:514", 1) == "udp:127.0.0.1:515"
+    # ephemeral stays ephemeral: every host binds its own port
+    assert offset_listen_spec("tcp:127.0.0.1:0", 3) == "tcp:127.0.0.1:0"
+    assert offset_listen_spec("tail:/var/log/fw.log", 0) == "tail:/var/log/fw.log"
+    assert offset_listen_spec("tail:/var/log/fw.log", 2).endswith(".host2")
+    with pytest.raises(AnalysisError):
+        offset_listen_spec("tcp:127.0.0.1:6514", -1)
+
+
+def test_epoch_payload_roundtrip_and_corruption():
+    arrays = {
+        "counts_lo": np.arange(8, dtype=np.uint32),
+        "counts_hi": np.zeros(8, dtype=np.uint32),
+    }
+    extra = {"rank": 1, "meta": {"id": 4, "lines": 99}, "wal_next": 7}
+    payload = pack_epoch_payload(arrays, extra)
+    arr2, extra2 = unpack_epoch_payload(payload)
+    assert extra2 == extra
+    assert set(arr2) == set(arrays)
+    for k in arrays:
+        assert np.array_equal(arr2[k], arrays[k])
+    # a flipped body byte must be a typed refusal (CRC), never silence
+    torn = bytearray(payload)
+    torn[-1] ^= 0xFF
+    with pytest.raises(AnalysisError):
+        unpack_epoch_payload(bytes(torn))
+    with pytest.raises(AnalysisError):
+        unpack_epoch_payload(payload[:-3])
+    with pytest.raises(AnalysisError):
+        unpack_epoch_payload(b"NOPE" + payload[4:])
+
+
+# ---------------------------------------------------------------------------
+# Typed refusals at the composition boundaries.
+# ---------------------------------------------------------------------------
+
+def test_distributed_requires_hybrid_mesh(corpus):
+    _, prefix, _, td = corpus
+    with pytest.raises(AnalysisError, match="hybrid"):
+        DistServeDriver(
+            prefix, AnalysisConfig(**RUN_CFG),
+            dist_scfg(os.path.join(td, "refuse-mesh")),
+            DistServeConfig(workers="thread"),
+        )
+
+
+def test_distributed_refuses_static_analysis(corpus):
+    _, prefix, _, td = corpus
+    with pytest.raises(AnalysisError, match="static"):
+        DistServeDriver(
+            prefix, dist_cfg(),
+            dist_scfg(os.path.join(td, "refuse-static"), static_analysis=True),
+            DistServeConfig(workers="thread"),
+        )
+
+
+def test_tenants_distributed_refusal(corpus):
+    from ruleset_analysis_tpu.runtime.tenantserve import TenantServeDriver
+
+    _, _, _, td = corpus
+    with pytest.raises(AnalysisError, match="do not compose"):
+        TenantServeDriver(
+            os.path.join(td, "nonexistent-manifest.json"),
+            AnalysisConfig(**RUN_CFG),
+            dist_scfg(os.path.join(td, "refuse-tenants")),
+            distributed=DistServeConfig(),
+        )
+
+
+def test_cli_dist_flags_require_distributed(corpus, capsys):
+    from ruleset_analysis_tpu import cli
+
+    _, prefix, _, td = corpus
+    rc = cli.main([
+        "serve", "--ruleset", prefix, "--listen", "tcp:127.0.0.1:0",
+        "--window", "lines:100", "--serve-dir", os.path.join(td, "cli-no-dist"),
+        "--dist-hosts", "3",
+    ])
+    assert rc == 2
+    assert "--distributed" in capsys.readouterr().err
+
+
+def test_cli_distributed_requires_hybrid(corpus, capsys):
+    from ruleset_analysis_tpu import cli
+
+    _, prefix, _, td = corpus
+    rc = cli.main([
+        "serve", "--ruleset", prefix, "--listen", "tcp:127.0.0.1:0",
+        "--window", "lines:100", "--serve-dir", os.path.join(td, "cli-flat"),
+        "--distributed",
+    ])
+    assert rc == 2
+
+
+# ---------------------------------------------------------------------------
+# The merge law: N-host published windows == single-host union replay.
+# ---------------------------------------------------------------------------
+
+def test_two_host_bit_identity(corpus):
+    packed, prefix, lines, td = corpus
+    n_hosts, windows = 2, 2
+    union = lines[:n_hosts * windows * WL]
+    streams = host_slices(union, n_hosts, windows)
+
+    # solo reference: one driver replays the union at 2x window length
+    solo_dir = os.path.join(td, "solo")
+    solo = ServeDriver(
+        prefix, AnalysisConfig(**RUN_CFG),
+        dist_scfg(solo_dir, window_lines=n_hosts * WL, max_windows=windows),
+    )
+    out: dict = {}
+    th = threading.Thread(
+        target=lambda: out.update(summary=solo.run())
+    )
+    th.start()
+    ep = os.path.join(solo_dir, "endpoint.json")
+    wait_for(lambda: os.path.exists(ep), msg="solo endpoint")
+    time.sleep(0.2)
+    (addr,) = read_json(ep)["listeners"].values()
+    send_tcp(tuple(addr), union)
+    th.join(timeout=240)
+    assert not th.is_alive(), "solo serve hung"
+    assert out["summary"]["drops"] == 0
+
+    dist_dir = os.path.join(td, "dist")
+    drv, th, dout = start_dist(
+        prefix, dist_cfg(), dist_scfg(dist_dir, max_windows=windows),
+        DistServeConfig(hosts=n_hosts, workers="thread"),
+    )
+    wait_hosts_up(drv, dout, n_hosts)
+    for r in range(n_hosts):
+        send_tcp(host_tcp(drv, r), streams[r])
+    summary = finish_dist(th, dout)
+    assert summary["windows_published"] == windows
+    assert summary["lines_total"] == len(union)
+    assert summary["drops"] == 0
+    assert summary["dead_hosts"] == []
+
+    # merged window w == solo window w, talkers INCLUDED: at this
+    # geometry candidate coverage is complete, so even the sampled
+    # talker section reproduces exactly under the sum-merge law
+    for w in range(windows):
+        a = read_json(os.path.join(dist_dir, f"window-{w:06d}.json"))
+        b = read_json(os.path.join(solo_dir, f"window-{w:06d}.json"))
+        assert a.get("talkers") == b.get("talkers"), f"window {w} talkers"
+        assert image(a) == image(b), f"window {w} diverged"
+    ca = read_json(os.path.join(dist_dir, "cumulative.json"))
+    cb = read_json(os.path.join(solo_dir, "cumulative.json"))
+    assert ca.get("talkers") == cb.get("talkers")
+    assert image(ca) == image(cb)
+    # per-host accounting rides the merged window meta
+    w0 = read_json(os.path.join(dist_dir, "window-000000.json"))
+    meta = w0["totals"]["window"]
+    assert meta["merged_hosts"] == [0, 1]
+    assert set(meta["hosts"]) == {"0", "1"}
+    assert sum(h["lines"] for h in meta["hosts"].values()) == n_hosts * WL
+
+
+# ---------------------------------------------------------------------------
+# Whole-host death: typed WindowIncomplete, no hang, no silent loss.
+# ---------------------------------------------------------------------------
+
+def test_killed_host_names_window_incomplete(corpus):
+    packed, prefix, lines, td = corpus
+    n_hosts, windows = 2, 2
+    union = lines[:n_hosts * windows * WL]
+    streams = host_slices(union, n_hosts, windows)
+
+    dist_dir = os.path.join(td, "chaos")
+    drv, th, out = start_dist(
+        prefix, dist_cfg(),
+        dist_scfg(dist_dir, max_windows=windows),
+        DistServeConfig(hosts=n_hosts, workers="thread", merge_timeout_sec=60),
+    )
+    wait_hosts_up(drv, out, n_hosts)
+
+    # live per-host gauges reach rank 0 over the merge plane, and the
+    # JSON and labeled-prom views agree (the registry auditor pins the
+    # formatting law; this pins the LIVE path)
+    wait_for(
+        lambda: all(
+            "queue_depth" in drv.host_gauges().get(str(r), {})
+            for r in range(n_hosts)
+        ),
+        msg="per-host gauges",
+    )
+    gj = drv.host_gauges()
+    prom = drv.render_labeled_prom()
+    assert set(gj) == {"0", "1"}
+    assert 'host="0"' in prom and 'host="1"' in prom
+    assert "ra_serve_host_" in prom
+
+    send_tcp(host_tcp(drv, 0), streams[0])
+    send_tcp(host_tcp(drv, 1), streams[1][:WL])  # window 0 only
+    # wait until host 1's window-0 epoch merged, then kill it mid-window-1
+    wait_for(
+        lambda: out.get("error") or drv.hosts[1].last_wid >= 0,
+        msg="host 1 epoch 0",
+    )
+    drv.kill_host(1)
+    wait_for(
+        lambda: out.get("error") or 1 not in drv.live_hosts(),
+        msg="host 1 marked dead",
+    )
+    summary = finish_dist(th, out)
+
+    assert summary["dead_hosts"] == [1]
+    assert summary["windows_published"] == windows
+    # host 0's full stream + host 1's completed window 0: nothing a
+    # survivor delivered is lost, and nothing is silently absorbed
+    assert summary["lines_total"] == windows * WL + WL
+    w1 = read_json(os.path.join(dist_dir, "window-000001.json"))
+    inc = w1["totals"]["window"]["incomplete"]
+    assert any(r == "host_died:1" for r in inc["reasons"]), inc
+    assert inc["dead_hosts"] == [1]
+    w0 = read_json(os.path.join(dist_dir, "window-000000.json"))
+    assert "incomplete" not in w0["totals"]["window"]
+
+
+# ---------------------------------------------------------------------------
+# Elastic resume: ladder-max fingerprint, any world resumes any world.
+# ---------------------------------------------------------------------------
+
+def test_resume_across_world_sizes(corpus):
+    packed, prefix, lines, td = corpus
+    union = lines[:800]
+
+    base = os.path.join(td, "elastic")
+    ck = os.path.join(base, "ckpt")
+    scfg_kw = dict(
+        checkpoint_every_windows=1, checkpoint_dir=ck, max_windows=2,
+    )
+    # first world: 2 hosts, 2 windows
+    drv, th, out = start_dist(
+        prefix, dist_cfg(), dist_scfg(base, **scfg_kw),
+        DistServeConfig(hosts=2, max_hosts=3, workers="thread"),
+    )
+    wait_hosts_up(drv, out, 2)
+    streams = host_slices(union[:800], 2, 2)
+    for r in range(2):
+        send_tcp(host_tcp(drv, r), streams[r])
+    s1 = finish_dist(th, out)
+    assert s1["windows_published"] == 2
+    assert s1["drops"] == 0
+
+    # resume at a DIFFERENT world (3 hosts) on the same ladder: the
+    # fingerprint pins the ladder max, not the live host count
+    drv, th, out = start_dist(
+        prefix, dist_cfg(resume=True),
+        dist_scfg(base, **{**scfg_kw, "max_windows": 3}),
+        DistServeConfig(hosts=3, max_hosts=3, workers="thread"),
+    )
+    wait_hosts_up(drv, out, 3)
+    # cumulative state restored before any new traffic
+    assert drv.windows_published == 2
+    assert drv.total_lines == 800
+    for r in range(3):
+        send_tcp(host_tcp(drv, r), lines[800 + r * 66:800 + (r + 1) * 66])
+    # 3 hosts x 66 lines < WL each: stopping publishes the partial tail
+    wait_for(
+        lambda: out.get("error")
+        or all(drv.hosts[r].gauges.get("lines_total", 0) >= 66
+               or drv.hosts[r].last_wid >= 2
+               for r in range(3))
+        or drv.total_lines >= 800,
+        timeout=60, msg="resumed ingest",
+    )
+    time.sleep(1.0)
+    drv.stop()
+    s2 = finish_dist(th, out)
+    assert s2["windows_published"] >= 2  # restored count carries over
+
+    # a CHANGED ladder maximum is a typed refusal, not silent reuse
+    with pytest.raises(ckpt_mismatch_types()):
+        drv, th, out = start_dist(
+            prefix, dist_cfg(resume=True), dist_scfg(base, **scfg_kw),
+            DistServeConfig(hosts=2, max_hosts=4, workers="thread"),
+        )
+        finish_dist(th, out, timeout=60)
+
+
+def ckpt_mismatch_types():
+    from ruleset_analysis_tpu.runtime import checkpoint as ckpt
+
+    return (ckpt.CheckpointMismatch,)
+
+
+# ---------------------------------------------------------------------------
+# Forensics: per-host shards merge, the doctor names the dead host.
+# ---------------------------------------------------------------------------
+
+def _shard(role, pid, events=(), cursors=None):
+    return {
+        "kind": "ra-blackbox-shard",
+        "role": role,
+        "pid": pid,
+        "trigger": "abort",
+        "ring_events": list(events),
+        "cursors": cursors or {},
+    }
+
+
+def test_blackbox_merge_names_dead_host(tmp_path):
+    bb = tmp_path / "blackbox"
+    bb.mkdir()
+    sup = _shard(
+        "serve-sup", 100,
+        events=[
+            {"name": "serve.host.spawn", "ph": "i", "args": {"host": 1}},
+            {"name": "serve.host.died", "ph": "i", "args": {"host": 1}},
+        ],
+        cursors={"dead_hosts": [1], "windows_published": 3},
+    )
+    worker = _shard(
+        "serve-host0", 101,
+        events=[{"name": "serve.rotate", "ph": "i", "args": {"host": 0}}],
+    )
+    (bb / "blackbox-100.json").write_text(json.dumps(sup))
+    (bb / "blackbox-101.json").write_text(json.dumps(worker))
+    out_path = flightrec.merge(str(bb), trigger="abort", exit_code=7)
+    bundle = read_json(out_path)
+    a = bundle["analysis"]
+    assert a["dead_hosts"] == ["1"]
+    assert a["host_events"].get("1", 0) >= 2
+    assert a["host_events"].get("0", 0) >= 1
+    diags = flightrec.diagnose(bundle, exit_code=7)
+    dead = [d for d in diags if "ingest host died" in d["cause"]]
+    assert dead, diags
+    assert "host 1" in dead[0]["cause"]
+    assert "host_died:<rank>" in dead[0]["advice"]
+
+
+def test_worker_arm_does_not_reexport_env(tmp_path, monkeypatch):
+    # the spawned worker arms its own shard ring from the inherited
+    # RA_BLACKBOX_DIR but must NOT re-export/prune the shared dir (the
+    # supervisor owns the bundle lifecycle)
+    bb = tmp_path / "bb"
+    monkeypatch.delenv(flightrec.ENV_VAR, raising=False)
+    flightrec.arm(str(bb), role="serve-host7", export_env=False)
+    try:
+        assert flightrec.ENV_VAR not in os.environ
+    finally:
+        flightrec.disarm()
+
+
+def test_registry_distserve_audit_clean():
+    from ruleset_analysis_tpu.verify.registry import audit_distserve
+
+    assert audit_distserve() == []
+
+
+# ---------------------------------------------------------------------------
+# Process isolation + SIGKILL chaos + WAL rejoin (spawned interpreters
+# recompile XLA from scratch — minutes on one core, so slow-marked).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_process_mode_sigkill_rejoin(corpus):
+    """SIGKILL a whole host process; `--dist-respawn` absorbs it.
+
+    With a merge wait that outlives the replacement's cold start, the
+    respawned rank rejoins at the merge frontier and delivers the
+    windows its predecessor never saw — every published window ends up
+    COMPLETE (no incomplete markers, no drops), lines_total covers
+    every delivered line once, and the supervisor shuts down cleanly
+    even though the replacement came up long after its siblings (the
+    per-generation stop-delivery path).  The typed host_died naming of
+    an UNREPLACED death is pinned in-tier by
+    test_killed_host_names_window_incomplete; this is the other half
+    of the failure model: replacement means seamless, not renamed.
+    """
+    packed, prefix, lines, td = corpus
+    n_hosts, windows = 2, 3
+    wl = 100
+    union = lines[:n_hosts * windows * wl]
+    streams = host_slices(union, n_hosts, windows, wl=wl)
+
+    dist_dir = os.path.join(td, "proc-chaos")
+    drv, th, out = start_dist(
+        prefix, dist_cfg(),
+        dist_scfg(dist_dir, window_lines=wl, max_windows=windows, wal=True),
+        DistServeConfig(
+            hosts=n_hosts, workers="process", respawn=True,
+            merge_timeout_sec=600,
+        ),
+    )
+    wait_hosts_up(drv, out, n_hosts, timeout=300)
+    send_tcp(host_tcp(drv, 0), streams[0])
+    send_tcp(host_tcp(drv, 1), streams[1][:wl])
+    wait_for(
+        lambda: out.get("error") or drv.hosts[1].last_wid >= 0,
+        timeout=300, msg="host 1 epoch 0",
+    )
+    pid = drv.hosts[1].proc.pid
+    os.kill(pid, 9)  # whole-host SIGKILL, not a polite stop
+    # respawn brings a NEW process up on the same rank; its WAL replay
+    # seq starts past the merged windows, so nothing double-counts
+    wait_for(
+        lambda: out.get("error")
+        or (1 in drv.live_hosts() and drv.hosts[1].generation >= 1),
+        timeout=300, msg="host 1 respawn",
+    )
+    wait_for(
+        lambda: out.get("error")
+        or (drv.hosts[1].addresses and drv.hosts[1].generation >= 1
+            and host_tcp(drv, 1)),
+        timeout=300, msg="replacement listener",
+    )
+    send_tcp(host_tcp(drv, 1), streams[1][wl:])
+    summary = finish_dist(th, out, timeout=900)
+    assert summary["windows_published"] == windows
+    assert summary["lines_total"] == len(union)
+    assert summary["drops"] == 0
+    assert summary["hosts_spawned"] == n_hosts + 1
+    assert summary["hosts"]["1"]["generation"] >= 1
+    assert summary["dead_hosts"] == []  # rejoined, no longer dead
+    for w in range(windows):
+        meta = read_json(
+            os.path.join(dist_dir, f"window-{w:06d}.json")
+        )["totals"]["window"]
+        assert "incomplete" not in meta, (w, meta)
+        assert meta["lines"] == n_hosts * wl
