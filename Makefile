@@ -27,7 +27,7 @@ test:
 # suites.  Exit-coded for CI; same 1-core caveat as the gate above.
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_retry.py \
-		tests/test_wal.py -q -m 'not slow' \
+		tests/test_wal.py tests/test_failover.py -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
 
 # Multi-host serve scaling acceptance (DESIGN §22): 1-host vs 2-host

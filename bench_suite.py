@@ -3601,6 +3601,367 @@ def bench_servescale() -> dict:
     }
 
 
+def bench_failover() -> dict:
+    """Supervisor failover soak (DESIGN §23): kill the lease-holder
+    mid-soak, elect a successor, replay the spools — and price the
+    armed failover plane.
+
+    Four legs, one corpus, thread-mode workers (one process, shared jit
+    caches — the kill is the in-process supervisor-death seam, exactly
+    what the slow CLI SIGKILL e2e pins end-to-end):
+
+    1. **Bare reference** — lease + spool disabled
+       (``lease_ttl_sec=0``, ``spool_budget_mb=0``): the pre-§23 serve
+       plane's sustained rate on this corpus.
+    2. **Armed control** — lease + spool on, no chaos: publishes every
+       window under term 1.  Asserted in-bench: the spool/lease armed
+       overhead — 1 - armed_rate/bare_rate — is **< 2%** (the r19
+       SERVESCALE plane must not get slower by growing a failover
+       plane; both legs are paced identically, so the rates differ
+       only by per-window spool fsyncs and ttl/4 lease heartbeats).
+    3. **Victim** — a full merge-plane partition
+       (``dist.epoch.ship`` armed for the whole leg) parks every epoch
+       in the durable spools, then the supervisor dies abruptly with
+       ZERO windows published.
+    4. **Successor** — wins term 2 off the on-disk lease and replays
+       the spools.  Asserted in-bench: every window it publishes is
+       **bit-identical** (VOLATILE-stripped, talkers included) to the
+       unkilled control's, zero drops, zero skipped windows, every
+       window stamped with exactly one fencing term (control windows
+       term 1, successor windows term 2 — one publisher per term), and
+       **time-to-takeover** (successor start -> last replayed window on
+       disk, election + replay inclusive) is **<= 2x the lease TTL**.
+
+    ``RA_FAILOVER_LINES`` (default 12k; 2 hosts x 4 windows) and
+    ``RA_FAILOVER_RATE`` (default 3k lines/s offered PER HOST) size
+    the soak.
+    """
+    import os
+    import socket
+    import tempfile
+    import threading
+
+    import jax
+
+    from ruleset_analysis_tpu.config import (
+        AnalysisConfig,
+        DistServeConfig,
+        ServeConfig,
+    )
+    from ruleset_analysis_tpu.hostside import aclparse, synth
+    from ruleset_analysis_tpu.hostside import pack as pack_mod
+    from ruleset_analysis_tpu.runtime import faults
+    from ruleset_analysis_tpu.runtime.distserve import DistServeDriver
+    from ruleset_analysis_tpu.errors import AnalysisError
+    from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS
+    from ruleset_analysis_tpu.runtime.stream import run_stream
+
+    n_hosts = 2
+    windows = 4
+    ttl = 2.0
+    rate = float(os.environ.get("RA_FAILOVER_RATE", "3000"))
+    wl = int(float(os.environ.get("RA_FAILOVER_LINES", "12000"))) // (
+        n_hosts * windows
+    )
+    total = wl * n_hosts * windows
+    BATCH = 4096
+
+    cfg_text = synth.synth_config(n_acls=2, rules_per_acl=10, seed=0)
+    packed = pack_mod.pack_rulesets([aclparse.parse_asa_config(cfg_text, "fw1")])
+    t = _tuples(packed, total, seed=23)
+    lines = synth.render_syslog(packed, t, seed=23)
+    host_stream = {
+        r: [
+            ln
+            for w in range(windows)
+            for ln in lines[(w * n_hosts + r) * wl:(w * n_hosts + r + 1) * wl]
+        ]
+        for r in range(n_hosts)
+    }
+
+    def image(rep: dict) -> dict:
+        rep = json.loads(json.dumps(rep))
+        for k in VOLATILE_TOTALS:
+            rep["totals"].pop(k, None)
+        # window meta names hosts, chunks, and the fencing term —
+        # provenance, not analysis content (the term is asserted
+        # separately: that is the one-publisher-per-term pin)
+        rep["totals"].pop("window", None)
+        rep["totals"].pop("chunks", None)
+        return rep
+
+    def read_json(path):
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+    def paced_send(addr, seg, rate):
+        s = socket.create_connection(tuple(addr))
+        t0 = time.perf_counter()
+        sent = 0
+        for i in range(0, len(seg), 500):
+            burst = seg[i:i + 500]
+            s.sendall(("\n".join(burst) + "\n").encode())
+            sent += len(burst)
+            lag = sent / rate - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+        s.close()
+
+    def wait_for(pred, timeout, what):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.05)
+        raise RuntimeError(f"failover: timed out waiting for {what}")
+
+    def run_driver(drv):
+        out: dict = {}
+
+        def runner():
+            try:
+                out["summary"] = drv.run()
+            except BaseException as e:  # surfaced by the caller
+                out["error"] = e
+
+        th = threading.Thread(target=runner)
+        th.start()
+        return th, out
+
+    def host_tcp(drv, r):
+        with drv._lock:
+            h = drv.hosts.get(r)
+            addrs = dict(h.addresses) if h else {}
+        for lbl, ad in addrs.items():
+            if lbl.startswith("tcp"):
+                return tuple(ad)
+        return None
+
+    def serve_leg(d, name, dscfg, *, feed=True):
+        """One paced 2-host run; returns (driver, summary, sustained)."""
+        sd = os.path.join(d, name)
+        drv = DistServeDriver(
+            os.path.join(d, "rules"),
+            AnalysisConfig(
+                batch_size=BATCH, prefetch_depth=0, mesh_shape="hybrid"
+            ),
+            ServeConfig(
+                listen=("tcp:127.0.0.1:0",), window_lines=wl,
+                serve_dir=sd, max_windows=windows, http="off",
+                checkpoint_every_windows=0, reload_watch=False,
+                queue_lines=1 << 18,
+            ),
+            dscfg,
+        )
+        th, out = run_driver(drv)
+        wait_for(
+            lambda: out.get("error")
+            or all(host_tcp(drv, r) for r in range(n_hosts)),
+            300, f"{name} host listeners",
+        )
+        if "error" in out:
+            raise RuntimeError(f"failover: {name} leg failed: {out['error']}")
+        t0 = time.perf_counter()
+        senders = [
+            threading.Thread(
+                target=paced_send,
+                args=(host_tcp(drv, r), host_stream[r], rate),
+            )
+            for r in range(n_hosts)
+        ]
+        for s in senders:
+            s.start()
+        for s in senders:
+            s.join()
+        if not feed:
+            return drv, th, out, t0
+        th.join(timeout=600)
+        if th.is_alive() or "error" in out:
+            raise RuntimeError(
+                f"failover: {name} leg failed: {out.get('error')}"
+            )
+        last = os.path.join(sd, f"window-{windows - 1:06d}.json")
+        sustained = total / max(os.path.getmtime(last) - wall0[name], 1e-3)
+        summary = out["summary"]
+        assert summary["drops"] == 0, f"{name} dropped {summary['drops']}"
+        assert summary["windows_published"] == windows, summary
+        return drv, summary, sustained
+
+    wall0: dict = {}
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "rules")
+        pack_mod.save_packed(packed, prefix)
+        warm_cfg = AnalysisConfig(batch_size=BATCH, prefetch_depth=0)
+        run_stream(packed, iter(lines[:64]), warm_cfg)
+
+        # ---- leg 1: bare (no lease, no spool) ----
+        wall0["bare"] = time.time()
+        _, bare_sum, bare_rate = serve_leg(
+            d, "bare",
+            DistServeConfig(
+                hosts=n_hosts, workers="thread",
+                lease_ttl_sec=0.0, spool_budget_mb=0,
+            ),
+        )
+        log(f"failover: bare {bare_rate:,.0f} lines/s over {total} lines")
+
+        # ---- leg 2: armed control (lease + spool, no chaos) ----
+        wall0["control"] = time.time()
+        _, ctl_sum, armed_rate = serve_leg(
+            d, "control",
+            DistServeConfig(
+                hosts=n_hosts, workers="thread", lease_ttl_sec=ttl,
+            ),
+        )
+        assert ctl_sum["term"] == 1
+        overhead = max(0.0, 1.0 - armed_rate / bare_rate)
+        log(
+            f"failover: armed {armed_rate:,.0f} lines/s "
+            f"({overhead:.2%} overhead vs bare)"
+        )
+        assert overhead < 0.02, (
+            f"spool/lease armed overhead {overhead:.2%} >= 2% "
+            f"(bare {bare_rate:,.0f} vs armed {armed_rate:,.0f} lines/s)"
+        )
+
+        # ---- leg 3: victim (full partition, then supervisor death) ----
+        fo_dir = os.path.join(d, "failover")
+        with faults.armed(faults.FaultPlan.parse("dist.epoch.ship@1:99999")):
+            drv, th, out, _ = serve_leg(
+                d, "failover",
+                DistServeConfig(
+                    hosts=n_hosts, workers="thread",
+                    merge_timeout_sec=600, lease_ttl_sec=ttl,
+                ),
+                feed=False,
+            )
+            wait_for(
+                lambda: out.get("error") or all(
+                    drv.host_gauges().get(str(r), {}).get("spool_seq", 0)
+                    >= windows
+                    for r in range(n_hosts)
+                ),
+                300, "every epoch durably spooled",
+            )
+            assert drv.windows_published == 0  # term 1 published NOTHING
+            drv.kill_supervisor()
+            th.join(timeout=600)
+            assert not th.is_alive(), "killed supervisor failed to die"
+            err = out.get("error")
+            assert isinstance(err, AnalysisError), err
+
+        # ---- leg 4: successor (election + replay) ----
+        t_takeover = time.perf_counter()
+        succ = DistServeDriver(
+            prefix,
+            AnalysisConfig(
+                batch_size=BATCH, prefetch_depth=0, mesh_shape="hybrid",
+                resume=True,
+            ),
+            ServeConfig(
+                listen=("tcp:127.0.0.1:0",), window_lines=wl,
+                serve_dir=fo_dir, max_windows=windows, http="off",
+                checkpoint_every_windows=0, reload_watch=False,
+                queue_lines=1 << 18,
+            ),
+            DistServeConfig(
+                hosts=n_hosts, workers="thread",
+                merge_timeout_sec=600, lease_ttl_sec=ttl,
+            ),
+        )
+        th, out = run_driver(succ)
+        last = os.path.join(fo_dir, f"window-{windows - 1:06d}.json")
+        wait_for(lambda: out.get("error") or os.path.exists(last),
+                 300, "successor replay")
+        takeover = time.perf_counter() - t_takeover
+        th.join(timeout=600)
+        if th.is_alive() or "error" in out:
+            raise RuntimeError(
+                f"failover: successor leg failed: {out.get('error')}"
+            )
+        s2 = out["summary"]
+        assert takeover <= 2 * ttl, (
+            f"takeover {takeover:.2f}s > 2x lease TTL ({2 * ttl:.1f}s)"
+        )
+        assert s2["term"] == 2
+        assert s2["windows_published"] == windows, s2
+        assert s2["lines_total"] == total, s2
+        assert s2["drops"] == 0 and s2["skipped_windows"] == [], s2
+        assert s2["failover"]["replay_windows"] == windows, s2["failover"]
+        assert s2["failover"]["replay_refused"] == 0, s2["failover"]
+
+        identical = 0
+        for w in range(windows):
+            a = read_json(os.path.join(fo_dir, f"window-{w:06d}.json"))
+            b = read_json(os.path.join(d, "control", f"window-{w:06d}.json"))
+            # exactly one publisher per fencing term: the control's
+            # windows all carry term 1, the successor's all term 2
+            assert a["totals"]["window"]["term"] == 2, a["totals"]["window"]
+            assert b["totals"]["window"]["term"] == 1, b["totals"]["window"]
+            assert image(a) == image(b), (
+                f"replayed window {w} diverged from the unkilled control"
+            )
+            assert a.get("talkers") == b.get("talkers"), (
+                f"replayed window {w} talkers diverged"
+            )
+            identical += 1
+        cum_same = image(
+            read_json(os.path.join(fo_dir, "cumulative.json"))
+        ) == image(read_json(os.path.join(d, "control", "cumulative.json")))
+        assert cum_same, "replayed cumulative diverged from the control"
+
+    return {
+        "bench": "failover",
+        "metric": "failover_time_to_takeover_sec",
+        "value": round(takeover, 3),
+        "unit": "sec",
+        "vs_baseline": round(takeover / (2 * ttl), 3),  # x the 2xTTL budget
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "devices": len(jax.devices()),
+            "hosts": n_hosts,
+            "workers": "thread",
+            "windows": windows,
+            "lines_total": total,
+            "offered_rate_per_host_lines_per_sec": rate,
+            "lease_ttl_sec": ttl,
+            "bare_sustained_lines_per_sec": round(bare_rate, 1),
+            "armed_sustained_lines_per_sec": round(armed_rate, 1),
+            "armed_overhead_frac": round(overhead, 4),
+            "takeover_budget_sec": 2 * ttl,
+            "epochs_replayed": s2["failover"]["spool_replayed"],
+            "windows_replayed": s2["failover"]["replay_windows"],
+            "windows_bit_identical": identical,
+            "cumulative_bit_identical": cum_same,
+            "victim_windows_published": 0,
+            "terms": {"control": 1, "successor": 2},
+            "method": (
+                "both rate legs are paced identically at the same "
+                "offered rate, so the armed-overhead fraction isolates "
+                "per-window spool fsyncs + ttl/4 lease heartbeats; the "
+                "victim leg parks every epoch behind an armed "
+                "dist.epoch.ship partition so term 1 provably publishes "
+                "nothing before the in-process supervisor death, and "
+                "time-to-takeover clocks the successor from run() start "
+                "to the last replayed window on disk (election + spool "
+                "replay inclusive)"
+            ),
+            "guards": {
+                "armed_overhead_lt_2pct": True,
+                "takeover_le_2x_ttl": True,
+                "bit_identical_all_windows": True,
+                "talkers_identical": True,
+                "cumulative_bit_identical": True,
+                "zero_drops_all_legs": True,
+                "zero_skipped_windows": True,
+                "one_publisher_per_term": True,
+                "victim_published_nothing": True,
+            },
+        },
+    }
+
+
 BENCHES = {
     "stage": bench_stage,
     "exact": bench_exact,
@@ -3625,6 +3986,7 @@ BENCHES = {
     "blackbox": bench_blackbox,
     "tenant": bench_tenant,
     "servescale": bench_servescale,
+    "failover": bench_failover,
     "v6": bench_v6,
     "v6recall": bench_v6recall,
 }
@@ -3634,12 +3996,14 @@ BENCHES = {
 #: minutes of wall time by design), `servesoak` and `autoscale` (paced
 #: live-service soaks with sockets + threads), `feedscale` (worker
 #: fleets of spawned processes), `tenant` (17 full serve drivers
-#: with live sockets) and `servescale` (three paced multi-process
-#: distributed-serve soaks) are explicit-only
+#: with live sockets), `servescale` (three paced multi-process
+#: distributed-serve soaks) and `failover` (four paced supervisor
+#: kill/election soaks) are explicit-only
 DEFAULT_BENCHES = [
     n for n in BENCHES
     if n not in ("sustained", "servesoak", "autoscale", "feedscale",
-                 "retrysoak", "blackbox", "tenant", "servescale")
+                 "retrysoak", "blackbox", "tenant", "servescale",
+                 "failover")
 ]
 
 

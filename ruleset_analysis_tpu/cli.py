@@ -795,6 +795,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             ("dist_max_hosts", 0), ("dist_workers", "process"),
             ("dist_merge_bind", "127.0.0.1:0"),
             ("dist_merge_timeout", 120.0), ("dist_respawn", False),
+            ("dist_lease_ttl", 2.0), ("dist_spool_dir", ""),
+            ("dist_spool_budget_mb", 64),
         ):
             if getattr(args, flag) != dflt:
                 print(f"error: --{flag.replace('_', '-')} requires "
@@ -864,6 +866,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
                 merge_bind=args.dist_merge_bind,
                 merge_timeout_sec=args.dist_merge_timeout,
                 respawn=args.dist_respawn,
+                lease_ttl_sec=args.dist_lease_ttl,
+                spool_dir=args.dist_spool_dir,
+                spool_budget_mb=args.dist_spool_budget_mb,
             )
     except (ValueError, errors.AnalysisError) as e:
         print(f"error: {e}", file=sys.stderr)
@@ -1288,7 +1293,7 @@ def _add_blackbox_flags(p) -> None:
 def _cmd_doctor(args: argparse.Namespace) -> int:
     """Postmortem bundle + exit code -> ranked human-readable diagnosis.
 
-    The first-response runbook for exit codes 3-7: reads the
+    The first-response runbook for exit codes 3-8: reads the
     ``postmortem.json`` a crashed run's flight recorder merged and names
     the failing stage, the fired fault sites, and the next action.
     """
@@ -1545,7 +1550,7 @@ def make_parser() -> argparse.ArgumentParser:
         help="diagnose a crashed run: postmortem.json (the flight "
              "recorder's merged crash bundle) + exit code -> ranked "
              "causes with next actions — the first-response runbook for "
-             "exit codes 3-7",
+             "exit codes 3-8",
     )
     p.add_argument("bundle",
                    help="postmortem.json path, or the blackbox directory "
@@ -1755,6 +1760,24 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--dist-respawn", action="store_true",
                    help="respawn a dead host at the merge frontier; its "
                         "WAL replays the lost tail on rejoin")
+    p.add_argument("--dist-lease-ttl", type=float, default=2.0,
+                   metavar="SEC",
+                   help="supervisor-lease TTL (DESIGN §23): a holder "
+                        "that cannot renew this long self-fences (stops "
+                        "publishing, exits typed code 8); a successor "
+                        "steals only after 1.5x, so takeover completes "
+                        "within ~2x TTL with no split brain.  0 "
+                        "disables the lease/failover plane (default 2)")
+    p.add_argument("--dist-spool-dir", default="", metavar="DIR",
+                   help="durable per-host epoch spool + lease root "
+                        "(default: under --serve-dir).  Point at shared "
+                        "storage so a successor elsewhere can replay "
+                        "every host's spooled window epochs")
+    p.add_argument("--dist-spool-budget-mb", type=int, default=64,
+                   metavar="MB",
+                   help="per-host epoch-spool disk budget; oldest "
+                        "segments evict first, counted never silent "
+                        "(0 disables spooling; default 64)")
     _add_autoscale_flags(p)
     _add_blackbox_flags(p)
     p.add_argument("--fault-plan", default=None, metavar="SPEC",

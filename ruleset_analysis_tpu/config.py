@@ -470,6 +470,22 @@ class DistServeConfig:
     #: process replays its predecessor's WAL tail past the last merged
     #: seq, so the rejoined host loses nothing that was spooled
     respawn: bool = False
+    #: supervisor-lease TTL (DESIGN §23): the holder self-fences when it
+    #: cannot renew within this long; a successor steals only after
+    #: 1.5x, so takeover completes within ~2x TTL and the stale
+    #: supervisor provably stops publishing first.  0 disables the
+    #: whole lease/failover plane (single-supervisor PR 17 behaviour —
+    #: the bench A/B leg and an operational escape hatch).
+    lease_ttl_sec: float = 2.0
+    #: where the durable per-host epoch spools + the lease live; ""
+    #: places them under serve_dir (host-<rank>/spool and lease/).  Set
+    #: this to shared storage so a successor on another machine can
+    #: replay every host's spooled epochs.
+    spool_dir: str = ""
+    #: per-host epoch-spool disk budget (oldest segments evicted first,
+    #: eviction counted — never silent); 0 disables spooling (epochs
+    #: then survive only inside the supervisor's pending map)
+    spool_budget_mb: int = 64
 
     def __post_init__(self) -> None:
         if self.hosts < 1:
@@ -496,6 +512,16 @@ class DistServeConfig:
         if self.merge_timeout_sec <= 0:
             raise ValueError(
                 f"merge_timeout_sec must be > 0, got {self.merge_timeout_sec}"
+            )
+        if self.lease_ttl_sec < 0:
+            raise ValueError(
+                f"lease_ttl_sec must be >= 0 (0 disables the lease plane), "
+                f"got {self.lease_ttl_sec}"
+            )
+        if self.spool_budget_mb < 0:
+            raise ValueError(
+                f"spool_budget_mb must be >= 0 (0 disables spooling), "
+                f"got {self.spool_budget_mb}"
             )
 
     @property

@@ -92,6 +92,22 @@ class WalQuarantine(AnalysisError):
     the next segment (DESIGN §19)."""
 
 
+class SupervisorFenced(AnalysisError):
+    """A distributed-serve supervisor lost its leadership lease.
+
+    Raised by the merge/publication plane the moment a stale supervisor
+    would otherwise publish: either its own lease renewals have been
+    failing longer than the lease TTL (it must assume a successor may
+    already hold the lease), or it has OBSERVED a higher fencing term on
+    disk (a successor definitely won).  Publishing anyway could produce
+    two different publications for one window id — the split-brain
+    failure mode the fencing term exists to make impossible — so the
+    stale supervisor aborts typed (exit 8) instead.  The successor's
+    replay of the durable epoch spools re-publishes anything this
+    supervisor had pending, bit-identically (runtime/lease.py,
+    DESIGN §23)."""
+
+
 class InjectedFault(AnalysisError):
     """A deterministic fault fired by an armed plan (runtime/faults.py).
 
@@ -181,9 +197,11 @@ EXIT_FEED = 5
 EXIT_STALL = 6
 #: elastic re-formation budget exhausted (--max-reforms)
 EXIT_REFORM_BUDGET = 7
+#: a distributed-serve supervisor was fenced by a newer leadership term
+EXIT_FENCED = 8
 
 #: Human names for the documented codes — the ``doctor`` tool's first
-#: lookup (exit codes 3-7 each map to a runbook entry in its diagnosis;
+#: lookup (exit codes 3-8 each map to a runbook entry in its diagnosis;
 #: see tools/doctor.py and README "Exit codes").
 EXIT_CODE_NAMES = {
     EXIT_OK: "ok",
@@ -194,6 +212,7 @@ EXIT_CODE_NAMES = {
     EXIT_FEED: "feed-failure",
     EXIT_STALL: "stall",
     EXIT_REFORM_BUDGET: "reform-budget-exhausted",
+    EXIT_FENCED: "supervisor-fenced",
 }
 
 
@@ -211,6 +230,8 @@ def exit_code_for(exc: BaseException) -> int:
         return EXIT_STALL
     if isinstance(exc, ReformBudgetExhausted):
         return EXIT_REFORM_BUDGET
+    if isinstance(exc, SupervisorFenced):
+        return EXIT_FENCED
     if isinstance(
         exc, (FeedWorkerError, IngestError, WireCorrupt, NativeParserUnavailable)
     ):

@@ -99,6 +99,34 @@ def fingerprint(
     return h.hexdigest()[:16]
 
 
+def fence_fingerprint(base: str, term: int) -> str:
+    """Stamp a distributed-serve fencing term onto a base fingerprint.
+
+    The term is NOT part of the resume identity — a failover successor
+    (term N+1) must restore its dead predecessor's snapshot (term N) —
+    so it rides as a ``-t<term>`` suffix that ``split_fence`` peels off
+    before the strict base comparison.  What the suffix buys is fencing
+    at the storage layer: a restore that finds a snapshot from a HIGHER
+    term than the restoring supervisor's lease proves a successor
+    already ran, and the stale supervisor must abort typed
+    (SupervisorFenced) instead of republishing old windows (DESIGN §23).
+    """
+    return f"{base}-t{term}"
+
+
+def split_fence(fp: str) -> tuple[str, int]:
+    """Split a fingerprint into (base, fencing term).
+
+    Fingerprints without a ``-t<term>`` suffix (every pre-failover
+    snapshot, and every non-distserve snapshot) split as term 0 so old
+    snapshots keep restoring unchanged.
+    """
+    base, sep, tail = fp.rpartition("-t")
+    if sep and tail.isdigit():
+        return base, int(tail)
+    return fp, 0
+
+
 @dataclasses.dataclass
 class Snapshot:
     """Host-side image of one checkpoint."""

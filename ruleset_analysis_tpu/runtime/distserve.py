@@ -61,7 +61,7 @@ import numpy as np
 from ..config import (
     AnalysisConfig, AutoscaleConfig, DistServeConfig, ServeConfig,
 )
-from ..errors import AnalysisError
+from ..errors import AnalysisError, SupervisorFenced, WalQuarantine
 from ..hostside import pack as pack_mod
 from ..hostside.listener import offset_listen_spec
 from ..models import pipeline
@@ -69,6 +69,7 @@ from ..ops.topk import TopKTracker
 from ..parallel.distributed import pack_epoch_payload, unpack_epoch_payload
 from . import checkpoint as ckpt
 from . import faults, flightrec, obs, retrypolicy
+from .lease import EpochSpool, SupervisorLease
 from .autoscale import PolicyEngine, host_ladder, render_prom_labeled
 from .metrics import LatencyHistogram
 from .serve import (
@@ -179,6 +180,9 @@ class HostServeDriver(ServeDriver):
         start_window: int = 0,
         wal_resume_seq: int = 0,
         serialize_dispatch: bool = False,
+        spool_dir: str = "",
+        spool_budget_mb: int = 0,
+        spool_resume: bool = False,
     ):
         super().__init__(ruleset_prefix, cfg, scfg, topk=topk)
         self.rank = rank
@@ -193,6 +197,28 @@ class HostServeDriver(ServeDriver):
         self._retire_req = False
         self._retiring = False
         self._kill_req = False  # chaos seam: abrupt in-process host death
+        # external stop (supervisor S-frame / signal), as opposed to the
+        # local max_windows finish: only the former aborts the final
+        # backlog drain (_teardown sets _stop_req on EVERY exit path, so
+        # it cannot distinguish the two)
+        self._ext_stop = threading.Event()
+        # durable epoch spool (DESIGN §23): every closed window's packed
+        # epoch is appended here BEFORE it ships, so it survives this
+        # host AND any supervisor; a failover successor replays it
+        self._ship_backlog: list[bytes] = []  # parked by partition mode
+        self._spool: EpochSpool | None = None
+        if spool_dir and spool_budget_mb > 0:
+            try:
+                self._spool = EpochSpool(
+                    spool_dir, budget_bytes=spool_budget_mb << 20
+                )
+                if not spool_resume:
+                    # a fresh (non-rejoin) start must not leave a stale
+                    # spool for a later failover to replay into new ids
+                    self._spool.reset()
+            except (WalQuarantine, OSError) as e:
+                self._spool = None
+                self._degrade("spool", e)
 
     # -- control surface (reader thread / supervisor) ---------------------
     def request_retire(self) -> None:
@@ -205,6 +231,10 @@ class HostServeDriver(ServeDriver):
         raises at its next tick, losing the open window exactly like a
         SIGKILL would (minus what the WAL already spooled)."""
         self._kill_req = True
+
+    def stop(self) -> None:
+        self._ext_stop.set()
+        super().stop()
 
     # -- overridden device dispatch ---------------------------------------
     def _run_chunk(self, batch_np: np.ndarray) -> None:
@@ -277,7 +307,54 @@ class HostServeDriver(ServeDriver):
             "wal_next": int(self._wal_next),
             "degraded": self.degraded_set(),
         }
-        self._emit(b"E", pack_epoch_payload(ep.arrays, extra))
+        payload = pack_epoch_payload(ep.arrays, extra)
+        if self._spool is not None:
+            try:
+                self._spool.append_epoch(payload)
+            except (AnalysisError, OSError) as e:
+                # full/readonly spool volume: the epoch still SHIPS (the
+                # live merge is unaffected) — only failover durability
+                # degrades, and /health says so
+                self._degrade("spool", e)
+                obs.instant("serve.host.spool_fail", args={
+                    "host": self.rank, "window": ep.meta.get("id"),
+                })
+        if self._ship_backlog:
+            # partition mode: epochs must reach the supervisor in window
+            # order, so nothing ships until the backlog drains at heal
+            self._ship_backlog.append(payload)
+            return
+        self._ship_or_park(payload)
+
+    def _ship_attempt(self, payload: bytes) -> None:
+        # chaos site: the ship connection fails (severed merge-plane
+        # link / partition analog); the retry seam absorbs a transient
+        # burst, exhaustion parks the epoch in the partition backlog
+        faults.fire("dist.epoch.ship")
+        self._emit(b"E", payload)
+
+    def _ship_or_park(self, payload: bytes) -> None:
+        try:
+            retrypolicy.call("dist.epoch.ship", lambda: self._ship_attempt(payload))
+        except (AnalysisError, OSError) as e:
+            self._ship_backlog.append(payload)
+            self._degrade(f"partition:{self.rank}", e)
+            obs.instant("serve.host.partition", args={
+                "host": self.rank, "backlog": len(self._ship_backlog),
+            })
+
+    def _heal_partition(self) -> None:
+        """Drain the parked epochs in order (one probe per gauge tick);
+        the spool already holds them, so a persistent partition costs
+        latency, never data — zero silent drops on heal."""
+        while self._ship_backlog:
+            try:
+                self._ship_attempt(self._ship_backlog[0])
+            except (AnalysisError, OSError):
+                return  # still partitioned; next tick probes again
+            self._ship_backlog.pop(0)
+        self._recover(f"partition:{self.rank}")
+        obs.instant("serve.host.partition_heal", args={"host": self.rank})
 
     def _publish(self, rep_obj: dict, prev: dict | None, meta: dict) -> None:
         # rank 0 owns publication; the worker keeps only the in-memory
@@ -305,12 +382,51 @@ class HostServeDriver(ServeDriver):
         now = time.monotonic()
         if now >= self._gauge_next:
             self._gauge_next = now + 0.5
+            self._emit_gauges()
+
+    def _emit_gauges(self) -> None:
+        if self._ship_backlog:
+            self._heal_partition()
+        gauges = self.metrics_gauges()
+        gauges["spool_depth"] = len(self._ship_backlog)
+        gauges["spool_seq"] = (
+            int(self._spool.next_seq) if self._spool is not None else 0
+        )
+        try:
             self._emit(b"G", json.dumps({
                 "rank": self.rank,
-                "gauges": self.metrics_gauges(),
+                "gauges": gauges,
                 "degraded": self.degraded_set(),
                 "addresses": self.listeners.addresses(),
             }).encode("utf-8"))
+        except OSError:
+            pass  # gauge frames are advisory; epochs have the
+            # retry/backlog plane, and the supervisor's monitor
+            # owns death detection
+
+    def run(self) -> dict:
+        try:
+            summary = super().run()
+            self._drain_backlog_final()
+            summary["degraded"] = self.degraded_set()
+            return summary
+        finally:
+            if self._spool is not None:
+                self._spool.close()  # fsync: the tail survives a crash
+
+    def _drain_backlog_final(self) -> None:
+        """Clean-finish barrier: a parked epoch must not die with its
+        producer when the partition is healable — keep probing until
+        the backlog drains or a stop tears the host down.  A stop
+        during a persistent partition is NOT a drop: the spool holds
+        every parked epoch durably for the elected successor's replay.
+        """
+        while self._ship_backlog and not self._ext_stop.is_set():
+            # the gauge frame keeps the drain observable (spool_depth,
+            # partition marker) AND probes the heal path each tick
+            self._emit_gauges()
+            if self._ship_backlog:
+                self._ext_stop.wait(0.5)  # still partitioned; re-probe
 
 
 # ---------------------------------------------------------------------------
@@ -352,6 +468,9 @@ def _worker_entry(spec_json: str) -> None:
         topk=int(spec["topk"]),
         start_window=int(spec["start_window"]),
         wal_resume_seq=int(spec["wal_resume_seq"]),
+        spool_dir=spec.get("spool_dir", ""),
+        spool_budget_mb=int(spec.get("spool_budget_mb", 0)),
+        spool_resume=bool(spec.get("spool_resume", False)),
     )
 
     def control_reader() -> None:
@@ -508,6 +627,18 @@ class DistServeDriver:
             ckpt.fingerprint(self.packed, cfg, dscfg.ladder_max, 0)
             + "-distserve"
         )
+        # supervisor lease + fencing term (DESIGN §23): 0 until a lease
+        # is won; every published artifact, gauge, and checkpoint
+        # fingerprint carries it, and losing the lease turns every
+        # publication path into a typed SupervisorFenced abort
+        self.term = 0
+        self._lease: SupervisorLease | None = None
+        self._fenced_seen: tuple[int, str] | None = None
+        self._sup_kill = False  # chaos seam: abrupt supervisor death
+        self.spool_replayed_total = 0  # epochs replayed at takeover
+        self.replay_windows_total = 0  # windows published from replay
+        self.replay_lag_windows = 0  # frontier lag measured at takeover
+        self.replay_refused_total = 0  # corrupt spooled epochs refused
         # merged publication state (mirrors ServeDriver so its unbound
         # render/publish methods run here unchanged)
         self.ring = WindowRing(scfg.ring)
@@ -633,6 +764,75 @@ class DistServeDriver:
         elif h.proc is not None:
             h.proc.kill()
 
+    def kill_supervisor(self) -> None:
+        """Chaos surface: abrupt merge/publication-supervisor death.
+
+        The merge loop raises at its next tick, dying with whatever
+        epochs were pending unpublished — exactly what a SIGKILL costs
+        (the per-host spools keep them; an elected successor replays).
+        """
+        self._sup_kill = True
+        with self._cond:
+            self._cond.notify_all()
+
+    # -- lease / failover --------------------------------------------------
+    def _spool_root(self) -> str:
+        return self.dscfg.spool_dir or self.scfg.serve_dir
+
+    def _lease_dir(self) -> str:
+        return os.path.join(self._spool_root(), "lease")
+
+    def _host_spool_dir(self, rank: int) -> str:
+        root = self.dscfg.spool_dir
+        if root:
+            return os.path.join(root, f"host-{rank}")
+        return os.path.join(self.scfg.serve_dir, f"host-{rank}", "spool")
+
+    def _on_lease_fenced(self) -> None:
+        """Heartbeat-thread callback: a HIGHER term was observed."""
+        if self._lease is not None:
+            self._fenced_seen = self._lease.observed()
+            obs.instant("lease.fenced", args={
+                "term": self.term,
+                "winner_term": self._fenced_seen[0],
+                "winner": self._fenced_seen[1],
+            })
+            flightrec.cursor(fenced_by_term=self._fenced_seen[0])
+        self.stop()
+
+    def _check_fenced(self) -> None:
+        """Raise typed BEFORE any externally visible effect once this
+        supervisor may no longer publish (observed a higher term, or its
+        own renewals aged past the TTL).  Called on every publication,
+        checkpoint, and merge-loop pass — the split-brain half of the
+        DESIGN §23 argument (the other half is the lease's 1.5x steal
+        margin)."""
+        L = self._lease
+        if L is None or not L.fenced:
+            return
+        t, h = self._fenced_seen or L.observed()
+        raise SupervisorFenced(
+            f"stale supervisor fenced: this process held term {self.term} "
+            f"but term {t} is now held by {h!r} (or renewals aged past the "
+            f"{L.ttl:.1f}s TTL); publishing would risk two publications "
+            "for one window id — the successor replays the epoch spools "
+            "and publishes bit-identically instead"
+        )
+
+    def failover_gauges(self) -> dict:
+        """Leader/lease/replay gauges — merged into ``metrics_gauges``
+        so the JSON /metrics block and the prom families carry the SAME
+        values (audit_distserve parity)."""
+        L = self._lease
+        return {
+            "leader_term": self.term,
+            "lease_age_sec": round(L.age(), 3) if L is not None else 0.0,
+            "lease_fenced": int(L.fenced) if L is not None else 0,
+            "spool_replayed_total": self.spool_replayed_total,
+            "replay_windows_total": self.replay_windows_total,
+            "replay_lag_windows": self.replay_lag_windows,
+        }
+
     # -- health / metrics -------------------------------------------------
     def health(self) -> dict:
         with self._lock:
@@ -666,6 +866,7 @@ class DistServeDriver:
         return {
             "status": "degraded" if degraded else "ok",
             "distributed": True,
+            "term": self.term,
             "degraded_subsystems": deg + host_deg,
             "degraded_events": self.degraded_events,
             "recovered_events": self.recovered_events,
@@ -752,6 +953,7 @@ class DistServeDriver:
             "degraded_events_total": self.degraded_events,
             "recovered_events_total": self.recovered_events,
         }
+        g.update(self.failover_gauges())
         g.update(retrypolicy.gauges())
         eng = self._engine
         if eng is not None:
@@ -802,8 +1004,30 @@ class DistServeDriver:
                 self._engine = PolicyEngine(
                     self.ascfg, world=self.dscfg.hosts, ladder=self._ladder
                 )
+            if self.dscfg.lease_ttl_sec > 0:
+                ttl = self.dscfg.lease_ttl_sec
+                self._lease = SupervisorLease(
+                    self._lease_dir(),
+                    holder=f"{socket.gethostname()}:pid{os.getpid()}",
+                    ttl_sec=ttl,
+                )
+                t_wait = time.monotonic()
+                # blocks until this process wins a term: behind a live
+                # incumbent it waits out the 1.5x-TTL staleness window,
+                # so the previous holder has provably self-fenced first
+                self.term = self._lease.acquire(
+                    stop=self._stop_req, timeout=max(30.0, 10 * ttl)
+                )
+                obs.instant("lease.acquired", args={
+                    "term": self.term,
+                    "holder": self._lease.holder,
+                    "wait_sec": round(time.monotonic() - t_wait, 3),
+                })
+                flightrec.cursor(term=self.term)
+                self._lease.start_heartbeat(on_fenced=self._on_lease_fenced)
             if self.cfg.resume:
                 self._restore()
+                self._replay_spools()
             obs.register_sampler("distserve", self.metrics_gauges)
             if self._msock is not None:
                 self._accept_thread = threading.Thread(
@@ -818,6 +1042,7 @@ class DistServeDriver:
             self._write_json("endpoint.json", {
                 "pid": os.getpid(),
                 "distributed": True,
+                "term": self.term,
                 "hosts": self.dscfg.hosts,
                 "http": list(self.http_address) if self.http_address else None,
                 "merge": (
@@ -856,6 +1081,15 @@ class DistServeDriver:
             dead = sorted(r for r, h in self.hosts.items() if h.dead)
         summary = {
             "distributed": True,
+            "term": self.term,
+            "failover": {
+                "spool_replayed": self.spool_replayed_total,
+                "replay_windows": self.replay_windows_total,
+                "replay_refused": self.replay_refused_total,
+                "lease_renews": (
+                    self._lease.renews if self._lease is not None else 0
+                ),
+            },
             "hosts": host_summaries,
             "hosts_spawned": self.hosts_spawned,
             "dead_hosts": dead,
@@ -925,6 +1159,10 @@ class DistServeDriver:
             )
             self.hosts_spawned += 1
         wcfg = self._worker_cfg.replace(resume=bool(rejoin and scfg.wal))
+        spool_dir = (
+            self._host_spool_dir(rank)
+            if self.dscfg.spool_budget_mb > 0 else ""
+        )
         obs.instant("serve.host.spawn", args={
             "host": rank, "rejoin": bool(rejoin),
             "start_window": start_window, "wal_seq": wal_seq,
@@ -936,6 +1174,9 @@ class DistServeDriver:
                 self.prefix, wcfg, wscfg,
                 topk=self.topk, start_window=start_window,
                 wal_resume_seq=wal_seq, serialize_dispatch=True,
+                spool_dir=spool_dir,
+                spool_budget_mb=self.dscfg.spool_budget_mb,
+                spool_resume=rejoin,
             )
 
             def runner(_r=rank, _drv=drv):
@@ -972,6 +1213,9 @@ class DistServeDriver:
             "merge_addr": f"{addr[0]}:{addr[1]}",
             "start_window": start_window,
             "wal_resume_seq": wal_seq,
+            "spool_dir": spool_dir,
+            "spool_budget_mb": self.dscfg.spool_budget_mb,
+            "spool_resume": bool(rejoin),
         })
         p = mp.get_context("spawn").Process(
             target=_worker_entry, args=(spec,),
@@ -1159,6 +1403,7 @@ class DistServeDriver:
         dead: list[int],
         missing: list[int],
     ) -> None:
+        self._check_fenced()  # a stale supervisor must never publish
         ranks = sorted(recs)
         with obs.span("distserve.merge", window=w, hosts=len(ranks)):
             arrays = merge_register_arrays([recs[r][0] for r in ranks])
@@ -1220,6 +1465,7 @@ class DistServeDriver:
                 reasons.append(f"host_missing:{r}")
             meta = {
                 "id": w,
+                "term": self.term,  # which leadership published this
                 "mode": "lines" if self.scfg.window_lines else "sec",
                 "length": self.scfg.window_lines or self.scfg.window_sec,
                 "lines": lines,
@@ -1306,6 +1552,13 @@ class DistServeDriver:
         while True:
             with self._cond:
                 self._cond.wait(timeout=0.2)
+            if self._sup_kill:
+                raise AnalysisError(
+                    "distserve supervisor killed (injected supervisor "
+                    "death); pending epochs stay in the host spools for "
+                    "the elected successor to replay"
+                )
+            self._check_fenced()
             self._check_workers()
             self._maybe_autoscale()
             if self._stop_req.is_set():
@@ -1464,6 +1717,8 @@ class DistServeDriver:
 
     # -- checkpoint (rank-0 merged ring; ladder-max fingerprint) -----------
     def _save_ckpt(self) -> None:
+        self._check_fenced()  # a fenced snapshot could roll back the
+        # successor's frontier — refuse it like any other publication
         arrays: dict[str, np.ndarray] = {}
         wmeta = []
         for ep in self.ring.epochs:
@@ -1488,7 +1743,11 @@ class DistServeDriver:
             parsed=self.total_parsed,
             skipped=self.total_skipped,
             tracker_tables=self.cum_tracker.tables(),
-            fingerprint=self._fp,
+            # the fencing term rides the fingerprint as a -t<term>
+            # suffix (ckpt.split_fence peels it): a restore that finds
+            # a HIGHER term than its own lease proves a successor
+            # already ran — SupervisorFenced, not a resume
+            fingerprint=ckpt.fence_fingerprint(self._fp, self.term),
             extra={
                 "serve": {
                     "next_window": self.next_wid,
@@ -1539,7 +1798,16 @@ class DistServeDriver:
         )
         if snap is None:
             return
-        if snap.fingerprint != self._fp:
+        base_fp, snap_term = ckpt.split_fence(snap.fingerprint)
+        if snap_term > self.term and self._lease is not None:
+            t, h = self._lease.observed()
+            raise SupervisorFenced(
+                f"checkpoint was written by fencing term {snap_term} but "
+                f"this supervisor holds term {self.term} (newest observed "
+                f"leadership: term {t} by {h!r}); a successor already ran "
+                "— refusing to roll its frontier back"
+            )
+        if base_fp != self._fp:
             raise ckpt.CheckpointMismatch(
                 "distributed serve checkpoint was taken with a different "
                 "ruleset, sketch geometry, or host-tier ladder maximum; "
@@ -1610,6 +1878,112 @@ class DistServeDriver:
                 self._render_cumulative().to_json()
             )
 
+    # -- failover replay (DESIGN §23) --------------------------------------
+    def _scan_spool_ranks(self) -> list[int]:
+        root = self._spool_root()
+        ranks = []
+        try:
+            names = os.listdir(root)
+        except OSError:
+            return ranks
+        for n in names:
+            if n.startswith("host-"):
+                try:
+                    r = int(n[5:])
+                except ValueError:
+                    continue
+                if os.path.isdir(self._host_spool_dir(r)):
+                    ranks.append(r)
+        return sorted(ranks)
+
+    def _replay_spools(self) -> None:
+        """Elected-successor takeover: replay every host's durable epoch
+        spool past the restored merge frontier and publish those windows
+        exactly as the dead supervisor would have — the merge laws are
+        associative, so replay order is free and the output is
+        bit-identical to the union (the tentpole invariant the failover
+        chaos tests pin).
+
+        Loss discipline mirrors the merge loop's: a window some host
+        spooled later epochs past but not this one gets a typed
+        ``host_missing:<rank>`` marker; a window NO host's spool reached
+        is skipped with explicit accounting; a corrupt spooled epoch is
+        refused typed by ``unpack_epoch_payload`` and counted — never a
+        crash, never a silently wrong merge.
+        """
+        if self.dscfg.spool_budget_mb <= 0:
+            return
+        t0 = time.monotonic()
+        frontier = self.next_wid
+        pending: dict[int, dict[int, tuple[dict, dict]]] = {}
+        top_by_host: dict[int, int] = {}
+        epochs = 0
+        for rank in self._scan_spool_ranks():
+            try:
+                spool = EpochSpool(
+                    self._host_spool_dir(rank),
+                    budget_bytes=self.dscfg.spool_budget_mb << 20,
+                )
+            except (WalQuarantine, OSError) as e:
+                self._degrade(f"spool{rank}", e)
+                continue
+            try:
+                for seq, payload in spool.replay(0):
+                    try:
+                        arrays, extra = unpack_epoch_payload(payload)
+                        wid = int(extra["meta"]["id"])
+                    except (AnalysisError, KeyError, TypeError, ValueError) as e:
+                        self.replay_refused_total += 1
+                        obs.instant("distserve.replay.refused", args={
+                            "host": rank, "seq": seq,
+                            "error": f"{type(e).__name__}: {e}"[:160],
+                        })
+                        continue
+                    epochs += 1
+                    top_by_host[rank] = max(top_by_host.get(rank, -1), wid)
+                    # the replayed epoch's WAL cursor supersedes the
+                    # checkpointed one: a rejoining host must not replay
+                    # WAL lines a replayed window already covers (that
+                    # would double-count them)
+                    self._host_wal_restored[rank] = max(
+                        self._host_wal_restored.get(rank, 0),
+                        int(extra.get("wal_next", 0)),
+                    )
+                    if wid >= frontier:
+                        pending.setdefault(wid, {})[rank] = (arrays, extra)
+            finally:
+                spool.close()
+        self.spool_replayed_total = epochs
+        self.replay_lag_windows = len(pending)
+        for w in sorted(pending):
+            while self.next_wid < w:
+                # a window below every surviving spool record: all its
+                # epochs are gone (evicted/quarantined) — skip loudly
+                self.skipped_windows.append(self.next_wid)
+                obs.instant("serve.window.skipped", args={
+                    "window": self.next_wid, "replay": True,
+                })
+                self.next_wid += 1
+            recs = pending[w]
+            missing = sorted(
+                r for r, top in top_by_host.items()
+                if r not in recs and top > w
+            )
+            self.next_wid = w + 1
+            self._publish_window(w, recs, [], missing)
+            self.replay_windows_total += 1
+        obs.instant("distserve.failover.replay", args={
+            "frontier": frontier,
+            "epochs": epochs,
+            "windows": self.replay_windows_total,
+            "refused": self.replay_refused_total,
+            "takeover_sec": round(time.monotonic() - t0, 3),
+        })
+        flightrec.cursor(
+            replay_windows=self.replay_windows_total,
+            next_window=self.next_wid,
+        )
+
     # -- plumbing ----------------------------------------------------------
     def _start_http(self) -> None:
         if self._http is None:
@@ -1676,4 +2050,9 @@ class DistServeDriver:
                 self._http_thread.join(timeout=5.0)
             else:
                 self._http.server_close()
+        if self._lease is not None:
+            # planned exit releases (clears the stamp so a successor
+            # wins immediately); a fenced holder leaves lease.json to
+            # the winner — release() knows the difference
+            self._lease.release()
         obs.unregister_sampler("distserve")

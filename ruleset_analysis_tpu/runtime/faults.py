@@ -175,6 +175,29 @@ SITES: dict[str, tuple[str, str]] = {
         "(unwritable metrics file analog); the tick error is counted "
         "and the ra-metrics thread keeps running — serve marks the "
         "metrics subsystem degraded and recovery re-arms it"),
+    "lease.acquire": (
+        "raise", "the distributed-serve supervisor lease cannot be "
+        "claimed at startup (unwritable lease dir / storage fault "
+        "analog); the supervisor must abort typed before spawning any "
+        "ingest host, never publish without a fencing term"),
+    "lease.renew": (
+        "raise", "the lease-holder's heartbeat renewal fails and stays "
+        "failed (partition / storage-freeze analog); the holder must "
+        "self-fence within the lease TTL — stop publishing BEFORE a "
+        "successor can win the lease — so a split brain can never "
+        "double-publish one window id"),
+    "dist.epoch.spool": (
+        "raise", "a host's durable epoch-spool append fails (full / "
+        "readonly volume analog); the host marks the spool subsystem "
+        "degraded and keeps ingesting+shipping — losing durability is "
+        "visible /health evidence, never a silent service stop"),
+    "dist.epoch.ship": (
+        "raise", "shipping a window epoch to the merge supervisor "
+        "fails (severed host-tier connection / partition analog); the "
+        "dist.epoch.ship retry site absorbs a transient burst, "
+        "exhaustion parks the epoch in the partition backlog (degraded "
+        "``partition:<rank>``) for heal-time reconciliation — the "
+        "spooled copy survives either way"),
 }
 
 

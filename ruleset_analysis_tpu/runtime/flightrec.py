@@ -735,7 +735,7 @@ def load_bundle(path: str) -> dict:
 def diagnose(bundle: dict, exit_code: int | None = None) -> list[dict]:
     """Ranked diagnoses (most specific first) for one bundle.
 
-    The first-response runbook for exit codes 3-7 (README "Exit codes"):
+    The first-response runbook for exit codes 3-8 (README "Exit codes"):
     each entry carries the suspected cause, the bundle evidence behind
     it, and the operator's next action.
     """
@@ -856,6 +856,31 @@ def diagnose(bundle: dict, exit_code: int | None = None) -> list[dict]:
             "peers died more times than the budget allows; inspect the "
             "worker shards for the recurring death cause before raising "
             "--max-reforms",
+        )
+    elif rc == 8:
+        fenced_by = next(
+            (
+                s.get("cursors", {}).get("fenced_by_term")
+                for s in a.get("per_shard", [])
+                if s.get("cursors", {}).get("fenced_by_term") is not None
+            ),
+            None,
+        )
+        term_txt = (
+            f"fenced by term {fenced_by}" if fenced_by is not None
+            else "renewals aged past the lease TTL"
+        )
+        add(
+            f"stale distributed-serve supervisor {term_txt} — a "
+            "successor won the publication lease",
+            f"exit code 8 ({EXIT_CODE_NAMES.get(8)}); the error text "
+            f"names the winning term and holder: {bundle.get('error')}",
+            "this abort is the split-brain guard WORKING: the successor "
+            "replays the per-host epoch spools and publishes every "
+            "pending window bit-identically, so nothing is lost — do "
+            "NOT restart this process against the same "
+            "--dist-spool-dir while the winner is live; check "
+            "lease.json there for the current holder",
         )
     elif trigger == "unhandled":
         add(

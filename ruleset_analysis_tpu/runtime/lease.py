@@ -1,0 +1,335 @@
+"""Supervisor lease + durable epoch spool for distributed serve failover.
+
+PR 17's multi-host serve (DESIGN §22) made rank 0 the sole merge and
+publication supervisor: one process death or partition silently ended
+publication for the whole fleet even though every ingest tier stayed
+healthy.  This module supplies the two primitives that kill that SPOF
+(DESIGN §23):
+
+- :class:`SupervisorLease` — a filesystem lease with a monotonically
+  increasing **fencing term**.  Exactly-one-winner-per-term is a POSIX
+  construction, not a protocol: claiming term ``N`` means creating
+  ``term-<N>.claim`` with ``O_CREAT | O_EXCL``, which at most one
+  process can ever succeed at.  The holder heartbeats ``lease.json``
+  (atomic write-then-rename); it **self-fences** — reports
+  ``fenced=True`` so the publication plane aborts typed — as soon as
+  its renewals have been failing longer than the TTL, while a successor
+  steals only after observing staleness **1.5x** the TTL.  Under the
+  one-filesystem-clock assumption (the lease dir lives on one
+  filesystem whose writers share a clock domain, true for the
+  single-machine multi-process topology this repo exercises), the stale
+  holder therefore provably stops publishing BEFORE any successor can
+  win: split brain cannot produce two publications for one window id.
+
+- :class:`EpochSpool` — a durable per-host spool of RAEP1 window-epoch
+  frames, inheriting the WAL discipline wholesale from
+  :class:`runtime.wal.WriteAheadLog` (O_APPEND framing, seq-gap = exact
+  loss accounting, typed quarantine on damage, budget eviction counted
+  never silent).  Every epoch a host ships to the supervisor is spooled
+  FIRST, so a window epoch survives both its producer and any
+  supervisor; an elected successor replays all spools past the fenced
+  merge frontier and publishes bit-identically (the register merge laws
+  are associative, so replay order is free).
+
+Chaos seams (runtime/faults.py): ``lease.acquire`` (claim fails at
+startup — typed abort before any host spawns), ``lease.renew`` (the
+heartbeat dies and stays dead, the partition/storage-freeze analog —
+the holder must self-fence within the TTL), ``dist.epoch.spool``
+(append fails — the host degrades the spool subsystem but keeps
+serving).  Unit-pinned in tests/test_failover.py without device work.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+from ..errors import StallError, WalQuarantine
+from . import faults
+from .wal import WriteAheadLog
+
+LEASE_FILE = "lease.json"
+#: a successor steals only after observing this much staleness, in TTLs;
+#: the holder self-fences at 1.0 TTL, so the 0.5-TTL margin is what
+#: makes "stale holder stops publishing before a successor can win" a
+#: timing theorem rather than a race (DESIGN §23)
+STEAL_FACTOR = 1.5
+
+#: epoch-spool segment magic (8 bytes, like the WAL's): payload records
+#: are whole RAEP1 frames, one window epoch each
+SPOOL_MAGIC = b"RASPOOL1"
+#: a window epoch (meta JSON + npz of the register planes) is MBs, not
+#: syslog-line sized; anything past this bound is broken framing
+MAX_EPOCH_BYTES = 256 << 20
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    """fsync'd write-then-rename (the elastic rendezvous idiom)."""
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path), suffix=".tmp")
+    with os.fdopen(fd, "w", encoding="utf-8") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _claim_name(term: int) -> str:
+    return f"term-{term:020d}.claim"
+
+
+class SupervisorLease:
+    """One supervisor's handle on the publication lease.
+
+    Lifecycle: :meth:`acquire` blocks until this process wins a term,
+    then a daemon heartbeat thread renews every ``ttl/4``; the
+    publication plane consults :attr:`fenced` before every externally
+    visible effect (publish, checkpoint) and raises
+    ``SupervisorFenced`` when it reports True.  :meth:`release` stops
+    the heartbeat and deletes ``lease.json`` so a planned handoff does
+    not cost the successor the staleness wait.
+    """
+
+    def __init__(self, lease_dir: str, holder: str, ttl_sec: float):
+        self.dir = os.path.abspath(lease_dir)
+        self.holder = holder
+        self.ttl = float(ttl_sec)
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+        except OSError as e:
+            raise WalQuarantine(
+                f"cannot create lease directory {lease_dir!r}: {e}"
+            ) from e
+        self.term = 0
+        self.renews = 0
+        self._observed_fence = False  # saw a claim for a HIGHER term
+        self._last_renew = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._on_fenced = None
+
+    # -- on-disk state ----------------------------------------------------
+    def _scan_top_claim(self) -> int:
+        """Highest term anyone has ever claimed (0 = never claimed)."""
+        top = 0
+        try:
+            for n in os.listdir(self.dir):
+                if n.startswith("term-") and n.endswith(".claim"):
+                    try:
+                        top = max(top, int(n[5:-6]))
+                    except ValueError:
+                        continue
+        except OSError:
+            pass
+        return top
+
+    def _read_lease(self) -> dict | None:
+        try:
+            with open(os.path.join(self.dir, LEASE_FILE), encoding="utf-8") as f:
+                d = json.load(f)
+            return d if isinstance(d, dict) else None
+        except (OSError, ValueError):
+            return None  # missing or torn — stale by definition
+
+    def observed(self) -> tuple[int, str]:
+        """(term, holder) of the newest leadership anyone advertised —
+        what a fenced supervisor names in its abort message.  The holder
+        is ``"?"`` while a winner has claimed but not yet heartbeat."""
+        top = self._scan_top_claim()
+        lease = self._read_lease()
+        if lease and int(lease.get("term", 0)) >= top:
+            return int(lease.get("term", 0)), str(lease.get("holder", "?"))
+        return top, "?"
+
+    def _staleness(self, top: int) -> float:
+        """Seconds since the newest sign of a live holder (claim-file
+        mtime or heartbeat stamp) — inf when there has never been one."""
+        newest = -float("inf")
+        lease = self._read_lease()
+        if lease and int(lease.get("term", 0)) >= top:
+            try:
+                newest = max(newest, float(lease.get("stamp", 0.0)))
+            except (TypeError, ValueError):
+                pass
+        if top > 0:
+            try:
+                newest = max(
+                    newest,
+                    os.path.getmtime(os.path.join(self.dir, _claim_name(top))),
+                )
+            except OSError:
+                pass
+        return time.time() - newest  # inf when newest stayed -inf
+
+    # -- acquisition ------------------------------------------------------
+    def acquire(self, *, stop: threading.Event | None = None,
+                timeout: float | None = None) -> int:
+        """Block until this process wins the lease; returns the term.
+
+        Waits for the incumbent (if any) to go stale past
+        ``STEAL_FACTOR * ttl``, then claims the next term with
+        ``O_CREAT | O_EXCL`` — losing the creation race just means
+        someone else won that term, and the loop waits on THEIR
+        freshness.  ``timeout`` bounds the wait with a typed
+        :class:`StallError`; ``stop`` aborts it cooperatively.
+        """
+        # chaos site: the lease cannot be claimed at startup (readonly /
+        # unreachable lease volume) — abort typed before spawning hosts
+        faults.fire("lease.acquire")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            top = self._scan_top_claim()
+            if top == 0 or self._staleness(top) > STEAL_FACTOR * self.ttl:
+                try:
+                    fd = os.open(
+                        os.path.join(self.dir, _claim_name(top + 1)),
+                        os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                        0o644,
+                    )
+                except FileExistsError:
+                    continue  # lost the race for this term; re-observe
+                except OSError as e:
+                    raise WalQuarantine(
+                        f"cannot claim lease term {top + 1} in "
+                        f"{self.dir!r}: {e}"
+                    ) from e
+                try:
+                    os.write(fd, self.holder.encode("utf-8", "replace"))
+                finally:
+                    os.close(fd)
+                self.term = top + 1
+                self._observed_fence = False
+                self._last_renew = time.monotonic()
+                self._write_stamp()
+                return self.term
+            if stop is not None and stop.is_set():
+                raise StallError("lease acquisition cancelled")
+            if deadline is not None and time.monotonic() > deadline:
+                t, h = self.observed()
+                raise StallError(
+                    f"lease acquisition timed out after {timeout:.1f}s: "
+                    f"term {t} held by {h!r} is still fresh"
+                )
+            time.sleep(min(0.05, self.ttl / 8 or 0.05))
+
+    def _write_stamp(self) -> None:
+        _atomic_write_json(
+            os.path.join(self.dir, LEASE_FILE),
+            {"term": self.term, "holder": self.holder, "stamp": time.time()},
+        )
+
+    # -- renewal / fencing ------------------------------------------------
+    def renew(self, *, stop: threading.Event | None = None) -> None:
+        """One heartbeat: re-stamp the lease, or discover we are fenced.
+
+        Raises ``InjectedFault`` when the ``lease.renew`` chaos seam is
+        armed (the heartbeat thread then stops renewing FOREVER — the
+        partition persists, and self-fencing by age takes over)."""
+        # chaos site: the holder's renewal fails and stays failed
+        # (partition / storage freeze) — it must self-fence within TTL
+        faults.fire("lease.renew", stop=stop)
+        if self._scan_top_claim() > self.term:
+            if not self._observed_fence:
+                self._observed_fence = True
+                cb = self._on_fenced
+                if cb is not None:
+                    cb()
+            return
+        try:
+            self._write_stamp()
+        except OSError:
+            return  # renewal failed; age keeps growing toward self-fence
+        self._last_renew = time.monotonic()
+        self.renews += 1
+
+    @property
+    def fenced(self) -> bool:
+        """True the moment this holder may no longer publish: it saw a
+        higher term claimed, or its own renewals have been failing
+        longer than the TTL (a successor could win any moment)."""
+        return self._observed_fence or self.age() > self.ttl
+
+    def age(self) -> float:
+        """Seconds since the last successful renewal."""
+        return time.monotonic() - self._last_renew
+
+    # -- heartbeat thread -------------------------------------------------
+    def start_heartbeat(self, on_fenced=None) -> None:
+        """Renew every ``ttl/4`` from a daemon thread; ``on_fenced``
+        fires (once, from that thread) when a higher term is observed."""
+        from ..errors import InjectedFault
+
+        self._on_fenced = on_fenced
+
+        def _beat() -> None:
+            while not self._stop.is_set():
+                try:
+                    self.renew(stop=self._stop)
+                except InjectedFault:
+                    return  # stop renewing forever: the partition persists
+                if self._observed_fence:
+                    return
+                self._stop.wait(self.ttl / 4)
+
+        self._thread = threading.Thread(
+            target=_beat, daemon=True, name="ra-lease-hb"
+        )
+        self._thread.start()
+
+    def release(self) -> None:
+        """Planned handoff: stop heartbeating and clear the stamp so a
+        successor need not wait out the staleness window.  A fenced
+        holder leaves ``lease.json`` alone — it belongs to the winner."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        # ``self.fenced``, not just the observed flag: an age-fenced
+        # holder may already have a successor it never saw — unlinking
+        # here would let a third party steal the winner's term early
+        if not self.fenced and self.term > 0:
+            try:
+                os.unlink(os.path.join(self.dir, LEASE_FILE))
+            except OSError:
+                pass
+
+
+class EpochSpool(WriteAheadLog):
+    """Durable per-host spool of RAEP1 window-epoch frames.
+
+    Exactly the WAL discipline with epoch-sized records: segments are
+    ``seg-<start_seq>.wal`` files under ``RASPOOL1`` magic, each record
+    one complete RAEP1 frame (which carries its own CRCs too — a
+    replayed payload still goes through ``unpack_epoch_payload``'s
+    typed refusal before it can touch a merge).  ``replay(from_seq)``
+    yields ``(seq, payload_bytes)``.
+    """
+
+    _MAGICS = (SPOOL_MAGIC,)
+    _WRITE_MAGIC = SPOOL_MAGIC
+    _MAX_RECORD = MAX_EPOCH_BYTES
+
+    def __init__(self, spool_dir: str, *, budget_bytes: int = 64 << 20):
+        super().__init__(
+            spool_dir,
+            # epoch records are large; size segments so small test
+            # budgets stay legal (budget >= 2 * segment) and eviction
+            # granularity stays one-or-few epochs
+            segment_bytes=min(4 << 20, max(4096, budget_bytes // 2)),
+            budget_bytes=budget_bytes,
+        )
+
+    def append_epoch(self, payload: bytes) -> int:
+        """Durably spool one packed epoch BEFORE it ships; returns seq.
+
+        Raises ``InjectedFault`` when the ``dist.epoch.spool`` seam is
+        armed (full/readonly volume analog) — the host must degrade the
+        spool subsystem and keep ingesting, never die."""
+        faults.fire("dist.epoch.spool")
+        return self.append_bytes(payload)
+
+    @classmethod
+    def _decode_record(cls, payload: bytes, magic: bytes) -> tuple:
+        return (payload,)

@@ -103,6 +103,14 @@ RETRY_SITES: dict[str, RetrySite] = {
         "publisher subsystem (in-memory endpoints keep serving) rather "
         "than aborting ingest",
     ),
+    "dist.epoch.ship": RetrySite(
+        "dist.epoch.ship",
+        "shipping a window epoch from an ingest host to the merge "
+        "supervisor; a transient socket fault retries in place, "
+        "exhaustion enters partition mode (the epoch waits in the "
+        "backlog, the durable spool already holds it) instead of "
+        "killing the host's ingest tier",
+    ),
 }
 
 
@@ -137,6 +145,11 @@ DEFAULT_POLICIES: dict[str, RetryPolicy] = {
     "listener.bind": RetryPolicy(attempts=6, base_sec=0.2, cap_sec=2.0),
     "listener.accept": RetryPolicy(attempts=5, base_sec=0.1, cap_sec=2.0),
     "serve.publish": RetryPolicy(attempts=4, base_sec=0.05, cap_sec=1.0),
+    # the ship seam spins fast and gives up early: the durable spool
+    # already holds the epoch, so a persistent failure should enter
+    # partition mode (heal-time reconciliation) quickly, not block the
+    # host's serve loop through a long backoff ladder
+    "dist.epoch.ship": RetryPolicy(attempts=4, base_sec=0.05, cap_sec=0.5),
 }
 
 assert set(DEFAULT_POLICIES) == set(RETRY_SITES)

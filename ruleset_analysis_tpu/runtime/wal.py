@@ -83,6 +83,11 @@ def _seg_name(start_seq: int) -> str:
     return f"seg-{start_seq:020d}.wal"
 
 
+class _BadRecord(Exception):
+    """A CRC-valid record that fails its format's payload framing —
+    a writer bug, not disk damage, but still a typed quarantine."""
+
+
 class _Segment:
     __slots__ = ("path", "start", "count", "bytes")
 
@@ -98,7 +103,22 @@ class _Segment:
 
 
 class WriteAheadLog:
-    """One serve process's ingest WAL (single-writer, scan-on-open)."""
+    """One serve process's ingest WAL (single-writer, scan-on-open).
+
+    The segment/eviction/quarantine machinery is format-parametric so
+    the distributed-serve epoch spool (runtime/lease.py ``EpochSpool``)
+    can reuse the whole discipline verbatim: subclasses override the
+    three class attributes below plus :meth:`_decode_record` and get
+    O_APPEND durability, seq-gap loss accounting, and typed quarantine
+    for free.
+    """
+
+    #: segment-header magics this format accepts on replay
+    _MAGICS: tuple[bytes, ...] = (MAGIC, MAGIC2)
+    #: segment-header magic new segments are written with
+    _WRITE_MAGIC: bytes = MAGIC2
+    #: framing sanity bound for one record's payload
+    _MAX_RECORD: int = MAX_RECORD_BYTES
 
     def __init__(
         self,
@@ -168,21 +188,21 @@ class WriteAheadLog:
             segs.append(_Segment(path, start, count, nbytes))
         return segs
 
-    @staticmethod
-    def _count_records(path: str) -> int:
+    @classmethod
+    def _count_records(cls, path: str) -> int:
         """Record count of the final segment (torn tail tolerated)."""
         n = 0
         try:
             with open(path, "rb") as f:
                 hdr = f.read(HEADER_BYTES)
-                if len(hdr) < HEADER_BYTES or hdr[:8] not in (MAGIC, MAGIC2):
+                if len(hdr) < HEADER_BYTES or hdr[:8] not in cls._MAGICS:
                     return 0  # quarantined at replay; count unknown
                 while True:
                     rec = f.read(_REC.size)
                     if len(rec) < _REC.size:
                         return n
                     ln, _crc = _REC.unpack(rec)
-                    if ln > MAX_RECORD_BYTES:
+                    if ln > cls._MAX_RECORD:
                         return n  # broken framing; replay quarantines
                     payload = f.read(ln)
                     if len(payload) < ln:
@@ -209,7 +229,7 @@ class WriteAheadLog:
             pass
         seg = _Segment(path, self.next_seq, 0, HEADER_BYTES)
         fd = os.open(seg.path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-        os.write(fd, _HDR.pack(MAGIC2, seg.start))
+        os.write(fd, _HDR.pack(self._WRITE_MAGIC, seg.start))
         self._fd = fd
         self._segments.append(seg)
 
@@ -229,6 +249,14 @@ class WriteAheadLog:
         payload = (
             bytes((len(tkey),)) + tkey + line.encode("utf-8", errors="replace")
         )
+        return self.append_bytes(payload)
+
+    def append_bytes(self, payload: bytes) -> int:
+        """Durably spool one raw payload; returns its seq.
+
+        The format-agnostic append path: the WAL's :meth:`append` frames
+        (tenant, line) into it, the epoch spool appends RAEP1 frames
+        directly."""
         rec = _REC.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
         with self._lock:
             cur = self._segments[-1] if self._segments else None
@@ -344,14 +372,14 @@ class WriteAheadLog:
             return
         with f:
             hdr = f.read(HEADER_BYTES)
-            if len(hdr) < HEADER_BYTES or hdr[:8] not in (MAGIC, MAGIC2) or (
+            if len(hdr) < HEADER_BYTES or hdr[:8] not in self._MAGICS or (
                 _HDR.unpack(hdr)[1] != seg.start
             ):
                 self._quarantine(
                     seg, max(seg.start, from_seq), end, "bad segment header"
                 )
                 return
-            v2 = hdr[:8] == MAGIC2
+            magic = hdr[:8]
             seq = seg.start
             while True:
                 rec = f.read(_REC.size)
@@ -364,7 +392,7 @@ class WriteAheadLog:
                         )
                     return  # clean end / torn tail of the final segment
                 ln, crc = _REC.unpack(rec)
-                if ln > MAX_RECORD_BYTES:
+                if ln > self._MAX_RECORD:
                     self._quarantine(
                         seg, max(seq, from_seq), end, "absurd record length"
                     )
@@ -385,27 +413,34 @@ class WriteAheadLog:
                     )
                     return
                 if seq >= from_seq:
-                    if v2:
-                        tlen = payload[0] if payload else 0
-                        if 1 + tlen > len(payload):
-                            # CRC passed, so this is a writer bug, not
-                            # disk damage — still a typed quarantine
-                            self._quarantine(
-                                seg, max(seq, from_seq), end,
-                                "bad tenant framing",
-                            )
-                            return
-                        tenant = payload[1:1 + tlen].decode(
-                            "utf-8", errors="replace"
+                    try:
+                        decoded = self._decode_record(payload, magic)
+                    except _BadRecord as bad:
+                        # CRC passed, so this is a writer bug, not disk
+                        # damage — still a typed quarantine
+                        self._quarantine(
+                            seg, max(seq, from_seq), end, str(bad)
                         )
-                        line = payload[1 + tlen:].decode(
-                            "utf-8", errors="replace"
-                        )
-                    else:
-                        tenant = DEFAULT_TENANT
-                        line = payload.decode("utf-8", errors="replace")
-                    yield seq, line, tenant
+                        return
+                    yield (seq, *decoded)
                 seq += 1
+
+    @classmethod
+    def _decode_record(cls, payload: bytes, magic: bytes) -> tuple:
+        """Decode one CRC-valid payload into the tuple tail replay
+        yields after the seq; raise :class:`_BadRecord` on framing a
+        CRC cannot catch.  The WAL yields ``(line, tenant)``; the epoch
+        spool overrides this to yield the raw payload."""
+        if magic == MAGIC2:
+            tlen = payload[0] if payload else 0
+            if 1 + tlen > len(payload):
+                raise _BadRecord("bad tenant framing")
+            tenant = payload[1:1 + tlen].decode("utf-8", errors="replace")
+            line = payload[1 + tlen:].decode("utf-8", errors="replace")
+        else:
+            tenant = DEFAULT_TENANT
+            line = payload.decode("utf-8", errors="replace")
+        return line, tenant
 
     def _note_lost(self, seg: _Segment, from_seq: int, end: int | None,
                    why: str, countable_final: bool) -> None:

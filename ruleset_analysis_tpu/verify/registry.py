@@ -224,11 +224,28 @@ def audit_retry(root: str | None = None) -> list[AuditFinding]:
                 "faults.SITES",
             ))
         fs = re.escape(meta.fault_site)
-        if not re.search(fs + r"@\d+:[1-9](?!\d)", tests_text):
+        transient_ks = [
+            int(k)
+            for k in re.findall(fs + r"@\d+:([1-9])(?!\d)", tests_text)
+        ]
+        if not transient_ks:
             findings.append(AuditFinding(
                 "retry", "no-transient-schedule", site,
                 f"no test schedules {meta.fault_site}@N:k (single-digit "
                 "k) — the recovery half of the seam is untested",
+            ))
+        elif (
+            site in DEFAULT_POLICIES
+            and min(transient_ks) >= DEFAULT_POLICIES[site].attempts
+        ):
+            # a "transient" schedule at or past the attempt budget never
+            # recovers in place — it silently tests the escalation path
+            # twice and the recovery path not at all
+            findings.append(AuditFinding(
+                "retry", "transient-schedule-exceeds-budget", site,
+                f"every {meta.fault_site}@N:k schedule has k >= the "
+                f"policy's {DEFAULT_POLICIES[site].attempts} attempts — "
+                "no test proves in-place recovery",
             ))
         if not re.search(fs + r"@\d+:\d{2,}", tests_text):
             findings.append(AuditFinding(
@@ -552,6 +569,63 @@ def audit_distserve(root: str | None = None) -> list[AuditFinding]:
             "live and dead hosts render identical flags — per-host "
             "blocks are not independent",
         ))
+
+    # failover-gauge parity (ISSUE 18 satellite): the leader-term /
+    # lease-age / spool-replay gauges must flow through the REAL
+    # metrics_gauges() (one source of truth) and survive the same
+    # ra_serve_ prom rendering the /metrics?format=prom path uses —
+    # a gauge added to failover_gauges() but dropped from the merge,
+    # or renamed on one side, fails here before any dashboard drifts.
+    from ..runtime.autoscale import render_prom
+
+    drv._pending = {}
+    drv._deg_lock = threading.Lock()
+    drv.degraded = {}
+    drv._engine = None
+    drv._lease = None
+    for attr in (
+        "hosts_spawned", "hosts_dead_total", "hosts_retired_total",
+        "windows_published", "next_wid", "total_lines", "live_drops",
+        "drops_restored", "late_epochs", "late_epoch_lines",
+        "degraded_events", "recovered_events",
+    ):
+        setattr(drv, attr, 0)
+    drv.skipped_windows = []
+    drv.term = 7
+    drv.spool_replayed_total = 41
+    drv.replay_windows_total = 5
+    drv.replay_lag_windows = 2
+    drv.replay_refused_total = 0
+    fg = drv.failover_gauges()
+    want_keys = {
+        "leader_term", "lease_age_sec", "lease_fenced",
+        "spool_replayed_total", "replay_windows_total",
+        "replay_lag_windows",
+    }
+    if set(fg) != want_keys:
+        findings.append(AuditFinding(
+            "distserve", "failover-gauge-drift",
+            ",".join(sorted(set(fg) ^ want_keys)),
+            "failover_gauges() keys drifted from the documented set "
+            "(DESIGN §23) — dashboards and audit_distserve disagree",
+        ))
+    allg = drv.metrics_gauges()
+    prom_all = render_prom(allg, prefix="ra_serve_").splitlines()
+    for key, v in fg.items():
+        if allg.get(key) != v:
+            findings.append(AuditFinding(
+                "distserve", "failover-merge-drift", key,
+                "a failover gauge is missing from (or disagrees with) "
+                "metrics_gauges() — /metrics no longer carries it",
+            ))
+            continue
+        body = f"{v:g}" if isinstance(v, float) else f"{v}"
+        if f"ra_serve_{key} {body}" not in prom_all:
+            findings.append(AuditFinding(
+                "distserve", "failover-prom-drift", key,
+                "a failover gauge present in the JSON /metrics block is "
+                "absent from the ra_serve_ Prometheus rendering",
+            ))
     return findings
 
 

@@ -1,0 +1,798 @@
+"""Supervisor failover + partition-tolerant epoch merge (DESIGN §23).
+
+Pins the PR-18 tentpole invariants:
+
+- **Exactly one winner per term**: the filesystem lease's
+  ``O_CREAT|O_EXCL`` claim files make every fencing term have exactly
+  one holder, racing acquirers included; a holder that loses its lease
+  (higher term observed, or its own renewals aged past the TTL) stops
+  publishing *typed* before a successor can win — split brain can
+  never produce two publications for one window id.
+- **Durable epoch spool**: every window epoch lands in a CRC'd
+  ``RASPOOL1`` segment (the WAL discipline) BEFORE it ships, so it
+  survives its producer and any supervisor.  Byte-level corruption —
+  every truncation offset, every flipped byte — is a typed
+  refusal/quarantine, never a crash, a hang, or a wrong merge.
+- **Failover replay**: an elected successor replays all spooled epochs
+  past the fenced merge frontier and publishes windows bit-identical
+  to what the dead supervisor would have published.
+- **Partition degraded mode**: a host that cannot reach the supervisor
+  keeps ingesting to its spool, marks ``partition:<rank>``, and
+  reconciles on heal with zero silent drops.
+
+Chaos seams exercised here (the fault/retry registry auditors grep
+this file): ``lease.acquire``, ``lease.renew``, ``dist.epoch.spool``,
+and the ``dist.epoch.ship`` retry seam — transient recovery via the
+``dist.epoch.ship@1:2`` schedule, budget exhaustion into partition
+mode via ``dist.epoch.ship@1:6`` and ``dist.epoch.ship@1:99``.
+
+Thread-mode only in tier 1; the CLI supervisor-SIGKILL e2e is
+``slow``-marked (a spawned interpreter recompiles XLA from scratch).
+"""
+
+import json
+import os
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import (
+    AnalysisConfig,
+    DistServeConfig,
+    ServeConfig,
+)
+from ruleset_analysis_tpu.errors import (
+    EXIT_CODE_NAMES,
+    AnalysisError,
+    InjectedFault,
+    SupervisorFenced,
+    WalQuarantine,
+    exit_code_for,
+)
+from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+from ruleset_analysis_tpu.parallel.distributed import (
+    pack_epoch_payload,
+    unpack_epoch_payload,
+)
+from ruleset_analysis_tpu.runtime import faults
+from ruleset_analysis_tpu.runtime import checkpoint as ckpt
+from ruleset_analysis_tpu.runtime.distserve import DistServeDriver
+from ruleset_analysis_tpu.runtime.lease import EpochSpool, SupervisorLease
+from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS as VOLATILE
+
+
+def image(obj) -> dict:
+    if not isinstance(obj, dict):
+        obj = json.loads(obj.to_json())
+    obj = json.loads(json.dumps(obj))
+    for k in VOLATILE:
+        obj["totals"].pop(k, None)
+    # window meta carries per-host blocks + the fencing term; neither is
+    # analysis content — bit-identity is about the counters
+    obj["totals"].pop("window", None)
+    obj["totals"].pop("chunks", None)
+    return obj
+
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    """v4+v6 packed ruleset + 800 mixed lines (same geometry as the
+    distserve suite, so the in-process jit caches stay warm)."""
+    td = tmp_path_factory.mktemp("failover")
+    cfg_text = synth.synth_config(
+        n_acls=2, rules_per_acl=8, seed=0, v6_fraction=0.25
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    prefix = str(td / "rules")
+    pack.save_packed(packed, prefix)
+    t = synth.synth_tuples(packed, 600, seed=1)
+    lines = synth.render_syslog(packed, t, seed=1)
+    t6 = synth.synth_tuples6(packed, 200, seed=2)
+    lines += synth.render_syslog6(packed, t6, seed=3)
+    return packed, prefix, lines, str(td)
+
+
+RUN_CFG = dict(batch_size=128, prefetch_depth=0)
+WL = 200
+TTL = 3.0  # generous enough that a 1-core jit-compile pause cannot
+# age a healthy holder past self-fence mid-test
+
+
+def dist_cfg(**kw) -> AnalysisConfig:
+    return AnalysisConfig(**{**RUN_CFG, "mesh_shape": "hybrid", **kw})
+
+
+def dist_scfg(serve_dir, **kw) -> ServeConfig:
+    return ServeConfig(**{
+        "listen": ("tcp:127.0.0.1:0",), "window_lines": WL,
+        "serve_dir": str(serve_dir), "http": "off",
+        "checkpoint_every_windows": 0, "reload_watch": False,
+        **kw,
+    })
+
+
+def host_slices(lines, n_hosts, windows, wl=WL):
+    return {
+        r: [
+            ln
+            for w in range(windows)
+            for ln in lines[(w * n_hosts + r) * wl:(w * n_hosts + r + 1) * wl]
+        ]
+        for r in range(n_hosts)
+    }
+
+
+def start_dist(prefix, cfg, scfg, dscfg, **kw):
+    drv = DistServeDriver(prefix, cfg, scfg, dscfg, **kw)
+    out: dict = {}
+
+    def runner():
+        try:
+            out["summary"] = drv.run()
+        except BaseException as e:  # surfaced by finish_dist()
+            out["error"] = e
+
+    th = threading.Thread(target=runner, daemon=True)
+    th.start()
+    return drv, th, out
+
+
+def finish_dist(th, out, timeout=240):
+    th.join(timeout=timeout)
+    assert not th.is_alive(), "distributed serve hung"
+    if "error" in out:
+        raise out["error"]
+    return out["summary"]
+
+
+def host_tcp(drv, rank):
+    with drv._lock:
+        h = drv.hosts.get(rank)
+        addrs = dict(h.addresses) if h else {}
+    for lbl, ad in addrs.items():
+        if lbl.startswith("tcp"):
+            return tuple(ad)
+    return None
+
+
+def wait_for(pred, timeout=120, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def wait_hosts_up(drv, out, n_hosts, timeout=120):
+    wait_for(
+        lambda: out.get("error")
+        or all(host_tcp(drv, r) for r in range(n_hosts)),
+        timeout, "host listeners",
+    )
+    if "error" in out:
+        raise out["error"]
+
+
+def send_tcp(addr, lines):
+    s = socket.create_connection(addr)
+    s.sendall(("\n".join(lines) + "\n").encode())
+    s.close()
+
+
+def read_json(path):
+    with open(path, "r", encoding="utf-8") as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Lease protocol: exactly one winner per term, steal, fence, release.
+# ---------------------------------------------------------------------------
+
+def test_lease_exactly_one_winner_per_term(tmp_path):
+    """Racing acquirers each win a DISTINCT term — the O_EXCL claim
+    file makes a term's winner unique even under a thundering herd."""
+    d = str(tmp_path / "lease")
+    leases = [
+        SupervisorLease(d, holder=f"sup-{i}", ttl_sec=0.2) for i in range(4)
+    ]
+    won = []
+    lock = threading.Lock()
+
+    def go(lease):
+        t = lease.acquire(timeout=60)
+        with lock:
+            won.append(t)
+
+    ths = [threading.Thread(target=go, args=(L,)) for L in leases]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join(timeout=60)
+    assert sorted(won) == [1, 2, 3, 4]  # one winner per term, no reuse
+    for term in (1, 2, 3, 4):
+        assert os.path.exists(
+            os.path.join(d, f"term-{term:020d}.claim")
+        )
+
+
+def test_lease_steal_fence_and_release(tmp_path):
+    d = str(tmp_path / "lease")
+    a = SupervisorLease(d, holder="sup-a", ttl_sec=0.2)
+    assert a.acquire(timeout=10) == 1
+    # a successor must wait out the 1.5x-TTL staleness window before
+    # it can steal: the incumbent provably self-fences (age > TTL)
+    # strictly first
+    t0 = time.monotonic()
+    b = SupervisorLease(d, holder="sup-b", ttl_sec=0.2)
+    assert b.acquire(timeout=10) == 2
+    assert time.monotonic() - t0 >= 0.2
+    assert a.age() > a.ttl  # the loser had already self-fenced by age
+    b._write_stamp()
+
+    fenced = []
+    a._on_fenced = lambda: fenced.append(1)
+    a.renew()
+    a.renew()  # idempotent: the callback fires exactly once
+    assert fenced == [1]
+    assert a.fenced
+    assert a.observed() == (2, "sup-b")
+
+    # a fenced holder must NOT clear the winner's stamp on release
+    a.release()
+    assert read_json(os.path.join(d, "lease.json"))["holder"] == "sup-b"
+    b.release()
+    b.release()  # idempotent
+    assert not os.path.exists(os.path.join(d, "lease.json"))
+    # with the stamp cleared, the next holder only waits on claim-file
+    # staleness, never on a dead stamp
+    c = SupervisorLease(d, holder="sup-c", ttl_sec=0.2)
+    assert c.acquire(timeout=10) == 3
+
+
+def test_lease_fault_seams(tmp_path):
+    """lease.acquire / lease.renew chaos seams: typed at startup,
+    self-fence-by-age when renewals stop (the heartbeat never renews
+    again after an injected partition)."""
+    with faults.armed(faults.FaultPlan.parse("lease.acquire@1")):
+        with pytest.raises(InjectedFault):
+            SupervisorLease(
+                str(tmp_path / "l1"), holder="x", ttl_sec=0.2
+            ).acquire(timeout=5)
+
+    lease = SupervisorLease(str(tmp_path / "l2"), holder="x", ttl_sec=0.2)
+    assert lease.acquire(timeout=5) == 1
+    with faults.armed(faults.FaultPlan.parse("lease.renew@1")):
+        lease.start_heartbeat()
+        wait_for(lambda: lease.fenced, timeout=10, msg="self-fence by age")
+    assert lease.renews == 0  # the partitioned heartbeat never renewed
+    lease.release()
+
+
+def test_fence_fingerprint_roundtrip():
+    assert ckpt.fence_fingerprint("abc-distserve", 7) == "abc-distserve-t7"
+    assert ckpt.split_fence("abc-distserve-t7") == ("abc-distserve", 7)
+    # a pre-failover snapshot has no suffix: term 0, full fp preserved
+    assert ckpt.split_fence("abc-distserve") == ("abc-distserve", 0)
+    assert ckpt.split_fence("weird-t") == ("weird-t", 0)
+
+
+# ---------------------------------------------------------------------------
+# Epoch spool: durability, fault seam, byte-level corruption fuzz.
+# ---------------------------------------------------------------------------
+
+def test_spool_roundtrip_and_fault_seam(tmp_path):
+    sp = EpochSpool(str(tmp_path / "spool"), budget_bytes=1 << 20)
+    with faults.armed(faults.FaultPlan.parse("dist.epoch.spool@1")):
+        with pytest.raises(InjectedFault):
+            sp.append_epoch(b"epoch-0")
+    assert sp.append_epoch(b"epoch-0") == 0  # the failed try burned no seq
+    assert sp.append_epoch(b"epoch-1") == 1
+    sp.close()
+    sp2 = EpochSpool(str(tmp_path / "spool"), budget_bytes=1 << 20)
+    assert [(s, p) for s, p in sp2.replay(0)] == [
+        (0, b"epoch-0"), (1, b"epoch-1"),
+    ]
+    sp2.close()
+
+
+def test_spool_corruption_fuzz(tmp_path):
+    """Truncate at EVERY offset and flip a bit at EVERY byte of a spool
+    segment: the reader must yield only exact original records (a
+    prefix-consistent subset), quarantining or clipping the rest —
+    never crash, never hang, never a wrong payload."""
+    src = tmp_path / "pristine"
+    sp = EpochSpool(str(src), budget_bytes=1 << 20)
+    payloads = [bytes([0x40 + i]) * (20 + i) for i in range(3)]
+    for p in payloads:
+        sp.append_epoch(p)
+    sp.close()
+    (seg,) = [n for n in os.listdir(src) if n.endswith(".wal")]
+    pristine = (src / seg).read_bytes()
+
+    scratch = tmp_path / "scratch"
+
+    def check(blob: bytes) -> None:
+        if scratch.exists():
+            shutil.rmtree(scratch)
+        scratch.mkdir()
+        (scratch / seg).write_bytes(blob)
+        try:
+            spool = EpochSpool(str(scratch), budget_bytes=1 << 20)
+        except WalQuarantine:
+            return  # typed refusal at open is a legal outcome
+        try:
+            seen = -1
+            for seq, payload in spool.replay(0):
+                assert payload == payloads[seq], (
+                    f"corrupt spool yielded a WRONG payload at seq {seq}"
+                )
+                assert seq > seen
+                seen = seq
+        finally:
+            spool.close()
+
+    check(pristine)  # the harness itself round-trips
+    for cut in range(len(pristine)):
+        check(pristine[:cut])
+    for off in range(len(pristine)):
+        blob = bytearray(pristine)
+        blob[off] ^= 0x40
+        check(bytes(blob))
+
+
+def test_epoch_payload_byte_fuzz():
+    """RAEP1 frames: every truncation and every single-byte flip is a
+    typed AnalysisError — the CRC spans both bodies and the length
+    fields self-check, so no mutation can decode silently wrong."""
+    arrays = {"counts": np.arange(6, dtype=np.uint32)}
+    extra = {"rank": 0, "meta": {"id": 1, "lines": 6}, "wal_next": 3}
+    payload = pack_epoch_payload(arrays, extra)
+    unpack_epoch_payload(payload)  # sanity: pristine decodes
+    for cut in range(len(payload)):
+        with pytest.raises(AnalysisError):
+            unpack_epoch_payload(payload[:cut])
+    for off in range(len(payload)):
+        torn = bytearray(payload)
+        torn[off] ^= 0x10
+        with pytest.raises(AnalysisError):
+            unpack_epoch_payload(bytes(torn))
+
+
+# ---------------------------------------------------------------------------
+# Replay brain: frontier, gap markers, corrupt-record refusal (unit).
+# ---------------------------------------------------------------------------
+
+def _bare_supervisor(serve_dir, next_wid=0):
+    drv = DistServeDriver.__new__(DistServeDriver)
+    drv._lock = threading.Lock()
+    drv.dscfg = DistServeConfig(hosts=2, workers="thread", spool_budget_mb=4)
+    drv.scfg = dist_scfg(serve_dir)
+    drv.next_wid = next_wid
+    drv.skipped_windows = []
+    drv._host_wal_restored = {}
+    drv.spool_replayed_total = 0
+    drv.replay_windows_total = 0
+    drv.replay_lag_windows = 0
+    drv.replay_refused_total = 0
+    published = []
+    drv._publish_window = lambda w, recs, dead, missing: published.append(
+        (w, sorted(recs), dead, missing)
+    )
+    return drv, published
+
+
+def _epoch(rank, wid):
+    return pack_epoch_payload(
+        {"c": np.arange(4, dtype=np.uint32) + wid},
+        {"rank": rank, "meta": {"id": wid}, "wal_next": 100 * wid + rank},
+    )
+
+
+def test_replay_frontier_gaps_and_refusal(tmp_path):
+    sd = str(tmp_path / "serve")
+    drv, published = _bare_supervisor(sd)
+    # host 0 spooled w0..w2; host 1 spooled w0 and w2 (w1 lost) plus one
+    # record that is WAL-valid but not a decodable RAEP1 frame
+    for rank, wids in ((0, [0, 1, 2]), (1, [0, 2])):
+        sp = EpochSpool(drv._host_spool_dir(rank), budget_bytes=4 << 20)
+        for w in wids:
+            sp.append_epoch(_epoch(rank, w))
+        sp.append_epoch(b"RAEP1 but not really a frame")
+        sp.close()
+
+    drv._replay_spools()
+    assert published == [
+        (0, [0, 1], [], []),
+        (1, [0], [], [1]),  # host 1 spooled PAST w1: typed host_missing
+        (2, [0, 1], [], []),
+    ]
+    assert drv.replay_refused_total == 2  # one garbage record per host
+    assert drv.spool_replayed_total == 5
+    assert drv.replay_windows_total == 3
+    assert drv.next_wid == 3
+    assert drv.skipped_windows == []
+    # replayed WAL cursors supersede checkpointed ones (no double replay
+    # when the host itself rejoins)
+    assert drv._host_wal_restored == {0: 200, 1: 201}
+
+    # a restored frontier is respected: nothing below it re-publishes
+    drv2, published2 = _bare_supervisor(sd, next_wid=2)
+    drv2._replay_spools()
+    assert published2 == [(2, [0, 1], [], [])]
+
+    # a window BELOW every surviving record is skipped loudly, never a
+    # frontier hang: drop both hosts' w0+w1 spools, keep w2 only
+    sd3 = str(tmp_path / "serve3")
+    drv3, published3 = _bare_supervisor(sd3)
+    sp = EpochSpool(drv3._host_spool_dir(0), budget_bytes=4 << 20)
+    sp.append_epoch(_epoch(0, 2))
+    sp.close()
+    drv3._replay_spools()
+    assert published3 == [(2, [0], [], [])]
+    assert drv3.skipped_windows == [0, 1]
+
+
+# ---------------------------------------------------------------------------
+# Partition tolerance: transient recovery, park + heal, zero drops.
+# ---------------------------------------------------------------------------
+
+def test_ship_transient_recovery_and_spool_degrade(corpus):
+    """A transient merge-plane burst (dist.epoch.ship@1:2 — under the
+    4-attempt budget) recovers in place; a dead spool volume
+    (dist.epoch.spool@1:9) degrades failover durability typed while
+    the live merge keeps publishing."""
+    packed, prefix, lines, td = corpus
+    union = lines[:WL]
+    sd = os.path.join(td, "transient")
+    with faults.armed(faults.FaultPlan.parse(
+        "dist.epoch.ship@1:2,dist.epoch.spool@1:9"
+    )):
+        drv, th, out = start_dist(
+            prefix, dist_cfg(), dist_scfg(sd, max_windows=1),
+            DistServeConfig(
+                hosts=1, workers="thread", merge_timeout_sec=600,
+                lease_ttl_sec=TTL,
+            ),
+        )
+        wait_hosts_up(drv, out, 1)
+        send_tcp(host_tcp(drv, 0), union)
+        summary = finish_dist(th, out)
+    assert summary["windows_published"] == 1
+    assert summary["lines_total"] == len(union)
+    assert summary["drops"] == 0
+    ship = summary["retry"]["dist.epoch.ship"]
+    assert ship["recoveries"] >= 1  # retried in place, never parked
+    assert ship["giveups"] == 0
+    # the spool died typed; serving continued
+    assert "spool" in summary["hosts"]["0"]["summary"]["degraded"]
+    meta = read_json(
+        os.path.join(sd, "window-000000.json")
+    )["totals"]["window"]
+    assert "incomplete" not in meta
+
+
+def test_partition_park_heal_zero_silent_drops(corpus):
+    """Budget exhaustion (dist.epoch.ship@1:6) enters partition mode:
+    the epoch parks in the backlog (spool-backed), /health carries
+    partition:<rank>, and the heal probes drain everything in window
+    order — all windows publish complete, zero silent drops."""
+    packed, prefix, lines, td = corpus
+    windows = 2
+    union = lines[:windows * WL]
+    sd = os.path.join(td, "heal")
+    with faults.armed(faults.FaultPlan.parse("dist.epoch.ship@1:6")):
+        drv, th, out = start_dist(
+            prefix, dist_cfg(), dist_scfg(sd, max_windows=windows),
+            DistServeConfig(
+                hosts=1, workers="thread", merge_timeout_sec=600,
+                lease_ttl_sec=TTL,
+            ),
+        )
+        wait_hosts_up(drv, out, 1)
+        send_tcp(host_tcp(drv, 0), union)
+        # the retry ladder exhausts (hits 1-4), parks, and the host
+        # marks itself partitioned — visible in the supervisor's gauges
+        wait_for(
+            lambda: out.get("error")
+            or "partition:0" in (drv.hosts[0].degraded or []),
+            msg="partition degraded marker",
+        )
+        summary = finish_dist(th, out)
+    assert summary["windows_published"] == windows
+    assert summary["lines_total"] == len(union)
+    assert summary["drops"] == 0
+    assert summary["retry"]["dist.epoch.ship"]["giveups"] >= 1  # parked
+    # the heal drained the backlog: the host ends un-degraded
+    assert summary["hosts"]["0"]["summary"]["degraded"] == []
+    for w in range(windows):
+        meta = read_json(
+            os.path.join(sd, f"window-{w:06d}.json")
+        )["totals"]["window"]
+        assert "incomplete" not in meta, (w, meta)
+        assert meta["lines"] == WL
+
+
+# ---------------------------------------------------------------------------
+# The tentpole: kill the supervisor, elect a successor, replay the
+# spools, publish bit-identically. Exactly one publisher per term.
+# ---------------------------------------------------------------------------
+
+def test_supervisor_failover_replay_bit_identity(corpus):
+    packed, prefix, lines, td = corpus
+    n_hosts, windows = 2, 2
+    union = lines[:n_hosts * windows * WL]
+    streams = host_slices(union, n_hosts, windows)
+
+    # control: identical per-host streams, no chaos — exactly what the
+    # victim supervisor WOULD have published
+    ctl_dir = os.path.join(td, "ctl")
+    drv, th, out = start_dist(
+        prefix, dist_cfg(), dist_scfg(ctl_dir, max_windows=windows),
+        DistServeConfig(hosts=n_hosts, workers="thread", lease_ttl_sec=TTL),
+    )
+    wait_hosts_up(drv, out, n_hosts)
+    for r in range(n_hosts):
+        send_tcp(host_tcp(drv, r), streams[r])
+    sc = finish_dist(th, out)
+    assert sc["windows_published"] == windows and sc["drops"] == 0
+    assert sc["term"] == 1
+
+    # victim: a full merge-plane partition parks EVERY epoch in the
+    # durable spools (none reach the supervisor), then the supervisor
+    # dies abruptly with all windows unpublished
+    fo_dir = os.path.join(td, "failover")
+    with faults.armed(faults.FaultPlan.parse("dist.epoch.ship@1:99")):
+        drv, th, out = start_dist(
+            prefix, dist_cfg(), dist_scfg(fo_dir, max_windows=windows),
+            DistServeConfig(
+                hosts=n_hosts, workers="thread", merge_timeout_sec=600,
+                lease_ttl_sec=TTL,
+            ),
+        )
+        wait_hosts_up(drv, out, n_hosts)
+        assert drv.term == 1
+        assert drv.health()["term"] == 1
+        assert drv.metrics_gauges()["leader_term"] == 1
+        for r in range(n_hosts):
+            send_tcp(host_tcp(drv, r), streams[r])
+        wait_for(
+            lambda: out.get("error") or all(
+                drv.host_gauges().get(str(r), {}).get("spool_seq", 0)
+                >= windows
+                for r in range(n_hosts)
+            ),
+            msg="all epochs durably spooled",
+        )
+        wait_for(
+            lambda: out.get("error") or all(
+                f"partition:{r}" in (drv.hosts[r].degraded or [])
+                for r in range(n_hosts)
+            ),
+            msg="partition markers on both hosts",
+        )
+        assert drv.windows_published == 0  # term 1 published NOTHING
+        drv.kill_supervisor()
+        with pytest.raises(AnalysisError, match="supervisor killed"):
+            finish_dist(th, out)
+    assert not os.path.exists(os.path.join(fo_dir, "window-000000.json"))
+
+    # successor: wins the next term, replays both spools past the
+    # (empty) frontier, and publishes every window bit-identically
+    drv, th, out = start_dist(
+        prefix, dist_cfg(resume=True), dist_scfg(fo_dir, max_windows=windows),
+        DistServeConfig(
+            hosts=n_hosts, workers="thread", merge_timeout_sec=600,
+            lease_ttl_sec=TTL,
+        ),
+    )
+    s2 = finish_dist(th, out)
+    assert s2["term"] == 2  # exactly one publisher per term
+    assert s2["windows_published"] == windows
+    assert s2["lines_total"] == len(union)
+    assert s2["drops"] == 0
+    assert s2["failover"]["spool_replayed"] == n_hosts * windows
+    assert s2["failover"]["replay_windows"] == windows
+    assert s2["failover"]["replay_refused"] == 0
+    assert s2["skipped_windows"] == []
+    for w in range(windows):
+        a = read_json(os.path.join(fo_dir, f"window-{w:06d}.json"))
+        b = read_json(os.path.join(ctl_dir, f"window-{w:06d}.json"))
+        assert a["totals"]["window"]["term"] == 2
+        assert b["totals"]["window"]["term"] == 1
+        assert a.get("talkers") == b.get("talkers"), f"window {w} talkers"
+        assert image(a) == image(b), f"window {w} diverged"
+    ca = read_json(os.path.join(fo_dir, "cumulative.json"))
+    cb = read_json(os.path.join(ctl_dir, "cumulative.json"))
+    assert image(ca) == image(cb)
+
+
+def test_dual_supervisor_race_fences_the_stale_one(corpus):
+    """Induced split brain: while supervisor A holds term 1, a rival
+    claims term 2.  A's very next renewal observes the higher term,
+    stops publishing typed (SupervisorFenced, exit code 8) — it can
+    never produce a second publication for a window the winner owns."""
+    packed, prefix, lines, td = corpus
+    sd = os.path.join(td, "race")
+    drv, th, out = start_dist(
+        prefix, dist_cfg(), dist_scfg(sd, max_windows=10),
+        DistServeConfig(
+            hosts=1, workers="thread", merge_timeout_sec=600,
+            lease_ttl_sec=30.0,
+        ),
+    )
+    wait_hosts_up(drv, out, 1)
+    send_tcp(host_tcp(drv, 0), lines[:WL])
+    wait_for(
+        lambda: out.get("error") or drv.windows_published >= 1,
+        msg="window 0 published under term 1",
+    )
+    w0 = read_json(os.path.join(sd, "window-000000.json"))
+    assert w0["totals"]["window"]["term"] == 1
+
+    # the rival wins term 2 (same O_EXCL protocol the lease uses)
+    claim = os.path.join(drv._lease_dir(), f"term-{2:020d}.claim")
+    fd = os.open(claim, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    os.close(fd)
+    drv._lease.renew()  # next heartbeat: observe, fence, stop
+    with pytest.raises(SupervisorFenced) as ei:
+        finish_dist(th, out)
+    assert "term 2" in str(ei.value)
+    assert exit_code_for(ei.value) == 8
+    assert EXIT_CODE_NAMES[8] == "supervisor-fenced"
+    # the fenced gate also refuses directly — the publication-path half
+    with pytest.raises(SupervisorFenced):
+        drv._check_fenced()
+    # nothing was published past the fence, and window 0 still carries
+    # exactly the term that produced it
+    assert not os.path.exists(os.path.join(sd, "window-000001.json"))
+    assert read_json(
+        os.path.join(sd, "window-000000.json")
+    )["totals"]["window"]["term"] == 1
+
+
+def test_registry_failover_audits_clean():
+    from ruleset_analysis_tpu.verify.registry import (
+        audit_distserve,
+        audit_retry,
+    )
+
+    assert audit_retry() == []
+    assert audit_distserve() == []
+
+
+# ---------------------------------------------------------------------------
+# CLI supervisor-SIGKILL e2e (spawned interpreter: slow-marked).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_cli_supervisor_sigkill_failover_e2e(corpus):
+    """SIGKILL the whole CLI supervisor process mid-deployment; an
+    in-process successor wins the next term off the on-disk lease,
+    replays the spools, republishes the already-published window
+    bit-identically (idempotent republication) and publishes the
+    window the dead supervisor never merged."""
+    packed, prefix, lines, td = corpus
+    wl, windows, n_hosts = 100, 2, 2
+    union = lines[:n_hosts * windows * wl]
+    streams = host_slices(union, n_hosts, windows, wl=wl)
+    sd = os.path.join(td, "sigkill")
+
+    # two consecutive fixed ports (host rank offsets the listen spec)
+    for _ in range(20):
+        s0 = socket.socket()
+        s0.bind(("127.0.0.1", 0))
+        port = s0.getsockname()[1]
+        s1 = socket.socket()
+        try:
+            s1.bind(("127.0.0.1", port + 1))
+        except OSError:
+            continue
+        finally:
+            s1.close()
+            s0.close()
+        break
+
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "ruleset_analysis_tpu.cli", "serve",
+            "--ruleset", prefix, "--listen", f"tcp:127.0.0.1:{port}",
+            "--window", f"lines:{wl}", "--max-windows", str(windows),
+            "--serve-dir", sd, "--batch-size", "128",
+            "--mesh", "hybrid", "--distributed", "--dist-hosts", "2",
+            "--dist-workers", "thread", "--dist-merge-timeout", "600",
+            "--dist-lease-ttl", "2",
+        ],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        ep_path = os.path.join(sd, "endpoint.json")
+        wait_for(
+            lambda: os.path.exists(ep_path) or proc.poll() is not None,
+            timeout=600, msg="CLI endpoint",
+        )
+        assert proc.poll() is None, "CLI supervisor died at startup"
+        ep = read_json(ep_path)
+        assert ep["term"] == 1
+
+        def up(p):
+            try:
+                socket.create_connection(("127.0.0.1", p), timeout=0.5).close()
+                return True
+            except OSError:
+                return False
+
+        wait_for(lambda: up(port) and up(port + 1), timeout=600,
+                 msg="host listeners")
+        send_tcp(("127.0.0.1", port), streams[0])  # both windows
+        send_tcp(("127.0.0.1", port + 1), streams[1][:wl])  # window 0 only
+        w0_path = os.path.join(sd, "window-000000.json")
+        wait_for(lambda: os.path.exists(w0_path) or proc.poll() is not None,
+                 timeout=600, msg="window 0 published")
+        assert proc.poll() is None
+
+        # window 1 is merge-pending (host 1 silent, huge merge timeout):
+        # host 0's w1 epoch is already durably spooled — watch rank 0's
+        # /metrics until the supervisor itself says so
+        host, hport = ep["http"]
+
+        def pending():
+            try:
+                with urllib.request.urlopen(
+                    f"http://{host}:{hport}/metrics", timeout=2
+                ) as r:
+                    g = json.loads(r.read())
+            except OSError:
+                return False
+            return g.get("gauges", g).get("merge_pending_windows", 0) >= 1
+
+        wait_for(pending, timeout=600, msg="window 1 merge-pending")
+        w0_before = read_json(w0_path)
+
+        os.kill(ep["pid"], signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+
+    drv, th, out = start_dist(
+        prefix,
+        dist_cfg(resume=True),
+        dist_scfg(sd, window_lines=wl, max_windows=windows),
+        DistServeConfig(
+            hosts=n_hosts, workers="thread", merge_timeout_sec=600,
+            lease_ttl_sec=2.0,
+        ),
+    )
+    s2 = finish_dist(th, out, timeout=900)
+    assert s2["term"] == 2
+    assert s2["windows_published"] == windows
+    # the victim's ring checkpoint (default cadence: every window)
+    # covered w0, so the restored frontier is 1 and replay publishes
+    # exactly the window the dead supervisor never merged — a fenced
+    # t1 fingerprint restored by a t2 holder, never a refusal
+    assert s2["failover"]["replay_windows"] == 1
+
+    # the checkpoint-covered window is NOT republished: it still
+    # carries the term that produced it, byte for byte
+    w0_after = read_json(w0_path)
+    assert w0_after["totals"]["window"]["term"] == 1
+    assert image(w0_after) == image(w0_before)
+    assert w0_after.get("talkers") == w0_before.get("talkers")
+    # window 1: host 0's spooled epoch, published by the successor
+    w1 = read_json(os.path.join(sd, "window-000001.json"))
+    assert w1["totals"]["window"]["term"] == 2
+    assert set(w1["totals"]["window"]["hosts"]) == {"0"}
+    assert w1["totals"]["window"]["lines"] == wl

@@ -10,7 +10,7 @@ exists so a crashed box with only the repo checkout — no installed
 entry point — can still be diagnosed).  Reads the ``postmortem.json``
 an aborted run's flight recorder merged (``--blackbox-dir``, DESIGN
 §20), ranks the likely causes against the documented exit-code classes
-(README "Exit codes", 3-7), and prints the operator's next action.
+(README "Exit codes", 3-8), and prints the operator's next action.
 
 For the timeline view of the same bundle, ``tools/trace_summary.py``
 accepts a postmortem bundle directly and renders its ``blackbox`` block
@@ -32,7 +32,7 @@ from ruleset_analysis_tpu.runtime import flightrec  # noqa: E402
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="ranked diagnosis from a crashed run's postmortem "
-        "bundle (the first-response runbook for exit codes 3-7)"
+        "bundle (the first-response runbook for exit codes 3-8)"
     )
     ap.add_argument("bundle", help="postmortem.json, or the blackbox dir")
     ap.add_argument("--exit-code", type=int, default=None, metavar="RC",

@@ -359,6 +359,56 @@ def summarize(path: str, top: int = 5) -> dict:
             "recoveries": sum(s["recoveries"] for s in retry_by_site.values()),
             "giveups": sum(s["giveups"] for s in retry_by_site.values()),
         }
+
+    # failover attribution (DESIGN §23): lease.acquired carries the won
+    # term + election wait, distserve.failover.replay the spool-replay
+    # accounting, lease.fenced every fencing event — time-to-takeover
+    # (election wait + replay) and "who fenced whom" read off the trace
+    failover = None
+    acquired = [
+        e for e in events
+        if e.get("ph") == "i" and e.get("name") == "lease.acquired"
+        and isinstance(e.get("args"), dict)
+    ]
+    fenced_ev = [
+        e for e in events
+        if e.get("ph") == "i" and e.get("name") == "lease.fenced"
+        and isinstance(e.get("args"), dict)
+    ]
+    replays = [
+        e for e in events
+        if e.get("ph") == "i" and e.get("name") == "distserve.failover.replay"
+        and isinstance(e.get("args"), dict)
+    ]
+    if acquired or fenced_ev or replays:
+        wait_sec = sum(
+            float(e["args"].get("wait_sec", 0.0)) for e in acquired
+        )
+        replay_sec = sum(
+            float(e["args"].get("takeover_sec", 0.0)) for e in replays
+        )
+        failover = {
+            "terms_won": [int(e["args"].get("term", 0)) for e in acquired],
+            "time_to_takeover_sec": round(wait_sec + replay_sec, 3),
+            "election_wait_sec": round(wait_sec, 3),
+            "epochs_replayed": sum(
+                int(e["args"].get("epochs", 0)) for e in replays
+            ),
+            "windows_replayed": sum(
+                int(e["args"].get("windows", 0)) for e in replays
+            ),
+            "replay_refused": instants.get("distserve.replay.refused", 0),
+            "fencing_events": [
+                {
+                    "fenced_term": int(e["args"].get("term", 0)),
+                    "winner_term": int(e["args"].get("winner_term", 0)),
+                    "winner": e["args"].get("winner", "?"),
+                }
+                for e in fenced_ev
+            ],
+            "partitions": instants.get("serve.host.partition", 0),
+            "partition_heals": instants.get("serve.host.partition_heal", 0),
+        }
     return {
         "path": path,
         "events": len(events),
@@ -382,6 +432,7 @@ def summarize(path: str, top: int = 5) -> dict:
         **({"feed": feed} if feed else {}),
         **({"devprof": devprof} if devprof else {}),
         **({"retries": retries} if retries else {}),
+        **({"failover": failover} if failover else {}),
         **({"blackbox": _blackbox_block(bundle)} if bundle else {}),
     }
 
@@ -494,6 +545,27 @@ def render(s: dict) -> str:
                 f"    {site}: {st['attempts']} retry(ies) "
                 f"({st['backoff_sec']:.3f}s backoff), "
                 f"{st['recoveries']} recovered, {st['giveups']} gave up"
+            )
+    if s.get("failover"):
+        fo = s["failover"]
+        terms = ", ".join(str(t) for t in fo["terms_won"]) or "-"
+        out.append(
+            f"  failover: term(s) {terms} won in "
+            f"{fo['time_to_takeover_sec']:.3f}s "
+            f"({fo['election_wait_sec']:.3f}s election), "
+            f"{fo['epochs_replayed']} epoch(s) -> "
+            f"{fo['windows_replayed']} window(s) replayed, "
+            f"{fo['replay_refused']} refused"
+        )
+        for fe in fo["fencing_events"]:
+            out.append(
+                f"    fenced: term {fe['fenced_term']} lost to term "
+                f"{fe['winner_term']} ({fe['winner']})"
+            )
+        if fo["partitions"] or fo["partition_heals"]:
+            out.append(
+                f"    partitions: {fo['partitions']} parked, "
+                f"{fo['partition_heals']} healed"
             )
     if s.get("blackbox"):
         bb = s["blackbox"]
