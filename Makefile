@@ -5,7 +5,7 @@
 # parallel python process starves the distributed rendezvous tests and
 # fabricates failures.  Run `make lint`, THEN the gate.
 
-.PHONY: lint lint-fast test chaos postmortem servescale
+.PHONY: lint lint-fast test chaos obs postmortem servescale
 
 # Static program-invariant lint (DESIGN §18): abstract-eval traces of
 # the full shipping step grid + the repo registry audit.  No device, no
@@ -28,6 +28,16 @@ test:
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py tests/test_retry.py \
 		tests/test_wal.py tests/test_failover.py -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider
+
+# Observability-plane subset (DESIGN §15/§20/§24): the obs timeline +
+# flight-recorder + device-attribution suites plus the window-lineage /
+# SLO burn-rate suite (lineage record identity under failover replay,
+# ledger chaos, doctor join, burn-rate hysteresis, /metrics parity).
+# Exit-coded for CI; same 1-core caveat as the gate above.
+obs:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_obs.py tests/test_flightrec.py \
+		tests/test_devprof.py tests/test_lineage.py -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
 
 # Multi-host serve scaling acceptance (DESIGN §22): 1-host vs 2-host

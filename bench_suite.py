@@ -3628,9 +3628,13 @@ def bench_failover() -> dict:
        **bit-identical** (VOLATILE-stripped, talkers included) to the
        unkilled control's, zero drops, zero skipped windows, every
        window stamped with exactly one fencing term (control windows
-       term 1, successor windows term 2 — one publisher per term), and
-       **time-to-takeover** (successor start -> last replayed window on
-       disk, election + replay inclusive) is **<= 2x the lease TTL**.
+       term 1, successor windows term 2 — one publisher per term),
+       every replayed window's **lineage record** is identical to the
+       control's outside the volatile term/path stamps (DESIGN §24's
+       replay-identity law) with a gapless successor ledger frontier,
+       and **time-to-takeover** (successor start -> last replayed
+       window on disk, election + replay inclusive) is **<= 2x the
+       lease TTL**.
 
     ``RA_FAILOVER_LINES`` (default 12k; 2 hosts x 4 windows) and
     ``RA_FAILOVER_RATE`` (default 3k lines/s offered PER HOST) size
@@ -3653,8 +3657,13 @@ def bench_failover() -> dict:
     from ruleset_analysis_tpu.runtime import faults
     from ruleset_analysis_tpu.runtime.distserve import DistServeDriver
     from ruleset_analysis_tpu.errors import AnalysisError
-    from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS
+    from ruleset_analysis_tpu.runtime.report import (
+        LINEAGE_VOLATILE,
+        VOLATILE_TOTALS,
+        lineage_frontier,
+    )
     from ruleset_analysis_tpu.runtime.stream import run_stream
+    from ruleset_analysis_tpu.runtime.wal import LineageLog
 
     n_hosts = 2
     windows = 4
@@ -3891,6 +3900,18 @@ def bench_failover() -> dict:
         assert s2["failover"]["replay_windows"] == windows, s2["failover"]
         assert s2["failover"]["replay_refused"] == 0, s2["failover"]
 
+        def lineage_identity(rec: dict) -> dict:
+            # the replay-identity law (DESIGN §24): strip the volatile
+            # fields AND the per-host payload_crc — epoch payloads carry
+            # run-local wall stamps, so the byte CRC is only comparable
+            # within one serve dir, never across the control/failover pair
+            core = {k: v for k, v in rec.items() if k not in LINEAGE_VOLATILE}
+            core["hosts"] = [
+                {k: v for k, v in h.items() if k != "payload_crc"}
+                for h in core["hosts"]
+            ]
+            return core
+
         identical = 0
         for w in range(windows):
             a = read_json(os.path.join(fo_dir, f"window-{w:06d}.json"))
@@ -3905,7 +3926,27 @@ def bench_failover() -> dict:
             assert a.get("talkers") == b.get("talkers"), (
                 f"replayed window {w} talkers diverged"
             )
+            # lineage replay identity: the replayed record is the SAME
+            # deterministic function of the delivered lines, term/path
+            # volatiles aside ("dist", every host's WAL range + drop
+            # counts), and the successor stamps (term 2, path "replay")
+            # against the control's (term 1, "live")
+            la = a["totals"]["lineage"]
+            lb = b["totals"]["lineage"]
+            assert lineage_identity(la) == lineage_identity(lb), (
+                f"replayed window {w} lineage core diverged"
+            )
+            assert la["kind"] == "dist" and len(la["hosts"]) == n_hosts
+            assert (la["term"], la["path"]) == (2, "replay"), la
+            assert (lb["term"], lb["path"]) == (1, "live"), lb
             identical += 1
+        # the successor's ledger frontier is gapless and complete
+        fr = lineage_frontier(
+            LineageLog.read(os.path.join(fo_dir, LineageLog.NAME))
+        )
+        assert fr["windows"] >= windows and fr["gaps"] == [], fr
+        assert fr["last_complete"] == windows - 1, fr
+        assert fr["first_incomplete"] is None, fr
         cum_same = image(
             read_json(os.path.join(fo_dir, "cumulative.json"))
         ) == image(read_json(os.path.join(d, "control", "cumulative.json")))
@@ -3957,6 +3998,332 @@ def bench_failover() -> dict:
                 "zero_skipped_windows": True,
                 "one_publisher_per_term": True,
                 "victim_published_nothing": True,
+                "lineage_replay_identity": True,
+                "lineage_frontier_complete": True,
+            },
+        },
+    }
+
+
+def bench_lineage() -> dict:
+    """Lineage plane + SLO burn-rate overhead & acceptance (DESIGN §24).
+
+    Three legs, one solo-serve corpus, one process (shared jit caches):
+
+    1. **Overhead pairs** — ``RA_LINEAGE_PAIRS`` (default 3) interleaved
+       disarmed/armed runs: disarmed = ``--lineage off``, no ``--slo``,
+       trends off; armed = lineage ledger + sealed records + a 2-objective
+       SLO policy + trend plane.  Both legs are paced identically at
+       ``RA_LINEAGE_RATE`` (default 8k lines/s — under the serve loop's
+       measured 1-core capacity, the servesoak discipline); sustained =
+       lines / (send start -> last window published), so the ratio
+       isolates the armed plane's per-window cost (one canonical-JSON
+       CRC + one O_APPEND write + burn-rate arithmetic) from load noise.
+       Asserted in-bench: **median armed/disarmed sustained ratio >=
+       0.98** (the provenance plane must not tax the hot path).
+    2. **Ledger audit** — after the last armed run: every window file's
+       ``totals.lineage`` equals the ledger record, every seal CRC
+       re-verifies, and the frontier is gapless and complete.
+    3. **Breach + recovery e2e** — a fresh armed run with
+       ``drop_rate<=0.001``: one window with chaos-injected listener
+       drops breaches (fast+slow burn over budget within the rotation),
+       three clean windows recover.  Asserted in-bench: exactly one
+       ``slo.breach`` and one ``slo.recovered`` transition (gauge
+       counters), the breached window's lineage record carries the drop
+       count, and the JSON /metrics SLO + build-info gauges agree with
+       the prom exposition (flat, labeled, and ``ra_build_info``).
+    """
+    import os
+    import socket
+    import tempfile
+    import threading
+
+    import jax
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, ServeConfig
+    from ruleset_analysis_tpu.hostside import aclparse, synth
+    from ruleset_analysis_tpu.hostside import pack as pack_mod
+    from ruleset_analysis_tpu.runtime import faults
+    from ruleset_analysis_tpu.runtime.report import (
+        lineage_frontier, seal_lineage,
+    )
+    from ruleset_analysis_tpu.runtime.serve import ServeDriver
+    from ruleset_analysis_tpu.runtime.stream import run_stream
+    from ruleset_analysis_tpu.runtime.wal import LineageLog
+
+    windows = 3
+    pairs = int(os.environ.get("RA_LINEAGE_PAIRS", "3"))
+    rate = float(os.environ.get("RA_LINEAGE_RATE", "8000"))
+    wl = int(float(os.environ.get("RA_LINEAGE_LINES", "9000"))) // windows
+    total = wl * windows
+    BATCH = 4096
+    SLO = "p99_publish_ms<=60000,drop_rate<=0.5"
+
+    cfg_text = synth.synth_config(n_acls=2, rules_per_acl=10, seed=0)
+    packed = pack_mod.pack_rulesets([aclparse.parse_asa_config(cfg_text, "fw1")])
+    t = _tuples(packed, total, seed=29)
+    lines = synth.render_syslog(packed, t, seed=29)
+
+    def read_json(path):
+        with open(path, "r", encoding="utf-8") as f:
+            return json.load(f)
+
+    def wait_for(pred, timeout, what):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return
+            time.sleep(0.02)
+        raise RuntimeError(f"lineage: timed out waiting for {what}")
+
+    def run_serve(d, name, *, armed, feed_lines, wl, http="off", slo=None):
+        sd = os.path.join(d, name)
+        drv = ServeDriver(
+            os.path.join(d, "rules"),
+            AnalysisConfig(batch_size=BATCH, prefetch_depth=0),
+            ServeConfig(
+                listen=("tcp:127.0.0.1:0",), window_lines=wl,
+                serve_dir=sd, max_windows=0, http=http,
+                checkpoint_every_windows=0, reload_watch=False,
+                queue_lines=1 << 18,
+                lineage=armed,
+                slo=(SLO if slo is None else slo) if armed else "",
+                trend_threshold=4.0 if armed else 0.0,
+            ),
+        )
+        out: dict = {}
+
+        def runner():
+            try:
+                out["summary"] = drv.run()
+            except BaseException as e:
+                out["error"] = e
+
+        th = threading.Thread(target=runner)
+        th.start()
+        wait_for(
+            lambda: out.get("error") or (
+                drv.listeners.listeners and drv.listeners.alive()
+                and (http == "off" or drv.http_address)
+            ),
+            60, f"{name} listener",
+        )
+        if "error" in out:
+            raise RuntimeError(f"lineage: {name} failed: {out['error']}")
+        addr = tuple(drv.listeners.listeners[0].address)
+        t0 = time.perf_counter()
+        s = socket.create_connection(addr)
+        # paced replay (the servesoak discipline): bursts of 500 lines
+        # against the wall clock, so both legs see the same offered rate
+        sent = 0
+        for i in range(0, len(feed_lines), 500):
+            burst = feed_lines[i:i + 500]
+            s.sendall(("\n".join(burst) + "\n").encode())
+            sent += len(burst)
+            lag = sent / rate - (time.perf_counter() - t0)
+            if lag > 0:
+                time.sleep(lag)
+        s.close()
+        want = len(feed_lines) // wl
+        wait_for(
+            lambda: out.get("error") or drv.windows_published >= want,
+            300, f"{name} windows",
+        )
+        if "error" in out:
+            raise RuntimeError(f"lineage: {name} failed: {out['error']}")
+        sustained = len(feed_lines) / max(time.perf_counter() - t0, 1e-6)
+        return drv, th, out, sustained
+
+    def stop(drv, th, out):
+        drv.stop()
+        th.join(timeout=120)
+        if th.is_alive():
+            raise RuntimeError("lineage: serve failed to stop")
+        if "error" in out:
+            raise RuntimeError(f"lineage: {out['error']}")
+        return out["summary"]
+
+    with tempfile.TemporaryDirectory() as d:
+        pack_mod.save_packed(packed, os.path.join(d, "rules"))
+        run_stream(
+            packed, iter(lines[:64]),
+            AnalysisConfig(batch_size=BATCH, prefetch_depth=0),
+        )
+
+        # ---- leg 1: interleaved disarmed/armed overhead pairs ----
+        ratios = []
+        rates: dict = {"disarmed": [], "armed": []}
+        last_armed_dir = None
+        for i in range(pairs):
+            _d, th, out, off_rate = run_serve(
+                d, f"off-{i}", armed=False, feed_lines=lines, wl=wl,
+            )
+            soff = stop(_d, th, out)
+            assert soff["drops"] == 0
+            _a, th, out, on_rate = run_serve(
+                d, f"on-{i}", armed=True, feed_lines=lines, wl=wl,
+            )
+            son = stop(_a, th, out)
+            assert son["drops"] == 0
+            last_armed_dir = os.path.join(d, f"on-{i}")
+            rates["disarmed"].append(round(off_rate, 1))
+            rates["armed"].append(round(on_rate, 1))
+            ratios.append(on_rate / off_rate)
+            log(
+                f"lineage: pair {i}: disarmed {off_rate:,.0f} vs armed "
+                f"{on_rate:,.0f} lines/s (ratio {ratios[-1]:.4f})"
+            )
+        med_ratio = sorted(ratios)[len(ratios) // 2]
+        assert med_ratio >= 0.98, (
+            f"lineage/SLO armed plane costs too much: median sustained "
+            f"ratio {med_ratio:.4f} < 0.98 ({ratios})"
+        )
+
+        # ---- leg 2: ledger audit on the last armed run ----
+        ledger = LineageLog.read(os.path.join(last_armed_dir, LineageLog.NAME))
+        assert len(ledger) == windows, f"ledger holds {len(ledger)} records"
+        for w in range(windows):
+            rep = read_json(
+                os.path.join(last_armed_dir, f"window-{w:06d}.json")
+            )
+            lin = rep["totals"]["lineage"]
+            assert lin == ledger[w], f"window {w} record drifted"
+            assert seal_lineage(dict(lin))["crc"] == lin["crc"]
+            assert lin["path"] == "live" and "incomplete" not in lin
+        fr = lineage_frontier(ledger)
+        assert fr["last_complete"] == windows - 1
+        assert fr["first_incomplete"] is None and fr["gaps"] == []
+
+        # ---- leg 3: provoked breach + recovery, JSON<->prom parity ----
+        import urllib.request
+
+        drv, th, out, _rate = run_serve(
+            d, "breach", armed=True, feed_lines=lines[:wl], wl=wl,
+            http="127.0.0.1:0", slo="drop_rate<=0.001",
+        )
+        try:
+            addr = tuple(drv.listeners.listeners[0].address)
+            wait_for(
+                lambda: out.get("error") or drv.slo.windows_observed >= 1,
+                60, "clean window observed",
+            )
+            # one window with 200 chaos-dropped lines: drop_rate ~6%
+            # >> 0.001 -> fast AND slow burn cross in one rotation
+            with faults.armed(faults.FaultPlan.parse("listener.drop@1:200")):
+                s = socket.create_connection(addr)
+                s.sendall(
+                    ("\n".join(lines[wl:2 * wl + 200]) + "\n").encode()
+                )
+                s.close()
+                wait_for(
+                    lambda: out.get("error")
+                    or drv.slo.windows_observed >= 2,
+                    300, "breach window",
+                )
+            if "error" in out:
+                raise RuntimeError(f"lineage: {out['error']}")
+            assert drv.slo.breaches_total == 1, drv.slo.gauges()
+            assert drv.slo.gauges()["slo_breached"] == 1
+            breach_rec = drv.lineage_record(1)
+            assert breach_rec["hosts"][0]["drops"] == 200, breach_rec
+            # three clean windows: burn_fast falls under 1 -> recovery
+            for w in range(3):
+                s = socket.create_connection(addr)
+                s.sendall(("\n".join(lines[:wl]) + "\n").encode())
+                s.close()
+                wait_for(
+                    lambda: out.get("error")
+                    or drv.slo.windows_observed >= 3 + w,
+                    300, f"recovery window {w}",
+                )
+            assert drv.slo.recoveries_total == 1, drv.slo.gauges()
+            assert drv.slo.gauges()["slo_breached"] == 0
+
+            host, port = drv.http_address
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=10
+            ) as r:
+                mjson = json.load(r)
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics?format=prom", timeout=10
+            ) as r:
+                prom = r.read().decode()
+            prom_vals = {}
+            for line in prom.splitlines():
+                if line and not line.startswith("#") and " " in line:
+                    k, v = line.rsplit(" ", 1)
+                    try:
+                        prom_vals[k] = float(v)
+                    except ValueError:
+                        pass
+            slo_keys = [k for k in mjson if k.startswith("slo_")]
+            assert slo_keys, "no SLO gauges on /metrics"
+            for k in slo_keys:
+                assert prom_vals.get(f"ra_serve_{k}") == float(mjson[k]), (
+                    f"JSON<->prom drift on {k}: "
+                    f"{mjson[k]} vs {prom_vals.get(f'ra_serve_{k}')}"
+                )
+            assert prom_vals.get("ra_serve_lineage_records_total") == float(
+                mjson["lineage_records_total"]
+            )
+            for lk, lv in drv.slo.labeled_gauges()["drop_rate"].items():
+                assert (
+                    prom_vals[f'ra_serve_{lk}{{objective="drop_rate"}}']
+                    == float(lv)
+                ), f"labeled drift on {lk}"
+            bi = mjson["build_info"]
+            assert "ra_build_info{" in prom
+            for k, v in bi.items():
+                assert f'{k}="{v}"' in prom, f"build_info label {k} missing"
+        finally:
+            stop(drv, th, out)
+
+    return {
+        "bench": "lineage",
+        "metric": "lineage_armed_sustained_ratio",
+        "value": round(med_ratio, 4),
+        "unit": "ratio",
+        "vs_baseline": round(med_ratio / 0.98, 4),  # x the floor
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "devices": len(jax.devices()),
+            "pairs": pairs,
+            "windows_per_run": windows,
+            "lines_per_run": total,
+            "offered_rate_lines_per_sec": rate,
+            "slo_policy": SLO,
+            "disarmed_sustained_lines_per_sec": rates["disarmed"],
+            "armed_sustained_lines_per_sec": rates["armed"],
+            "sustained_ratios": [round(r, 4) for r in ratios],
+            "ledger_records_audited": windows,
+            "breach_drop_lines": 200,
+            "breaches_total": 1,
+            "recoveries_total": 1,
+            "method": (
+                "interleaved disarmed/armed pairs replay the same corpus "
+                "through one solo serve process, paced identically at the "
+                "offered rate (sustained = lines / send-start->last-"
+                "window, so the ratio isolates the armed plane's "
+                "per-window cost from load noise); armed adds the sealed "
+                "lineage ledger, a 2-objective SLO burn-rate engine, and "
+                "the trend plane.  The breach leg serves under a "
+                "drop_rate<=0.001 policy and injects exactly 200 "
+                "listener.drop chaos hits into one window (drop_rate "
+                "~6% >> bound; fast+slow burn cross in one rotation), "
+                "then feeds three clean windows for the recovery "
+                "transition; /metrics JSON gauges are compared "
+                "numerically against the prom exposition (flat, "
+                "objective-labeled, and ra_build_info)"
+            ),
+            "guards": {
+                "median_ratio_ge_0_98": True,
+                "ledger_equals_window_files": True,
+                "seal_crcs_verify": True,
+                "frontier_gapless": True,
+                "one_breach_one_recovery": True,
+                "breach_window_lineage_names_drops": True,
+                "json_prom_slo_parity": True,
+                "json_prom_build_info_parity": True,
             },
         },
     }
@@ -3987,6 +4354,7 @@ BENCHES = {
     "tenant": bench_tenant,
     "servescale": bench_servescale,
     "failover": bench_failover,
+    "lineage": bench_lineage,
     "v6": bench_v6,
     "v6recall": bench_v6recall,
 }
@@ -3997,13 +4365,14 @@ BENCHES = {
 #: live-service soaks with sockets + threads), `feedscale` (worker
 #: fleets of spawned processes), `tenant` (17 full serve drivers
 #: with live sockets), `servescale` (three paced multi-process
-#: distributed-serve soaks) and `failover` (four paced supervisor
-#: kill/election soaks) are explicit-only
+#: distributed-serve soaks), `failover` (four paced supervisor
+#: kill/election soaks) and `lineage` (live-socket lineage/SLO
+#: overhead + breach soaks) are explicit-only
 DEFAULT_BENCHES = [
     n for n in BENCHES
     if n not in ("sustained", "servesoak", "autoscale", "feedscale",
                  "retrysoak", "blackbox", "tenant", "servescale",
-                 "failover")
+                 "failover", "lineage")
 ]
 
 

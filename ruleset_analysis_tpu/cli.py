@@ -853,6 +853,9 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             wal_dir=args.wal_dir,
             wal_segment_bytes=args.wal_segment_kb << 10,
             wal_budget_bytes=args.wal_budget_mb << 20,
+            lineage=args.lineage != "off",
+            slo=args.slo,
+            trend_threshold=args.trend_threshold,
         )
         dscfg = None
         if args.distributed:
@@ -1306,8 +1309,14 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
     except (OSError, ValueError) as e:
         print(f"error: unreadable postmortem bundle: {e}", file=sys.stderr)
         return 1
-    diags = flightrec.diagnose(bundle, exit_code=args.exit_code)
+    lpath = getattr(args, "lineage", None) or flightrec.find_lineage(args.bundle)
+    lineage = flightrec.load_lineage(lpath) if lpath else []
+    diags = flightrec.diagnose(
+        bundle, exit_code=args.exit_code, lineage=lineage
+    )
     if args.json:
+        from .runtime.report import lineage_frontier
+
         payload = json_mod.dumps(
             {
                 "trigger": bundle.get("trigger"),
@@ -1318,6 +1327,10 @@ def _cmd_doctor(args: argparse.Namespace) -> int:
                 "error": bundle.get("error"),
                 "error_type": bundle.get("error_type"),
                 "failing_stage": bundle.get("analysis", {}).get("failing_stage"),
+                "lineage_path": lpath,
+                "lineage_frontier": (
+                    lineage_frontier(lineage) if lineage else None
+                ),
                 "diagnosis": diags,
             },
             indent=2,
@@ -1558,6 +1571,12 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--exit-code", type=int, default=None, metavar="RC",
                    help="the run's CLI exit code (default: the code "
                         "recorded in the bundle)")
+    p.add_argument("--lineage", default=None, metavar="PATH",
+                   help="serve dir's lineage.jsonl to join with the "
+                        "bundle (default: auto-detected beside the "
+                        "bundle); the joined diagnosis names the last "
+                        "fully-published window and the first "
+                        "missing/incomplete one")
     p.add_argument("--json", action="store_true")
     p.add_argument("--out", default=None)
     p.set_defaults(fn=_cmd_doctor)
@@ -1719,6 +1738,36 @@ def make_parser() -> argparse.ArgumentParser:
                    help="total on-disk WAL budget; past it the oldest "
                         "segment evicts with its records counted as "
                         "explicit drops at the next resume (default 64)")
+    p.add_argument("--lineage", choices=["on", "off"], default="on",
+                   help="window provenance plane (DESIGN §24, default "
+                        "on): every published window carries a sealed "
+                        "totals.lineage record — contributing hosts with "
+                        "their delivered WAL ranges, drop/quarantine "
+                        "counts, supervisor term, publication path "
+                        "(live/replay/backlog_heal), reload generation, "
+                        "CRC — appended durably to SERVE_DIR/"
+                        "lineage.jsonl and served at /lineage; 'off' "
+                        "drops the plane for benchmarking the overhead")
+    p.add_argument("--slo", default="", metavar="SPEC",
+                   help="SLO burn-rate alerting over published windows "
+                        "(Google SRE fast/slow pairs), e.g. "
+                        "'p99_publish_ms<=500,drop_rate<=0.001': each "
+                        "objective tracks fast(3)/slow(12)-window burn "
+                        "rates; crossing 2x fast AND 1x slow emits a "
+                        "typed slo.breach event (obs instant + metrics "
+                        "JSONL + flight recorder) and slo.recovered "
+                        "after 3 clean windows.  Metrics: "
+                        "p50/p90/p99_publish_ms, drop_rate, "
+                        "incomplete_rate, degraded_subsystems")
+    p.add_argument("--trend-threshold", type=float, default=4.0,
+                   metavar="X",
+                   help="per-rule traffic trend events in diff.json: a "
+                        "rule whose per-line hit rate grows by more "
+                        "than Xx between consecutive windows emits "
+                        "rule_burst, shrinking by Xx emits rule_quiet, "
+                        "with sqrt(X) hysteresis so steady load near "
+                        "the boundary never storms (0 disables; "
+                        "default 4.0)")
     p.add_argument("--mesh", choices=["flat", "hybrid"], default="flat",
                    help="device mesh topology (parallel/mesh.py); "
                         "--distributed requires 'hybrid' (the host tier "

@@ -365,6 +365,23 @@ class ServeConfig:
     #: and evicted-but-unreplayed records surface as explicit, exactly-
     #: counted drops at the next resume (never a silent gap)
     wal_budget_bytes: int = 64 << 20
+    #: window provenance plane (DESIGN §24): every published window
+    #: carries a sealed ``totals.lineage`` record, appends it to
+    #: ``serve_dir/lineage.jsonl``, and serves it on ``/lineage``.  On
+    #: by default — provenance is the audit trail the reports exist for;
+    #: ``--lineage off`` is the disarm knob the overhead bench compares
+    #: against.
+    lineage: bool = True
+    #: SLO policy spec (runtime/metrics.py::SloPolicy), e.g.
+    #: ``"p99_publish_ms<=500,drop_rate<=0.001"``; empty = no SLO
+    #: engine.  Breach/recovery fire on multi-window burn-rate
+    #: transitions, never per-window.
+    slo: str = ""
+    #: per-rule trend hysteresis ratio: a rule's window-over-window hit
+    #: RATE rising past ``threshold``x (or collapsing below 1/x)
+    #: publishes one typed ``rule_burst``/``rule_quiet`` event into
+    #: diff.json + the flight recorder.  Must be > 1; 0 disables.
+    trend_threshold: float = 4.0
 
     def __post_init__(self) -> None:
         if (self.window_lines > 0) == (self.window_sec > 0):
@@ -418,6 +435,17 @@ class ServeConfig:
                 "wal_dir/wal_segment_bytes/wal_budget_bytes require wal=True "
                 "(serve --wal)"
             )
+        if self.trend_threshold != 0 and self.trend_threshold <= 1.0:
+            raise ValueError(
+                "trend_threshold must be > 1 (a multiplicative rate "
+                f"band) or 0 to disable, got {self.trend_threshold}"
+            )
+        if self.slo:
+            # parse errors surface at config time as the documented
+            # ValueError class, not mid-serve
+            from .runtime.metrics import SloPolicy
+
+            SloPolicy.parse(self.slo)
         if self.http != "off":
             host, _, port = self.http.rpartition(":")
             if not host or not port.isdigit():

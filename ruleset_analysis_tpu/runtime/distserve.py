@@ -55,6 +55,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 
 import numpy as np
 
@@ -71,16 +72,20 @@ from . import checkpoint as ckpt
 from . import faults, flightrec, obs, retrypolicy
 from .lease import EpochSpool, SupervisorLease
 from .autoscale import PolicyEngine, host_ladder, render_prom_labeled
-from .metrics import LatencyHistogram
+from .metrics import LatencyHistogram, build_info, render_build_info_prom
 from .serve import (
     ServeDriver, WindowEpoch, WindowRing, _make_http_server,
     _merge_quarantine, merge_register_arrays, zero_arrays,
 )
+from .wal import LineageLog
+from .report import seal_lineage
 
 # ---------------------------------------------------------------------------
 # Host-tier control frames: one length-prefixed frame = u32 LE body
 # length + 1 kind byte + body.  Worker -> rank 0: H(ello, JSON),
-# E(poch, pack_epoch_payload bytes), G(auges, JSON), B(ye, JSON).
+# E(poch, pack_epoch_payload bytes), F (an epoch draining out of the
+# partition backlog at heal — same body as E, lineage path stamp
+# differs), G(auges, JSON), B(ye, JSON).
 # Rank 0 -> worker: R(etire), S(top).  Thread-mode workers skip the
 # socket but run the SAME frames through the same dispatch, so the wire
 # discipline is exercised in-tier, not only in the slow process tests.
@@ -167,6 +172,11 @@ class HostServeDriver(ServeDriver):
     rank 0's merged-ring checkpoint, and a rejoining worker replays its
     WAL tail past ``wal_resume_seq`` (the last seq rank 0 merged).
     """
+
+    #: this tier's lineage records (host-local ledger under host-<r>/);
+    #: the supervisor assembles the authoritative "dist" records from
+    #: the shipped per-epoch extras
+    _lineage_kind = "host"
 
     def __init__(
         self,
@@ -305,6 +315,10 @@ class HostServeDriver(ServeDriver):
                 [int(d), int(s)] for d, s in self._v6_digests.items()
             ],
             "wal_next": int(self._wal_next),
+            # the closed window's inclusive WAL low bound (the next
+            # window is already open here, so _win_wal_lo has advanced):
+            # rank 0 stamps [wal_lo, wal_next) into the dist lineage
+            "wal_lo": int(getattr(self, "_prev_win_wal_lo", 0)),
             "degraded": self.degraded_set(),
         }
         payload = pack_epoch_payload(ep.arrays, extra)
@@ -326,12 +340,14 @@ class HostServeDriver(ServeDriver):
             return
         self._ship_or_park(payload)
 
-    def _ship_attempt(self, payload: bytes) -> None:
+    def _ship_attempt(self, payload: bytes, kind: bytes = b"E") -> None:
         # chaos site: the ship connection fails (severed merge-plane
         # link / partition analog); the retry seam absorbs a transient
-        # burst, exhaustion parks the epoch in the partition backlog
+        # burst, exhaustion parks the epoch in the partition backlog.
+        # b"F" marks an epoch arriving via the backlog-heal drain so
+        # rank 0 can stamp path="backlog_heal" on the window's lineage
         faults.fire("dist.epoch.ship")
-        self._emit(b"E", payload)
+        self._emit(kind, payload)
 
     def _ship_or_park(self, payload: bytes) -> None:
         try:
@@ -349,7 +365,7 @@ class HostServeDriver(ServeDriver):
         latency, never data — zero silent drops on heal."""
         while self._ship_backlog:
             try:
-                self._ship_attempt(self._ship_backlog[0])
+                self._ship_attempt(self._ship_backlog[0], kind=b"F")
             except (AnalysisError, OSError):
                 return  # still partitioned; next tick probes again
             self._ship_backlog.pop(0)
@@ -358,7 +374,13 @@ class HostServeDriver(ServeDriver):
 
     def _publish(self, rep_obj: dict, prev: dict | None, meta: dict) -> None:
         # rank 0 owns publication; the worker keeps only the in-memory
-        # window map (bounded by the ring) as a debug surface
+        # window map (bounded by the ring) as a debug surface.  The
+        # host-tier lineage record still ledgers locally (kind "host",
+        # host-<r>/lineage.jsonl): the doctor joins it against rank 0's
+        # "dist" records when diagnosing which tier lost a window
+        lin = rep_obj.get("totals", {}).get("lineage")
+        if lin is not None:
+            self._lineage_append(lin)
         with self._pub_lock:
             self._published["report"] = rep_obj
             self._window_reports[meta["id"]] = rep_obj
@@ -689,6 +711,11 @@ class DistServeDriver:
         self._accept_thread: threading.Thread | None = None
         self._accept_stop = False
         self._t0 = time.time()
+        # lineage + SLO + trend state (DESIGN §24): the same shared
+        # initializer ServeDriver's ctor calls, so the borrowed
+        # _publish finds identical attributes here.  term resets to 0
+        # (matching the line above); run() overwrites it at lease win
+        self._init_lineage_plane()
         # bind the endpoints HERE, like ServeDriver: a bad --http or
         # --dist-merge-bind port must be the documented clean bind
         # error (exit 2), never a mid-run failure with traffic flowing
@@ -726,6 +753,18 @@ class DistServeDriver:
     _recover = ServeDriver._recover
     degraded_set = ServeDriver.degraded_set
     render_latency_prom = ServeDriver.render_latency_prom
+    _init_lineage_plane = ServeDriver._init_lineage_plane
+    _lineage_append = ServeDriver._lineage_append
+    lineage_record = ServeDriver.lineage_record
+    _observe_slo = ServeDriver._observe_slo
+
+    def lineage_tail(self) -> dict:
+        """The ``/lineage`` view plus the live leadership snapshot: who
+        holds the publication right the records' term stamps refer to."""
+        out = ServeDriver.lineage_tail(self)
+        if self._lease is not None:
+            out["lease"] = self._lease.describe()
+        return out
 
     # -- public control ---------------------------------------------------
     def stop(self) -> None:
@@ -953,6 +992,11 @@ class DistServeDriver:
             "degraded_events_total": self.degraded_events,
             "recovered_events_total": self.recovered_events,
         }
+        if self.scfg.lineage:
+            g["lineage_records_total"] = self.lineage_records_total
+            g["trend_events_total"] = self.trend_events_total
+        if self.slo is not None:
+            g.update(self.slo.gauges())
         g.update(self.failover_gauges())
         g.update(retrypolicy.gauges())
         eng = self._engine
@@ -973,10 +1017,25 @@ class DistServeDriver:
     def _sample_metrics(self) -> dict:
         return {"hosts": self.host_gauges()}
 
+    def build_info_dict(self) -> dict:
+        """Static build identity for ``ra_build_info`` (no ``world``
+        attribute here: the mesh label carries the host-tier width)."""
+        return build_info({
+            "mesh": f"{self.cfg.mesh_shape}/{self.dscfg.hosts}",
+        })
+
     def render_labeled_prom(self) -> str:
         """Host-labeled Prometheus families from the SAME per-host gauge
-        blocks the JSON ``/metrics`` serves (audit_distserve parity)."""
-        return render_prom_labeled(
+        blocks the JSON ``/metrics`` serves (audit_distserve parity),
+        plus the build-info and objective-labeled SLO families every
+        serve tier exports."""
+        out = render_build_info_prom(self.build_info_dict())
+        if self.slo is not None:
+            out += render_prom_labeled(
+                self.slo.labeled_gauges(),
+                prefix="ra_serve_", label="objective",
+            )
+        return out + render_prom_labeled(
             self.host_gauges(), prefix="ra_serve_host_", label="host"
         )
 
@@ -1027,6 +1086,27 @@ class DistServeDriver:
                 self._lease.start_heartbeat(on_fenced=self._on_lease_fenced)
             if self.cfg.resume:
                 self._restore()
+            if scfg.lineage:
+                # rank 0's provenance ledger (DESIGN §24), opened BEFORE
+                # the takeover replay so the successor's replayed
+                # windows ledger here like any live publication
+                lpath = os.path.join(scfg.serve_dir, LineageLog.NAME)
+                if self.cfg.resume:
+                    live = set(self.ring.window_ids())
+                    for r in LineageLog.read(lpath):
+                        if (
+                            r.get("kind") != "merged"
+                            and r.get("window") in live
+                        ):
+                            self._lineage_recent[r["window"]] = r
+                            self.lineage_records_total += 1
+                else:
+                    try:
+                        os.remove(lpath)
+                    except OSError:
+                        pass
+                self._lineage_log = LineageLog(lpath)
+            if self.cfg.resume:
                 self._replay_spools()
             obs.register_sampler("distserve", self.metrics_gauges)
             if self._msock is not None:
@@ -1129,6 +1209,9 @@ class DistServeDriver:
             reload_watch=False,
             views=(),
             wal_dir=os.path.join(host_dir, "wal") if scfg.wal else "",
+            # burn-rate alerting runs at rank 0 over the MERGED windows;
+            # per-host engines would double-fire every breach event
+            slo="",
         )
         with self._lock:
             h = self.hosts.get(rank)
@@ -1241,8 +1324,15 @@ class DistServeDriver:
 
     # -- frame dispatch (worker threads / conn readers) --------------------
     def _on_frame(self, rank: int, kind: bytes, body: bytes) -> None:
-        if kind == b"E":
+        if kind in (b"E", b"F"):
             arrays, extra = unpack_epoch_payload(body)
+            # provenance stamps (DESIGN §24): the CRC is over the exact
+            # shipped payload bytes — the spool holds those same bytes,
+            # so a failover successor's replayed record carries the
+            # identical crc (the replay-identity law, pinned in tests).
+            # b"F" marks arrival via the partition backlog-heal drain
+            extra["payload_crc"] = zlib.crc32(body) & 0xFFFFFFFF
+            extra["healed"] = kind == b"F"
             wid = int(extra["meta"]["id"])
             with self._cond:
                 h = self.hosts[rank]
@@ -1402,6 +1492,8 @@ class DistServeDriver:
         recs: dict[int, tuple[dict, dict]],
         dead: list[int],
         missing: list[int],
+        *,
+        path: str = "live",
     ) -> None:
         self._check_fenced()  # a stale supervisor must never publish
         ranks = sorted(recs)
@@ -1502,6 +1594,52 @@ class DistServeDriver:
                 v6_digests=self._v6_digests,
             )
             rep_obj = json.loads(rep.to_json())
+            if self.scfg.lineage:
+                # the merged window's provenance (DESIGN §24): one entry
+                # per contributing host with its delivered WAL range and
+                # the crc of the exact epoch payload it shipped.  All of
+                # it is a deterministic function of the delivered epochs
+                # — only term/path/published_unix/crc (LINEAGE_VOLATILE)
+                # may differ between a live publish and a failover
+                # successor's replay of the same spooled bytes
+                eff_path = path
+                if eff_path == "live" and any(
+                    recs[r][1].get("healed") for r in ranks
+                ):
+                    eff_path = "backlog_heal"
+                lrec: dict = {
+                    "window": w,
+                    "kind": "dist",
+                    "hosts": [{
+                        "rank": int(r),
+                        "wal_seq_lo": int(recs[r][1].get("wal_lo", 0)),
+                        "wal_seq_hi": int(recs[r][1].get("wal_next", 0)),
+                        "drops": int(
+                            recs[r][1]["meta"].get("drops", 0)
+                        ),
+                        "quarantine_hits": int(sum(
+                            int(row[-1])
+                            for row in recs[r][1].get("quarantine", [])
+                        )),
+                        "payload_crc": int(
+                            recs[r][1].get("payload_crc", 0)
+                        ),
+                    } for r in ranks],
+                    "generation": int(self.reloads),
+                    "term": int(self.term),
+                    "path": eff_path,
+                    "published_unix": round(time.time(), 3),
+                }
+                if dead:
+                    lrec["dead_hosts"] = sorted(dead)
+                if missing:
+                    lrec["missing_hosts"] = sorted(missing)
+                if meta.get("incomplete"):
+                    lrec["incomplete"] = meta["incomplete"]
+                rep_obj["totals"]["lineage"] = seal_lineage(lrec)
+                # merged-K records sealed inside the borrowed _publish
+                # carry the same path stamp
+                self._path = eff_path
             if meta.get("incomplete"):
                 self.cum_incomplete_windows.append(w)
                 for r in meta["incomplete"]["reasons"]:
@@ -1540,6 +1678,12 @@ class DistServeDriver:
                 drops=drops, dead=len(dead), missing=len(missing),
             )
             self._publish(rep_obj, prev, meta)
+            self._path = "live"
+            # burn-rate engine over the MERGED windows (rank 0 has no
+            # per-window ingest->publish histogram, so latency
+            # objectives are host-tier concerns; drop/incomplete/
+            # degraded objectives burn here)
+            self._observe_slo(meta)
             if (
                 self.scfg.checkpoint_every_windows
                 and self.windows_published
@@ -1940,6 +2084,11 @@ class DistServeDriver:
                         })
                         continue
                     epochs += 1
+                    # the spool holds the exact bytes the host shipped
+                    # (or would have shipped), so this crc matches what
+                    # the dead supervisor stamped at live arrival —
+                    # lineage cores come out identical (replay-identity)
+                    extra["payload_crc"] = zlib.crc32(payload) & 0xFFFFFFFF
                     top_by_host[rank] = max(top_by_host.get(rank, -1), wid)
                     # the replayed epoch's WAL cursor supersedes the
                     # checkpointed one: a rejoining host must not replay
@@ -1970,7 +2119,7 @@ class DistServeDriver:
                 if r not in recs and top > w
             )
             self.next_wid = w + 1
-            self._publish_window(w, recs, [], missing)
+            self._publish_window(w, recs, [], missing, path="replay")
             self.replay_windows_total += 1
         obs.instant("distserve.failover.replay", args={
             "frontier": frontier,
@@ -2055,4 +2204,8 @@ class DistServeDriver:
             # wins immediately); a fenced holder leaves lease.json to
             # the winner — release() knows the difference
             self._lease.release()
+        if self._lineage_log is not None:
+            self._lineage_log.sync()
+            self._lineage_log.close()
+            self._lineage_log = None
         obs.unregister_sampler("distserve")

@@ -198,6 +198,14 @@ SITES: dict[str, tuple[str, str]] = {
         "exhaustion parks the epoch in the partition backlog (degraded "
         "``partition:<rank>``) for heal-time reconciliation — the "
         "spooled copy survives either way"),
+    "lineage.append": (
+        "raise", "appending a published window's lineage record to "
+        "lineage.jsonl fails (full volume / fd-revoked analog); the "
+        "append is a CORE publication step — the serve loop aborts "
+        "typed rather than publish a window without provenance, and "
+        "the single-write O_APPEND discipline means the log holds only "
+        "complete records (a torn final line reads as absent, never as "
+        "corruption)"),
 }
 
 

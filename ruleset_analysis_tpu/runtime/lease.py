@@ -255,6 +255,20 @@ class SupervisorLease:
         """Seconds since the last successful renewal."""
         return time.monotonic() - self._last_renew
 
+    def describe(self) -> dict:
+        """One JSON-able snapshot of the leadership state — the lineage
+        plane's ``term``/``path`` stamps and the doctor's postmortem
+        join both read leadership from here rather than re-deriving it
+        from the stamp file (one fencing law, one reader)."""
+        return {
+            "term": int(self.term),
+            "holder": self.holder,
+            "ttl_sec": float(self.ttl),
+            "age_sec": round(self.age(), 3),
+            "fenced": bool(self.fenced),
+            "renews": int(self.renews),
+        }
+
     # -- heartbeat thread -------------------------------------------------
     def start_heartbeat(self, on_fenced=None) -> None:
         """Renew every ``ttl/4`` from a daemon thread; ``on_fenced``

@@ -456,3 +456,264 @@ class Profiler:
                     flush=True,
                 )
         return False
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate engine (DESIGN §24).  Policy objectives are evaluated per
+# published window against the same log2 latency histograms and
+# drop/incomplete/degraded counters the serve drivers already keep —
+# no second measurement path, so the alert and the evidence can never
+# disagree.  Fast/slow window pairs in the Google SRE style: the fast
+# deque catches a sharp regression within a few rotations, the slow
+# deque confirms sustained budget burn, and breach/recover fire only on
+# state TRANSITIONS (hysteresis), never per-window, so a steady bad or
+# steady good service emits nothing.
+# ---------------------------------------------------------------------------
+
+#: Window-stat keys an ``--slo`` objective may bound.  Latency quantiles
+#: come from the per-window ingest->publish histogram (milliseconds);
+#: the rates are per-window fractions in [0, 1]; ``degraded_subsystems``
+#: is the live degraded-set size at rotation.
+SLO_METRICS: tuple[str, ...] = (
+    "p50_publish_ms",
+    "p90_publish_ms",
+    "p99_publish_ms",
+    "drop_rate",
+    "incomplete_rate",
+    "degraded_subsystems",
+)
+
+_SLO_OBJ_RE = None  # compiled lazily; objective grammar: metric<=number
+
+
+class SloPolicy:
+    """Parsed ``--slo`` policy: a list of ``(metric, bound)`` objectives.
+
+    Grammar (one comma-separated spec, whitespace-tolerant)::
+
+        p99_publish_ms<=500,drop_rate<=0.001
+
+    Only ``<=`` bounds: every supported metric is a "smaller is better"
+    quantity, so one comparator keeps the spec unambiguous.  Unknown
+    metric names are a hard :class:`ValueError` at parse time (config
+    validation), never a silently-ignored objective at runtime.
+    """
+
+    def __init__(self, objectives: list[tuple[str, float]]):
+        self.objectives = list(objectives)
+
+    @classmethod
+    def parse(cls, spec: str) -> "SloPolicy":
+        import re
+
+        global _SLO_OBJ_RE
+        if _SLO_OBJ_RE is None:
+            _SLO_OBJ_RE = re.compile(
+                r"^\s*([a-z0-9_]+)\s*<=\s*([0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?)\s*$"
+            )
+        objectives: list[tuple[str, float]] = []
+        seen: set[str] = set()
+        for part in str(spec).split(","):
+            if not part.strip():
+                continue
+            m = _SLO_OBJ_RE.match(part)
+            if m is None:
+                raise ValueError(
+                    f"bad --slo objective {part.strip()!r} "
+                    "(want metric<=number, e.g. p99_publish_ms<=500)"
+                )
+            metric, bound = m.group(1), float(m.group(2))
+            if metric not in SLO_METRICS:
+                raise ValueError(
+                    f"unknown --slo metric {metric!r} "
+                    f"(supported: {', '.join(SLO_METRICS)})"
+                )
+            if metric in seen:
+                raise ValueError(f"duplicate --slo metric {metric!r}")
+            seen.add(metric)
+            objectives.append((metric, bound))
+        if not objectives:
+            raise ValueError("empty --slo spec")
+        return cls(objectives)
+
+
+class SloBurnEngine:
+    """Multi-window burn-rate evaluator over per-window SLO stats.
+
+    Each objective keeps two sliding windows of per-rotation compliance
+    bits: ``fast`` (default 3 rotations) and ``slow`` (default 12).
+    Burn rate = violating fraction / error budget; an objective BREACHES
+    when the fast burn crosses ``fast_burn`` AND the slow burn crosses
+    1.0 (budget fully consumed at the slow horizon), and RECOVERS once
+    the fast burn falls back under 1.0 — i.e. the whole fast window is
+    clean again.  The asymmetric pair is the hysteresis: one bad window
+    alerts within ``fast`` rotations, and recovery needs ``fast``
+    consecutive clean rotations, so the state cannot flap per-window.
+    ``observe`` returns transition events only; gauges stay flat numeric
+    so :func:`autoscale.render_prom` exports them with JSON<->prom
+    parity for free.
+    """
+
+    def __init__(
+        self,
+        policy: SloPolicy,
+        *,
+        fast: int = 3,
+        slow: int = 12,
+        budget: float = 0.01,
+        fast_burn: float = 2.0,
+    ):
+        if fast < 1 or slow < fast:
+            raise ValueError("want 1 <= fast <= slow")
+        self.policy = policy
+        self.fast = int(fast)
+        self.slow = int(slow)
+        self.budget = float(budget)
+        self.fast_burn = float(fast_burn)
+        # per-objective: compliance-bit deque (1 = violated), breached flag
+        self._bits: dict[str, list[int]] = {m: [] for m, _ in policy.objectives}
+        self._breached: dict[str, bool] = {m: False for m, _ in policy.objectives}
+        self._burn: dict[str, tuple[float, float]] = {
+            m: (0.0, 0.0) for m, _ in policy.objectives
+        }
+        self.windows_observed = 0
+        self.breaches_total = 0
+        self.recoveries_total = 0
+
+    def _burn_of(self, bits: list[int], horizon: int) -> float:
+        tail = bits[-horizon:]
+        if not tail:
+            return 0.0
+        return (sum(tail) / len(tail)) / self.budget
+
+    def observe(self, stats: dict) -> list[dict]:
+        """Feed one published window's stats; return transition events.
+
+        Missing stat keys count as compliant (a window with no latency
+        samples cannot violate a latency objective).  Events carry the
+        objective, bound, observed value, and both burn rates — enough
+        for the obs instant / flight-recorder record to stand alone.
+        """
+        self.windows_observed += 1
+        events: list[dict] = []
+        for metric, bound in self.policy.objectives:
+            val = stats.get(metric)
+            violated = 1 if (val is not None and float(val) > bound) else 0
+            bits = self._bits[metric]
+            bits.append(violated)
+            del bits[:-self.slow]
+            bf = self._burn_of(bits, self.fast)
+            bs = self._burn_of(bits, self.slow)
+            self._burn[metric] = (bf, bs)
+            was = self._breached[metric]
+            ev = None
+            if not was and bf >= self.fast_burn and bs >= 1.0:
+                self._breached[metric] = True
+                self.breaches_total += 1
+                ev = "slo.breach"
+            elif was and bf < 1.0:
+                self._breached[metric] = False
+                self.recoveries_total += 1
+                ev = "slo.recovered"
+            if ev is not None:
+                events.append({
+                    "event": ev,
+                    "objective": metric,
+                    "bound": bound,
+                    "value": None if val is None else float(val),
+                    "burn_fast": round(bf, 4),
+                    "burn_slow": round(bs, 4),
+                    "window": stats.get("window"),
+                })
+        return events
+
+    def gauges(self) -> dict:
+        """Flat numeric gauges for the driver ``metrics_gauges`` merge."""
+        g = {
+            "slo_objectives": len(self.policy.objectives),
+            "slo_windows_observed": self.windows_observed,
+            "slo_breached": sum(1 for b in self._breached.values() if b),
+            "slo_breaches_total": self.breaches_total,
+            "slo_recoveries_total": self.recoveries_total,
+        }
+        return g
+
+    def labeled_gauges(self) -> dict[str, dict]:
+        """Per-objective gauge dicts for the labeled prom exposition."""
+        out: dict[str, dict] = {}
+        for metric, bound in self.policy.objectives:
+            bf, bs = self._burn[metric]
+            out[metric] = {
+                "slo_bound": float(bound),
+                "slo_burn_fast": round(bf, 4),
+                "slo_burn_slow": round(bs, 4),
+                "slo_objective_breached": 1 if self._breached[metric] else 0,
+            }
+        return out
+
+
+def window_slo_stats(
+    hist: "LatencyHistogram | None",
+    *,
+    lines: int,
+    drops: int,
+    incomplete: bool,
+    degraded: int,
+    window: int | None = None,
+) -> dict:
+    """One published window's stats in the shape ``SloBurnEngine.observe``
+    and the lineage plane share.  Centralised so solo, tenant, and
+    distributed serve cannot diverge on what "drop rate" means: drops
+    over (delivered lines + drops), i.e. the fraction of offered lines
+    the window lost."""
+    stats: dict = {
+        "drop_rate": (drops / (lines + drops)) if (lines + drops) > 0 else 0.0,
+        "incomplete_rate": 1.0 if incomplete else 0.0,
+        "degraded_subsystems": int(degraded),
+        "window": window,
+    }
+    if hist is not None and hist.count > 0:
+        for p, key in ((0.5, "p50_publish_ms"), (0.9, "p90_publish_ms"),
+                       (0.99, "p99_publish_ms")):
+            q = hist.quantile(p)
+            if q == q and q != float("inf"):  # not NaN / overflow bucket
+                stats[key] = q * 1e3
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# Build-info gauge (ra_build_info): the scrape-side answer to "what
+# binary produced these numbers".  Constant-per-process labels (version,
+# jax version, SIMD kind, mesh topology) with a value of 1, the standard
+# Prometheus build-info idiom; the JSON /metrics variant carries the
+# same dict verbatim and verify/registry.py::audit_observability holds
+# the two renderings to each other.
+# ---------------------------------------------------------------------------
+
+
+def build_info(extra: dict | None = None) -> dict:
+    """Assemble the build-info label dict (all values coerced to str)."""
+    from .. import __version__
+
+    try:
+        import jax
+
+        jax_version = str(jax.__version__)
+    except Exception:  # pragma: no cover - jax is baked into the image
+        jax_version = "unknown"
+    try:
+        from ..hostside import fastparse
+
+        simd = str(fastparse.simd_kind())
+    except Exception:  # pragma: no cover - fastparse probe never raises
+        simd = "unknown"
+    info = {"version": str(__version__), "jax": jax_version, "simd": simd}
+    for k, v in (extra or {}).items():
+        info[str(k)] = str(v)
+    return info
+
+
+def render_build_info_prom(info: dict, *, name: str = "ra_build_info") -> str:
+    """One ``ra_build_info{...} 1`` line from :func:`build_info`'s dict."""
+    body = _prom_labels({k: str(info[k]) for k in info})
+    return f"# TYPE {name} gauge\n{name}{{{body}}} 1\n"

@@ -630,6 +630,19 @@ def metric_event(kind: str, **fields) -> None:
         m.event(kind, fields)
 
 
+def typed_event(kind: str, **fields) -> None:
+    """One typed service event on BOTH planes at once.
+
+    The SLO / lineage / trend emitters (DESIGN §24) publish each event
+    as a trace instant (which the armed flight-recorder tap also
+    captures, so ``slo.breach`` lands in a postmortem ring) AND as a
+    metrics-JSONL event record — one call site, so the two planes can
+    never carry different stories about the same transition.
+    """
+    instant(kind, args=fields)
+    metric_event(kind, **fields)
+
+
 def register_sampler(name: str, fn) -> None:
     """Expose a live gauge callback (``fn() -> dict``) to snapshots.
 

@@ -34,6 +34,9 @@ from ..hostside.pack import PackedRuleset
 #:   autoscale   scale decisions/timings (wall-clock, not answers)
 #:   recovery    elastic re-formation accounting
 #:   devprof     capture-window timings, not answers
+#:   lineage     provenance (term/path/publish stamp vary across
+#:               control-vs-failover republication; its CORE fields
+#:               have their own identity law — lineage_core below)
 VOLATILE_TOTALS = (
     "elapsed_sec",
     "lines_per_sec",
@@ -47,6 +50,7 @@ VOLATILE_TOTALS = (
     "devprof",
     "degraded",
     "latency",
+    "lineage",
 )
 
 
@@ -342,3 +346,149 @@ def check_window_compat(old: dict, new: dict, expect: str) -> None:
                 f"{got[0]}:{got[1]:g}, expected {want[0]}:{want[1]:g} — "
                 "reports from different window lengths are not comparable"
             )
+
+
+# ---------------------------------------------------------------------------
+# Window lineage (DESIGN §24).  A published window's provenance record:
+# who contributed (hosts + delivered WAL seq ranges + loss accounting),
+# which supervisor term published it, and which path it took
+# (live | replay | backlog_heal).  The CORE of the record — everything
+# except HOW/WHEN it was published — is a deterministic function of the
+# delivered lines, so a failover republication must reproduce it
+# bit-for-bit; term/path/publish stamp are the volatile envelope.
+# ---------------------------------------------------------------------------
+
+#: lineage fields that legitimately differ between a live publication
+#: and a failover replay of the SAME window (the replay-identity law
+#: strips exactly these before comparing)
+LINEAGE_VOLATILE = ("term", "path", "published_unix", "crc")
+
+
+def lineage_core(rec: dict) -> dict:
+    """The deterministic core: the record minus its volatile envelope."""
+    return {k: v for k, v in rec.items() if k not in LINEAGE_VOLATILE}
+
+
+def seal_lineage(rec: dict) -> dict:
+    """Stamp ``crc`` = CRC32 of the canonical-JSON core, in place.
+
+    The CRC covers ONLY the core, so replay-identical windows carry
+    identical CRCs even though their term/path differ — one u32 equality
+    is the cheap audit for "same evidence, different publisher".
+    """
+    import zlib
+
+    core = json.dumps(
+        lineage_core(rec), sort_keys=True, separators=(",", ":")
+    ).encode("utf-8")
+    rec["crc"] = zlib.crc32(core) & 0xFFFFFFFF
+    return rec
+
+
+def lineage_frontier(records: list[dict]) -> dict:
+    """The operator's "where did it stop" join (tools/doctor.py).
+
+    From a lineage log: the last window published with COMPLETE evidence
+    (no incomplete marker), the first window that is missing from the
+    log or carries an incomplete marker, and the contiguity gaps — the
+    three facts a postmortem needs before replaying anything.
+    """
+    by_window: dict[int, dict] = {}
+    for r in records:
+        if isinstance(r.get("window"), int) and r.get("kind") != "merged":
+            by_window[r["window"]] = r  # last write wins (replay republish)
+    if not by_window:
+        return {"windows": 0, "last_complete": None, "first_incomplete": None,
+                "gaps": []}
+    ids = sorted(by_window)
+    gaps = [w for w in range(ids[0], ids[-1] + 1) if w not in by_window]
+    last_complete = None
+    first_incomplete = gaps[0] if gaps else None
+    for w in ids:
+        if by_window[w].get("incomplete"):
+            if first_incomplete is None or w < first_incomplete:
+                first_incomplete = w
+        else:
+            last_complete = w
+    return {
+        "windows": len(ids),
+        "last_complete": last_complete,
+        "first_incomplete": first_incomplete,
+        "gaps": gaps,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Per-rule trend events (ROADMAP item 3 pre-work).  A rule whose hit
+# RATE jumps or collapses window-over-window is the churn an operator
+# investigates before citing the report in a deletion decision; the
+# threshold is multiplicative with a minimum-hits floor and the caller
+# keeps a per-rule state dict so a multi-window ramp emits ONE event per
+# transition, never a storm (steady load emits nothing at all).
+# ---------------------------------------------------------------------------
+
+#: below this many hits in BOTH windows a rule's ratio is noise, not a
+#: trend (a 0->3 hop would otherwise read as an infinite burst)
+TREND_MIN_HITS = 32
+
+
+def trend_events(
+    old: dict,
+    new: dict,
+    *,
+    threshold: float,
+    state: dict,
+    min_hits: int = TREND_MIN_HITS,
+) -> list[dict]:
+    """Diff per-rule hit rates between consecutive window reports.
+
+    ``rule_burst``: the new rate exceeds ``threshold`` x the old rate
+    (and the new window has >= ``min_hits`` hits).  ``rule_quiet``: the
+    old rate exceeded the floor and the new rate fell under old /
+    ``threshold``.  Rates normalise by each window's delivered lines, so
+    an ingest lull does not read as every rule going quiet.  ``state``
+    maps rule key -> the last emitted label; an event is returned only
+    on label CHANGE (hysteresis — re-asserting "still bursting" every
+    window is the storm this flag exists to prevent).
+    """
+
+    def load(rep: dict) -> tuple[dict, float]:
+        hits = {
+            (e["firewall"], e["acl"], e["index"]): int(e["hits"])
+            for e in rep.get("per_rule", [])
+        }
+        lines = float(rep.get("totals", {}).get("lines_total") or 0.0)
+        return hits, max(lines, 1.0)
+
+    hits_a, lines_a = load(old)
+    hits_b, lines_b = load(new)
+    key_str = lambda k: f"{k[0]} {k[1]} {k[2]}"  # noqa: E731
+    events: list[dict] = []
+    for k in sorted(set(hits_a) & set(hits_b)):
+        ha, hb = hits_a[k], hits_b[k]
+        ra, rb = ha / lines_a, hb / lines_b
+        label = None
+        if hb >= min_hits and rb > ra * threshold:
+            label = "rule_burst"
+        elif ha >= min_hits and rb < ra / threshold:
+            label = "rule_quiet"
+        ks = key_str(k)
+        prev = state.get(ks)
+        if label is None:
+            # back inside the band: clear the state so a LATER burst of
+            # the same rule is a fresh transition, but emit nothing
+            if prev is not None:
+                state.pop(ks, None)
+            continue
+        if label == prev:
+            continue  # still bursting/quiet: hysteresis swallows it
+        state[ks] = label
+        events.append({
+            "event": label,
+            "rule": ks,
+            "old_hits": ha,
+            "new_hits": hb,
+            "old_rate": round(ra, 9),
+            "new_rate": round(rb, 9),
+        })
+    return events

@@ -62,10 +62,22 @@ from ..models import pipeline
 from ..ops.topk import TopKTracker
 from . import checkpoint as ckpt
 from . import devprof, faults, flightrec, obs, retrypolicy
-from .metrics import LatencyHistogram
-from .wal import DEFAULT_TENANT, WriteAheadLog
-from .autoscale import PolicyEngine, render_prom, world_ladder
-from .report import diff_report_objs
+from .metrics import (
+    LatencyHistogram,
+    SloBurnEngine,
+    SloPolicy,
+    build_info,
+    render_build_info_prom,
+    window_slo_stats,
+)
+from .wal import DEFAULT_TENANT, LineageLog, WriteAheadLog
+from .autoscale import (
+    PolicyEngine,
+    render_prom,
+    render_prom_labeled,
+    world_ladder,
+)
+from .report import diff_report_objs, seal_lineage, trend_events
 
 def merge_register_arrays(items: list[dict[str, np.ndarray]]) -> dict[str, np.ndarray]:
     """Merge K window register images under the _merge_tail laws.
@@ -454,6 +466,33 @@ class ServeDriver:
         self.cum_incomplete_reasons: list[str] = []
         self.cum_incomplete_windows: list[int] = []
         self._t0 = time.time()
+        self._init_lineage_plane()
+
+    def _init_lineage_plane(self) -> None:
+        """Lineage + SLO + trend state shared by every serve driver.
+
+        Split out of ``__init__`` because DistServeDriver is not a
+        subclass — it borrows the publication methods unbound and calls
+        this from its own constructor so ``_publish`` finds the same
+        state on either class.
+        """
+        scfg = self.scfg
+        # publication provenance (DESIGN §24): solo serve has no lease,
+        # so term 0 / path "live" unless a subsystem overrides them
+        self.term = 0
+        self._path = "live"
+        self._generation = 0  # reload/migration generation at rotate
+        self._lineage_log = None  # LineageLog, opened in run()
+        self._lineage_recent: dict[int, dict] = {}  # window id -> record
+        self._lineage_merged: dict[int, dict] = {}  # merged-K k -> record
+        self.lineage_records_total = 0
+        # per-rule trend plane: rule key -> last emitted label
+        self._trend_state: dict[str, str] = {}
+        self.trend_events_total = 0
+        # SLO burn-rate engine (runtime/metrics.py), armed by --slo
+        self.slo = (
+            SloBurnEngine(SloPolicy.parse(scfg.slo)) if scfg.slo else None
+        )
 
     # -- public control surface -----------------------------------------
     def request_reload(self) -> None:
@@ -671,7 +710,25 @@ class ServeDriver:
                 "autoscale_flaps_total": eng.flaps,
                 "autoscale_budget_left": eng.budget_left,
             })
+        # lineage + SLO planes (DESIGN §24): flat numerics, so the prom
+        # gauge render carries them with JSON parity for free
+        if self.scfg.lineage:
+            g["lineage_records_total"] = self.lineage_records_total
+            g["trend_events_total"] = self.trend_events_total
+        if self.slo is not None:
+            g.update(self.slo.gauges())
         return g
+
+    def build_info_dict(self) -> dict:
+        """``ra_build_info`` labels: what binary produced these numbers.
+
+        Served verbatim on JSON ``/metrics`` (``build_info``) and as the
+        standard value-1 labeled gauge on the prom variant; the two are
+        parity-audited (verify/registry.py::audit_observability).
+        """
+        return build_info({
+            "mesh": f"{self.cfg.mesh_shape}/{max(self.world, 1)}",
+        })
 
     def render_latency_prom(self) -> str:
         """Prometheus HISTOGRAM exposition of the cumulative
@@ -683,12 +740,21 @@ class ServeDriver:
     def render_labeled_prom(self) -> str:
         """Labeled Prometheus families appended to ``/metrics?format=prom``.
 
-        The single-host service has none; the distributed rank-0 driver
-        (runtime/distserve.py) overrides this with host-labeled series
-        rendered from the SAME per-host JSON gauge blocks — the parity
-        the registry audit (verify/registry.py::audit_distserve) pins.
+        Every driver exports ``ra_build_info`` and (when ``--slo`` is
+        armed) the per-objective burn-rate series; the distributed
+        rank-0 driver (runtime/distserve.py) extends this with
+        host-labeled series rendered from the SAME per-host JSON gauge
+        blocks — the parity the registry audit
+        (verify/registry.py::audit_distserve) pins.
         """
-        return ""
+        out = render_build_info_prom(self.build_info_dict())
+        if self.slo is not None:
+            out += render_prom_labeled(
+                self.slo.labeled_gauges(),
+                prefix="ra_serve_",
+                label="objective",
+            )
+        return out
 
     # -- report access (HTTP + tests) ------------------------------------
     def published(self, name: str) -> dict | None:
@@ -983,6 +1049,28 @@ class ServeDriver:
                     else self.wal.next_seq
                 )
 
+            if scfg.lineage:
+                # provenance ledger (DESIGN §24): O_APPEND jsonl beside
+                # the window files; opening it is CORE setup — a serve
+                # dir we cannot append lineage to cannot publish
+                lpath = os.path.join(scfg.serve_dir, LineageLog.NAME)
+                if self.cfg.resume:
+                    # repopulate the ring-retained /lineage view from
+                    # the ledger (window reports re-render from epochs;
+                    # provenance re-reads from its own log)
+                    live = set(self.ring.window_ids())
+                    for r in LineageLog.read(lpath):
+                        if r.get("kind") != "merged" and r.get("window") in live:
+                            self._lineage_recent[r["window"]] = r
+                            self.lineage_records_total += 1
+                else:
+                    # fresh (non-resume) run, fresh ledger — the WAL
+                    # reset discipline, applied to provenance
+                    try:
+                        os.remove(lpath)
+                    except OSError:
+                        pass
+                self._lineage_log = LineageLog(lpath)
             obs.register_sampler("listener", self._sample_metrics)
             obs.register_sampler("serve", self.metrics_gauges)
             self.listeners.start()
@@ -1108,6 +1196,13 @@ class ServeDriver:
             self.listeners.alive() == len(self.listeners.listeners)
         )
         self._win_saw_stall = False
+        # lineage (DESIGN §24): the first WAL seq this window can cover;
+        # rotation stamps the exclusive hi bound from the same cursor,
+        # so [lo, hi) is exactly the delivered range.  The previous
+        # window's lo survives one rotation for the _emit_epoch hook,
+        # which runs AFTER the next window opens (distserve ships it)
+        self._prev_win_wal_lo = int(getattr(self, "_win_wal_lo", 0))
+        self._win_wal_lo = int(self._wal_next)
 
     #: receipt stamps retained per window before stride decimation
     _RECEIPT_CAP = 1 << 16
@@ -1234,6 +1329,9 @@ class ServeDriver:
         assert self.wal is not None
         n = 0
         noted = 0  # losses already charged to a window
+        # lineage: windows that rotate DURING replay publish with
+        # path="replay" — same core record, honest envelope
+        self._path = "replay"
         with obs.span("serve.wal.replay", from_seq=self._wal_resume_seq):
             # tenant keys in the records are the tenancy plane's concern
             # (runtime/tenantserve.py); the single-tenant driver replays
@@ -1261,6 +1359,7 @@ class ServeDriver:
                     and self.win_pushed >= self.scfg.window_lines
                 ):
                     self._rotate()
+        self._path = "live"
         self.wal_replayed = n
         if self.wal.replay_lost > noted or self.wal.replay_lost_unknown:
             self._note_wal_loss(
@@ -1412,6 +1511,7 @@ class ServeDriver:
             win_latency = (
                 self._win_lat.summary() if self._win_lat.count else None
             )
+            win_hist = self._win_lat  # survives _begin_window's reset
             meta = self._window_meta(partial=partial)
             arrays = pipeline.state_to_host(self.state)
             ep = WindowEpoch(
@@ -1437,6 +1537,12 @@ class ServeDriver:
                 json.loads(rep.to_json()),
                 strict=meta.get("reloads", 0) == 0 and self.cfg.exact_counts,
             )
+            if self.scfg.lineage:
+                # provenance (DESIGN §24): assembled while the closed
+                # window's WAL cursor + quarantine are still live state
+                rep_obj["totals"]["lineage"] = self._assemble_lineage(
+                    meta, self.win_quarantine
+                )
             if meta.get("incomplete"):
                 self.cum_incomplete_windows.append(meta["id"])
                 for r in meta["incomplete"]["reasons"]:
@@ -1480,11 +1586,105 @@ class ServeDriver:
             # this host's disk
             self._emit_epoch(ep)
             self._publish(rep_obj, prev, meta)
+            self._observe_slo(meta, win_hist)
             if (
                 self.scfg.checkpoint_every_windows
                 and self.windows_published % self.scfg.checkpoint_every_windows == 0
             ):
                 self._save_ring_ckpt()
+
+    #: lineage record kind this driver publishes (HostServeDriver says
+    #: "host"; the distributed supervisor assembles "dist" records of
+    #: its own in runtime/distserve.py)
+    _lineage_kind = "window"
+
+    def _assemble_lineage(self, meta: dict, quarantine: dict) -> dict:
+        """The closed window's sealed provenance record (DESIGN §24).
+
+        Everything except ``term``/``path``/``published_unix``/``crc``
+        is a deterministic function of the delivered lines — the
+        replay-identity law tests pin.
+        """
+        rec: dict = {
+            "window": meta["id"],
+            "kind": self._lineage_kind,
+            "hosts": [{
+                "rank": int(getattr(self, "rank", 0)),
+                "wal_seq_lo": int(self._win_wal_lo),
+                "wal_seq_hi": int(self._wal_next),
+                "drops": int(meta.get("drops", 0)),
+                "quarantine_hits": int(sum(quarantine.values())),
+            }],
+            "generation": int(self.reloads),
+            "term": int(self.term),
+            "path": self._path,
+            "published_unix": round(time.time(), 3),
+        }
+        if meta.get("incomplete"):
+            rec["incomplete"] = meta["incomplete"]
+        return seal_lineage(rec)
+
+    def _lineage_append(self, rec: dict) -> None:
+        """Ledger a publication's lineage record — a CORE step.
+
+        The jsonl append happens BEFORE the window file is written and
+        lets failures propagate typed: a window must never publish
+        without its provenance, and the single-write O_APPEND
+        discipline means the ledger can never hold a torn record
+        (chaos-pinned via the ``lineage.append`` site).
+        """
+        if self._lineage_log is not None:
+            self._lineage_log.append(rec)
+        with self._pub_lock:
+            if rec.get("kind") == "merged":
+                self._lineage_merged[rec["k"]] = rec
+            else:
+                self._lineage_recent[rec["window"]] = rec
+                live = set(self.ring.window_ids())
+                for wid in [
+                    w for w in self._lineage_recent if w not in live
+                ]:
+                    del self._lineage_recent[wid]
+        self.lineage_records_total += 1
+
+    def lineage_tail(self) -> dict:
+        """The ``/lineage`` HTTP view: ring-retained records."""
+        with self._pub_lock:
+            recs = [self._lineage_recent[w] for w in sorted(self._lineage_recent)]
+            merged = [self._lineage_merged[k] for k in sorted(self._lineage_merged)]
+        return {
+            "records": recs,
+            "merged": merged,
+            "records_total": self.lineage_records_total,
+        }
+
+    def lineage_record(self, wid: int) -> dict | None:
+        with self._pub_lock:
+            return self._lineage_recent.get(wid)
+
+    def _observe_slo(self, meta: dict, hist=None) -> None:
+        """Feed one published window to the burn-rate engine (--slo)."""
+        if self.slo is None:
+            return
+        stats = window_slo_stats(
+            hist if (hist is not None and hist.count) else None,
+            lines=int(meta.get("lines", 0)),
+            drops=int(meta.get("drops", 0)),
+            incomplete=bool(meta.get("incomplete")),
+            degraded=len(self.degraded_set()),
+            window=meta.get("id"),
+        )
+        events = self.slo.observe(stats)
+        for ev in events:
+            # typed obs instant (reaches the flight ring via the armed
+            # tap) + metrics-JSONL event: slo.breach / slo.recovered
+            obs.typed_event(ev.pop("event"), **ev)
+        if events:
+            flightrec.cursor(
+                slo_breached=sum(
+                    1 for b in self.slo._breached.values() if b
+                ),
+            )
 
     def _emit_epoch(self, ep: WindowEpoch) -> None:
         """A closed window leaves the driver (no-op hook).
@@ -1510,6 +1710,26 @@ class ServeDriver:
                     prev["totals"].get("window", {}).get("id"),
                     meta["id"],
                 ]
+                if self.scfg.trend_threshold > 0:
+                    # per-rule rate trends with hysteresis: an event only
+                    # on label TRANSITION, so steady load emits nothing
+                    evs = trend_events(
+                        prev, rep_obj,
+                        threshold=self.scfg.trend_threshold,
+                        state=self._trend_state,
+                    )
+                    if evs:
+                        diff_obj["trend_events"] = evs
+                        self.trend_events_total += len(evs)
+                        for ev in evs:
+                            obs.typed_event(ev["event"], **{
+                                k: v for k, v in ev.items() if k != "event"
+                            })
+            # lineage ledger append BEFORE the window file exists: a
+            # window is never published without its provenance record
+            lin = rep_obj.get("totals", {}).get("lineage")
+            if lin is not None:
+                self._lineage_append(lin)
             with self._pub_lock:
                 self._published["report"] = rep_obj
                 self._published["cumulative"] = cum_obj
@@ -1541,15 +1761,31 @@ class ServeDriver:
                 if eps:
                     # serve-thread render: the serve thread is the only
                     # mutator of ring + packed, so no snapshot needed
-                    self._write_json(
-                        f"merged-{k}.json",
-                        self._attach_static(
-                            json.loads(
-                                self._render_merged(eps, self.packed).to_json()
-                            ),
-                            strict=False,
+                    merged_obj = self._attach_static(
+                        json.loads(
+                            self._render_merged(eps, self.packed).to_json()
                         ),
+                        strict=False,
                     )
+                    if self.scfg.lineage:
+                        # merged-K provenance: the parent-window links
+                        # (in-memory + merged JSON only — the jsonl
+                        # ledger stays one record per window)
+                        mrec = seal_lineage({
+                            "window": meta["id"],
+                            "kind": "merged",
+                            "k": k,
+                            "parents": [
+                                ep.meta["id"] for ep in eps
+                            ],
+                            "term": int(self.term),
+                            "path": self._path,
+                            "published_unix": round(time.time(), 3),
+                        })
+                        merged_obj["totals"]["lineage"] = mrec
+                        with self._pub_lock:
+                            self._lineage_merged[k] = mrec
+                    self._write_json(f"merged-{k}.json", merged_obj)
 
     def _render_cumulative(self):
         # rendered only from _publish, AFTER _rotate merged the window's
@@ -2066,6 +2302,10 @@ class ServeDriver:
             self._watch_thread.join(timeout=5.0)
         if self.wal is not None:
             self.wal.close()
+        if self._lineage_log is not None:
+            self._lineage_log.sync()
+            self._lineage_log.close()
+            self._lineage_log = None
         obs.unregister_sampler("listener")
         obs.unregister_sampler("serve")
 
@@ -2211,7 +2451,11 @@ def _make_http_handler():
                             "text/plain; version=0.0.4; charset=utf-8",
                         )
                     return self._send(
-                        200, {**drv._sample_metrics(), **drv.metrics_gauges()}
+                        200, {
+                            **drv._sample_metrics(),
+                            **drv.metrics_gauges(),
+                            "build_info": drv.build_info_dict(),
+                        }
                     )
                 if path == "/report":
                     obj = drv.published("report")
@@ -2265,12 +2509,32 @@ def _make_http_handler():
                     return self._send(200, obj) if obj else self._send(
                         404, {"error": "no windows in the ring"}
                     )
+                if path == "/lineage":
+                    if not drv.scfg.lineage:
+                        return self._send(404, {
+                            "error": "lineage disabled (--lineage off)",
+                        })
+                    return self._send(200, drv.lineage_tail())
+                if path.startswith("/lineage/window/"):
+                    try:
+                        wid = int(path.rsplit("/", 1)[1])
+                    except ValueError:
+                        return self._send(400, {"error": "bad window id"})
+                    obj = drv.lineage_record(wid)
+                    return self._send(200, obj) if obj else self._send(
+                        404, {
+                            "error": f"no lineage for window {wid} in the "
+                            "ring (the full history is lineage.jsonl in "
+                            "the serve dir)",
+                        }
+                    )
                 return self._send(404, {
                     "error": "unknown path",
                     "endpoints": [
                         "/health", "/metrics", "/report",
                         "/report/cumulative", "/report/static",
                         "/report/window/<id>", "/report/merged/<k>", "/diff",
+                        "/lineage", "/lineage/window/<id>",
                     ],
                 })
             except BrokenPipeError:

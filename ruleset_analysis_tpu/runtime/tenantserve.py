@@ -68,8 +68,15 @@ from ..models import pipeline
 from ..ops.topk import TopKTracker
 from . import devprof, faults, flightrec, obs, retrypolicy
 from .autoscale import render_prom, render_prom_labeled
-from .metrics import LatencyHistogram
-from .report import diff_report_objs
+from .metrics import (
+    LatencyHistogram,
+    SloBurnEngine,
+    SloPolicy,
+    build_info,
+    render_build_info_prom,
+    window_slo_stats,
+)
+from .report import diff_report_objs, seal_lineage, trend_events
 from .serve import (
     WindowEpoch,
     WindowRing,
@@ -88,7 +95,7 @@ from .tenancy import (
     TenantTap,
     load_manifest,
 )
-from .wal import WriteAheadLog
+from .wal import LineageLog, WriteAheadLog
 
 
 class _ReloadFlushError(Exception):
@@ -134,6 +141,10 @@ class _Lane:
         self.static_obj: dict | None = None
         self.static_done_t: float | None = None
         self.static_duration = 0.0
+        # lineage + trend planes (DESIGN §24), per lane: ring-retained
+        # provenance records and the per-rule hysteresis labels
+        self.lineage_recent: dict[int, dict] = {}
+        self._trend_state: dict[str, str] = {}
         # window-local fields are (re)set by _begin_window
         self.win_id = 0
         self.next_rotation: float | None = None
@@ -259,6 +270,16 @@ class TenantServeDriver:
         self.wal: WriteAheadLog | None = None
         self.world = 0  # mesh extent, set in run()
         self._t0 = time.time()
+        # lineage + SLO planes (DESIGN §24): no lease on the tenancy
+        # tier, so term stays 0 and every path is "live" (no --resume)
+        self.term = 0
+        self._wal_next = 0  # shared-WAL cursor (record v2, all tenants)
+        self._lineage_log: LineageLog | None = None
+        self.lineage_records_total = 0
+        self.trend_events_total = 0
+        self.slo = (
+            SloBurnEngine(SloPolicy.parse(scfg.slo)) if scfg.slo else None
+        )
 
     # -- public control surface -------------------------------------------
     def request_reload(self, tenant: str | None = None) -> None:
@@ -362,6 +383,15 @@ class TenantServeDriver:
                 # a fresh spool (the record-v2 tenant key is exercised by
                 # the wal-level replay tests)
                 self.wal.reset()
+            if scfg.lineage:
+                # ONE shared provenance ledger; each record carries its
+                # tenant key, mirroring the shared WAL's record-v2 law
+                lpath = os.path.join(scfg.serve_dir, LineageLog.NAME)
+                try:
+                    os.remove(lpath)
+                except OSError:
+                    pass
+                self._lineage_log = LineageLog(lpath)
             obs.register_sampler("listener", self._sample_metrics)
             obs.register_sampler("serve", self.metrics_gauges)
             self.listeners.start()
@@ -491,6 +521,9 @@ class TenantServeDriver:
             self.listeners.alive() == len(self.listeners.listeners)
         )
         lane._win_saw_stall = False
+        # lineage: the shared-WAL cursor when this lane's window opened
+        # (the delivered range is a shared-fate bound, like drops)
+        lane._win_wal_lo = int(self._wal_next)
 
     _RECEIPT_CAP = 4096
 
@@ -637,6 +670,11 @@ class TenantServeDriver:
                 json.loads(rep.to_json()),
                 strict=meta.get("reloads", 0) == 0 and self.cfg.exact_counts,
             )
+            if self.scfg.lineage:
+                rep_obj["totals"]["lineage"] = self._assemble_lineage(
+                    lane, meta
+                )
+            win_hist = lane._win_lat
             if meta.get("incomplete"):
                 lane.cum_incomplete_windows.append(meta["id"])
                 for r in meta["incomplete"]["reasons"]:
@@ -672,6 +710,81 @@ class TenantServeDriver:
                 drops=meta["drops"],
             )
             self._publish(lane, rep_obj, prev, meta)
+            self._observe_slo(lane, meta, win_hist)
+
+    def _assemble_lineage(self, lane: _Lane, meta: dict) -> dict:
+        """One tenant window's sealed provenance record (DESIGN §24).
+
+        ``kind`` is "tenant" and the record carries the tenant key; the
+        WAL range is the SHARED spool's cursor span over the lane's
+        window (record v2 interleaves tenants), so like the drop marker
+        it is a shared-fate bound, not a per-tenant slice.
+        """
+        rec: dict = {
+            "window": meta["id"],
+            "kind": "tenant",
+            "tenant": lane.name,
+            "hosts": [{
+                "rank": 0,
+                "wal_seq_lo": int(getattr(lane, "_win_wal_lo", 0)),
+                "wal_seq_hi": int(self._wal_next),
+                "drops": int(meta.get("drops", 0)),
+                "quarantine_hits": int(sum(lane.win_quarantine.values())),
+            }],
+            "generation": int(lane.reloads),
+            "term": int(self.term),
+            "path": "live",
+            "published_unix": round(time.time(), 3),
+        }
+        if meta.get("incomplete"):
+            rec["incomplete"] = meta["incomplete"]
+        return seal_lineage(rec)
+
+    def _lineage_append(self, lane: _Lane, rec: dict) -> None:
+        """Ledger one lane's record — CORE, same law as serve.py: the
+        jsonl append precedes the window file and failures abort typed
+        (a window must never publish without its provenance)."""
+        if self._lineage_log is not None:
+            self._lineage_log.append(rec)
+        with self._pub_lock:
+            lane.lineage_recent[rec["window"]] = rec
+            live = set(lane.ring.window_ids())
+            for wid in [w for w in lane.lineage_recent if w not in live]:
+                del lane.lineage_recent[wid]
+        self.lineage_records_total += 1
+
+    def lineage_tail(self) -> dict:
+        """The ``/lineage`` HTTP view: ring-retained records per lane."""
+        with self._pub_lock:
+            return {
+                "records_total": self.lineage_records_total,
+                "tenants": {
+                    name: [
+                        lane.lineage_recent[w]
+                        for w in sorted(lane.lineage_recent)
+                    ]
+                    for name, lane in sorted(self.lanes.items())
+                },
+            }
+
+    def _observe_slo(self, lane: _Lane, meta: dict, hist=None) -> None:
+        """Feed one lane's published window to the burn-rate engine.
+
+        ONE engine across tenants (the SLO guards the service, windows
+        arrive interleaved); the breach event names the tenant whose
+        window tripped it."""
+        if self.slo is None:
+            return
+        stats = window_slo_stats(
+            hist if (hist is not None and hist.count) else None,
+            lines=int(meta.get("lines", 0)),
+            drops=int(meta.get("drops", 0)),
+            incomplete=bool(meta.get("incomplete")),
+            degraded=len(self.degraded_set()),
+            window=meta.get("id"),
+        )
+        for ev in self.slo.observe(stats):
+            obs.typed_event(ev.pop("event"), tenant=lane.name, **ev)
 
     def _publish(self, lane: _Lane, rep_obj, prev, meta) -> None:
         with obs.span("serve.publish", window=meta["id"], tenant=lane.name):
@@ -687,6 +800,28 @@ class TenantServeDriver:
                     prev["totals"].get("window", {}).get("id"), meta["id"],
                 ]
                 diff_obj["tenant"] = lane.name
+                if self.scfg.trend_threshold > 0:
+                    # per-rule quiet/burst events, per-lane hysteresis
+                    # state (one tenant's burst never flaps another's)
+                    evs = trend_events(
+                        prev, rep_obj,
+                        threshold=self.scfg.trend_threshold,
+                        state=lane._trend_state,
+                    )
+                    if evs:
+                        diff_obj["trend_events"] = evs
+                        self.trend_events_total += len(evs)
+                        for ev in evs:
+                            obs.typed_event(
+                                ev["event"], tenant=lane.name,
+                                **{
+                                    k: v for k, v in ev.items()
+                                    if k != "event"
+                                },
+                            )
+            lin = rep_obj.get("totals", {}).get("lineage")
+            if lin is not None:
+                self._lineage_append(lane, lin)
             with self._pub_lock:
                 lane.published["report"] = rep_obj
                 lane.published["cumulative"] = cum_obj
@@ -1030,9 +1165,20 @@ class TenantServeDriver:
                 "wal_bytes": w["bytes"],
                 "wal_evicted_records_total": w["evicted_records"],
             })
+        if self.scfg.lineage:
+            g["lineage_records_total"] = self.lineage_records_total
+            g["trend_events_total"] = self.trend_events_total
+        if self.slo is not None:
+            g.update(self.slo.gauges())
         g.update(devprof.gauges())
         g.update(devprof.device_memory_gauges())
         return g
+
+    def build_info_dict(self) -> dict:
+        """Static build identity for ``ra_build_info`` (tenancy tier)."""
+        return build_info({
+            "mesh": f"{self.cfg.mesh_shape}/{max(self.world, 1)}",
+        })
 
     def render_prom_all(self) -> str:
         """The full Prometheus exposition: service gauges, per-tenant
@@ -1040,6 +1186,7 @@ class TenantServeDriver:
         histogram per tenant — every series derives from the same counts
         the JSON endpoint serves (drift-checked by verify/registry.py)."""
         parts = [
+            render_build_info_prom(self.build_info_dict()),
             render_prom(self.metrics_gauges(), prefix="ra_serve_"),
             render_prom_labeled(
                 self.per_tenant_gauges(), prefix="ra_serve_tenant_",
@@ -1047,6 +1194,11 @@ class TenantServeDriver:
             ),
             self.lat_cum.render_prom("ra_serve_ingest_to_publish_seconds"),
         ]
+        if self.slo is not None:
+            parts.append(render_prom_labeled(
+                self.slo.labeled_gauges(),
+                prefix="ra_serve_", label="objective",
+            ))
         for name, lane in sorted(self.lanes.items()):
             parts.append(lane.lat_cum.render_prom(
                 "ra_serve_tenant_ingest_to_publish_seconds",
@@ -1168,6 +1320,10 @@ class TenantServeDriver:
             self._watch_thread.join(timeout=5.0)
         if self.wal is not None:
             self.wal.close()
+        if self._lineage_log is not None:
+            self._lineage_log.sync()
+            self._lineage_log.close()
+            self._lineage_log = None
         obs.unregister_sampler("listener")
         obs.unregister_sampler("serve")
 
@@ -1212,8 +1368,9 @@ class TenantServeDriver:
                 lane = self.lanes[tenant]
                 if self.wal is not None:
                     # durably spool WITH the tenant key (record v2),
-                    # BEFORE window accounting (serve.py discipline)
-                    self.wal.append(body, tenant=tenant)
+                    # BEFORE window accounting (serve.py discipline);
+                    # the cursor feeds the lineage records' WAL range
+                    self._wal_next = self.wal.append(body, tenant=tenant) + 1
                 for ev in lane.batcher.push(body):
                     self._consume_event(lane, ev)
                 self._note_receipt(lane, t_recv)
@@ -1308,9 +1465,16 @@ def _make_tenant_http_handler():
                         **drv.metrics_gauges(),
                         "tenants": drv.per_tenant_gauges(),
                         "fairness": drv.fairness(),
+                        "build_info": drv.build_info_dict(),
                     })
                 if path == "/tenants":
                     return self._send(200, drv.tenants_obj())
+                if path == "/lineage":
+                    if not drv.scfg.lineage:
+                        return self._send(404, {
+                            "error": "lineage disabled (--lineage off)",
+                        })
+                    return self._send(200, drv.lineage_tail())
                 if path.startswith("/t/"):
                     parts = path.split("/")  # /t/<name>/report[...]
                     name = parts[2] if len(parts) > 2 else ""
@@ -1351,13 +1515,26 @@ def _make_tenant_http_handler():
                         return self._send(200, obj) if obj else self._send(
                             404, {"error": f"window {wid} not in the ring"}
                         )
+                    if sub == "lineage":
+                        if not drv.scfg.lineage:
+                            return self._send(404, {
+                                "error": "lineage disabled (--lineage off)",
+                            })
+                        lane = drv.lanes[name]
+                        with drv._pub_lock:
+                            recs = [
+                                lane.lineage_recent[w]
+                                for w in sorted(lane.lineage_recent)
+                            ]
+                        return self._send(200, {"records": recs})
                 return self._send(404, {
                     "error": "unknown path",
                     "endpoints": [
-                        "/health", "/metrics", "/tenants",
+                        "/health", "/metrics", "/tenants", "/lineage",
                         "/t/<name>/report", "/t/<name>/report/cumulative",
                         "/t/<name>/report/static",
                         "/t/<name>/report/window/<id>", "/t/<name>/diff",
+                        "/t/<name>/lineage",
                     ],
                 })
             except BrokenPipeError:

@@ -58,7 +58,7 @@ import struct
 import threading
 import zlib
 
-from ..errors import WalQuarantine
+from ..errors import AnalysisError, WalQuarantine
 
 MAGIC = b"RAWAL1\x00\x00"  # 8 bytes — v1: payload IS the line
 #: v2 (ISSUE 16): payload = u8 tenant-key length | tenant utf-8 | line
@@ -515,3 +515,83 @@ class WriteAheadLog:
                     pass
                 os.close(self._fd)
                 self._fd = None
+
+
+class LineageLog:
+    """Append-only ``lineage.jsonl``: the window provenance ledger.
+
+    One JSON object per published window (DESIGN §24), written with the
+    WAL's own durability idiom — a single ``os.write`` on an O_APPEND fd
+    — so a record is either wholly present (newline-terminated) or not
+    there at all.  A SIGKILL can tear at most the FINAL line, and a torn
+    final line has no trailing newline, so :meth:`read` skips it the
+    same way WAL replay treats a torn tail as a clean end, never as
+    corruption.  Appending is a CORE publication step: it fires the
+    ``lineage.append`` fault site and lets failures propagate typed —
+    a window must never publish without its lineage record, so there is
+    no publisher-style retry/degrade softening here.
+    """
+
+    NAME = "lineage.jsonl"
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fd = os.open(
+            path, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644
+        )
+        self.appended = 0
+
+    def append(self, record: dict) -> None:
+        from . import faults
+        import json
+
+        faults.fire("lineage.append")
+        data = (
+            json.dumps(record, sort_keys=True, separators=(",", ":")) + "\n"
+        ).encode("utf-8")
+        try:
+            with self._lock:
+                os.write(self._fd, data)
+                self.appended += 1
+        except OSError as e:
+            raise AnalysisError(
+                f"lineage append failed for window "
+                f"{record.get('window')}: {e}"
+            ) from e
+
+    def sync(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                try:
+                    os.fsync(self._fd)
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
+    @staticmethod
+    def read(path: str) -> list[dict]:
+        """Parse a lineage log, tolerating (only) a torn final line."""
+        import json
+
+        out: list[dict] = []
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except FileNotFoundError:
+            return out
+        lines = raw.split(b"\n")
+        tail = lines.pop()  # b"" after a complete final record
+        for ln in lines:
+            if not ln.strip():
+                continue
+            out.append(json.loads(ln))  # non-final damage IS corruption
+        if tail.strip():
+            # torn final append: ignore, exactly like the WAL's torn tail
+            pass
+        return out

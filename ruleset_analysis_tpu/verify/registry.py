@@ -373,6 +373,64 @@ def audit_observability(root: str | None = None) -> list[AuditFinding]:
                 "the prom-bucket-derived quantile disagrees with the "
                 "JSON gauge of the same histogram",
             ))
+
+    # -- half 3: build-info + SLO burn-rate parity (ISSUE 19 satellite) --
+    from ..runtime.autoscale import render_prom_labeled
+    from ..runtime.metrics import (
+        SloBurnEngine, SloPolicy, build_info, render_build_info_prom,
+    )
+
+    info = build_info({"mesh": "probe/2"})
+    for key in ("version", "jax", "simd", "mesh"):
+        if not info.get(key):
+            findings.append(AuditFinding(
+                "observability", "build-info-key-missing", key,
+                "build_info() dropped a required label — the "
+                "ra_build_info gauge would not identify the build",
+            ))
+    bi_prom = render_build_info_prom(info)
+    if "ra_build_info{" not in bi_prom or not bi_prom.rstrip().endswith("} 1"):
+        findings.append(AuditFinding(
+            "observability", "build-info-prom-shape", "ra_build_info",
+            "render_build_info_prom() must expose exactly one "
+            "ra_build_info{...} 1 gauge line",
+        ))
+    for k, v in info.items():
+        if f'{k}="{v}"' not in bi_prom:
+            findings.append(AuditFinding(
+                "observability", "build-info-prom-drift", k,
+                "a build_info() JSON label is absent from the "
+                "ra_build_info prom labels — JSON and prom disagree "
+                "about the build identity",
+            ))
+
+    slo = SloBurnEngine(SloPolicy.parse("p99_publish_ms<=500,drop_rate<=0.001"))
+    slo.observe({"p99_publish_ms": 900.0, "drop_rate": 0.5})
+    slo_prom = render_prom(slo.gauges(), prefix="ra_serve_")
+    for key, v in slo.gauges().items():
+        if isinstance(v, (int, float)) and f"ra_serve_{key}" not in slo_prom:
+            findings.append(AuditFinding(
+                "observability", "slo-gauge-prom-drift", key,
+                "a numeric SLO JSON gauge is absent from the prom "
+                "gauge rendering",
+            ))
+    labeled = slo.labeled_gauges()
+    slo_lab_prom = render_prom_labeled(
+        labeled, prefix="ra_serve_", label="objective"
+    )
+    for objective, lg in labeled.items():
+        for key, v in lg.items():
+            if not isinstance(v, (int, float)):
+                continue
+            series = f'ra_serve_{key}{{objective="{objective}"}}'
+            if series not in slo_lab_prom:
+                findings.append(AuditFinding(
+                    "observability", "slo-labeled-prom-drift",
+                    f"{objective}/{key}",
+                    "a per-objective SLO JSON gauge has no "
+                    "objective-labeled prom series — scrapers and the "
+                    "JSON endpoint would disagree",
+                ))
     return findings
 
 
@@ -539,9 +597,27 @@ def audit_distserve(root: str | None = None) -> list[AuditFinding]:
     h1.dead_reason = "audit probe"
     h1.degraded = ["wal"]
     drv.hosts = {0: h0, 1: h1}
+    # lineage/SLO/build-info plane (ISSUE 19): the real render methods
+    # read these — keep in lockstep with DistServeDriver.__init__
+    from types import SimpleNamespace
+
+    from ..runtime.metrics import SloBurnEngine, SloPolicy
+
+    drv.cfg = SimpleNamespace(mesh_shape="hybrid")
+    drv.dscfg = SimpleNamespace(hosts=2)
+    drv.scfg = SimpleNamespace(lineage=True)
+    drv.slo = SloBurnEngine(SloPolicy.parse("drop_rate<=0.001"))
+    drv.lineage_records_total = 3
+    drv.trend_events_total = 1
 
     js = drv.host_gauges()
     prom = drv.render_labeled_prom()
+    if "ra_build_info{" not in prom:
+        findings.append(AuditFinding(
+            "distserve", "build-info-missing", "ra_build_info",
+            "the distributed /metrics prom rendering dropped the "
+            "ra_build_info identity gauge",
+        ))
     if set(js) != {"0", "1"}:
         findings.append(AuditFinding(
             "distserve", "host-block-drift", ",".join(sorted(js)),
@@ -625,6 +701,24 @@ def audit_distserve(root: str | None = None) -> list[AuditFinding]:
                 "distserve", "failover-prom-drift", key,
                 "a failover gauge present in the JSON /metrics block is "
                 "absent from the ra_serve_ Prometheus rendering",
+            ))
+    # lineage + SLO gauges ride the same merged rendering (ISSUE 19)
+    for key, want in (
+        ("lineage_records_total", 3),
+        ("trend_events_total", 1),
+        ("slo_objectives", 1),
+    ):
+        if allg.get(key) != want:
+            findings.append(AuditFinding(
+                "distserve", "lineage-gauge-drift", key,
+                "a lineage/SLO gauge is missing from (or disagrees "
+                "with) the distributed metrics_gauges() merge",
+            ))
+        elif f"ra_serve_{key} {want}" not in prom_all:
+            findings.append(AuditFinding(
+                "distserve", "lineage-prom-drift", key,
+                "a lineage/SLO gauge present in JSON /metrics is absent "
+                "from the ra_serve_ Prometheus rendering",
             ))
     return findings
 

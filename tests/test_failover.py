@@ -69,6 +69,8 @@ from ruleset_analysis_tpu.runtime import checkpoint as ckpt
 from ruleset_analysis_tpu.runtime.distserve import DistServeDriver
 from ruleset_analysis_tpu.runtime.lease import EpochSpool, SupervisorLease
 from ruleset_analysis_tpu.runtime.report import VOLATILE_TOTALS as VOLATILE
+from ruleset_analysis_tpu.runtime.report import lineage_core, lineage_frontier
+from ruleset_analysis_tpu.runtime.wal import LineageLog
 
 
 def image(obj) -> dict:
@@ -387,8 +389,8 @@ def _bare_supervisor(serve_dir, next_wid=0):
     drv.replay_lag_windows = 0
     drv.replay_refused_total = 0
     published = []
-    drv._publish_window = lambda w, recs, dead, missing: published.append(
-        (w, sorted(recs), dead, missing)
+    drv._publish_window = lambda w, recs, dead, missing, path="live": (
+        published.append((w, sorted(recs), dead, missing))
     )
     return drv, published
 
@@ -613,9 +615,57 @@ def test_supervisor_failover_replay_bit_identity(corpus):
         assert b["totals"]["window"]["term"] == 1
         assert a.get("talkers") == b.get("talkers"), f"window {w} talkers"
         assert image(a) == image(b), f"window {w} diverged"
+        # lineage replay-identity law (DESIGN §24): the record is a
+        # deterministic function of the delivered lines, so the
+        # failover-replayed window's core — per-host WAL ranges, drop
+        # counts, host set — is IDENTICAL to the control publication;
+        # only the volatile envelope (term, path, timestamp) moves.
+        # payload_crc covers the exact epoch BYTES (which carry run-local
+        # wall-clock meta), so it is compared within-run below, against
+        # the spool, not across the two runs
+        la, lb = a["totals"]["lineage"], b["totals"]["lineage"]
+
+        def no_crc(rec):
+            core = lineage_core(rec)
+            core["hosts"] = [
+                {k: v for k, v in h.items() if k != "payload_crc"}
+                for h in core["hosts"]
+            ]
+            return core
+
+        assert no_crc(la) == no_crc(lb), f"window {w} lineage"
+        assert la["kind"] == "dist" and len(la["hosts"]) == n_hosts
+        assert all(h["payload_crc"] for h in la["hosts"])
+        assert (la["term"], la["path"]) == (2, "replay")
+        assert (lb["term"], lb["path"]) == (1, "live")
+    # within the failover dir the law is exact: the spool still holds
+    # the bytes the replay consumed, and the stamped payload_crc is
+    # crc32 of exactly those bytes — the same stamp a live E-frame
+    # arrival would have produced for the same payload
+    import zlib as zlib_mod
+    fo_lineage = {
+        r["window"]: r
+        for r in LineageLog.read(os.path.join(fo_dir, LineageLog.NAME))
+    }
+    for r in range(n_hosts):
+        spool = EpochSpool(os.path.join(fo_dir, f"host-{r}", "spool"))
+        try:
+            for _seq, payload in spool.replay(0):
+                arrays, extra = unpack_epoch_payload(payload)
+                wid = int(extra["meta"]["id"])
+                hrec = next(
+                    h for h in fo_lineage[wid]["hosts"] if h["rank"] == r
+                )
+                assert hrec["payload_crc"] == zlib_mod.crc32(payload) & 0xFFFFFFFF
+        finally:
+            spool.close()
     ca = read_json(os.path.join(fo_dir, "cumulative.json"))
     cb = read_json(os.path.join(ctl_dir, "cumulative.json"))
     assert image(ca) == image(cb)
+    # the successor's ledger alone reconstructs the full frontier
+    fr = lineage_frontier(LineageLog.read(os.path.join(fo_dir, LineageLog.NAME)))
+    assert fr["windows"] == windows and fr["last_complete"] == windows - 1
+    assert fr["first_incomplete"] is None and fr["gaps"] == []
 
 
 def test_dual_supervisor_race_fences_the_stale_one(corpus):

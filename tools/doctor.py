@@ -2,7 +2,8 @@
 """Crash-forensics doctor: postmortem bundle + exit code -> diagnosis.
 
 Usage:
-    python tools/doctor.py POSTMORTEM.json [--exit-code RC] [--json]
+    python tools/doctor.py POSTMORTEM.json [--exit-code RC]
+                           [--lineage PATH] [--json]
 
 The standalone twin of ``ruleset-analyze doctor`` (the logic lives in
 ``ruleset_analysis_tpu/runtime/flightrec.py::diagnose``; this wrapper
@@ -37,6 +38,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("bundle", help="postmortem.json, or the blackbox dir")
     ap.add_argument("--exit-code", type=int, default=None, metavar="RC",
                     help="the run's CLI exit code (default: from the bundle)")
+    ap.add_argument("--lineage", default=None, metavar="PATH",
+                    help="serve dir's lineage.jsonl to join with the bundle "
+                         "(default: auto-detected beside the bundle); the "
+                         "joined diagnosis names the last fully-published "
+                         "window and the first missing/incomplete one")
     ap.add_argument("--json", action="store_true", help="machine output")
     args = ap.parse_args(argv)
     try:
@@ -44,8 +50,13 @@ def main(argv: list[str] | None = None) -> int:
     except Exception as e:  # unreadable/foreign file: a clean error line
         print(f"error: unreadable postmortem bundle: {e}", file=sys.stderr)
         return 1
-    diags = flightrec.diagnose(bundle, exit_code=args.exit_code)
+    lpath = args.lineage or flightrec.find_lineage(args.bundle)
+    lineage = flightrec.load_lineage(lpath) if lpath else []
+    diags = flightrec.diagnose(
+        bundle, exit_code=args.exit_code, lineage=lineage
+    )
     if args.json:
+        from ruleset_analysis_tpu.runtime.report import lineage_frontier
         print(json.dumps({
             "trigger": bundle.get("trigger"),
             "exit_code": (
@@ -53,6 +64,8 @@ def main(argv: list[str] | None = None) -> int:
                 else bundle.get("exit_code")
             ),
             "failing_stage": bundle.get("analysis", {}).get("failing_stage"),
+            "lineage_path": lpath,
+            "lineage_frontier": lineage_frontier(lineage) if lineage else None,
             "diagnosis": diags,
         }, indent=2))
     else:

@@ -32,6 +32,10 @@ answers the attribution question directly from the timeline:
   occupancy %, the top stage by time, and the unattributed fraction,
   read from the ``devprof.summary`` instant the capture emits onto the
   obs timeline (the full table lives in the capture's devprof.json).
+- **lineage** — when a ``lineage.jsonl`` window-provenance ledger sits
+  beside the trace (serve runs with ``--lineage on``, DESIGN §24): the
+  record/kind counts, the last fully-published window, the first
+  missing/incomplete one, and any contiguity gaps.
 - **retries** — the transient-fault survival plane (DESIGN §19):
   per-site retry attempts with their summed backoff, recoveries, and
   giveups, from the ``retry.attempt``/``retry.recovered``/
@@ -48,6 +52,7 @@ import argparse
 import collections
 import gzip
 import json
+import os
 import sys
 
 #: span names whose duration IS waiting, reported as stalls not work
@@ -115,6 +120,67 @@ def _blackbox_block(bundle: dict) -> dict:
     }
 
 
+def _lineage_block(path: str) -> dict | None:
+    """Window-provenance summary from a lineage.jsonl beside the trace.
+
+    Serve runs with ``--lineage on`` (the default) append one sealed
+    record per published window to ``serve_dir/lineage.jsonl``; traces
+    and postmortem bundles usually land in (or under) that same dir.
+    Stdlib-only twin of runtime/report.py::lineage_frontier so this
+    tool stays runnable on a box with nothing installed.
+    """
+    d = os.path.dirname(os.path.abspath(path))
+    lpath = None
+    for cand in (d, os.path.dirname(d)):
+        c = os.path.join(cand, "lineage.jsonl")
+        if os.path.isfile(c):
+            lpath = c
+            break
+    if lpath is None:
+        return None
+    by_id: dict[int, dict] = {}
+    kinds: collections.Counter = collections.Counter()
+    paths: collections.Counter = collections.Counter()
+    try:
+        with open(lpath, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue  # torn final line after a crash is legal
+                kinds[str(r.get("kind"))] += 1
+                paths[str(r.get("path"))] += 1
+                if r.get("kind") != "merged" and r.get("window") is not None:
+                    by_id[int(r["window"])] = r  # last write wins
+    except OSError:
+        return None
+    ids = sorted(by_id)
+    gaps = (
+        [w for w in range(ids[0], ids[-1] + 1) if w not in by_id]
+        if ids else []
+    )
+    last_complete = None
+    first_incomplete = gaps[0] if gaps else None
+    for wid in ids:
+        if by_id[wid].get("incomplete"):
+            if first_incomplete is None or wid < first_incomplete:
+                first_incomplete = wid
+        else:
+            last_complete = wid
+    return {
+        "path": lpath,
+        "records": sum(kinds.values()),
+        "kinds": dict(kinds),
+        "paths": dict(paths),
+        "last_complete": last_complete,
+        "first_incomplete": first_incomplete,
+        "gaps": gaps[:8],
+    }
+
+
 def summarize(path: str, top: int = 5) -> dict:
     """Machine-readable attribution for one merged trace file."""
     events, bundle = _load_events(path)
@@ -135,6 +201,7 @@ def summarize(path: str, top: int = 5) -> dict:
             "top_stalls": [],
             "instants": dict(instants),
             **({"blackbox": _blackbox_block(bundle)} if bundle else {}),
+            **({"lineage": lb} if (lb := _lineage_block(path)) else {}),
         }
     t_min = min(e["ts"] for e in spans)
     t_max = max(e["ts"] + e.get("dur", 0) for e in spans)
@@ -434,6 +501,7 @@ def summarize(path: str, top: int = 5) -> dict:
         **({"retries": retries} if retries else {}),
         **({"failover": failover} if failover else {}),
         **({"blackbox": _blackbox_block(bundle)} if bundle else {}),
+        **({"lineage": lb} if (lb := _lineage_block(path)) else {}),
     }
 
 
@@ -596,6 +664,24 @@ def render(s: dict) -> str:
                 out.append(f"      cursors: {cur}")
         if bb.get("degraded"):
             out.append(f"    degraded: {'; '.join(bb['degraded'])}")
+    if s.get("lineage"):
+        ln = s["lineage"]
+        kinds = ", ".join(f"{k} x{v}" for k, v in sorted(ln["kinds"].items()))
+        out.append(
+            f"  lineage: {ln['records']} record(s) in {ln['path']} ({kinds})"
+        )
+        out.append(
+            f"    last complete window: {ln['last_complete']}   "
+            f"first missing/incomplete: {ln['first_incomplete']}"
+        )
+        if ln["gaps"]:
+            out.append(f"    gap window id(s): {ln['gaps']}")
+        off_live = {
+            k: v for k, v in ln["paths"].items() if k not in ("live", "None")
+        }
+        if off_live:
+            alt = ", ".join(f"{k} x{v}" for k, v in sorted(off_live.items()))
+            out.append(f"    non-live publication paths: {alt}")
     if s["instants"]:
         marks = ", ".join(f"{k} x{v}" for k, v in sorted(s["instants"].items()))
         out.append(f"  instants: {marks}")
