@@ -5,7 +5,7 @@
 # parallel python process starves the distributed rendezvous tests and
 # fabricates failures.  Run `make lint`, THEN the gate.
 
-.PHONY: lint lint-fast test chaos obs postmortem servescale
+.PHONY: lint lint-fast test chaos obs postmortem servescale epochstore
 
 # Static program-invariant lint (DESIGN §18): abstract-eval traces of
 # the full shipping step grid + the repo registry audit.  No device, no
@@ -48,6 +48,15 @@ obs:
 # never run concurrently with the tier-1 gate.
 servescale:
 	JAX_PLATFORMS=cpu python bench_suite.py servescale
+
+# Durable epoch-store acceptance (DESIGN §25): segment-tree range
+# queries >= 10x a naive linear fold and bit-identical to it, the
+# spill-armed serve within 2% of disarmed, and a mid-compaction crash
+# leaving a readable store with zero lost epochs.  Writes the
+# EPOCHSTORE_r22_cpu.json evidence artifact shape.  Same 1-core caveat:
+# never run concurrently with the tier-1 gate.
+epochstore:
+	JAX_PLATFORMS=cpu python bench_suite.py epochstore
 
 # Doctor acceptance path (DESIGN §20): chaos-killed runs must leave a
 # complete postmortem bundle the doctor can diagnose (failing stage +

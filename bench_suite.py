@@ -4329,6 +4329,397 @@ def bench_lineage() -> dict:
     }
 
 
+def bench_epochstore() -> dict:
+    """Durable epoch store (DESIGN §25): query speedup, spill tax, crash.
+
+    Three legs, the ISSUE 20 acceptance artifact:
+
+    1. **Range-query speedup** — ``RA_EPOCHSTORE_EPOCHS`` (default 512)
+       synthetic epochs spilled through the production spill/compact
+       path, then random ``[t0,t1]`` queries spanning >= 256 epochs
+       answered twice: the segment-tree decomposition (<= 2 log n stored
+       aggregates + one merge fold) vs the naive linear L0 fold.
+       Asserted in-bench: **median speedup >= 10x** and every query pair
+       **bit-identical** (registers, tracker tables, accounting).
+       Compaction throughput (spills/s through the binary-counter
+       promote) rides along in the detail.
+    2. **Spill overhead pairs** — ``RA_EPOCHSTORE_PAIRS`` (default 3)
+       interleaved disarmed/armed solo-serve runs over one corpus, paced
+       identically at ``RA_EPOCHSTORE_RATE`` (default 8k lines/s, the
+       servesoak discipline); armed = ``--epoch-store`` spilling every
+       rotation.  Asserted in-bench: **median armed/disarmed sustained
+       ratio >= 0.98**, and the last armed run's ``/report/range`` over
+       the full span answers complete with the corpus line total.
+    3. **Compaction crash** — a child process spills epochs under an
+       armed ``epochstore.compact`` crash plan (os._exit at the worst
+       instant: pair chosen, merged node unwritten).  Asserted in-bench:
+       the reopened store is readable, holds **every epoch whose spill
+       started** (zero lost), repair restores the level invariant, and
+       the full-span tree fold still equals the linear fold bit for bit.
+    """
+    import os
+    import socket
+    import subprocess
+    import tempfile
+    import textwrap
+    import threading
+    import urllib.request
+
+    import jax
+    import numpy as np
+
+    from ruleset_analysis_tpu.config import AnalysisConfig, ServeConfig
+    from ruleset_analysis_tpu.hostside import aclparse, synth
+    from ruleset_analysis_tpu.hostside import pack as pack_mod
+    from ruleset_analysis_tpu.runtime import epochstore
+    from ruleset_analysis_tpu.runtime.serve import ServeDriver
+    from ruleset_analysis_tpu.runtime.stream import run_stream
+
+    n_epochs = int(os.environ.get("RA_EPOCHSTORE_EPOCHS", "512"))
+    assert n_epochs >= 512, "leg 1 needs >= 512 epochs for 256-wide spans"
+    n_queries = 16
+    pairs = int(os.environ.get("RA_EPOCHSTORE_PAIRS", "3"))
+    rate = float(os.environ.get("RA_EPOCHSTORE_RATE", "8000"))
+    windows = 3
+    wl = int(float(os.environ.get("RA_EPOCHSTORE_LINES", "9000"))) // windows
+    BATCH = 4096
+
+    def synth_epoch(wid: int):
+        rng = np.random.default_rng(wid)
+
+        class _Ep:
+            arrays = {
+                "counts_lo": rng.integers(0, 2**32, 1024, dtype=np.uint32),
+                "counts_hi": rng.integers(0, 3, 1024, dtype=np.uint32),
+                "cms": rng.integers(0, 2**32, (4, 1024), dtype=np.uint32),
+                "hll": rng.integers(0, 30, (256, 8), dtype=np.uint32),
+                "talk_cms": rng.integers(
+                    0, 2**32, (4, 1024), dtype=np.uint32
+                ),
+            }
+            meta = {
+                "id": wid, "lines": 1000 + wid, "parsed": 990,
+                "skipped": 10, "chunks": 2, "drops": 0,
+                "started_unix": 10.0 + wid, "ended_unix": 11.0 + wid,
+            }
+            tracker_tables = {
+                int(a): {
+                    int(s): int(e) for s, e in zip(
+                        rng.integers(0, 2**32, 8),
+                        rng.integers(1, 10_000, 8),
+                    )
+                } for a in range(2)
+            }
+            quarantine = {}
+
+        return _Ep()
+
+    def agg_equal(a, b):
+        return (
+            all(np.array_equal(a.arrays[k], b.arrays[k]) for k in a.arrays)
+            and a.tables == b.tables and a.summary == b.summary
+            and a.quarantine == b.quarantine
+        )
+
+    results: dict = {}
+    with tempfile.TemporaryDirectory() as d:
+        # ---- leg 1: tree vs naive fold over a 512-epoch store ----
+        store = epochstore.EpochStore(
+            os.path.join(d, "estore"), budget_bytes=256 << 20
+        )
+        store.bind_base(0)
+        t0 = time.perf_counter()
+        for wid in range(n_epochs):
+            store.spill(synth_epoch(wid))
+        build_sec = time.perf_counter() - t0
+        rng = np.random.default_rng(7)
+        speedups, q_tree_ms, q_naive_ms = [], [], []
+        for _ in range(n_queries):
+            span = int(rng.integers(256, n_epochs))
+            lo = int(rng.integers(0, n_epochs - span))
+            hi = lo + span - 1
+            t1 = time.perf_counter()
+            agg, marker = store.range_agg(lo, hi)
+            t2 = time.perf_counter()
+            ref, nmarker = store.naive_range_agg(lo, hi)
+            t3 = time.perf_counter()
+            assert marker is None and nmarker is None, (marker, nmarker)
+            assert agg_equal(agg, ref), f"fold drift on [{lo},{hi}]"
+            q_tree_ms.append((t2 - t1) * 1e3)
+            q_naive_ms.append((t3 - t2) * 1e3)
+            speedups.append((t3 - t2) / max(t2 - t1, 1e-9))
+        med_speedup = sorted(speedups)[len(speedups) // 2]
+        assert med_speedup >= 10.0, (
+            f"segment-tree range query only {med_speedup:.1f}x over the "
+            f"naive linear fold (need >= 10x): {speedups}"
+        )
+        depth = store.stats()["depth"]
+        store.close()
+        log(
+            f"epochstore: {n_epochs} epochs, depth {depth}: median query "
+            f"{sorted(q_tree_ms)[n_queries // 2]:.2f} ms vs naive "
+            f"{sorted(q_naive_ms)[n_queries // 2]:.1f} ms "
+            f"({med_speedup:.1f}x); build {n_epochs / build_sec:,.0f} "
+            f"spills/s"
+        )
+        results["leg1"] = {
+            "epochs": n_epochs,
+            "tree_depth": depth,
+            "queries": n_queries,
+            "query_tree_ms": [round(x, 3) for x in sorted(q_tree_ms)],
+            "query_naive_ms": [round(x, 2) for x in sorted(q_naive_ms)],
+            "median_speedup": round(med_speedup, 1),
+            "compaction_spills_per_sec": round(n_epochs / build_sec, 1),
+        }
+
+        # ---- leg 2: spill-armed vs disarmed serve pairs ----
+        cfg_text = synth.synth_config(n_acls=2, rules_per_acl=10, seed=0)
+        packed = pack_mod.pack_rulesets(
+            [aclparse.parse_asa_config(cfg_text, "fw1")]
+        )
+        t = _tuples(packed, wl * windows, seed=31)
+        lines = synth.render_syslog(packed, t, seed=31)
+        pack_mod.save_packed(packed, os.path.join(d, "rules"))
+        run_stream(
+            packed, iter(lines[:64]),
+            AnalysisConfig(batch_size=BATCH, prefetch_depth=0),
+        )
+
+        def wait_for(pred, timeout, what):
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                if pred():
+                    return
+                time.sleep(0.02)
+            raise RuntimeError(f"epochstore: timed out waiting for {what}")
+
+        def run_serve(name, *, armed, http="off"):
+            sd = os.path.join(d, name)
+            drv = ServeDriver(
+                os.path.join(d, "rules"),
+                AnalysisConfig(batch_size=BATCH, prefetch_depth=0),
+                ServeConfig(
+                    listen=("tcp:127.0.0.1:0",), window_lines=wl,
+                    serve_dir=sd, max_windows=0, http=http,
+                    checkpoint_every_windows=0, reload_watch=False,
+                    queue_lines=1 << 18,
+                    epoch_store=(
+                        os.path.join(sd, "estore") if armed else ""
+                    ),
+                ),
+            )
+            out: dict = {}
+
+            def runner():
+                try:
+                    out["summary"] = drv.run()
+                except BaseException as e:
+                    out["error"] = e
+
+            th = threading.Thread(target=runner)
+            th.start()
+            wait_for(
+                lambda: out.get("error") or (
+                    drv.listeners.listeners and drv.listeners.alive()
+                    and (http == "off" or drv.http_address)
+                ),
+                60, f"{name} listener",
+            )
+            if "error" in out:
+                raise RuntimeError(f"epochstore: {name}: {out['error']}")
+            addr = tuple(drv.listeners.listeners[0].address)
+            t0 = time.perf_counter()
+            s = socket.create_connection(addr)
+            sent = 0
+            for i in range(0, len(lines), 500):
+                burst = lines[i:i + 500]
+                s.sendall(("\n".join(burst) + "\n").encode())
+                sent += len(burst)
+                lag = sent / rate - (time.perf_counter() - t0)
+                if lag > 0:
+                    time.sleep(lag)
+            s.close()
+            wait_for(
+                lambda: out.get("error")
+                or drv.windows_published >= windows,
+                300, f"{name} windows",
+            )
+            if "error" in out:
+                raise RuntimeError(f"epochstore: {name}: {out['error']}")
+            sustained = len(lines) / max(time.perf_counter() - t0, 1e-6)
+            return drv, th, out, sustained
+
+        def stop(drv, th, out):
+            drv.stop()
+            th.join(timeout=120)
+            if th.is_alive():
+                raise RuntimeError("epochstore: serve failed to stop")
+            if "error" in out:
+                raise RuntimeError(f"epochstore: {out['error']}")
+            return out["summary"]
+
+        ratios = []
+        rates: dict = {"disarmed": [], "armed": []}
+        for i in range(pairs):
+            last = i == pairs - 1
+            drv, th, out, off_rate = run_serve(f"off-{i}", armed=False)
+            soff = stop(drv, th, out)
+            assert soff["drops"] == 0
+            drv, th, out, on_rate = run_serve(
+                f"on-{i}", armed=True,
+                http="127.0.0.1:0" if last else "off",
+            )
+            if last:
+                # the armed plane must ANSWER, not just keep up: the
+                # full-span range report equals the corpus totals
+                host, port = drv.http_address
+                with urllib.request.urlopen(
+                    f"http://{host}:{port}/report/range?from=0"
+                    f"&to={windows - 1}", timeout=10,
+                ) as r:
+                    rng_rep = json.load(r)
+                assert "range_incomplete" not in rng_rep, rng_rep
+                tot = rng_rep["totals"]
+                assert tot["lines_total"] == len(lines), tot
+                assert tot["window"]["windows"] == windows, tot
+            son = stop(drv, th, out)
+            assert son["drops"] == 0
+            assert son["epoch_store"]["epochs"] == windows, (
+                son["epoch_store"]
+            )
+            rates["disarmed"].append(round(off_rate, 1))
+            rates["armed"].append(round(on_rate, 1))
+            ratios.append(on_rate / off_rate)
+            log(
+                f"epochstore: pair {i}: disarmed {off_rate:,.0f} vs "
+                f"armed {on_rate:,.0f} lines/s (ratio {ratios[-1]:.4f})"
+            )
+        med_ratio = sorted(ratios)[len(ratios) // 2]
+        assert med_ratio >= 0.98, (
+            f"epoch-store spill taxes the hot path: median sustained "
+            f"ratio {med_ratio:.4f} < 0.98 ({ratios})"
+        )
+        results["leg2"] = {
+            "pairs": pairs,
+            "windows_per_run": windows,
+            "lines_per_run": len(lines),
+            "offered_rate_lines_per_sec": rate,
+            "disarmed_sustained_lines_per_sec": rates["disarmed"],
+            "armed_sustained_lines_per_sec": rates["armed"],
+            "sustained_ratios": [round(r, 4) for r in ratios],
+            "median_ratio": round(med_ratio, 4),
+        }
+
+        # ---- leg 3: crash mid-compaction, reopen, zero lost epochs ----
+        crash_dir = os.path.join(d, "crash-estore")
+        child = textwrap.dedent("""
+            import sys
+            import numpy as np
+            from ruleset_analysis_tpu.runtime import epochstore
+
+            store = epochstore.EpochStore(sys.argv[1])
+            store.bind_base(0)
+            for wid in range(32):
+                rng = np.random.default_rng(wid)
+
+                class _Ep:
+                    arrays = {
+                        "counts_lo": rng.integers(
+                            0, 2**32, 64, dtype=np.uint32),
+                        "counts_hi": np.zeros(64, dtype=np.uint32),
+                        "cms": rng.integers(
+                            0, 2**32, (2, 64), dtype=np.uint32),
+                        "hll": rng.integers(
+                            0, 30, (32, 4), dtype=np.uint32),
+                        "talk_cms": rng.integers(
+                            0, 2**32, (2, 64), dtype=np.uint32),
+                    }
+                    meta = {
+                        "id": wid, "lines": 100, "parsed": 100,
+                        "skipped": 0, "chunks": 1, "drops": 0,
+                        "started_unix": 1.0 + wid,
+                        "ended_unix": 2.0 + wid,
+                    }
+                    tracker_tables = {0: {wid: wid + 1}}
+                    quarantine = {}
+
+                store.spill(_Ep())
+                print(wid, flush=True)
+        """)
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            RA_FAULT_PLAN="epochstore.compact@6",
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", child, crash_dir],
+            capture_output=True, text=True, timeout=300,
+            cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        )
+        assert proc.returncode != 0, "crash plan never fired"
+        done = [int(x) for x in proc.stdout.split()]
+        assert done, f"child crashed before any spill: {proc.stderr[-500:]}"
+        survivor = epochstore.EpochStore(crash_dir)
+        st = survivor.stats()
+        # the crash fires INSIDE a spill's promote, after its L0 append:
+        # every started spill is on disk — completed prints + the victim
+        assert st["epochs"] == len(done) + 1, (st, done)
+        hi = st["epochs"] - 1
+        agg, marker = survivor.range_agg(0, hi)
+        ref, nmarker = survivor.naive_range_agg(0, hi)
+        assert marker is None and nmarker is None, (marker, nmarker)
+        assert agg_equal(agg, ref), "post-crash fold drift"
+        assert agg.summary["windows"] == st["epochs"]
+        survivor.close()
+        log(
+            f"epochstore: crash leg: {len(done)} spills acked, "
+            f"{st['epochs']} epochs survive, repair ok, fold identical"
+        )
+        results["leg3"] = {
+            "spills_acked_before_crash": len(done),
+            "epochs_after_reopen": st["epochs"],
+            "fault_plan": "epochstore.compact@6",
+            "holes_after_repair": st["holes_total"],
+        }
+
+    return {
+        "bench": "epochstore",
+        "metric": "range_query_median_speedup",
+        "value": results["leg1"]["median_speedup"],
+        "unit": "x_vs_naive_fold",
+        "vs_baseline": round(results["leg1"]["median_speedup"] / 10.0, 2),
+        "detail": {
+            "platform": jax.devices()[0].platform,
+            "devices": len(jax.devices()),
+            **results["leg1"],
+            "spill_overhead": results["leg2"],
+            "crash": results["leg3"],
+            "method": (
+                "leg 1 spills synthetic epochs through the production "
+                "spill/compact path and answers random >=256-wide "
+                "ranges twice — the segment-tree decomposition vs the "
+                "naive linear L0 fold — timing both and comparing "
+                "registers, tracker tables, and accounting bit for bit; "
+                "leg 2 interleaves disarmed/armed paced solo-serve "
+                "runs over one corpus (sustained = lines / send-start->"
+                "last-window) and reads /report/range over the full "
+                "span from the last armed run; leg 3 crashes a child "
+                "process mid-compaction (pair chosen, merged node "
+                "unwritten) and reopens the store"
+            ),
+            "guards": {
+                "median_speedup_ge_10x": True,
+                "tree_fold_bit_identical_to_naive": True,
+                "median_armed_ratio_ge_0_98": True,
+                "range_report_complete_over_full_span": True,
+                "zero_drops_both_legs": True,
+                "crash_store_readable": True,
+                "zero_lost_epochs_after_crash": True,
+                "post_crash_fold_bit_identical": True,
+            },
+        },
+    }
+
+
 BENCHES = {
     "stage": bench_stage,
     "exact": bench_exact,
@@ -4355,6 +4746,7 @@ BENCHES = {
     "servescale": bench_servescale,
     "failover": bench_failover,
     "lineage": bench_lineage,
+    "epochstore": bench_epochstore,
     "v6": bench_v6,
     "v6recall": bench_v6recall,
 }
@@ -4366,13 +4758,14 @@ BENCHES = {
 #: fleets of spawned processes), `tenant` (17 full serve drivers
 #: with live sockets), `servescale` (three paced multi-process
 #: distributed-serve soaks), `failover` (four paced supervisor
-#: kill/election soaks) and `lineage` (live-socket lineage/SLO
-#: overhead + breach soaks) are explicit-only
+#: kill/election soaks), `lineage` (live-socket lineage/SLO
+#: overhead + breach soaks) and `epochstore` (512-epoch store build +
+#: paced serve pairs + a crash child) are explicit-only
 DEFAULT_BENCHES = [
     n for n in BENCHES
     if n not in ("sustained", "servesoak", "autoscale", "feedscale",
                  "retrysoak", "blackbox", "tenant", "servescale",
-                 "failover", "lineage")
+                 "failover", "lineage", "epochstore")
 ]
 
 

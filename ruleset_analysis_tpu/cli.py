@@ -856,6 +856,8 @@ def _cmd_serve(args: argparse.Namespace) -> int:
             lineage=args.lineage != "off",
             slo=args.slo,
             trend_threshold=args.trend_threshold,
+            epoch_store=args.epoch_store,
+            epoch_store_budget_bytes=args.epoch_store_budget_mb << 20,
         )
         dscfg = None
         if args.distributed:
@@ -1759,6 +1761,26 @@ def make_parser() -> argparse.ArgumentParser:
                         "after 3 clean windows.  Metrics: "
                         "p50/p90/p99_publish_ms, drop_rate, "
                         "incomplete_rate, degraded_subsystems")
+    p.add_argument("--epoch-store", default="", metavar="DIR",
+                   help="durable epoch store + segment-tree summaries "
+                        "(DESIGN §25): every rotated window spills to "
+                        "CRC'd segment chains under DIR and compaction "
+                        "maintains power-of-two merged nodes, so "
+                        "/report/range?from=&to= renders any [t0,t1] "
+                        "report from <= 2*log2(n) stored aggregates — "
+                        "bit-identical to folding the raw epochs, no "
+                        "replay — and /report/last-hit serves each "
+                        "rule's last-hit window + wall time (the quiet "
+                        "horizon safe_to_delete verdicts cite).  Bounds "
+                        "range by id or unix seconds; a range the store "
+                        "cannot fully cover answers a typed "
+                        "range_incomplete, never silent zeros")
+    p.add_argument("--epoch-store-budget-mb", type=int, default=512,
+                   metavar="MB",
+                   help="total on-disk epoch-store budget; past it the "
+                        "oldest RAW-epoch segment evicts first (coarse "
+                        "summary nodes still answer aligned queries "
+                        "over the evicted span) (default 512)")
     p.add_argument("--trend-threshold", type=float, default=4.0,
                    metavar="X",
                    help="per-rule traffic trend events in diff.json: a "

@@ -382,6 +382,18 @@ class ServeConfig:
     #: publishes one typed ``rule_burst``/``rule_quiet`` event into
     #: diff.json + the flight recorder.  Must be > 1; 0 disables.
     trend_threshold: float = 4.0
+    #: durable epoch store directory (runtime/epochstore.py, DESIGN
+    #: §25): every rotated window spills here and background compaction
+    #: keeps power-of-two summary nodes, so ``/report/range?from=&to=``
+    #: answers any ``[t0,t1]`` report from O(log n) stored aggregates —
+    #: replay-free — and ``/report/last-hit`` cites each rule's quiet
+    #: horizon.  Empty = off (the ring stays the only history).
+    epoch_store: str = ""
+    #: total on-disk budget for the epoch store; exceeding it evicts
+    #: the OLDEST raw-epoch segment first (coarse summaries still
+    #: answer aligned queries over the evicted span), and an evicted
+    #: range answers a typed ``range_incomplete`` — never silent zeros
+    epoch_store_budget_bytes: int = 512 << 20
 
     def __post_init__(self) -> None:
         if (self.window_lines > 0) == (self.window_sec > 0):
@@ -434,6 +446,16 @@ class ServeConfig:
             raise ValueError(
                 "wal_dir/wal_segment_bytes/wal_budget_bytes require wal=True "
                 "(serve --wal)"
+            )
+        if self.epoch_store_budget_bytes < 1 << 20:
+            raise ValueError(
+                "epoch_store_budget_bytes must be >= 1 MiB, got "
+                f"{self.epoch_store_budget_bytes}"
+            )
+        if self.epoch_store_budget_bytes != 512 << 20 and not self.epoch_store:
+            raise ValueError(
+                "epoch_store_budget_bytes requires epoch_store "
+                "(serve --epoch-store DIR)"
             )
         if self.trend_threshold != 0 and self.trend_threshold <= 1.0:
             raise ValueError(

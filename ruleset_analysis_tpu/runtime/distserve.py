@@ -69,7 +69,7 @@ from ..models import pipeline
 from ..ops.topk import TopKTracker
 from ..parallel.distributed import pack_epoch_payload, unpack_epoch_payload
 from . import checkpoint as ckpt
-from . import faults, flightrec, obs, retrypolicy
+from . import epochstore, faults, flightrec, obs, retrypolicy
 from .lease import EpochSpool, SupervisorLease
 from .autoscale import PolicyEngine, host_ladder, render_prom_labeled
 from .metrics import LatencyHistogram, build_info, render_build_info_prom
@@ -757,6 +757,9 @@ class DistServeDriver:
     _lineage_append = ServeDriver._lineage_append
     lineage_record = ServeDriver.lineage_record
     _observe_slo = ServeDriver._observe_slo
+    _rule_labels = ServeDriver._rule_labels
+    _spill_epoch = ServeDriver._spill_epoch
+    range_report_obj = ServeDriver.range_report_obj
 
     def lineage_tail(self) -> dict:
         """The ``/lineage`` view plus the live leadership snapshot: who
@@ -995,6 +998,14 @@ class DistServeDriver:
         if self.scfg.lineage:
             g["lineage_records_total"] = self.lineage_records_total
             g["trend_events_total"] = self.trend_events_total
+        if self.epoch_store is not None:
+            g.update(self.epoch_store.gauges())
+            g.update(self.lat_range.gauges("latency_range_query_"))
+        if self._suffix is not None:
+            g.update({
+                "merged_suffix_hits_total": self._suffix.hits,
+                "merged_suffix_misses_total": self._suffix.misses,
+            })
         if self.slo is not None:
             g.update(self.slo.gauges())
         g.update(self.failover_gauges())
@@ -1086,6 +1097,22 @@ class DistServeDriver:
                 self._lease.start_heartbeat(on_fenced=self._on_lease_fenced)
             if self.cfg.resume:
                 self._restore()
+            if scfg.epoch_store:
+                # rank 0 spills MERGED windows only (DESIGN §25) —
+                # host tiers keep no history; opened before the spool
+                # replay so replayed windows land like live ones (the
+                # store dedupes ids below its frontier)
+                self.epoch_store = epochstore.EpochStore(
+                    scfg.epoch_store,
+                    budget_bytes=scfg.epoch_store_budget_bytes,
+                    trend_threshold=scfg.trend_threshold,
+                )
+                if not self.cfg.resume:
+                    self.epoch_store.reset()
+                self.epoch_store.bind_base(self.next_wid)
+                self.epoch_store.set_labels(
+                    self._rule_labels(self.packed)
+                )
             if scfg.lineage:
                 # rank 0's provenance ledger (DESIGN §24), opened BEFORE
                 # the takeover replay so the successor's replayed
@@ -1184,6 +1211,11 @@ class DistServeDriver:
             "world": self.dscfg.hosts,
             "degraded": self.degraded_set(),
             "retry": retrypolicy.counters(),
+            **(
+                {"epoch_store": self.epoch_store.stats()}
+                if self.epoch_store is not None
+                else {}
+            ),
             **(
                 {"autoscale": self._engine.summary()}
                 if self._engine is not None
@@ -1669,6 +1701,12 @@ class DistServeDriver:
                             h.wal_ckpt,
                             int(recs[r][1].get("wal_next", 0)),
                         )
+            # durable history spills the MERGED epoch (post cross-host
+            # register merge) so range queries see exactly what /report
+            # published, not any single host's shard
+            self._spill_epoch(ep)
+            if self._suffix is not None:
+                self._suffix.push(w, arrays)
             flightrec.cursor(
                 windows_published=self.windows_published,
                 next_window=self.next_wid,
@@ -2208,4 +2246,7 @@ class DistServeDriver:
             self._lineage_log.sync()
             self._lineage_log.close()
             self._lineage_log = None
+        if self.epoch_store is not None:
+            self.epoch_store.sync()
+            self.epoch_store.close()
         obs.unregister_sampler("distserve")

@@ -206,6 +206,19 @@ SITES: dict[str, tuple[str, str]] = {
         "the single-write O_APPEND discipline means the log holds only "
         "complete records (a torn final line reads as absent, never as "
         "corruption)"),
+    "epochstore.spill": (
+        "raise", "spilling a rotated window into the durable epoch "
+        "store fails (full / readonly volume analog) BEFORE any bytes "
+        "land; serve marks the epoch_store subsystem degraded and keeps "
+        "publishing — losing history is visible /health + /lineage "
+        "frontier evidence, never a torn store or a silent stop"),
+    "epochstore.compact": (
+        "crash", "SIGKILL at the worst instant of segment-tree "
+        "compaction: after the pair is chosen, before the merged "
+        "summary node is appended.  Compaction is append-then-link "
+        "(the O_APPEND record IS the link), so the store must reopen "
+        "readable with zero lost epochs and repair-at-open must "
+        "rebuild the missing summary from its intact children"),
 }
 
 

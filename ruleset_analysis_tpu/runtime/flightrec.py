@@ -972,6 +972,29 @@ def diagnose(
             "failure — check /health history and the degraded "
             "subsystems' first errors",
         )
+    es_win = [
+        s["cursors"]["epochstore_window"]
+        for s in a.get("per_shard", [])
+        if s.get("cursors", {}).get("epochstore_window") is not None
+    ]
+    if es_win:
+        es_levels = max(
+            (
+                s.get("cursors", {}).get("epochstore_levels") or 0
+                for s in a.get("per_shard", [])
+            ),
+            default=0,
+        )
+        add(
+            "durable epoch-store frontier at the dump",
+            f"last spilled window: {max(es_win)}; "
+            f"segment-tree levels: {es_levels}",
+            "every window <= the frontier answers /report/range without "
+            "replay; a frontier behind the lineage ledger's last "
+            "complete window means the final rotation published but "
+            "died before its spill — that window is recoverable from "
+            "the WAL, not the store",
+        )
     if lineage:
         from .report import lineage_frontier
 

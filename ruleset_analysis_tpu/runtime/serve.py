@@ -61,7 +61,7 @@ from ..hostside.listener import LineQueue, ListenerSet
 from ..models import pipeline
 from ..ops.topk import TopKTracker
 from . import checkpoint as ckpt
-from . import devprof, faults, flightrec, obs, retrypolicy
+from . import devprof, epochstore, faults, flightrec, obs, retrypolicy
 from .metrics import (
     LatencyHistogram,
     SloBurnEngine,
@@ -302,6 +302,156 @@ class WindowRing:
         return [ep.meta["id"] for ep in self.epochs]
 
 
+class _Swag:
+    """Two-stack sliding-window aggregate over one view's last ``size``
+    register images (the SWAG trick): each pushed image is merged at
+    most twice — once into the back accumulator, once into a suffix
+    aggregate when the stacks flip — so querying the window's merge is
+    O(1) amortized instead of re-folding ``size`` epochs.  Associativity
+    of the merge laws makes the regrouped result bit-identical."""
+
+    def __init__(self, size: int):
+        self.size = size
+        # front: (window id, suffix merge incl. self) with the OLDEST on
+        # top; back: raw pushes since the last flip
+        self.front: list[tuple[int, dict]] = []
+        self.back: list[tuple[int, dict]] = []
+        self.back_agg: dict | None = None
+
+    def _len(self) -> int:
+        return len(self.front) + len(self.back)
+
+    def push(self, wid: int, arrays: dict) -> None:
+        while self._len() >= self.size:
+            self._pop_oldest()
+        self.back.append((wid, arrays))
+        self.back_agg = (
+            arrays if self.back_agg is None
+            else merge_register_arrays([self.back_agg, arrays])
+        )
+
+    def _pop_oldest(self) -> None:
+        if not self.front:
+            agg = None
+            for wid, arrays in reversed(self.back):
+                agg = (
+                    arrays if agg is None
+                    else merge_register_arrays([arrays, agg])
+                )
+                self.front.append((wid, agg))
+            self.back = []
+            self.back_agg = None
+        self.front.pop()
+
+    def query(self) -> tuple[list[int], dict | None]:
+        """(window ids oldest-first, merged arrays or None when empty)."""
+        ids = [w for w, _ in reversed(self.front)] + [w for w, _ in self.back]
+        if self.front and self.back_agg is not None:
+            agg = merge_register_arrays([self.front[-1][1], self.back_agg])
+        elif self.front:
+            agg = self.front[-1][1]
+        else:
+            agg = self.back_agg
+        return ids, agg
+
+    def clear(self) -> None:
+        self.front = []
+        self.back = []
+        self.back_agg = None
+
+
+class SuffixMergeCache:
+    """Running suffix aggregates for the merged-K views ``_publish``
+    re-renders every rotation.
+
+    Correctness does not depend on the cache: :meth:`merged` returns
+    arrays only when its retained window ids EXACTLY match the ring's,
+    and ``None`` otherwise (cold start, post-reload migration, resume
+    restore) — the caller falls back to the full fold and the cache
+    self-heals as rotations refill it.  Only the ARRAYS are cached:
+    tracker/meta/quarantine merging stays per-epoch in
+    ``_render_merged`` so the rendered report is bit-identical to the
+    uncached fold (bounded trackers evict order-dependently; dicts are
+    cheap, registers are not).
+    """
+
+    def __init__(self, views: tuple[int, ...]):
+        self._swags = {k: _Swag(k) for k in set(views)}
+        self.hits = 0
+        self.misses = 0
+
+    def push(self, wid: int, arrays: dict) -> None:
+        for s in self._swags.values():
+            s.push(wid, arrays)
+
+    def merged(self, k: int, window_ids: list[int]) -> dict | None:
+        s = self._swags.get(k)
+        if s is None:
+            return None
+        ids, agg = s.query()
+        if agg is None or ids != window_ids:
+            self.misses += 1
+            return None
+        self.hits += 1
+        return agg
+
+    def invalidate(self) -> None:
+        """Reload migration / restore rewrote the epochs in place: the
+        cached merges are old-key-space images, drop them all."""
+        for s in self._swags.values():
+            s.clear()
+
+
+def render_range_report(
+    agg, packed, cfg, *, topk: int, v6_digests=None, window_extra=None
+):
+    """The canonical range-report renderer: one stored/folded aggregate
+    (runtime/epochstore.py ``EpochAgg``) -> a full Report.
+
+    The talker tracker is rebuilt by offering the aggregate's UNBOUNDED
+    max-deduped table in sorted order — deterministic and independent of
+    how the aggregate was folded, which is what makes the segment-tree
+    answer bit-identical to the naive linear fold (the property test and
+    the bench both pin tree == naive through THIS function).
+    """
+    tracker = TopKTracker(cfg.sketch.topk_capacity)
+    for acl in sorted(agg.tables):
+        table = agg.tables[acl]
+        for src in sorted(table):
+            tracker.offer(int(acl), int(src), int(table[src]))
+    s = agg.summary
+    totals = {
+        "lines_total": int(s["lines"]),
+        "lines_matched": int(s["parsed"]),
+        "lines_skipped": int(s["skipped"]),
+        "chunks": int(s["chunks"]),
+        "window": {
+            "range": [int(agg.span[0]), int(agg.span[1]) - 1],
+            "windows": int(s["windows"]),
+            "drops": int(s["drops"]),
+            "started_unix": s["started_unix"],
+            "ended_unix": s["ended_unix"],
+            **(
+                {"incomplete": {
+                    "windows": list(s["incomplete"]),
+                    "drops": int(s["drops"]),
+                }}
+                if s["incomplete"]
+                else {}
+            ),
+        },
+    }
+    if window_extra:
+        totals["window"].update(window_extra)
+    qt = _quarantine_totals(agg.quarantine)
+    if qt:
+        totals["quarantine"] = qt
+    return pipeline.finalize(
+        pipeline.AnalysisState(**agg.arrays), packed, cfg, tracker,
+        topk=topk, totals=totals, v6_digests=v6_digests or {},
+    )
+
+
 # ---------------------------------------------------------------------------
 # The serve driver.
 # ---------------------------------------------------------------------------
@@ -489,6 +639,13 @@ class ServeDriver:
         # per-rule trend plane: rule key -> last emitted label
         self._trend_state: dict[str, str] = {}
         self.trend_events_total = 0
+        # durable epoch store (DESIGN §25), opened in run() when
+        # --epoch-store is armed; the range-query latency histogram and
+        # the merged-K suffix cache ride here so DistServeDriver's
+        # borrowed _publish/_attach_static find them too
+        self.epoch_store: epochstore.EpochStore | None = None
+        self.lat_range = LatencyHistogram()
+        self._suffix = SuffixMergeCache(scfg.views) if scfg.views else None
         # SLO burn-rate engine (runtime/metrics.py), armed by --slo
         self.slo = (
             SloBurnEngine(SloPolicy.parse(scfg.slo)) if scfg.slo else None
@@ -682,6 +839,17 @@ class ServeDriver:
                 "wal_replayed_total": self.wal_replayed,
                 "wal_lost_total": self.wal_lost_total,
             })
+        if self.epoch_store is not None:
+            # store depth/compaction gauges + the range-query latency
+            # quantiles: ONE dict for JSON and prom, parity pinned by
+            # verify/registry.py::audit_epochstore
+            g.update(self.epoch_store.gauges())
+            g.update(self.lat_range.gauges("latency_range_query_"))
+        if self._suffix is not None:
+            g.update({
+                "merged_suffix_hits_total": self._suffix.hits,
+                "merged_suffix_misses_total": self._suffix.misses,
+            })
         # device attribution + live device-memory headroom (DESIGN §14):
         # numeric gauges reach the prom variant too; unsupported memory
         # stats stay explicit nulls in the JSON (prom skips non-numerics)
@@ -735,7 +903,12 @@ class ServeDriver:
         receipt->publish latency (``_bucket``/``_sum``/``_count`` with
         cumulative ``le`` labels), appended to the gauge rendering on
         ``/metrics?format=prom``."""
-        return self.lat_cum.render_prom("ra_serve_ingest_to_publish_seconds")
+        out = self.lat_cum.render_prom("ra_serve_ingest_to_publish_seconds")
+        if getattr(self, "epoch_store", None) is not None:
+            out += self.lat_range.render_prom(
+                "ra_serve_range_query_seconds"
+            )
+        return out
 
     def render_labeled_prom(self) -> str:
         """Labeled Prometheus families appended to ``/metrics?format=prom``.
@@ -855,11 +1028,23 @@ class ServeDriver:
             return obj
         from . import staticanalysis
 
-        return staticanalysis.attach_static_obj(obj, sa_obj, strict=strict)
+        obj = staticanalysis.attach_static_obj(obj, sa_obj, strict=strict)
+        store = getattr(self, "epoch_store", None)
+        if store is not None:
+            # the quiet-horizon join (DESIGN §25): safe_to_delete
+            # verdicts cite WHEN each rule last hit inside retained
+            # history, or that it never has
+            epochstore.attach_last_hit(obj, store)
+        return obj
 
     # -- internals -------------------------------------------------------
-    def _render_merged(self, eps: list[WindowEpoch], packed):
-        arrays = merge_register_arrays([ep.arrays for ep in eps])
+    def _render_merged(self, eps: list[WindowEpoch], packed, arrays=None):
+        # ``arrays`` lets _publish hand in the SuffixMergeCache's
+        # precomputed merge (bit-identical by associativity); tracker/
+        # meta/quarantine below stay per-epoch so the rendered report
+        # is byte-equal either way
+        if arrays is None:
+            arrays = merge_register_arrays([ep.arrays for ep in eps])
         tracker = TopKTracker(self.cfg.sketch.topk_capacity)
         for ep in eps:
             for acl, table in ep.tracker_tables.items():
@@ -1048,6 +1233,21 @@ class ServeDriver:
                     self._wal_resume_seq if self.cfg.resume
                     else self.wal.next_seq
                 )
+            if scfg.epoch_store:
+                # durable history (DESIGN §25): fresh runs reset like
+                # the WAL; resumed runs re-bind and the frontier check
+                # makes a window-id gap a typed startup refusal
+                self.epoch_store = epochstore.EpochStore(
+                    scfg.epoch_store,
+                    budget_bytes=scfg.epoch_store_budget_bytes,
+                    trend_threshold=scfg.trend_threshold,
+                )
+                if not self.cfg.resume:
+                    self.epoch_store.reset()
+                self.epoch_store.bind_base(self.win_id)
+                self.epoch_store.set_labels(
+                    self._rule_labels(self.packed)
+                )
 
             if scfg.lineage:
                 # provenance ledger (DESIGN §24): O_APPEND jsonl beside
@@ -1124,6 +1324,8 @@ class ServeDriver:
                 "lost": self.wal_lost_total,
                 "lost_unknown": self.wal_lost_unknown,
             }
+        if self.epoch_store is not None:
+            summary["epoch_store"] = self.epoch_store.stats()
         self._write_json("summary.json", summary)
         return summary
 
@@ -1585,6 +1787,13 @@ class ServeDriver:
             # (slow) publish phase, so the merge tier is never gated on
             # this host's disk
             self._emit_epoch(ep)
+            # durable history spill (DESIGN §25): every rotation, not
+            # just ring eviction — the store's frontier tracks
+            # publication, so the ring eviction point merely marks when
+            # the store becomes the ONLY copy
+            self._spill_epoch(ep)
+            if self._suffix is not None:
+                self._suffix.push(meta["id"], arrays)
             self._publish(rep_obj, prev, meta)
             self._observe_slo(meta, win_hist)
             if (
@@ -1652,11 +1861,17 @@ class ServeDriver:
         with self._pub_lock:
             recs = [self._lineage_recent[w] for w in sorted(self._lineage_recent)]
             merged = [self._lineage_merged[k] for k in sorted(self._lineage_merged)]
-        return {
+        out = {
             "records": recs,
             "merged": merged,
             "records_total": self.lineage_records_total,
         }
+        store = getattr(self, "epoch_store", None)
+        if store is not None:
+            # the durable-history frontier: a postmortem reading
+            # /lineage can say exactly which windows survived the crash
+            out["epoch_store"] = store.frontier()
+        return out
 
     def lineage_record(self, wid: int) -> dict | None:
         with self._pub_lock:
@@ -1694,6 +1909,69 @@ class ServeDriver:
         to the cross-host merge tier.  The base service is its own merge
         tier (the ring push above already happened), so nothing to do.
         """
+
+    @staticmethod
+    def _rule_labels(packed) -> list[tuple]:
+        """(firewall, acl, index) per key id — the epoch store's
+        last-hit/trend planes need rule identity in the exact string
+        space the static classes use."""
+        return [(m.firewall, m.acl, m.index) for m in packed.key_meta]
+
+    def _spill_epoch(self, ep: WindowEpoch) -> None:
+        """Durably spill the closed window into the epoch store.
+
+        A spill failure (the ``epochstore.spill`` site, or a real full/
+        readonly volume) degrades the ``epoch_store`` subsystem and
+        publication continues — history's frontier freezes visibly
+        (/health, /lineage, gauges) and stays frozen: resuming spills
+        mid-run would leave a window-id gap the store's dense numbering
+        exists to prevent.
+        """
+        store = self.epoch_store
+        if store is None or "epoch_store" in self.degraded_set():
+            return
+        try:
+            store.spill(ep)
+        except AnalysisError as e:
+            self._degrade("epoch_store", e)
+        else:
+            flightrec.cursor(
+                epochstore_window=int(ep.meta["id"]),
+                epochstore_levels=len(store._chains),
+            )
+
+    def range_report_obj(self, frm: str | None, to: str | None) -> dict:
+        """The ``/report/range`` answer: a full report rendered from
+        <= 2*log2(n) stored aggregates — or the typed range_incomplete
+        marker when the store cannot cover the span completely."""
+        store = self.epoch_store
+        if store is None:
+            return {"error": "epoch store not armed (serve --epoch-store)"}
+        t0 = time.monotonic()
+        try:
+            lo, hi = store.resolve_range(frm, to)
+        except AnalysisError as e:
+            return {"error": str(e)}
+        agg, marker = store.range_agg(lo, hi)
+        if marker is not None:
+            return marker
+        with self._pub_lock:
+            packed = self.packed
+        obj = self._attach_static(
+            json.loads(render_range_report(
+                agg, packed, self.cfg, topk=self.topk,
+                v6_digests=self._v6_digests,
+                window_extra={
+                    "mode": "lines" if self.scfg.window_lines else "sec",
+                    "length": (
+                        self.scfg.window_lines or self.scfg.window_sec
+                    ),
+                },
+            ).to_json()),
+            strict=False,
+        )
+        self.lat_range.record(time.monotonic() - t0)
+        return obj
 
     def _publish(self, rep_obj: dict, prev: dict | None, meta: dict) -> None:
         with obs.span("serve.publish", window=meta["id"]):
@@ -1760,10 +2038,21 @@ class ServeDriver:
                 eps = self.ring.last(k)
                 if eps:
                     # serve-thread render: the serve thread is the only
-                    # mutator of ring + packed, so no snapshot needed
+                    # mutator of ring + packed, so no snapshot needed.
+                    # The suffix cache answers the K-fold in O(1)
+                    # amortized merges when its retained ids match the
+                    # ring exactly; any mismatch (cold start, reload
+                    # migration, resume) falls back to the full fold
+                    cached = None
+                    if self._suffix is not None:
+                        cached = self._suffix.merged(
+                            k, [ep.meta["id"] for ep in eps]
+                        )
                     merged_obj = self._attach_static(
                         json.loads(
-                            self._render_merged(eps, self.packed).to_json()
+                            self._render_merged(
+                                eps, self.packed, arrays=cached
+                            ).to_json()
                         ),
                         strict=False,
                     )
@@ -2200,6 +2489,18 @@ class ServeDriver:
         self._fp = self._fingerprint(new_packed)
         self.reloads += 1
         self.win_reloads += 1
+        if not mig.identity:
+            if self._suffix is not None:
+                # the cached suffix merges are OLD-key-space images the
+                # in-place ring migration above just invalidated
+                self._suffix.invalidate()
+            if self.epoch_store is not None:
+                # windows >= the in-progress one live in the new key
+                # space: the store refuses ranges reaching across (and
+                # summary nodes never straddle the boundary)
+                self.epoch_store.mark_era(self.win_id, self.reloads)
+        if self.epoch_store is not None:
+            self.epoch_store.set_labels(self._rule_labels(new_packed))
         obs.instant("serve.reload.ok", args={
             "n_keys": new_packed.n_keys,
             "migrated": not mig.identity,
@@ -2302,6 +2603,9 @@ class ServeDriver:
             self._watch_thread.join(timeout=5.0)
         if self.wal is not None:
             self.wal.close()
+        if self.epoch_store is not None:
+            self.epoch_store.sync()
+            self.epoch_store.close()
         if self._lineage_log is not None:
             self._lineage_log.sync()
             self._lineage_log.close()
@@ -2509,6 +2813,32 @@ def _make_http_handler():
                     return self._send(200, obj) if obj else self._send(
                         404, {"error": "no windows in the ring"}
                     )
+                if path == "/report/range":
+                    # historical [t0,t1] analytics (DESIGN §25): bounds
+                    # are window ids or unix seconds; the answer is a
+                    # full report (O(log n) stored aggregates), a typed
+                    # range_incomplete marker, or a 400 on bad bounds
+                    from urllib.parse import parse_qs
+
+                    params = parse_qs(query)
+                    obj = drv.range_report_obj(
+                        (params.get("from") or [None])[0],
+                        (params.get("to") or [None])[0],
+                    )
+                    if "error" in obj:
+                        code = 404 if "not armed" in obj["error"] else 400
+                        return self._send(code, obj)
+                    return self._send(
+                        404 if obj.get("range_incomplete") else 200, obj
+                    )
+                if path == "/report/last-hit":
+                    store = getattr(drv, "epoch_store", None)
+                    if store is None:
+                        return self._send(404, {
+                            "error": "epoch store not armed "
+                                     "(serve --epoch-store)",
+                        })
+                    return self._send(200, store.last_hit_obj())
                 if path == "/lineage":
                     if not drv.scfg.lineage:
                         return self._send(404, {
@@ -2533,8 +2863,9 @@ def _make_http_handler():
                     "endpoints": [
                         "/health", "/metrics", "/report",
                         "/report/cumulative", "/report/static",
-                        "/report/window/<id>", "/report/merged/<k>", "/diff",
-                        "/lineage", "/lineage/window/<id>",
+                        "/report/window/<id>", "/report/merged/<k>",
+                        "/report/range?from=&to=", "/report/last-hit",
+                        "/diff", "/lineage", "/lineage/window/<id>",
                     ],
                 })
             except BrokenPipeError:

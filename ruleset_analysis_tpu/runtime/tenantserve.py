@@ -66,7 +66,7 @@ from ..hostside import pack as pack_mod
 from ..hostside.listener import ListenerSet, make_listener
 from ..models import pipeline
 from ..ops.topk import TopKTracker
-from . import devprof, faults, flightrec, obs, retrypolicy
+from . import devprof, epochstore, faults, flightrec, obs, retrypolicy
 from .autoscale import render_prom, render_prom_labeled
 from .metrics import (
     LatencyHistogram,
@@ -145,6 +145,9 @@ class _Lane:
         # provenance records and the per-rule hysteresis labels
         self.lineage_recent: dict[int, dict] = {}
         self._trend_state: dict[str, str] = {}
+        # durable epoch store (DESIGN §25), per lane: one tenant's
+        # history never shares segments with another's
+        self.store = None
         # window-local fields are (re)set by _begin_window
         self.win_id = 0
         self.next_rotation: float | None = None
@@ -364,6 +367,27 @@ class TenantServeDriver:
                 lane.ring = WindowRing(scfg.ring)
                 lane.cum_arrays = zero_arrays(lane.packed.n_keys, self.cfg)
                 lane.cum_tracker = TopKTracker(self.cfg.sketch.topk_capacity)
+                if scfg.epoch_store:
+                    # per-tenant sub-store, budget split evenly; like
+                    # the shared WAL below there is no tenancy resume,
+                    # so every run starts a fresh history
+                    lane.store = epochstore.EpochStore(
+                        os.path.join(
+                            scfg.epoch_store, f"tenant-{lane.name}"
+                        ),
+                        budget_bytes=max(
+                            1 << 20,
+                            scfg.epoch_store_budget_bytes
+                            // len(self.lanes),
+                        ),
+                        trend_threshold=scfg.trend_threshold,
+                    )
+                    lane.store.reset()
+                    lane.store.bind_base(lane.win_id)
+                    lane.store.set_labels([
+                        (m.firewall, m.acl, m.index)
+                        for m in lane.packed.key_meta
+                    ])
                 if scfg.static_analysis:
                     # initial analysis failures degrade ONE tenant's
                     # static plane; every other lane publishes verdicts
@@ -688,6 +712,16 @@ class TenantServeDriver:
             for acl, table in ep.tracker_tables.items():
                 for src, est in table.items():
                     lane.cum_tracker.offer(int(acl), int(src), int(est))
+            if (
+                lane.store is not None
+                and f"epoch_store:{lane.name}" not in self.degraded_set()
+            ):
+                # a spill failure degrades ONE tenant's history plane;
+                # it stays off so the survivor's numbering stays dense
+                try:
+                    lane.store.spill(ep)
+                except AnalysisError as e:
+                    self._degrade(f"epoch_store:{lane.name}", e)
             lane.total_lines += meta["lines"]
             lane.total_parsed += meta["parsed"]
             lane.total_skipped += meta["skipped"]
@@ -997,6 +1031,13 @@ class TenantServeDriver:
                 self.engine.set_arrays(lane.name, live_arrays)
             lane.packed = new_packed
             lane.batcher = batcher
+            if lane.store is not None:
+                if not mig.identity:
+                    lane.store.mark_era(lane.win_id, lane.reloads + 1)
+                lane.store.set_labels([
+                    (m.firewall, m.acl, m.index)
+                    for m in new_packed.key_meta
+                ])
             if sa_new is not None:
                 self._install_static(lane, sa_new, sa_obj_new, dur_new)
         if sa_new is not None:
@@ -1131,6 +1172,8 @@ class TenantServeDriver:
                 "queue_share": fairness["shares"].get(name, 0.0),
             }
             g.update(lane.lat_cum.gauges("latency_ingest_to_publish_"))
+            if lane.store is not None:
+                g.update(lane.store.gauges())
             out[name] = g
         return out
 
@@ -1168,6 +1211,22 @@ class TenantServeDriver:
         if self.scfg.lineage:
             g["lineage_records_total"] = self.lineage_records_total
             g["trend_events_total"] = self.trend_events_total
+        stores = [
+            lane.store for lane in self.lanes.values()
+            if lane.store is not None
+        ]
+        if stores:
+            # service-level rollup; per-tenant detail rides the labeled
+            # ``per_tenant_gauges`` series
+            g["epochstore_spilled_total"] = sum(
+                s.spilled_total for s in stores
+            )
+            g["epochstore_epochs"] = sum(
+                s.stats()["epochs"] for s in stores
+            )
+            g["epochstore_bytes"] = sum(
+                s.stats()["bytes"] for s in stores
+            )
         if self.slo is not None:
             g.update(self.slo.gauges())
         g.update(devprof.gauges())
@@ -1320,6 +1379,10 @@ class TenantServeDriver:
             self._watch_thread.join(timeout=5.0)
         if self.wal is not None:
             self.wal.close()
+        for lane in self.lanes.values():
+            if lane.store is not None:
+                lane.store.sync()
+                lane.store.close()
         if self._lineage_log is not None:
             self._lineage_log.sync()
             self._lineage_log.close()

@@ -425,6 +425,71 @@ class WriteAheadLog:
                     yield (seq, *decoded)
                 seq += 1
 
+    def read_record(self, seq: int) -> tuple | None:
+        """Random-access read of ONE record by seq (decoded tuple), or
+        ``None`` when no live segment covers it.
+
+        The epoch store's range queries (DESIGN §25) hinge on this being
+        cheap: the reader walks the covering segment's record *headers*
+        (``f.seek`` past every other payload) and CRC-checks only the
+        target, so a point read costs one header walk — not a replay of
+        the chain.  Damage found on the walk quarantines the segment
+        exactly like replay does (rename aside, loss pinned by seq math,
+        successors untouched) and the read reports ``None``; the caller
+        sees a typed gap, never bad bytes.
+        """
+        with self._lock:
+            seg = next(
+                (s for s in self._segments if s.start <= seq < s.end), None
+            )
+            succ = seg is not None and seg is not self._segments[-1]
+        if seg is None:
+            return None
+        end = seg.end if succ else None
+        try:
+            f = open(seg.path, "rb")
+        except OSError:
+            self._quarantine(seg, seg.start, end, "unreadable",
+                             countable_final=True)
+            return None
+        with f:
+            hdr = f.read(HEADER_BYTES)
+            if len(hdr) < HEADER_BYTES or hdr[:8] not in self._MAGICS or (
+                _HDR.unpack(hdr)[1] != seg.start
+            ):
+                self._quarantine(seg, seg.start, end, "bad segment header")
+                return None
+            magic = hdr[:8]
+            cur = seg.start
+            while True:
+                rec = f.read(_REC.size)
+                if len(rec) < _REC.size:
+                    return None  # torn tail before the target
+                ln, crc = _REC.unpack(rec)
+                if ln > self._MAX_RECORD:
+                    self._quarantine(
+                        seg, max(cur, seg.start), end, "absurd record length"
+                    )
+                    return None
+                if cur < seq:
+                    f.seek(ln, 1)  # skip payload unverified
+                    cur += 1
+                    continue
+                payload = f.read(ln)
+                if len(payload) < ln:
+                    return None  # torn tail IS the target
+                if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                    self._quarantine(
+                        seg, cur, end, "record CRC mismatch",
+                        countable_final=True,
+                    )
+                    return None
+                try:
+                    return self._decode_record(payload, magic)
+                except _BadRecord as bad:
+                    self._quarantine(seg, cur, end, str(bad))
+                    return None
+
     @classmethod
     def _decode_record(cls, payload: bytes, magic: bytes) -> tuple:
         """Decode one CRC-valid payload into the tuple tail replay

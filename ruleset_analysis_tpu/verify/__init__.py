@@ -37,5 +37,5 @@ from .grid import (  # noqa: F401
     trace_program,
 )
 from .jaxpr_lint import Finding, ProgramLint, lint_program  # noqa: F401
-from .registry import audit_registry  # noqa: F401
+from .registry import audit_epochstore, audit_registry  # noqa: F401
 from .report import LintReport, render_text, run_lint  # noqa: F401

@@ -659,6 +659,8 @@ def audit_distserve(root: str | None = None) -> list[AuditFinding]:
     drv.degraded = {}
     drv._engine = None
     drv._lease = None
+    drv.epoch_store = None
+    drv._suffix = None
     for attr in (
         "hosts_spawned", "hosts_dead_total", "hosts_retired_total",
         "windows_published", "next_wid", "total_lines", "live_drops",
@@ -723,10 +725,137 @@ def audit_distserve(root: str | None = None) -> list[AuditFinding]:
     return findings
 
 
+def audit_epochstore(root: str | None = None) -> list[AuditFinding]:
+    """Durable epoch store (DESIGN §25): config/flag lockstep, gauge
+    prom parity, and the segment-tree == linear-fold identity.
+
+    Drives a REAL store in a tempdir — spills synthetic epochs through
+    the production spill/compact path, then checks (a) every ServeConfig
+    ``epoch_store*`` field has a matching ``--epoch-store*`` CLI flag on
+    the serve-family subcommands, (b) ``EpochStore.gauges()`` keys all
+    carry the ``epochstore_`` prefix and survive the ``ra_serve_``
+    Prometheus rendering value-for-value (JSON<->prom parity, the same
+    law audit_observability pins for the other planes), (c) a range
+    query over the tree is bit-identical to the naive linear fold, and
+    (d) both ``epochstore.*`` fault sites are registered (ISSUE 20).
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from ..runtime import epochstore
+    from ..runtime.autoscale import render_prom
+    from ..runtime.faults import SITES
+    from ..config import ServeConfig
+
+    findings: list[AuditFinding] = []
+    # (a) config <-> CLI flag lockstep
+    _subs, flags = _cli_flags()
+    flag_names = {f for _sub, f in flags}
+    for field in dataclasses.fields(ServeConfig):
+        if not field.name.startswith("epoch_store"):
+            continue
+        flag = "--" + field.name.replace("_", "-").replace(
+            "-bytes", "-mb"
+        )
+        if flag not in flag_names:
+            findings.append(AuditFinding(
+                "epochstore", "flag-drift", field.name,
+                f"ServeConfig.{field.name} has no {flag} CLI flag",
+            ))
+    # (d) fault-site registration (audit_faults covers arming/tests)
+    for site in ("epochstore.spill", "epochstore.compact"):
+        if site not in SITES:
+            findings.append(AuditFinding(
+                "epochstore", "fault-site-missing", site,
+                "the epoch-store fault site is not registered",
+            ))
+
+    class _Ep:
+        def __init__(self, wid):
+            rng = np.random.default_rng(wid)
+            self.arrays = {
+                "counts_lo": rng.integers(
+                    0, 2**32, 8, dtype=np.uint32
+                ),
+                "counts_hi": np.zeros(8, dtype=np.uint32),
+                "cms": rng.integers(0, 2**32, (2, 16), dtype=np.uint32),
+                "hll": rng.integers(0, 30, (8, 4), dtype=np.uint32),
+                "talk_cms": rng.integers(
+                    0, 2**32, (2, 16), dtype=np.uint32
+                ),
+            }
+            self.meta = {
+                "id": wid, "lines": 100, "parsed": 90, "skipped": 10,
+                "chunks": 1, "drops": 0,
+                "started_unix": 1.0 + wid, "ended_unix": 2.0 + wid,
+            }
+            self.tracker_tables = {0: {wid: wid + 1}}
+            self.quarantine = {}
+
+    d = tempfile.mkdtemp(prefix="ra-audit-es-")
+    try:
+        store = epochstore.EpochStore(d, budget_bytes=8 << 20)
+        store.bind_base(0)
+        for wid in range(11):
+            store.spill(_Ep(wid))
+        # (c) tree fold == linear fold, bit for bit
+        agg, marker = store.range_agg(1, 9)
+        ref, _ = store.naive_range_agg(1, 9)
+        if marker is not None or agg is None:
+            findings.append(AuditFinding(
+                "epochstore", "range-refused", str(marker),
+                "a fully-stored range was refused",
+            ))
+        else:
+            for k in sorted(ref.arrays):
+                if not np.array_equal(agg.arrays[k], ref.arrays[k]):
+                    findings.append(AuditFinding(
+                        "epochstore", "fold-shape-drift", k,
+                        "segment-tree range fold differs from the "
+                        "linear fold — the merge laws broke",
+                    ))
+            if agg.tables != ref.tables or agg.summary != ref.summary:
+                findings.append(AuditFinding(
+                    "epochstore", "fold-shape-drift", "tables/summary",
+                    "tracker tables or accounting differ between the "
+                    "tree fold and the linear fold",
+                ))
+        # (b) gauge naming + JSON <-> prom value parity
+        g = store.gauges()
+        prom = render_prom(g, prefix="ra_serve_").splitlines()
+        for key, v in g.items():
+            if not key.startswith("epochstore_"):
+                findings.append(AuditFinding(
+                    "epochstore", "gauge-prefix-drift", key,
+                    "EpochStore.gauges() keys must carry the "
+                    "epochstore_ prefix (namespaced /metrics merge)",
+                ))
+                continue
+            body = f"{v:g}" if isinstance(v, float) else f"{v}"
+            if f"ra_serve_{key} {body}" not in prom:
+                findings.append(AuditFinding(
+                    "epochstore", "gauge-prom-drift", key,
+                    "a store gauge present in JSON /metrics is absent "
+                    "from the ra_serve_ Prometheus rendering",
+                ))
+        if g.get("epochstore_spilled_total") != 11:
+            findings.append(AuditFinding(
+                "epochstore", "gauge-count-drift", "spilled_total",
+                "the spill counter disagrees with the spills driven",
+            ))
+        store.close()
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return findings
+
+
 def audit_registry(root: str | None = None) -> list[AuditFinding]:
-    """All seven audits, in declaration order."""
+    """All eight audits, in declaration order."""
     return (
         audit_faults(root) + audit_cli(root) + audit_volatile(root)
         + audit_retry(root) + audit_observability(root)
         + audit_tenancy(root) + audit_distserve(root)
+        + audit_epochstore(root)
     )

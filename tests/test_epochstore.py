@@ -1,0 +1,492 @@
+"""Durable epoch store + segment-tree range merges (ISSUE 20).
+
+Pins the DESIGN §25 invariants:
+
+- **Fold-shape independence**: any ``[t0,t1]`` range answered from the
+  segment tree (<= 2 log n stored aggregates + one merge fold) is
+  bit-identical — registers, tracker tables, accounting — to the naive
+  linear fold over the same level-0 epochs, and to a single-shot merge
+  of the raw images.  Degenerates (single epoch, empty range) included.
+- **Typed incompleteness**: a quarantined gap, an empty store, a bound
+  beyond the frontier, or a query crossing a keyspace migration yields
+  a ``range_incomplete`` marker naming the reason — never silent zeros,
+  never a partial answer.
+- **Crash discipline**: ``epochstore.spill`` failing degrades the
+  subsystem and leaves the on-disk store readable; a crash at the worst
+  instant of compaction (pair chosen, merged node unwritten) loses zero
+  epochs — repair at the next open rebuilds the missing summary nodes.
+- **Suffix-merge reuse**: the merged-K rendering cache returns exactly
+  the arrays a cold merge would produce, misses (never lies) when its
+  window ids drift, and heals after an invalidation.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from ruleset_analysis_tpu.config import AnalysisConfig, ServeConfig
+from ruleset_analysis_tpu.errors import AnalysisError
+from ruleset_analysis_tpu.hostside import aclparse, pack, synth
+from ruleset_analysis_tpu.runtime import epochstore, faults
+from ruleset_analysis_tpu.runtime.serve import (
+    ServeDriver,
+    SuffixMergeCache,
+    merge_register_arrays,
+)
+
+RUN_CFG = dict(batch_size=128, prefetch_depth=0)
+WL = 150
+
+
+# ---------------------------------------------------------------------------
+# Offline store: fold-shape independence + typed refusals.
+# ---------------------------------------------------------------------------
+
+def _mk_epoch(wid, *, n_keys=16, unix_base=1.7e9):
+    rng = np.random.default_rng(1000 + wid)
+
+    class _Ep:
+        arrays = {
+            "counts_lo": rng.integers(0, 2**32, n_keys, dtype=np.uint32),
+            "counts_hi": rng.integers(0, 3, n_keys, dtype=np.uint32),
+            "cms": rng.integers(0, 2**32, (2, 32), dtype=np.uint32),
+            "hll": rng.integers(0, 30, (n_keys, 8), dtype=np.uint32),
+            "talk_cms": rng.integers(0, 2**32, (2, 32), dtype=np.uint32),
+        }
+        meta = {
+            "id": wid, "lines": 100 + wid, "parsed": 95, "skipped": 5,
+            "chunks": 1, "drops": 0,
+            "started_unix": unix_base + 60.0 * wid,
+            "ended_unix": unix_base + 60.0 * wid + 59.0,
+        }
+        tracker_tables = {
+            0: {int(rng.integers(0, 2**32)): int(rng.integers(1, 500))}
+        }
+        quarantine = {("fw1", "acl0", 0, "line"): wid + 1} if wid % 3 else {}
+
+    return _Ep()
+
+
+def _agg_equal(a, b):
+    return (
+        set(a.arrays) == set(b.arrays)
+        and all(np.array_equal(a.arrays[k], b.arrays[k]) for k in a.arrays)
+        and a.tables == b.tables
+        and a.summary == b.summary
+        and a.quarantine == b.quarantine
+    )
+
+
+def test_fold_shape_independence_tree_naive_oneshot(tmp_path):
+    store = epochstore.EpochStore(str(tmp_path / "es"))
+    store.bind_base(0)
+    eps = [_mk_epoch(w) for w in range(64)]
+    for ep in eps:
+        assert store.spill(ep)
+    rng = np.random.default_rng(5)
+    for _ in range(24):
+        lo = int(rng.integers(0, 63))
+        hi = int(rng.integers(lo, 64))
+        tree, m1 = store.range_agg(lo, hi)
+        naive, m2 = store.naive_range_agg(lo, hi)
+        assert m1 is None and m2 is None
+        assert _agg_equal(tree, naive), f"tree != naive on [{lo},{hi}]"
+        # the third shape: one merge_aggs left-fold over fresh images
+        one = epochstore.agg_from_epoch(eps[lo])
+        for ep in eps[lo + 1:hi + 1]:
+            one = epochstore.merge_aggs(one, epochstore.agg_from_epoch(ep))
+        assert _agg_equal(tree, one), f"tree != one-shot on [{lo},{hi}]"
+    # the tree genuinely decomposes: full span touches few nodes
+    assert store.stats()["depth"] >= 6
+    store.close()
+
+
+def test_range_degenerates_and_unix_resolution(tmp_path):
+    store = epochstore.EpochStore(str(tmp_path / "es"))
+    # empty store refuses typed
+    _, m = store.range_agg(0, 5)
+    assert m["range_incomplete"] and m["reason"] == "empty_store"
+    store.bind_base(10)  # non-zero base: ids are absolute window numbers
+    for w in range(10, 17):
+        store.spill(_mk_epoch(w))
+    # single epoch
+    agg, m = store.range_agg(12, 12)
+    assert m is None and agg.span == (12, 13)
+    assert agg.summary["windows"] == 1
+    # empty range (from > to)
+    _, m = store.range_agg(14, 12)
+    assert m["range_incomplete"] and m["reason"] == "empty_range"
+    # beyond the frontier: the marker names the first unspilled window
+    _, m = store.range_agg(10, 99)
+    assert m["reason"] == "beyond_frontier" and m["window"] == 17
+    # before recorded history
+    _, m = store.range_agg(2, 12)
+    assert m["reason"] == "missing"
+    # defaults cover the full extent
+    agg, m = store.range_agg(None, None)
+    assert m is None and agg.span == (10, 17)
+    # unix-second bounds map through the spill index
+    ep12 = _mk_epoch(12)
+    lo, hi = store.resolve_range(
+        str(ep12.meta["started_unix"]), str(_mk_epoch(14).meta["ended_unix"])
+    )
+    assert (lo, hi) == (12, 14)
+    with pytest.raises(AnalysisError):
+        store.resolve_range("not-a-number", None)
+    store.close()
+
+
+def test_quarantined_gap_refuses_typed_but_coarse_spans_answer(tmp_path):
+    d = str(tmp_path / "es")
+    store = epochstore.EpochStore(d)
+    store.bind_base(0)
+    for w in range(8):
+        store.spill(_mk_epoch(w))
+    expect_full, m = store.range_agg(0, 7)
+    assert m is None
+    store.close()
+    # flip one payload byte of a level-0 record (all 8 fit one segment)
+    l0 = os.path.join(d, "L00")
+    seg = os.path.join(l0, sorted(os.listdir(l0))[0])
+    with open(seg, "r+b") as f:
+        f.seek(os.path.getsize(seg) - 40)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    store = epochstore.EpochStore(d)
+    store.bind_base(8)
+    # an unaligned query needing the damaged level-0 chain: typed refusal
+    _, m = store.range_agg(7, 7)
+    assert m == {
+        "range_incomplete": True, "from": 7, "to": 7, "reason": "missing",
+        "window": 7,
+    }
+    assert store.range_incomplete_total == 1
+    assert store.stats()["quarantined_segments"] >= 1
+    # the aligned full span rides the intact level-3 summary node —
+    # bit-identical to the pre-damage fold, zero level-0 reads
+    agg, m = store.range_agg(0, 7)
+    assert m is None and _agg_equal(agg, expect_full)
+    store.close()
+
+
+def test_keyspace_migration_era_refusal_and_holes(tmp_path):
+    store = epochstore.EpochStore(str(tmp_path / "es"))
+    store.bind_base(0)
+    for w in range(3):
+        store.spill(_mk_epoch(w))
+    store.mark_era(3, generation=1)
+    for w in range(3, 6):
+        store.spill(_mk_epoch(w))
+    # crossing the era start: typed keyspace_migration refusal
+    _, m = store.range_agg(1, 4)
+    assert m["range_incomplete"] and m["reason"] == "keyspace_migration"
+    assert m["window"] == 2  # the newest window in the dead key space
+    # entirely inside the new era: answered, identical to naive
+    agg, m = store.range_agg(3, 5)
+    ref, _ = store.naive_range_agg(3, 5)
+    assert m is None and _agg_equal(agg, ref)
+    # the pair straddling the era produced a hole, never a bogus merge
+    assert store.holes_total >= 1
+    store.close()
+
+
+def test_reopen_repair_preserves_fold_and_counts(tmp_path):
+    d = str(tmp_path / "es")
+    store = epochstore.EpochStore(d)
+    store.bind_base(0)
+    for w in range(13):
+        store.spill(_mk_epoch(w))
+    want, _ = store.naive_range_agg(0, 12)
+    stats = store.stats()
+    store.close()
+    again = epochstore.EpochStore(d)
+    again.bind_base(13)  # resume exactly at the frontier
+    assert again.stats()["epochs"] == 13
+    assert again.stats()["nodes"] == stats["nodes"]
+    agg, m = again.range_agg(0, 12)
+    assert m is None and _agg_equal(agg, want)
+    # a gapped resume is a typed startup refusal, not silent misnumbering
+    with pytest.raises(AnalysisError):
+        again.bind_base(20)
+    again.close()
+
+
+# ---------------------------------------------------------------------------
+# Suffix-merge cache (merged-K rendering reuse).
+# ---------------------------------------------------------------------------
+
+def test_suffix_cache_bit_identical_and_self_healing():
+    sc = SuffixMergeCache((3,))
+    eps = [_mk_epoch(w) for w in range(10)]
+    for i, ep in enumerate(eps):
+        sc.push(ep.meta["id"], ep.arrays)
+        ids = [e.meta["id"] for e in eps[max(0, i - 2):i + 1]]
+        got = sc.merged(3, ids)
+        assert got is not None
+        ref = merge_register_arrays(
+            [e.arrays for e in eps[max(0, i - 2):i + 1]]
+        )
+        for k in ref:
+            assert np.array_equal(got[k], ref[k]), k
+    # id drift (a restore rewrote the ring): miss, never a wrong image
+    assert sc.merged(3, [99, 8, 9]) is None
+    # invalidation heals as fresh rotations refill the window
+    sc.invalidate()
+    assert sc.merged(3, [7, 8, 9]) is None
+    for ep in eps[8:]:
+        sc.push(ep.meta["id"], ep.arrays)
+    got = sc.merged(3, [8, 9])  # exact match on the refilled short window
+    assert got is not None
+    ref = merge_register_arrays([eps[8].arrays, eps[9].arrays])
+    for k in ref:
+        assert np.array_equal(got[k], ref[k]), k
+    assert sc.misses == 2 and sc.hits == 11
+
+
+# ---------------------------------------------------------------------------
+# Serve e2e: spill every rotation, /report/range == cumulative, last-hit.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def corpus(tmp_path_factory):
+    td = tmp_path_factory.mktemp("epochstore")
+    cfg_text = synth.synth_config(
+        n_acls=2, rules_per_acl=8, seed=0, v6_fraction=0.25
+    )
+    rs = aclparse.parse_asa_config(cfg_text, "fw1")
+    packed = pack.pack_rulesets([rs])
+    prefix = str(td / "rules")
+    pack.save_packed(packed, prefix)
+    t = synth.synth_tuples(packed, 500, seed=1)
+    lines = synth.render_syslog(packed, t, seed=1)
+    return packed, prefix, lines, str(td)
+
+
+def start_serve(prefix, cfg, scfg):
+    drv = ServeDriver(prefix, cfg, scfg)
+    out: dict = {}
+
+    def runner():
+        try:
+            out["summary"] = drv.run()
+        except BaseException as e:  # surfaced by finish()
+            out["error"] = e
+
+    th = threading.Thread(target=runner)
+    th.start()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if out.get("error"):
+            break
+        if drv.listeners.listeners and drv.listeners.alive() and (
+            scfg.http == "off" or drv.http_address
+        ):
+            break
+        time.sleep(0.05)
+    return drv, th, out
+
+
+def finish(th, out, timeout=120):
+    th.join(timeout=timeout)
+    assert not th.is_alive(), "serve hung"
+    if "error" in out:
+        raise out["error"]
+    return out["summary"]
+
+
+def send_tcp(addr, lines):
+    s = socket.create_connection(addr)
+    s.sendall(("\n".join(lines) + "\n").encode())
+    s.close()
+
+
+def get_json(http, path):
+    host, port = http
+    with urllib.request.urlopen(
+        f"http://{host}:{port}{path}", timeout=10
+    ) as r:
+        return json.load(r)
+
+
+def wait_for(pred, timeout=60, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_serve_range_report_replay_free_e2e(corpus, tmp_path):
+    packed, prefix, lines, td = corpus
+    es_dir = str(tmp_path / "estore")
+    scfg = ServeConfig(
+        listen=("tcp:127.0.0.1:0",), window_lines=WL, ring=2,
+        serve_dir=str(tmp_path / "serve"), max_windows=0, stop_after_sec=90,
+        reload_watch=False, checkpoint_every_windows=0, http="127.0.0.1:0",
+        queue_lines=10_000, epoch_store=es_dir,
+    )
+    drv, th, out = start_serve(prefix, AnalysisConfig(**RUN_CFG), scfg)
+    try:
+        addr = tuple(drv.listeners.listeners[0].address)
+        for w in range(3):
+            send_tcp(addr, lines[w * WL:(w + 1) * WL])
+            wait_for(
+                lambda w=w: out.get("error") or drv.windows_published > w,
+                msg=f"window {w}",
+            )
+        if "error" in out:
+            raise out["error"]
+        http = drv.http_address
+
+        # ring=2 but the store holds all 3: the full-span range report
+        # answers replay-free, per-rule identical to the cumulative
+        full = get_json(http, "/report/range?from=0&to=2")
+        assert "range_incomplete" not in full
+        cum = get_json(http, "/report/cumulative")
+        assert full["per_rule"] == cum["per_rule"]
+        assert full["totals"]["lines_total"] == cum["totals"]["lines_total"]
+        win = full["totals"]["window"]
+        assert win["range"] == [0, 2] and win["windows"] == 3
+        assert win["drops"] == 0 and "incomplete" not in win
+        assert win["mode"] == "lines" and win["length"] == WL
+        # sub-span: identical to replaying just that window's lines
+        one = get_json(http, "/report/range?from=1&to=1")
+        win1 = get_json(http, "/report/window/1")
+        assert one["per_rule"] == win1["per_rule"]
+
+        # typed incompleteness over HTTP: future bound -> 404 marker
+        host, port = http
+        req = urllib.request.Request(
+            f"http://{host}:{port}/report/range?from=0&to=9"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            raise AssertionError("future range served a report")
+        except urllib.error.HTTPError as e:
+            marker = json.loads(e.read().decode())
+            assert e.code == 404
+            assert marker["range_incomplete"]
+            assert marker["reason"] == "beyond_frontier"
+
+        # the quiet-horizon join: /report/last-hit + totals.static
+        lh = get_json(http, "/report/last-hit")
+        assert lh["frontier"] == 2
+        assert lh["rules"], "no last-hit rows despite 3 spilled windows"
+        static = full["totals"].get("static")
+        if static is not None and "last_hit" in static:
+            assert static["last_hit"]["horizon_window"] == 2
+
+        # gauges + prom parity + lineage frontier
+        m = get_json(http, "/metrics")
+        assert m["epochstore_spilled_total"] == 3
+        assert m["epochstore_epochs"] == 3
+        assert m["epochstore_range_queries_total"] >= 3
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics?format=prom", timeout=10
+        ) as r:
+            prom = r.read().decode()
+        assert "ra_serve_epochstore_spilled_total 3" in prom
+        assert "ra_serve_range_query_seconds_count" in prom
+        tail = drv.lineage_tail()
+        assert tail["epoch_store"]["last_spilled_window"] == 2
+    finally:
+        drv.stop()
+    summary = finish(th, out)
+    assert summary["epoch_store"]["epochs"] == 3
+    assert summary["drops"] == 0
+
+    # replay-free across process death: a fresh store open answers the
+    # same span without any serve loop or WAL replay
+    again = epochstore.EpochStore(es_dir)
+    agg, m = again.range_agg(0, 2)
+    assert m is None
+    assert agg.summary["windows"] == 3
+    assert agg.summary["lines"] == summary["lines_total"]
+    again.close()
+
+
+# ---------------------------------------------------------------------------
+# Chaos schedules (tier-1): spill raise + compaction crash.
+# ---------------------------------------------------------------------------
+
+def test_chaos_spill_raise_degrades_and_store_stays_readable(
+    corpus, tmp_path
+):
+    packed, prefix, lines, td = corpus
+    es_dir = str(tmp_path / "estore")
+    scfg = ServeConfig(
+        listen=("tcp:127.0.0.1:0",), window_lines=WL, ring=4,
+        serve_dir=str(tmp_path / "serve"), max_windows=3, stop_after_sec=60,
+        reload_watch=False, checkpoint_every_windows=0, http="off",
+        queue_lines=10_000, epoch_store=es_dir,
+    )
+    with faults.armed(faults.FaultPlan.parse("epochstore.spill@2")):
+        drv, th, out = start_serve(prefix, AnalysisConfig(**RUN_CFG), scfg)
+        addr = tuple(drv.listeners.listeners[0].address)
+        for w in range(3):
+            send_tcp(addr, lines[w * WL:(w + 1) * WL])
+        summary = finish(th, out)
+    # publication survived the full schedule; the history plane (and
+    # ONLY it) degraded at window 1 and stayed off — dense numbering
+    assert summary["windows_published"] == 3
+    assert "epoch_store" in summary["degraded"]
+    assert summary["epoch_store"]["epochs"] == 1
+    store = epochstore.EpochStore(es_dir)
+    agg, m = store.range_agg(0, 0)
+    assert m is None and agg.summary["windows"] == 1
+    _, m = store.range_agg(0, 2)
+    assert m["range_incomplete"] and m["reason"] == "beyond_frontier"
+    store.close()
+
+
+def test_chaos_compact_crash_loses_zero_epochs(tmp_path):
+    """SIGKILL-equivalent (os._exit) after the pair is chosen, before
+    the merged node lands: reopen must see every epoch whose spill
+    started, and repair must rebuild the unwritten summary node."""
+    es_dir = str(tmp_path / "estore")
+    child = (
+        "import sys\n"
+        "sys.path.insert(0, sys.argv[2])\n"
+        "from test_epochstore import _mk_epoch\n"
+        "from ruleset_analysis_tpu.runtime import epochstore\n"
+        "store = epochstore.EpochStore(sys.argv[1])\n"
+        "store.bind_base(0)\n"
+        "for w in range(12):\n"
+        "    store.spill(_mk_epoch(w))\n"
+        "    print(w, flush=True)\n"
+    )
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu",
+        RA_FAULT_PLAN="epochstore.compact@2",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", child, es_dir, os.path.dirname(__file__)],
+        capture_output=True, text=True, timeout=300,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env=env,
+    )
+    assert proc.returncode != 0, "crash plan never fired"
+    acked = [int(x) for x in proc.stdout.split()]
+    assert acked, f"no spill acked before crash: {proc.stderr[-500:]}"
+    store = epochstore.EpochStore(es_dir)
+    st = store.stats()
+    # the victim spill's level-0 append landed before the crash point
+    assert st["epochs"] == len(acked) + 1, (st, acked)
+    agg, m = store.range_agg(0, st["epochs"] - 1)
+    ref, mn = store.naive_range_agg(0, st["epochs"] - 1)
+    assert m is None and mn is None
+    assert _agg_equal(agg, ref)
+    assert agg.summary["windows"] == st["epochs"]
+    store.close()
